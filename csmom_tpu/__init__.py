@@ -1,0 +1,31 @@
+"""csmom_tpu — TPU-native cross-sectional momentum replication & backtesting framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference framework
+``AkshayJha22/Cross-Sectional-Momentum-Strategy-Replication-Backtesting-Framework``
+(a pure-pandas, single-process pipeline; see that repo's ``run_demo.py`` and
+``src/``).  Instead of long-format DataFrames iterated row by row, this
+framework represents market data as dense **masked panels** — ``f32[A, T]``
+arrays (assets x time) resident in accelerator HBM — and expresses all
+strategy logic as pure, jit-compiled functions over those panels:
+
+- ``panel``     ingest (CSV dialect repair, calendar alignment), Panel container
+- ``ops``       masked rolling windows, scans, cross-sectional ranking kernels
+- ``signals``   momentum (J, skip), turnover, intraday minute features
+- ``ranking``   decile assignment (exact pandas-qcut parity + fast rank mode)
+- ``models``    closed-form ridge regression with expanding-window time-series CV
+- ``costs``     square-root market impact, spread, fill models
+- ``backtest``  vectorized monthly decile engine, J x K grid, event-driven engine
+- ``analytics`` sharpe, t-stats, decile tables, results schemas
+- ``parallel``  device-mesh sharding (shard_map), distributed rank, collectives
+- ``strategy``  Strategy protocol; 'tpu' (JAX) and 'pandas' backends behind one API
+- ``cli``       run / replicate / grid / sweep commands
+- ``utils``     structured logging, profiling, error guards
+
+The parameter grid (J x K lookback/holding) is a ``vmap`` axis; the asset axis
+shards across a ``jax.sharding.Mesh`` with the cross-sectional rank as the only
+global collective (all_gather) and ``psum`` for portfolio reductions.
+"""
+
+__version__ = "0.1.0"
+
+from csmom_tpu.panel.panel import Panel  # noqa: F401

@@ -1,0 +1,31 @@
+"""``csmom`` CLI entry point.
+
+The reference has no CLI at all — its driver hardcodes every parameter
+(``/root/reference/run_demo.py:193-207``).  This module grows the
+run/replicate/grid/sweep subcommands as the framework lands; for now it
+reports the package version and available subcommands.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="csmom", description=__doc__)
+    from csmom_tpu import __version__
+
+    p.add_argument("--version", action="version", version=f"csmom_tpu {__version__}")
+    p.add_subparsers(dest="command")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not getattr(args, "command", None):
+        build_parser().print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

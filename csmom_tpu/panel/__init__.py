@@ -1,0 +1,20 @@
+"""Masked dense panels: ingest, calendars, containers, synthetic generators."""
+
+from csmom_tpu.panel.panel import Panel
+from csmom_tpu.panel.ingest import (
+    read_price_csv,
+    load_daily,
+    load_intraday,
+    long_to_panel,
+)
+from csmom_tpu.panel.calendar import month_end_segments, month_end_aggregate
+
+__all__ = [
+    "Panel",
+    "read_price_csv",
+    "load_daily",
+    "load_intraday",
+    "long_to_panel",
+    "month_end_segments",
+    "month_end_aggregate",
+]

@@ -1,0 +1,84 @@
+"""Calendar utilities: month-end segmentation and aggregation.
+
+The reference aggregates daily bars to month-end with
+``groupby(['ticker', pd.Grouper(key='date', freq='ME')]).agg(last, sum)``
+(``/root/reference/src/features.py:34-39``).  The panel-world equivalent:
+assign each trading day a month segment id, then reduce each segment with
+``jax.ops.segment_*`` — one fused pass over ``[A, T_daily]``, no Python
+loops, shardable along assets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+def month_end_segments(times: np.ndarray):
+    """Host-side: map daily timestamps -> (segment_ids, month_end_times).
+
+    Returns:
+      seg_ids:  int32[T_daily], 0..M-1, nondecreasing — month index per day.
+      month_ends: datetime64[M] calendar month-end stamps (pandas 'ME' labels).
+    """
+    t = np.asarray(times, dtype="datetime64[D]")
+    if t.size and (np.diff(t.view("int64")) < 0).any():
+        raise ValueError("times must be nondecreasing (segment kernels tell XLA "
+                         "indices_are_sorted=True; unsorted ids would be UB on TPU)")
+    months = t.astype("datetime64[M]")
+    uniq, seg_ids = np.unique(months, return_inverse=True)
+    # label each month by its calendar month-end, as pandas Grouper(freq='ME')
+    month_ends = (uniq + 1).astype("datetime64[D]") - np.timedelta64(1, "D")
+    return seg_ids.astype(np.int32), month_ends.astype("datetime64[ns]")
+
+
+@partial(jax.jit, static_argnames=("num_segments",))
+def month_end_aggregate(values, mask, seg_ids, num_segments: int):
+    """Month-end 'last valid price' + 'summed volume'-style reductions.
+
+    Mirrors ``features.py:34-39``: per (asset, month), the last *valid*
+    observation of ``values`` and whether any observation existed.  Implemented
+    with segment maxima over masked day ordinals + a gather, entirely inside
+    jit (static M keeps shapes fixed for XLA).
+
+    Args:
+      values: f[A, T] daily panel (NaN at masked slots).
+      mask:   bool[A, T].
+      seg_ids: i32[T] month index per day (from ``month_end_segments``).
+      num_segments: M, static.
+
+    Returns:
+      (last_vals f[A, M], any_mask bool[A, M])
+    """
+    A, T = values.shape
+    day_idx = jnp.arange(T, dtype=jnp.int32)
+    # per (asset, month): index of last valid day, -1 if none
+    masked_idx = jnp.where(mask, day_idx[None, :], -1)
+    last_idx = jax.vmap(
+        lambda row: jax.ops.segment_max(
+            row, seg_ids, num_segments=num_segments, indices_are_sorted=True
+        )
+    )(masked_idx)
+    any_mask = last_idx >= 0
+    gather_idx = jnp.clip(last_idx, 0, T - 1)
+    last_vals = jnp.take_along_axis(values, gather_idx, axis=1)
+    last_vals = jnp.where(any_mask, last_vals, jnp.nan)
+    return last_vals, any_mask
+
+
+@partial(jax.jit, static_argnames=("num_segments",))
+def segment_sum_panel(values, mask, seg_ids, num_segments: int):
+    """Per (asset, month) sum of valid observations (volume aggregation).
+
+    The reference fills missing volume with 0 before summing
+    (``features.py:31``); masked slots contribute 0 here likewise.
+    """
+    filled = jnp.where(mask, jnp.nan_to_num(values), 0.0)
+    return jax.vmap(
+        lambda row: jax.ops.segment_sum(
+            row, seg_ids, num_segments=num_segments, indices_are_sorted=True
+        )
+    )(filled)

@@ -1,0 +1,205 @@
+"""CSV ingest: cached yfinance dialects -> canonical long frames -> panels.
+
+The reference caches one CSV per (ticker, freq) and normalizes columns
+defensively on re-read (``/root/reference/src/data_io.py:131-228``).  Its
+cache dialects (observed in ``/root/reference/data/``) are:
+
+- dialect A (most files)::
+
+      Date,Adj Close,Close,High,Low,Open,Volume
+      ,AMD,AMD,AMD,AMD,AMD,AMD          <- junk "ticker" row
+      2018-01-02,10.97,...
+
+- dialect B (newer yfinance, e.g. ``AAPL_daily.csv``)::
+
+      Price,Close,High,Low,Open,Volume
+      Ticker,AAPL,AAPL,AAPL,AAPL,AAPL
+      Date,,,,,
+      2018-01-02,40.38,...
+
+The reference's normalizer cannot find a date column in dialect B and
+silently drops the whole file (``data_io.py:55-58,163`` — the bug recorded
+in SURVEY §2.1.1).  This ingest recognizes both dialects, so the full
+universe survives a cache roundtrip; the 19-ticker behaviour needed for
+golden-parity tests is obtained simply by loading 19 tickers.
+
+Output schemas match the reference's canonical ones (``data_io.py:15-16``):
+daily ``['date','ticker','open','high','low','close','adj_close','volume']``,
+intraday ``['datetime','ticker','price','volume']``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Sequence
+
+import numpy as np
+import pandas as pd
+
+from csmom_tpu.panel.panel import Panel, PanelBundle
+from csmom_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+DAILY_SCHEMA = ["date", "ticker", "open", "high", "low", "close", "adj_close", "volume"]
+INTRADAY_SCHEMA = ["datetime", "ticker", "price", "volume"]
+
+_FIELD_ALIASES = {
+    "open": "open",
+    "high": "high",
+    "low": "low",
+    "close": "close",
+    "adj close": "adj_close",
+    "adj_close": "adj_close",
+    "volume": "volume",
+    "price": "price",
+}
+
+
+def _strip_preamble(raw: pd.DataFrame) -> pd.DataFrame:
+    """Drop the junk header rows both yfinance cache dialects carry.
+
+    A data row is one whose first cell parses as a date; preamble rows have
+    first cell empty, 'Ticker', or 'Date'.
+    """
+    first = raw.iloc[:, 0].astype(str).str.strip()
+    junk = first.isin(["", "nan", "None", "Ticker", "Date", "Datetime"])
+    # only the leading block is preamble; stop at the first real row
+    keep_from = int(np.argmax(~junk.values)) if (~junk).any() else len(raw)
+    return raw.iloc[keep_from:]
+
+
+def read_price_csv(path: str, ticker: str, kind: str = "daily") -> pd.DataFrame:
+    """Read one cached CSV (either dialect) into the canonical long schema.
+
+    Unlike the reference's ``_normalize_daily_columns`` (``data_io.py:23-73``),
+    the timestamp is always taken from the *first column* once the preamble is
+    stripped — which is what both dialects actually put there — rather than
+    from a column literally named ``Date``.
+    """
+    raw = pd.read_csv(path, low_memory=False, dtype=str)
+    cols = [str(c).strip() for c in raw.columns]
+    body = _strip_preamble(raw)
+
+    time_col = "date" if kind == "daily" else "datetime"
+    out = pd.DataFrame()
+    out[time_col] = pd.to_datetime(body.iloc[:, 0], errors="coerce", utc=(kind != "daily"))
+    if kind != "daily":
+        # store tz-naive UTC timestamps; panels index by absolute instants
+        out[time_col] = out[time_col].dt.tz_localize(None)
+
+    for pos, col in enumerate(cols):
+        canon = _FIELD_ALIASES.get(col.lower())
+        if canon and pos > 0:
+            out[canon] = pd.to_numeric(body.iloc[:, pos], errors="coerce")
+
+    if kind == "daily":
+        if "adj_close" not in out:
+            # dialect B ships no Adj Close; yfinance's Close there is already
+            # the adjusted series (reference mirrors this at data_io.py:32-33)
+            out["adj_close"] = out.get("close", np.nan)
+        return _finalize(out, DAILY_SCHEMA, "date", ticker)
+
+    if "price" not in out:
+        for fallback in ("adj_close", "close"):
+            if fallback in out:
+                out["price"] = out[fallback]
+                break
+        else:
+            out["price"] = np.nan
+    return _finalize(out, INTRADAY_SCHEMA, "datetime", ticker)
+
+
+def _finalize(out: pd.DataFrame, schema, time_col: str, ticker: str) -> pd.DataFrame:
+    for c in schema:
+        if c not in out:
+            out[c] = np.nan
+    out["ticker"] = ticker
+    out = out.dropna(subset=[time_col])
+    return out[schema].reset_index(drop=True)
+
+
+def _load_universe(
+    data_dir: str, tickers: Sequence[str], kind: str, suffix: str
+) -> pd.DataFrame:
+    """Per-ticker load with the reference's fault isolation: a bad ticker is
+    skipped with a warning, never fatal (``data_io.py:173-175``)."""
+    frames = []
+    for t in tickers:
+        path = os.path.join(data_dir, f"{t}_{suffix}.csv")
+        try:
+            if not os.path.exists(path):
+                log.warning("no cache file for %s (%s) — skipping", t, path)
+                continue
+            df = read_price_csv(path, t, kind=kind)
+            if df.empty:
+                log.warning("no valid rows for %s after normalization — skipping", t)
+                continue
+            frames.append(df)
+        except Exception as e:  # noqa: BLE001 — universe-level fault isolation
+            log.warning("failed to load %s: %r — skipping", t, e)
+    schema = DAILY_SCHEMA if kind == "daily" else INTRADAY_SCHEMA
+    if not frames:
+        return pd.DataFrame(columns=schema)
+    return pd.concat(frames, ignore_index=True)
+
+
+def load_daily(data_dir: str, tickers: Sequence[str]) -> pd.DataFrame:
+    """Load the daily universe from cached CSVs into the canonical schema."""
+    return _load_universe(data_dir, tickers, "daily", "daily")
+
+
+def load_intraday(data_dir: str, tickers: Sequence[str]) -> pd.DataFrame:
+    """Load the intraday universe from cached CSVs into the canonical schema."""
+    return _load_universe(data_dir, tickers, "intraday", "intraday")
+
+
+def long_to_panel(
+    df: pd.DataFrame,
+    value_col: str,
+    time_col: str = "date",
+    tickers: Sequence[str] | None = None,
+    times: np.ndarray | None = None,
+) -> Panel:
+    """Pivot a canonical long frame into a masked dense Panel.
+
+    The time axis is the sorted union of observed timestamps (or an explicit
+    calendar); missing (asset, time) cells become masked NaN lanes — the
+    dense-panel replacement for pandas' implicit row dropping.
+    """
+    if tickers is None:
+        tickers = sorted(df["ticker"].unique())
+    if times is None:
+        times = np.sort(df[time_col].unique())
+    wide = (
+        df.pivot_table(index="ticker", columns=time_col, values=value_col, aggfunc="last")
+        .reindex(index=list(tickers), columns=pd.Index(times))
+    )
+    return Panel.from_dense(wide.values, tickers, np.asarray(times), name=value_col)
+
+
+def to_bundle(
+    df: pd.DataFrame,
+    value_cols: Iterable[str],
+    time_col: str = "date",
+    tickers: Sequence[str] | None = None,
+) -> PanelBundle:
+    """Pivot several value columns onto one shared (tickers, times) grid."""
+    if tickers is None:
+        tickers = sorted(df["ticker"].unique())
+    times = np.sort(df[time_col].unique())
+    panels = {
+        c: long_to_panel(df, c, time_col=time_col, tickers=tickers, times=times)
+        for c in value_cols
+    }
+    return PanelBundle(panels=panels, tickers=tuple(tickers), times=np.asarray(times))
+
+
+def daily_bundle(df: pd.DataFrame, tickers: Sequence[str] | None = None) -> PanelBundle:
+    return to_bundle(
+        df, ["open", "high", "low", "close", "adj_close", "volume"], "date", tickers
+    )
+
+
+def intraday_bundle(df: pd.DataFrame, tickers: Sequence[str] | None = None) -> PanelBundle:
+    return to_bundle(df, ["price", "volume"], "datetime", tickers)

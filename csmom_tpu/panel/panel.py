@@ -1,0 +1,156 @@
+"""Panel: the core data container of the framework.
+
+Where the reference keeps long-format DataFrames with canonical columns
+(``/root/reference/src/data_io.py:15-16`` defines the daily / intraday
+schemas), this framework keeps a dense ``values[A, T]`` array plus a boolean
+``mask[A, T]`` of observation validity.  The mask is the panel-world
+equivalent of pandas' implicit row-dropping (``dropna`` at
+``/root/reference/run_demo.py:41,49,127``): instead of removing rows, lanes
+are masked and every kernel is mask-aware.
+
+Design notes (TPU-first):
+
+- Static shapes: a Panel is built once per (universe, calendar) and every
+  jitted kernel sees a fixed ``[A, T]``; no dynamic shapes reach XLA.
+- ``values`` carries NaN at masked slots by convention so that an unmasked
+  reduction poisons loudly rather than silently reading garbage.
+- Axis layout is assets-major ``[A, T]`` so the asset axis (the scaling axis:
+  thousands of names vs. hundreds of months) is the leading, shardable axis.
+- The container itself is host-side metadata + device arrays; jit-compiled
+  functions take the raw ``(values, mask)`` arrays, never the Panel object,
+  keeping tracing free of Python objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+try:  # jax is the compute backend but the container also works with numpy only
+    import jax.numpy as jnp
+
+    _HAS_JAX = True
+except Exception:  # pragma: no cover
+    jnp = None
+    _HAS_JAX = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Panel:
+    """A dense masked (assets x time) panel.
+
+    Attributes:
+      values:  float array ``[A, T]``; NaN at masked slots.
+      mask:    bool array ``[A, T]``; True where an observation exists.
+      tickers: length-A asset identifiers.
+      times:   length-T ``np.datetime64`` timestamps (host-side; never traced).
+      name:    what the values are (e.g. ``"adj_close"``, ``"volume"``).
+    """
+
+    values: np.ndarray
+    mask: np.ndarray
+    tickers: tuple
+    times: np.ndarray
+    name: str = "values"
+
+    def __post_init__(self):
+        if self.values.shape != self.mask.shape:
+            raise ValueError(
+                f"values{self.values.shape} and mask{self.mask.shape} differ"
+            )
+        if self.values.shape[0] != len(self.tickers):
+            raise ValueError(
+                f"{len(self.tickers)} tickers but A={self.values.shape[0]}"
+            )
+        if self.values.shape[1] != len(self.times):
+            raise ValueError(f"{len(self.times)} times but T={self.values.shape[1]}")
+
+    # -- shape sugar ------------------------------------------------------
+    @property
+    def n_assets(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def n_times(self) -> int:
+        return self.values.shape[1]
+
+    @property
+    def shape(self):
+        return self.values.shape
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_dense(cls, values, tickers: Sequence[str], times, name: str = "values"):
+        """Build from a dense array; mask is derived from NaN-ness."""
+        values = np.asarray(values, dtype=np.float64)
+        mask = np.isfinite(values)
+        return cls(
+            values=values,
+            mask=mask,
+            tickers=tuple(tickers),
+            times=np.asarray(times),
+            name=name,
+        )
+
+    def device(self, dtype=None):
+        """Return ``(values, mask)`` as jax arrays, optionally cast.
+
+        This is the hand-off point host -> HBM; everything downstream is jit.
+        """
+        if not _HAS_JAX:  # pragma: no cover
+            raise RuntimeError("jax unavailable")
+        v = jnp.asarray(self.values, dtype=dtype) if dtype else jnp.asarray(self.values)
+        m = jnp.asarray(self.mask)
+        return v, m
+
+    # -- host-side views --------------------------------------------------
+    def to_dataframe(self):
+        """Wide DataFrame view (tickers x times) for debugging / oracles."""
+        import pandas as pd
+
+        return pd.DataFrame(
+            np.where(self.mask, self.values, np.nan),
+            index=list(self.tickers),
+            columns=self.times,
+        )
+
+    def select_assets(self, keep: Sequence[str]) -> "Panel":
+        idx = [self.tickers.index(t) for t in keep]
+        return Panel(
+            values=self.values[idx],
+            mask=self.mask[idx],
+            tickers=tuple(keep),
+            times=self.times,
+            name=self.name,
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover
+        a, t = self.shape
+        cov = float(self.mask.mean()) if self.mask.size else 0.0
+        return f"Panel({self.name!r}, A={a}, T={t}, coverage={cov:.1%})"
+
+
+@dataclasses.dataclass(frozen=True)
+class PanelBundle:
+    """Several aligned panels over one (tickers, times) grid.
+
+    The daily bundle carries what the reference's canonical daily schema
+    carries (``data_io.py:15``): open/high/low/close/adj_close/volume; the
+    intraday bundle carries price/volume (``data_io.py:16``).
+    """
+
+    panels: dict
+    tickers: tuple
+    times: np.ndarray
+
+    def __getitem__(self, key: str) -> Panel:
+        return self.panels[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.panels
+
+    @property
+    def fields(self):
+        return tuple(self.panels)

@@ -1,0 +1,5 @@
+"""Cross-cutting utilities: logging, profiling, numerical guards."""
+
+from csmom_tpu.utils.logging import get_logger
+
+__all__ = ["get_logger"]

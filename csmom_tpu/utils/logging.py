@@ -1,0 +1,40 @@
+"""Structured logging.
+
+The reference's observability is ~20 bare ``print()`` call sites
+(e.g. ``/root/reference/run_demo.py:43,72-73``, ``data_io.py:156,171``).
+Here every module logs through a namespaced stdlib logger with one shared
+format, switchable via ``CSMOM_LOG_LEVEL``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_CONFIGURED = False
+
+
+def _configure_root() -> None:
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    level = os.environ.get("CSMOM_LOG_LEVEL", "INFO").upper()
+    if not isinstance(logging.getLevelNamesMapping().get(level), int):
+        level = "INFO"
+    handler = logging.StreamHandler()
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)-7s %(name)s: %(message)s", "%H:%M:%S")
+    )
+    root = logging.getLogger("csmom_tpu")
+    root.setLevel(level)
+    if not root.handlers:
+        root.addHandler(handler)
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure_root()
+    if not name.startswith("csmom_tpu"):
+        name = f"csmom_tpu.{name}"
+    return logging.getLogger(name)

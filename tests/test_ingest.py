@@ -1,0 +1,104 @@
+"""Ingest tests: both cache dialects, fault isolation, panel pivot."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from csmom_tpu.panel import ingest
+from tests.conftest import DEMO_TICKERS, requires_reference, REFERENCE_DATA
+
+DIALECT_A = """Date,Adj Close,Close,High,Low,Open,Volume
+,FAKE,FAKE,FAKE,FAKE,FAKE,FAKE
+2020-01-02,10.0,10.5,11.0,9.5,10.0,1000
+2020-01-03,10.2,10.7,11.2,9.7,10.1,1100
+"""
+
+DIALECT_B = """Price,Close,High,Low,Open,Volume
+Ticker,FAKE,FAKE,FAKE,FAKE,FAKE
+Date,,,,,
+2020-01-02,10.0,11.0,9.5,10.0,1000
+2020-01-03,10.2,11.2,9.7,10.1,1100
+"""
+
+INTRADAY = """Datetime,Adj Close,Close,High,Low,Open,Volume
+,FAKE,FAKE,FAKE,FAKE,FAKE,FAKE
+2025-08-18 13:30:00+00:00,100.0,100.0,100.5,99.5,100.0,500
+2025-08-18 13:31:00+00:00,100.2,100.2,100.6,99.9,100.1,400
+"""
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_dialect_a(tmp_path):
+    p = _write(tmp_path, "FAKE_daily.csv", DIALECT_A)
+    df = ingest.read_price_csv(p, "FAKE", kind="daily")
+    assert list(df.columns) == ingest.DAILY_SCHEMA
+    assert len(df) == 2
+    assert df["adj_close"].tolist() == [10.0, 10.2]
+    assert df["close"].tolist() == [10.5, 10.7]
+    assert df["date"].iloc[0] == pd.Timestamp("2020-01-02")
+
+
+def test_dialect_b_fixes_reference_bug(tmp_path):
+    """Dialect B (the AAPL header) must survive the roundtrip — the reference
+    silently loses the whole file (SURVEY §2.1.1)."""
+    p = _write(tmp_path, "FAKE_daily.csv", DIALECT_B)
+    df = ingest.read_price_csv(p, "FAKE", kind="daily")
+    assert len(df) == 2
+    # no Adj Close column in dialect B -> adj_close falls back to close
+    assert df["adj_close"].tolist() == [10.0, 10.2]
+    assert df["volume"].tolist() == [1000.0, 1100.0]
+
+
+def test_intraday_dialect(tmp_path):
+    p = _write(tmp_path, "FAKE_intraday.csv", INTRADAY)
+    df = ingest.read_price_csv(p, "FAKE", kind="intraday")
+    assert list(df.columns) == ingest.INTRADAY_SCHEMA
+    assert df["price"].tolist() == [100.0, 100.2]
+    assert df["datetime"].iloc[0] == pd.Timestamp("2025-08-18 13:30:00")
+
+
+def test_fault_isolation(tmp_path):
+    """A missing or corrupt ticker is skipped, never fatal (data_io.py:173-175)."""
+    _write(tmp_path, "GOOD_daily.csv", DIALECT_A.replace("FAKE", "GOOD"))
+    _write(tmp_path, "BAD_daily.csv", "not,a,csv\nat all")
+    df = ingest.load_daily(str(tmp_path), ["GOOD", "BAD", "MISSING"])
+    assert set(df["ticker"]) == {"GOOD"}
+
+
+def test_long_to_panel_masks_gaps(tmp_path):
+    df = pd.DataFrame(
+        {
+            "date": pd.to_datetime(["2020-01-02", "2020-01-03", "2020-01-02"]),
+            "ticker": ["A", "A", "B"],
+            "adj_close": [1.0, 2.0, 3.0],
+        }
+    )
+    panel = ingest.long_to_panel(df, "adj_close")
+    assert panel.shape == (2, 2)
+    assert panel.mask.tolist() == [[True, True], [True, False]]
+    assert np.isnan(panel.values[1, 1])
+    assert panel.values[0, 1] == 2.0
+
+
+@requires_reference
+def test_reference_daily_roundtrip_full_universe():
+    """All 20 shipped daily caches load — including AAPL's dialect B."""
+    df = ingest.load_daily(REFERENCE_DATA, DEMO_TICKERS)
+    per = df.groupby("ticker").size()
+    assert set(per.index) == set(DEMO_TICKERS)
+    # AAPL has ~1762 bars (SURVEY §2 row 16); all tickers span 2018..2024
+    assert per["AAPL"] > 1700
+    assert df["adj_close"].notna().mean() > 0.99
+
+
+@requires_reference
+def test_reference_intraday_roundtrip():
+    df = ingest.load_intraday(REFERENCE_DATA, DEMO_TICKERS)
+    assert set(df["ticker"]) == set(DEMO_TICKERS)
+    per = df.groupby("ticker").size()
+    assert (per > 2000).all()
