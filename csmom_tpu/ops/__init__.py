@@ -1,0 +1,23 @@
+"""Core jit-compiled kernels over masked panels.
+
+Everything in this package is a pure function of arrays, safe under
+``jit`` / ``vmap`` / ``shard_map``: static shapes, no data-dependent Python
+control flow, masks instead of row drops.
+"""
+
+from csmom_tpu.ops.rolling import (
+    rolling_sum,
+    rolling_mean,
+    rolling_std,
+    rolling_count,
+)
+from csmom_tpu.ops.ranking import decile_assign, decile_assign_panel
+
+__all__ = [
+    "rolling_sum",
+    "rolling_mean",
+    "rolling_std",
+    "rolling_count",
+    "decile_assign",
+    "decile_assign_panel",
+]

@@ -1,0 +1,138 @@
+"""Cross-sectional decile assignment.
+
+The reference assigns deciles per date with ``pd.qcut(s, 10, labels=False,
+duplicates='drop')`` and, when qcut raises, falls back to ordinal-rank
+flooring (``/root/reference/run_demo.py:18-29``).  This is the one
+genuinely *global* op of the whole framework: every other kernel is
+independent per asset, but ranking needs the full cross-section — which is
+why it is also the op that needs a collective once the asset axis is sharded
+(see ``csmom_tpu.parallel``).
+
+Two modes, both pure jax (static shapes, vmapped over dates):
+
+- ``"qcut"``  — bit-exact replication of pandas semantics for parity:
+  linear-interpolated quantile edges over the valid cross-section, duplicate
+  edges dropped, right-closed intervals with the lowest edge included, and
+  all-invalid labels when fewer than two distinct edges survive (what
+  ``duplicates='drop'`` really does — it never raises, so the reference's
+  rank fallback is dead code in its live path).
+- ``"rank"``  — ordinal-rank flooring (the formula of the reference's
+  fallback, and the standard choice at scale): O(A log A) sort, no quantile
+  gathers, ties broken by position exactly like ``rank(method='first')``.
+
+Labels are int32 in ``[0, n_bins)`` with ``-1`` for masked lanes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_BIG = jnp.inf
+
+
+def _ordinal_ranks(x, valid):
+    """1-based ordinal ranks among valid lanes (ties by position),
+    matching ``Series.rank(method='first')``."""
+    A = x.shape[0]
+    key = jnp.where(valid, x, _BIG)
+    order = jnp.argsort(key, stable=True)  # invalid lanes sort last
+    ranks = jnp.zeros(A, dtype=jnp.int32).at[order].set(
+        jnp.arange(1, A + 1, dtype=jnp.int32)
+    )
+    return ranks
+
+
+def _rank_labels(x, valid, n_bins: int):
+    """The reference's fallback binning: ``floor(pct_rank * n)`` capped at
+    ``n-1`` (``run_demo.py:26-29``)."""
+    n_valid = jnp.sum(valid)
+    ranks = _ordinal_ranks(x, valid)
+    pct = ranks.astype(x.dtype) / jnp.maximum(n_valid, 1)
+    labels = jnp.floor(pct * n_bins).astype(jnp.int32)
+    labels = jnp.where(labels == n_bins, n_bins - 1, labels)
+    return jnp.where(valid, labels, -1)
+
+
+def _qcut_edges(x, valid, n_bins: int):
+    """Linear-interpolated quantile edges over the valid lanes.
+
+    Equivalent to ``np.quantile(v, linspace(0, 1, n_bins+1))`` on the
+    compacted valid vector, computed at static shape by sorting invalid
+    lanes to the back.
+    """
+    A = x.shape[0]
+    v_sorted = jnp.sort(jnp.where(valid, x, _BIG))
+    n = jnp.sum(valid)
+    q = jnp.linspace(0.0, 1.0, n_bins + 1).astype(x.dtype)
+    pos = q * jnp.maximum(n - 1, 0).astype(x.dtype)
+    lo = jnp.floor(pos).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, jnp.maximum(n - 1, 0)).astype(jnp.int32)
+    frac = pos - lo.astype(x.dtype)
+    lo = jnp.clip(lo, 0, A - 1)
+    hi = jnp.clip(hi, 0, A - 1)
+    return v_sorted[lo] * (1 - frac) + v_sorted[hi] * frac
+
+
+def _qcut_labels(x, valid, n_bins: int):
+    edges = _qcut_edges(x, valid, n_bins)  # [n_bins+1]
+    # duplicates='drop': keep first occurrence of each distinct edge
+    keep = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), edges[1:] != edges[:-1]]
+    )
+    n_edges = jnp.sum(keep)
+
+    # searchsorted(side='left') over *kept* edges == count of kept edges < x;
+    # intervals are right-closed with the lowest edge included, so a value
+    # equal to an interior edge lands in the lower bin and x == min lands in 0.
+    xe = x[:, None]
+    idx = jnp.sum(keep[None, :] & (edges[None, :] < xe), axis=1).astype(jnp.int32)
+    labels = jnp.maximum(idx - 1, 0)
+
+    # degenerate cross-section (all values identical, or a single value):
+    # fewer than 2 distinct edges -> pandas emits all-NaN labels, it does NOT
+    # raise, so the reference's rank fallback (run_demo.py:25-29) never runs
+    # with duplicates='drop' (verified empirically; it only fires for
+    # duplicates='raise').  We mirror the real behaviour: every lane invalid.
+    qcut_ok = n_edges >= 2
+    labels = jnp.where(qcut_ok, labels, -1)
+    n_bins_eff = jnp.where(qcut_ok, n_edges - 1, 0)
+    return jnp.where(valid, labels, -1), n_bins_eff.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("n_bins", "mode"))
+def decile_assign(x, valid, n_bins: int = 10, mode: str = "qcut"):
+    """Assign cross-sectional bins for one date.
+
+    Args:
+      x: f[A] signal values (NaN allowed at masked lanes).
+      valid: bool[A].
+      n_bins: number of quantile bins (10 = deciles).
+      mode: "qcut" (pandas parity) or "rank" (fast ordinal binning).
+
+    Returns:
+      (labels i32[A] with -1 at masked lanes, n_bins_effective i32 scalar)
+    """
+    if mode == "qcut":
+        return _qcut_labels(x, valid, n_bins)
+    if mode == "rank":
+        labels = _rank_labels(x, valid, n_bins)
+        n_eff = jnp.minimum(jnp.sum(valid), n_bins).astype(jnp.int32)
+        return labels, n_eff
+    raise ValueError(f"unknown mode {mode!r}")
+
+
+@partial(jax.jit, static_argnames=("n_bins", "mode"))
+def decile_assign_panel(x, valid, n_bins: int = 10, mode: str = "qcut"):
+    """Vectorize ``decile_assign`` over the time axis of an ``[A, T]`` panel.
+
+    Returns ``(labels i32[A, T], n_bins_effective i32[T])``.
+    """
+    labels_t, n_eff = jax.vmap(
+        lambda xv, mv: decile_assign(xv, mv, n_bins=n_bins, mode=mode),
+        in_axes=1,
+        out_axes=(1, 0),
+    )(x, valid)
+    return labels_t, n_eff

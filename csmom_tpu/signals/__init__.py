@@ -1,0 +1,5 @@
+"""Signal engineering over masked panels: momentum, turnover, intraday."""
+
+from csmom_tpu.signals.momentum import monthly_returns, momentum
+
+__all__ = ["monthly_returns", "momentum"]

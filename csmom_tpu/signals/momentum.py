@@ -1,0 +1,91 @@
+"""Momentum signals on monthly panels.
+
+Reference semantics (``/root/reference/src/features.py:5-57``): from month-end
+prices, ``ret_1m = pct_change`` per asset, then ``mom_J`` = shift by
+``skip`` months followed by a rolling-J compounded product
+``prod(1+r) - 1`` evaluated with a Python lambda per window — the hottest
+signal loop in the reference (SURVEY §3.2).
+
+Panel form: the window product telescopes, so the compounded (J, skip)
+momentum is a single gather-and-divide::
+
+    mom[a, t] = price[a, t-skip] / price[a, t-skip-J] - 1
+
+valid iff every monthly return inside the window exists.  That validity rule
+reproduces the reference's NaN semantics exactly on per-asset contiguous
+histories: pandas' ``min_periods=1`` never actually emits an early value
+because the leading ``pct_change`` NaN poisons every truncated window
+(measured in SURVEY §2.1.2: first valid ``mom_J`` lands at month
+J+skip+1), and an interior missing month poisons the windows covering it
+just like NaN propagates through ``np.prod``.
+
+No Python per-window work, no scan: O(A*T) elementwise ops + one prefix
+sum for the validity count — embarrassingly parallel along assets, which is
+what lets the asset axis shard cleanly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def monthly_returns(prices, mask):
+    """1-month simple returns per asset (``features.py:44``).
+
+    Args:
+      prices: f[A, M] month-end price panel (NaN at masked slots).
+      mask:   bool[A, M].
+
+    Returns:
+      (ret f[A, M], ret_valid bool[A, M]) — slot t holds
+      ``prices[t]/prices[t-1] - 1``; the first month of each asset is invalid.
+    """
+    prev = jnp.roll(prices, 1, axis=1)
+    prev_mask = jnp.roll(mask, 1, axis=1).at[:, 0].set(False)
+    valid = mask & prev_mask & (prev != 0.0)
+    ret = jnp.where(valid, prices / jnp.where(valid, prev, 1.0) - 1.0, jnp.nan)
+    return ret, valid
+
+
+@partial(jax.jit, static_argnames=("lookback", "skip"))
+def momentum(prices, mask, lookback: int = 12, skip: int = 1):
+    """Compounded (J, skip) momentum via the telescoped price ratio.
+
+    Args:
+      prices: f[A, M] month-end prices.
+      mask: bool[A, M].
+      lookback: J, number of months compounded.
+      skip: months skipped between the window end and formation date
+        (the Jegadeesh–Titman reversal-avoidance month).
+
+    Returns:
+      (mom f[A, M], mom_valid bool[A, M]) — ``mom[:, t]`` is the signal used
+      to form the portfolio held over month t+1.
+    """
+    _, ret_valid = monthly_returns(prices, mask)
+    A, M = prices.shape
+    t = jnp.arange(M)
+
+    # window of monthly returns entering the product: [t-skip-J+1, t-skip]
+    hi = t - skip
+    lo = t - skip - lookback
+    in_range = lo >= 0
+
+    # all J returns in the window must exist (NaN poisoning parity)
+    bad = (~ret_valid).astype(jnp.int32)
+    badc = jnp.concatenate(
+        [jnp.zeros((A, 1), jnp.int32), jnp.cumsum(bad, axis=1)], axis=1
+    )
+    hi_c = jnp.clip(hi, 0, M - 1)
+    lo_c = jnp.clip(lo + 1, 0, M - 1)
+    window_bad = badc[:, hi_c + 1] - badc[:, lo_c]
+
+    p_hi = prices[:, hi_c]
+    p_lo = prices[:, jnp.clip(lo, 0, M - 1)]
+    valid = in_range[None, :] & (window_bad == 0) & (p_lo != 0.0)
+    mom = jnp.where(valid, p_hi / jnp.where(valid, p_lo, 1.0) - 1.0, jnp.nan)
+    return mom, valid

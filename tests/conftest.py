@@ -10,13 +10,16 @@ TPU paths run f32/bf16 (kernels are dtype-polymorphic).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# NOTE: this image pins JAX_PLATFORMS=axon in the environment and a
+# sitecustomize imports jax at interpreter start, so env vars are captured
+# before conftest runs; jax.config.update is the only override that works.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

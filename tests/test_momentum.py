@@ -1,0 +1,84 @@
+"""Momentum kernel vs a pandas oracle implementing the reference formulas.
+
+The oracle re-derives features.py:44-52 semantics (pct_change -> shift(skip)
+-> rolling(J, min_periods=1).apply(prod(1+r)-1)) on wide frames.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from csmom_tpu.signals import monthly_returns, momentum
+
+
+def oracle_momentum(prices: pd.DataFrame, J: int, skip: int) -> pd.DataFrame:
+    """prices: wide (months x assets). Returns wide mom_J frame."""
+    ret = prices.pct_change()
+    shifted = ret.shift(skip)
+    return shifted.rolling(J, min_periods=1).apply(
+        lambda r: np.prod(1 + r) - 1, raw=True
+    )
+
+
+def _panelize(wide: pd.DataFrame):
+    vals = wide.values.T.astype(np.float64)  # [A, M]
+    return vals, np.isfinite(vals)
+
+
+@pytest.mark.parametrize("J,skip", [(12, 1), (6, 1), (3, 0), (9, 2)])
+def test_momentum_matches_pandas(rng, J, skip):
+    M, A = 60, 8
+    prices = pd.DataFrame(
+        100 * np.exp(np.cumsum(rng.normal(0, 0.05, size=(M, A)), axis=0))
+    )
+    vals, mask = _panelize(prices)
+    got, got_valid = momentum(vals, mask, lookback=J, skip=skip)
+    want = oracle_momentum(prices, J, skip).values.T
+    got = np.asarray(got)
+    # same NaN pattern
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12, equal_nan=True)
+
+
+def test_warmup_is_J_plus_skip_plus_1(rng):
+    """SURVEY §2.1.2: first valid mom_J at month index J+skip (0-based),
+    i.e. the (J+skip+1)-th month."""
+    M = 40
+    prices = pd.DataFrame(100 + np.cumsum(rng.normal(size=(M, 1)), axis=0))
+    vals, mask = _panelize(prices)
+    _, valid = momentum(vals, mask, lookback=12, skip=1)
+    first_valid = int(np.argmax(np.asarray(valid[0])))
+    assert first_valid == 13  # 14th month
+
+
+def test_interior_gap_poisons_windows(rng):
+    """A missing month must poison exactly the windows that cover it,
+    mirroring NaN propagation through np.prod."""
+    M = 50
+    prices = pd.DataFrame(100 * np.exp(np.cumsum(rng.normal(0, 0.03, size=(M, 1)), axis=0)))
+    prices.iloc[25] = np.nan
+    vals, mask = _panelize(prices)
+    got, _ = momentum(vals, mask, lookback=6, skip=1)
+    want = oracle_momentum(prices, 6, 1).values.T
+    np.testing.assert_array_equal(np.isnan(np.asarray(got)), np.isnan(want))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-9, equal_nan=True)
+
+
+def test_late_starting_asset(rng):
+    """An asset entering the panel mid-history warms up J+skip+1 months after
+    its own first observation (pandas compacts per ticker; masks must agree)."""
+    M = 40
+    prices = pd.DataFrame(100 * np.exp(np.cumsum(rng.normal(0, 0.03, size=(M, 2)), axis=0)))
+    prices.iloc[:10, 1] = np.nan
+    vals, mask = _panelize(prices)
+    _, valid = momentum(vals, mask, lookback=6, skip=1)
+    assert int(np.argmax(np.asarray(valid[1]))) == 10 + 7
+
+
+def test_monthly_returns(rng):
+    M, A = 30, 5
+    prices = pd.DataFrame(100 * np.exp(np.cumsum(rng.normal(0, 0.04, size=(M, A)), axis=0)))
+    vals, mask = _panelize(prices)
+    got, _ = monthly_returns(vals, mask)
+    want = prices.pct_change().values.T
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-9, equal_nan=True)
