@@ -1,0 +1,5 @@
+"""Performance analytics: Sharpe, t-stats, decile tables, result schemas."""
+
+from csmom_tpu.analytics.stats import sharpe, masked_mean, masked_std, t_stat
+
+__all__ = ["sharpe", "masked_mean", "masked_std", "t_stat"]

@@ -1,0 +1,59 @@
+"""High-level convenience pipeline: CSV caches -> panels -> backtests.
+
+This is the glue the reference keeps inline in ``run_demo.py``; kept thin so
+each stage stays independently usable and testable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from csmom_tpu.panel import ingest
+from csmom_tpu.panel.calendar import (
+    month_end_segments,
+    month_end_aggregate,
+    segment_sum_panel,
+)
+from csmom_tpu.panel.panel import Panel
+
+
+def monthly_price_panel(data_dir: str, tickers, field: str = "adj_close"):
+    """Daily CSV caches -> month-end price & volume panels.
+
+    Returns ``(prices Panel[A, M], volume Panel[A, M])`` with month-end
+    timestamps, mirroring ``compute_monthly_momentum_from_daily``'s
+    aggregation (``features.py:34-39``).
+    """
+    df = ingest.load_daily(data_dir, tickers)
+    price_daily = ingest.long_to_panel(df, field, time_col="date")
+    vol_daily = ingest.long_to_panel(
+        df, "volume", time_col="date",
+        tickers=price_daily.tickers, times=price_daily.times,
+    )
+    seg, month_ends = month_end_segments(price_daily.times)
+    m = len(month_ends)
+
+    pv, pm = price_daily.device()
+    prices_m, mask_m = month_end_aggregate(pv, pm, seg, m)
+    vv, vm = vol_daily.device()
+    vol_m = segment_sum_panel(vv, vm, seg, m)
+    # a month is a valid volume observation iff >=1 daily bar existed; a
+    # phantom 0 with mask=True would rank pre-listing months into the bottom
+    # volume decile of a turnover sort
+    vol_obs = np.asarray(segment_sum_panel(vm.astype(vv.dtype), vm, seg, m)) > 0
+
+    prices = Panel(
+        values=np.asarray(prices_m),
+        mask=np.asarray(mask_m),
+        tickers=price_daily.tickers,
+        times=month_ends,
+        name=f"month_end_{field}",
+    )
+    volume = Panel(
+        values=np.asarray(vol_m),
+        mask=vol_obs,
+        tickers=price_daily.tickers,
+        times=month_ends,
+        name="monthly_volume",
+    )
+    return prices, volume

@@ -1,0 +1,5 @@
+"""Backtest engines: vectorized monthly decile engine, J x K grid, event engine."""
+
+from csmom_tpu.backtest.monthly import monthly_spread_backtest, MonthlyResult
+
+__all__ = ["monthly_spread_backtest", "MonthlyResult"]

@@ -1,0 +1,114 @@
+"""Vectorized monthly cross-sectional decile backtest.
+
+Replaces the reference's ``monthly_replication`` driver
+(``/root/reference/run_demo.py:31-79``): momentum signal -> per-date decile
+sort -> equal-weighted decile means of next-month returns -> top-minus-bottom
+spread -> Sharpe.  The reference's groupby/unstack pipeline becomes a handful
+of masked one-hot matmuls over the ``[A, M]`` panel — the whole backtest is
+one jit-compiled call with no Python in the loop, which is what makes the
+J x K grid a trivial ``vmap`` and the asset axis shardable.
+
+Semantics parity notes (each verified by the golden test against the
+BASELINE measured numbers):
+
+- Deciles are assigned over all mom-valid assets at each date *including*
+  assets whose next-month return is missing; those assets drop out only from
+  the decile means (reference order: decile transform at ``run_demo.py:46``
+  precedes ``dropna(['next_ret','decile'])`` at ``:49``).
+- ``next_ret[a, t] = ret[a, t+1]`` with both months valid — identical to the
+  reference's post-filter ``pct_change().shift(-1)`` on contiguous
+  histories (SURVEY §2.1.5 documents the gappy-history caveat).
+- The spread is ``decile_mean[9] - decile_mean[0]``; a date where either
+  extreme decile is empty (qcut collapsed bins) yields an invalid spread,
+  mirroring NaN rows dropped at ``run_demo.py:67``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from csmom_tpu.ops.ranking import decile_assign_panel
+from csmom_tpu.signals.momentum import momentum, monthly_returns
+from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MonthlyResult:
+    """Outputs of one monthly decile backtest (all arrays time-indexed)."""
+
+    spread: jnp.ndarray        # f[M] top-minus-bottom next-month return
+    spread_valid: jnp.ndarray  # bool[M]
+    decile_means: jnp.ndarray  # f[n_bins, M] equal-weighted decile returns
+    decile_counts: jnp.ndarray # i32[n_bins, M]
+    labels: jnp.ndarray        # i32[A, M] decile id at formation, -1 invalid
+    mean_spread: jnp.ndarray   # scalar
+    ann_sharpe: jnp.ndarray    # scalar
+    tstat: jnp.ndarray         # scalar
+
+
+def decile_portfolio_returns(next_ret, next_valid, labels, n_bins: int):
+    """Equal-weighted mean next-period return per (decile, date).
+
+    One-hot membership matmul instead of groupby: ``member[b, a, t]`` is a
+    0/1 mask; sums reduce over assets.  Returns ``(means f[B, M],
+    counts i32[B, M])``.
+    """
+    bins = jnp.arange(n_bins, dtype=labels.dtype)
+    member = (labels[None, :, :] == bins[:, None, None]) & next_valid[None, :, :]
+    r = jnp.where(next_valid, jnp.nan_to_num(next_ret), 0.0)
+    sums = jnp.sum(member * r[None, :, :], axis=1)
+    counts = jnp.sum(member, axis=1)
+    means = jnp.where(counts > 0, sums / jnp.maximum(counts, 1), jnp.nan)
+    return means, counts.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("lookback", "skip", "n_bins", "mode", "freq"))
+def monthly_spread_backtest(
+    prices,
+    mask,
+    lookback: int = 12,
+    skip: int = 1,
+    n_bins: int = 10,
+    mode: str = "qcut",
+    freq: int = 12,
+) -> MonthlyResult:
+    """Full monthly momentum replication on a month-end price panel.
+
+    Args:
+      prices: f[A, M] month-end (adjusted) prices, NaN at masked slots.
+      mask: bool[A, M] observation mask.
+      lookback: J months compounded into the formation signal.
+      skip: skip months between window end and formation.
+      n_bins: cross-sectional quantile bins (10 = deciles).
+      mode: 'qcut' for pandas parity, 'rank' for the fast path at scale.
+      freq: periods per year for annualization (12 for monthly).
+    """
+    ret, ret_valid = monthly_returns(prices, mask)
+    mom, mom_valid = momentum(prices, mask, lookback=lookback, skip=skip)
+    labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
+
+    # next-month return aligned to the formation date
+    next_ret = jnp.roll(ret, -1, axis=1)
+    next_valid = jnp.roll(ret_valid, -1, axis=1).at[:, -1].set(False)
+    next_valid = next_valid & mom_valid
+
+    means, counts = decile_portfolio_returns(next_ret, next_valid, labels, n_bins)
+    spread = means[n_bins - 1] - means[0]
+    spread_valid = (counts[n_bins - 1] > 0) & (counts[0] > 0)
+    spread = jnp.where(spread_valid, spread, jnp.nan)
+
+    return MonthlyResult(
+        spread=spread,
+        spread_valid=spread_valid,
+        decile_means=means,
+        decile_counts=counts,
+        labels=labels,
+        mean_spread=masked_mean(spread, spread_valid),
+        ann_sharpe=sharpe(spread, spread_valid, freq_per_year=freq),
+        tstat=t_stat(spread, spread_valid),
+    )

@@ -63,17 +63,30 @@ def _qcut_edges(x, valid, n_bins: int):
     compacted valid vector, computed at static shape by sorting invalid
     lanes to the back.
     """
+    import numpy as np
+
     A = x.shape[0]
     v_sorted = jnp.sort(jnp.where(valid, x, _BIG))
     n = jnp.sum(valid)
-    q = jnp.linspace(0.0, 1.0, n_bins + 1).astype(x.dtype)
+    # pandas.qcut nudges each probability up one ulp when n_bins*p is not
+    # exactly the integer it "should" be (tile.py: np.putmask(quantiles,
+    # q*quantiles != arange, nextafter)); bit-exact edges need the same nudge.
+    # Static given n_bins, so computed host-side at trace time.
+    q = np.linspace(0.0, 1.0, n_bins + 1)
+    q = np.where(n_bins * q != np.arange(n_bins + 1), np.nextafter(q, 1), q)
+    q = jnp.asarray(q, dtype=x.dtype)
     pos = q * jnp.maximum(n - 1, 0).astype(x.dtype)
     lo = jnp.floor(pos).astype(jnp.int32)
     hi = jnp.minimum(lo + 1, jnp.maximum(n - 1, 0)).astype(jnp.int32)
     frac = pos - lo.astype(x.dtype)
     lo = jnp.clip(lo, 0, A - 1)
     hi = jnp.clip(hi, 0, A - 1)
-    return v_sorted[lo] * (1 - frac) + v_sorted[hi] * frac
+    a, b = v_sorted[lo], v_sorted[hi]
+    # numpy's _lerp, bit-for-bit: switches formulation at t=0.5 so that
+    # identical endpoints interpolate to exactly that value (anything else
+    # splits "duplicate" edges by 1 ulp and silently changes the bin count)
+    d = b - a
+    return jnp.where(frac < 0.5, a + d * frac, b - d * (1 - frac))
 
 
 def _qcut_labels(x, valid, n_bins: int):
@@ -96,7 +109,9 @@ def _qcut_labels(x, valid, n_bins: int):
     # raise, so the reference's rank fallback (run_demo.py:25-29) never runs
     # with duplicates='drop' (verified empirically; it only fires for
     # duplicates='raise').  We mirror the real behaviour: every lane invalid.
-    qcut_ok = n_edges >= 2
+    # (n>0 guard: with zero valid lanes every edge is NaN and NaN != NaN would
+    # let all 11 "distinct" edges through, reporting phantom live bins)
+    qcut_ok = (n_edges >= 2) & (jnp.sum(valid) > 0)
     labels = jnp.where(qcut_ok, labels, -1)
     n_bins_eff = jnp.where(qcut_ok, n_edges - 1, 0)
     return jnp.where(valid, labels, -1), n_bins_eff.astype(jnp.int32)
