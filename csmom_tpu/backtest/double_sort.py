@@ -1,0 +1,101 @@
+"""Momentum x volume double sort (Lee–Swaminathan 2000, Table II).
+
+The replicated paper's headline result beyond plain momentum: sort stocks
+independently into J-month momentum deciles (R1..R10) and average-turnover
+terciles (V1..V3); the R10-R1 spread is markedly larger among high-turnover
+stocks (1.46 %/mo in V3 vs 0.54 %/mo in V1 for J=K=6 — BASELINE.md).  The
+reference computes the turnover inputs but never performs this sort
+(SURVEY §2 row 6); this module completes the capability.
+
+Construction: independent two-way sort at each formation date; intersection
+cells (momentum extreme x volume tercile) are equal-weighted over the next
+month.  One jit call produces spreads for every volume tercile at once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from csmom_tpu.ops.ranking import decile_assign_panel
+from csmom_tpu.signals.momentum import momentum_dynamic, monthly_returns
+from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DoubleSortResult:
+    spreads: jnp.ndarray       # f[V, M] R-top minus R-bottom within tercile v
+    spread_valid: jnp.ndarray  # bool[V, M]
+    mean_spread: jnp.ndarray   # f[V]
+    ann_sharpe: jnp.ndarray    # f[V]
+    tstat: jnp.ndarray         # f[V]
+    cell_counts: jnp.ndarray   # i32[V, 2, M] members in (bottom, top) cells
+
+
+@partial(jax.jit, static_argnames=("n_bins", "n_vol_bins", "mode", "freq"))
+def volume_double_sort(
+    prices,
+    mask,
+    turnover,
+    turnover_valid,
+    lookback=6,
+    skip: int = 1,
+    n_bins: int = 10,
+    n_vol_bins: int = 3,
+    mode: str = "qcut",
+    freq: int = 12,
+) -> DoubleSortResult:
+    """Momentum spread within each volume tercile.
+
+    Args:
+      prices: f[A, M] month-end prices.
+      mask: bool[A, M].
+      turnover: f[A, M] volume signal (e.g. ``turn_avg``).
+      turnover_valid: bool[A, M].
+      lookback: J (traced ok).
+      n_vol_bins: volume groups (3 = LeSw terciles).
+    """
+    ret, ret_valid = monthly_returns(prices, mask)
+    mom, mom_valid = momentum_dynamic(prices, mask, lookback, skip)
+    mom_labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
+    # independent sort: volume terciles over assets with BOTH signals live,
+    # so the two sorts cover the same universe at each date
+    both = mom_valid & turnover_valid
+    vol_labels, _ = decile_assign_panel(
+        jnp.where(both, turnover, jnp.nan), both, n_bins=n_vol_bins, mode=mode
+    )
+
+    next_ret = jnp.roll(ret, -1, axis=1)
+    next_valid = jnp.roll(ret_valid, -1, axis=1).at[:, -1].set(False)
+    live = next_valid & (mom_labels >= 0) & (vol_labels >= 0)
+
+    rf = jnp.where(live, jnp.nan_to_num(next_ret), 0.0)
+
+    def per_tercile(v):
+        in_v = live & (vol_labels == v)
+
+        def cell(mom_bin):
+            mem = in_v & (mom_labels == mom_bin)
+            cnt = jnp.sum(mem, axis=0)
+            s = jnp.sum(jnp.where(mem, rf, 0.0), axis=0)
+            return s / jnp.maximum(cnt, 1), cnt
+
+        top_r, top_n = cell(n_bins - 1)
+        bot_r, bot_n = cell(0)
+        valid = (top_n > 0) & (bot_n > 0)
+        spread = jnp.where(valid, top_r - bot_r, jnp.nan)
+        return spread, valid, jnp.stack([bot_n, top_n]).astype(jnp.int32)
+
+    spreads, valids, counts = jax.vmap(per_tercile)(jnp.arange(n_vol_bins))
+    return DoubleSortResult(
+        spreads=spreads,
+        spread_valid=valids,
+        mean_spread=masked_mean(spreads, valids),
+        ann_sharpe=sharpe(spreads, valids, freq_per_year=freq),
+        tstat=t_stat(spreads, valids),
+        cell_counts=counts,
+    )

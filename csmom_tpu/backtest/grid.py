@@ -1,0 +1,178 @@
+"""Jegadeesh–Titman J x K strategy grid as a single compiled call.
+
+The reference computes one (J=12, K=1) cell (``run_demo.py:31-79``); the
+paper it replicates (Lee–Swaminathan 2000, following Jegadeesh–Titman 1993)
+reports a full grid of formation periods J and *overlapping* K-month holding
+periods: the portfolio held in month m averages the K cohorts formed at
+months m-1 .. m-K, each equal-weighted within its top/bottom decile
+(the "1/K overlapping portfolios" construction of JT §I).
+
+TPU-first design: nothing here is a loop over grid cells.
+
+- formation signals for all J values: one ``vmap`` over a traced J vector
+  (``momentum_dynamic`` — index arithmetic only, so J need not be static);
+- decile labels for all J: ``vmap`` of the ranking kernel;
+- cohort forward returns ``R[j, s, h]`` (cohort formed at s under J_j,
+  its spread h months later): a static unroll over h = 1..Kmax of
+  masked membership means — O(nJ * A * M * Kmax) fused elementwise work;
+- the K axis: a cumulative mean over h, gathered at each K — so every
+  (J, K) cell shares the same cohort tensor.
+
+One jit call returns the full [nJ, nK] grid of spread series and summary
+stats.  The asset axis stays the leading axis end-to-end, so the same code
+shards over devices with the ranking collective as the only global op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from csmom_tpu.ops.ranking import decile_assign_panel
+from csmom_tpu.signals.momentum import momentum_dynamic, monthly_returns
+from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GridResult:
+    """Full J x K grid outputs; axes [nJ, nK, ...] / time axis = holding month."""
+
+    spreads: jnp.ndarray       # f[nJ, nK, M] portfolio spread return in month m
+    spread_valid: jnp.ndarray  # bool[nJ, nK, M] (all K cohorts live)
+    mean_spread: jnp.ndarray   # f[nJ, nK]
+    ann_sharpe: jnp.ndarray    # f[nJ, nK]
+    tstat: jnp.ndarray         # f[nJ, nK]
+
+
+def _cohort_spreads(labels, ret, ret_valid, n_bins: int, max_hold: int):
+    """Forward spread of each formation cohort at horizons 1..max_hold.
+
+    Args:
+      labels: i32[A, M] decile ids at formation date s (-1 invalid).
+      ret:    f[A, M] month returns (month t = return over month t).
+      ret_valid: bool[A, M].
+
+    Returns:
+      (R f[M, H], R_valid bool[M, H]) where R[s, h-1] is the equal-weighted
+      top-minus-bottom return of the cohort formed at s, h months after
+      formation; valid iff both extreme deciles have >=1 member with a live
+      return that month.
+    """
+    A, M = ret.shape
+    top = labels == (n_bins - 1)
+    bot = labels == 0
+    rf = jnp.where(ret_valid, jnp.nan_to_num(ret), 0.0)
+
+    def at_horizon(h):
+        # member return h months after formation: ret[:, s+h]
+        r_h = jnp.roll(rf, -h, axis=1)
+        v_h = jnp.roll(ret_valid, -h, axis=1)
+        # months rolled past the end are dead
+        alive = jnp.arange(M) < (M - h)
+        v_h = v_h & alive[None, :]
+        def side(m):
+            mem = m & v_h
+            cnt = jnp.sum(mem, axis=0)
+            s = jnp.sum(jnp.where(mem, r_h, 0.0), axis=0)
+            return s / jnp.maximum(cnt, 1), cnt > 0
+        top_r, top_ok = side(top)
+        bot_r, bot_ok = side(bot)
+        return top_r - bot_r, top_ok & bot_ok
+
+    cols = [at_horizon(h) for h in range(1, max_hold + 1)]
+    R = jnp.stack([c[0] for c in cols], axis=1)
+    R_valid = jnp.stack([c[1] for c in cols], axis=1)
+    return R, R_valid
+
+
+def jk_grid_backtest(
+    prices,
+    mask,
+    Js,
+    Ks,
+    skip: int = 1,
+    n_bins: int = 10,
+    mode: str = "qcut",
+    max_hold: int | None = None,
+    freq: int = 12,
+) -> GridResult:
+    """Run the full J x K momentum grid in one compiled call.
+
+    Args:
+      prices: f[A, M] month-end price panel.
+      mask: bool[A, M].
+      Js: i32[nJ] formation lookbacks (traced — any values).
+      Ks: i32[nK] holding periods; max(Ks) must be <= max_hold.
+      skip: months skipped between formation window and holding (static-free).
+      n_bins: quantile bins.
+      mode: ranking mode ('qcut' parity / 'rank' fast).
+      max_hold: static horizon bound (defaults to max(Ks) when Ks is concrete).
+    """
+    import numpy as np
+
+    if isinstance(Ks, jax.core.Tracer) and max_hold is None:
+        raise ValueError(
+            "jk_grid_backtest called with traced Ks and no max_hold: the "
+            "static cohort-horizon bound cannot be inferred from a tracer, "
+            "and a too-small default would silently invalidate K > max_hold "
+            "columns — pass max_hold explicitly (>= max(Ks))"
+        )
+    if max_hold is None:
+        max_hold = int(np.max(Ks))
+    if not isinstance(Ks, jax.core.Tracer) and int(np.max(Ks)) > max_hold:
+        raise ValueError(
+            f"max(Ks)={int(np.max(Ks))} exceeds max_hold={max_hold}; raise "
+            "max_hold (the static cohort-horizon bound) to cover every K"
+        )
+    return _jk_grid_backtest(
+        prices, mask, Js, Ks, skip=skip, n_bins=n_bins, mode=mode,
+        max_hold=max_hold, freq=freq,
+    )
+
+
+@partial(jax.jit, static_argnames=("n_bins", "mode", "max_hold", "freq"))
+def _jk_grid_backtest(
+    prices, mask, Js, Ks, skip, n_bins, mode, max_hold, freq
+) -> GridResult:
+    Js = jnp.asarray(Js)
+    Ks = jnp.asarray(Ks)
+    ret, ret_valid = monthly_returns(prices, mask)
+
+    def per_J(J):
+        mom, mom_valid = momentum_dynamic(prices, mask, J, skip)
+        labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
+        return _cohort_spreads(labels, ret, ret_valid, n_bins, max_hold)
+
+    R, R_valid = jax.vmap(per_J)(Js)  # [nJ, M, H], [nJ, M, H]
+
+    # re-index by holding month: D[j, m, h] = R[j, m-(h+1), h]
+    nJ, M, H = R.shape
+    src = jnp.arange(M)[:, None] - (jnp.arange(H)[None, :] + 1)
+    in_range = src >= 0
+    src_c = jnp.clip(src, 0, M - 1)
+    D = R[:, src_c, jnp.arange(H)[None, :]]
+    D_valid = R_valid[:, src_c, jnp.arange(H)[None, :]] & in_range[None, :, :]
+
+    # prefix sums over the horizon axis -> any K is a gather
+    Dz = jnp.where(D_valid, D, 0.0)
+    csum = jnp.cumsum(Dz, axis=2)
+    cvalid = jnp.cumsum(D_valid.astype(jnp.int32), axis=2)
+
+    k_idx = jnp.clip(Ks - 1, 0, H - 1)
+    spreads = csum[:, :, k_idx] / jnp.maximum(Ks[None, None, :], 1)
+    all_live = cvalid[:, :, k_idx] == Ks[None, None, :]
+    spreads = jnp.transpose(spreads, (0, 2, 1))      # [nJ, nK, M]
+    spread_valid = jnp.transpose(all_live, (0, 2, 1))
+    spreads = jnp.where(spread_valid, spreads, jnp.nan)
+
+    return GridResult(
+        spreads=spreads,
+        spread_valid=spread_valid,
+        mean_spread=masked_mean(spreads, spread_valid),
+        ann_sharpe=sharpe(spreads, spread_valid, freq_per_year=freq),
+        tstat=t_stat(spreads, spread_valid),
+    )

@@ -1,0 +1,10 @@
+"""Execution cost models: market impact, spread, fills."""
+
+from csmom_tpu.costs.impact import (
+    square_root_impact,
+    market_fill,
+    limit_fill,
+    spread_cost,
+)
+
+__all__ = ["square_root_impact", "market_fill", "limit_fill", "spread_cost"]

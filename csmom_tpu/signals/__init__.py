@@ -1,5 +1,17 @@
 """Signal engineering over masked panels: momentum, turnover, intraday."""
 
-from csmom_tpu.signals.momentum import monthly_returns, momentum
+from csmom_tpu.signals.momentum import monthly_returns, momentum, momentum_dynamic
+from csmom_tpu.signals.turnover import (
+    turnover_features,
+    shares_outstanding_vector,
+    volume_tercile_labels,
+)
 
-__all__ = ["monthly_returns", "momentum"]
+__all__ = [
+    "monthly_returns",
+    "momentum",
+    "momentum_dynamic",
+    "turnover_features",
+    "shares_outstanding_vector",
+    "volume_tercile_labels",
+]

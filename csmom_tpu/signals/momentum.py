@@ -66,6 +66,17 @@ def momentum(prices, mask, lookback: int = 12, skip: int = 1):
       (mom f[A, M], mom_valid bool[A, M]) — ``mom[:, t]`` is the signal used
       to form the portfolio held over month t+1.
     """
+    return momentum_dynamic(prices, mask, lookback, skip)
+
+
+def momentum_dynamic(prices, mask, lookback, skip):
+    """``momentum`` with *traced* (lookback, skip) scalars.
+
+    The telescoped-ratio formulation only uses J and skip in index
+    arithmetic, so the lookback can be a traced value — which is what lets
+    the whole J x K parameter grid run as one ``vmap`` over a vector of Js
+    instead of one compilation per cell.
+    """
     _, ret_valid = monthly_returns(prices, mask)
     A, M = prices.shape
     t = jnp.arange(M)

@@ -1,0 +1,86 @@
+"""Turnover features — the Lee–Swaminathan volume leg.
+
+Reference: ``compute_monthly_turnover`` (``/root/reference/src/features.py:
+60-107``): ``adv_est = monthly_volume / 21``; shares outstanding from a
+per-ticker info map with a market-cap/price fallback; ``turnover_monthly =
+adv_est / shares_outstanding`` (guarded); ``turn_avg`` = rolling
+``lookback``-month mean.  The reference computes these and never uses them
+(SURVEY §2 row 6) — they are the hook for the paper's momentum x volume
+double sort (LeSw00 Table II: momentum spreads within low/mid/high-turnover
+terciles), implemented in ``csmom_tpu.backtest.double_sort``.
+
+Panel form: shares_outstanding becomes an ``f[A]`` vector (or ``f[A, M]``
+panel when time-varying data exists), everything else is elementwise +
+masked rolling means.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from csmom_tpu.ops.rolling import rolling_mean
+from csmom_tpu.ops.ranking import decile_assign_panel
+
+TRADING_DAYS_PER_MONTH = 21.0  # reference constant (features.py:79)
+
+
+def shares_outstanding_vector(tickers, shares_info: dict, last_price=None):
+    """Resolve per-asset shares outstanding from an info map.
+
+    Mirrors ``features.py:82-99``: prefer ``shares_outstanding``; fall back
+    to ``market_cap / price`` (int-truncated, like the reference) when a last
+    price is available; NaN otherwise.  Host-side helper (runs once).
+    """
+    import numpy as np
+
+    out = np.full(len(tickers), np.nan)
+    for i, t in enumerate(tickers):
+        info = (shares_info or {}).get(t) or {}
+        so = info.get("shares_outstanding")
+        if so is not None and not (isinstance(so, float) and np.isnan(so)):
+            out[i] = float(so)
+            continue
+        mcap = info.get("market_cap")
+        price = None if last_price is None else last_price[i]
+        # NaN mcap is truthy; int(NaN/price) raises — swallow like the
+        # reference's try/except (features.py:93-96) and leave NaN
+        try:
+            if mcap and price and price > 0 and np.isfinite(mcap):
+                out[i] = float(int(mcap / price))
+        except (ValueError, OverflowError, TypeError):
+            pass
+    return out
+
+
+@partial(jax.jit, static_argnames=("lookback",))
+def turnover_features(monthly_volume, volume_mask, shares_outstanding, lookback: int = 3):
+    """adv_est / turnover_monthly / turn_avg panels.
+
+    Args:
+      monthly_volume: f[A, M] summed monthly share volume.
+      volume_mask: bool[A, M] months with >=1 daily bar.
+      shares_outstanding: f[A] (NaN when unknown).
+      lookback: rolling window for ``turn_avg`` (reference default 3).
+
+    Returns dict of (value, valid) pairs.
+    """
+    adv = monthly_volume / TRADING_DAYS_PER_MONTH
+    so = shares_outstanding[:, None]
+    so_ok = jnp.isfinite(so) & (so > 0)
+    turn_valid = volume_mask & so_ok
+    turn = jnp.where(turn_valid, adv / jnp.where(so_ok, so, 1.0), jnp.nan)
+    turn_avg, turn_avg_valid = rolling_mean(turn, turn_valid, lookback, 1)
+    return {
+        "adv_est": (adv, volume_mask),
+        "turnover_monthly": (turn, turn_valid),
+        "turn_avg": (turn_avg, turn_avg_valid),
+    }
+
+
+@partial(jax.jit, static_argnames=("n_vol_bins", "mode"))
+def volume_tercile_labels(turn_avg, turn_valid, n_vol_bins: int = 3, mode: str = "qcut"):
+    """Per-date volume-tercile labels for the LeSw double sort (V1/V2/V3)."""
+    return decile_assign_panel(turn_avg, turn_valid, n_bins=n_vol_bins, mode=mode)

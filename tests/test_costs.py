@@ -1,0 +1,48 @@
+"""Cost models vs reference formulas (execution_models.py re-derived)."""
+
+import numpy as np
+import jax
+
+from csmom_tpu.costs import square_root_impact, market_fill, limit_fill
+
+
+def ref_impact(size, adv, vol, k=0.1, expo=0.5):
+    if adv <= 0:
+        return 0.0
+    return k * vol * (abs(size) / adv) ** expo
+
+
+def test_impact_matches_reference():
+    for size, adv, vol in [(50, 1e5, 0.02), (-500, 2e6, 0.35), (50, 0.0, 0.02), (0, 1e5, 0.02)]:
+        got = float(square_root_impact(size, adv, vol))
+        assert abs(got - ref_impact(size, adv, vol)) < 1e-15
+
+
+def test_market_fill_matches_reference():
+    price, size, adv, vol = 231.5, 50, 120000.0, 0.018
+    for side in (1, -1):
+        exec_p, imp = market_fill(price, size, adv, vol, side)
+        want = price * (1 + side * (0.001 / 2 + ref_impact(size, adv, vol)))
+        assert abs(float(exec_p) - want) < 1e-10
+
+
+def test_market_fill_vectorized():
+    prices = np.array([10.0, 20.0, 30.0])
+    sizes = np.array([50.0, -50.0, 50.0])
+    advs = np.array([1e5, 1e5, 0.0])
+    vols = np.array([0.02, 0.05, 0.02])
+    sides = np.sign(sizes)
+    exec_p, imp = market_fill(prices, sizes, advs, vols, sides)
+    assert exec_p.shape == (3,)
+    assert float(imp[2]) == 0.0  # zero-ADV guard
+
+
+def test_limit_fill_probabilities():
+    key = jax.random.PRNGKey(0)
+    keys = jax.random.split(key, 2000)
+    filled = np.array([
+        bool(limit_fill(k, 100.0, 50, 1e5, 0.02, aggressiveness=0.5)[0]) for k in keys[:300]
+    ])
+    p = filled.mean()
+    # p_full ~= (0.2+0.35)*(1-0.5*5e-4) ~= 0.5499
+    assert 0.40 < p < 0.70

@@ -1,0 +1,51 @@
+"""Turnover features vs a pandas oracle of the reference formulas."""
+
+import numpy as np
+import pandas as pd
+
+from csmom_tpu.signals.turnover import (
+    turnover_features,
+    shares_outstanding_vector,
+    TRADING_DAYS_PER_MONTH,
+)
+
+
+def test_turnover_matches_reference_formulas(rng):
+    A, M = 6, 24
+    vol = rng.integers(1e6, 5e8, size=(A, M)).astype(float)
+    vmask = np.ones((A, M), bool)
+    vmask[0, :5] = False
+    so = np.array([1e9, 5e8, np.nan, 2e9, 1e9, 3e8])
+
+    feats = turnover_features(vol, vmask, so, lookback=3)
+    adv, _ = feats["adv_est"]
+    turn, turn_valid = feats["turnover_monthly"]
+    tavg, tavg_valid = feats["turn_avg"]
+
+    np.testing.assert_allclose(np.asarray(adv), vol / TRADING_DAYS_PER_MONTH)
+    # asset 2 has unknown shares -> all turnover invalid
+    assert not np.asarray(turn_valid)[2].any()
+    # oracle: rolling 3-month mean with min_periods=1, NaN-skipping
+    for a in (1, 3):
+        t_series = pd.Series(np.where(vmask[a], vol[a] / 21.0 / so[a], np.nan))
+        want = t_series.rolling(3, min_periods=1).mean().values
+        got = np.where(np.asarray(tavg_valid)[a], np.asarray(tavg)[a], np.nan)
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+    # masked leading months of asset 0 are invalid then recover
+    assert not np.asarray(turn_valid)[0, :5].any()
+    assert np.asarray(turn_valid)[0, 5:].all()
+
+
+def test_shares_outstanding_resolution():
+    tickers = ["A", "B", "C", "D"]
+    info = {
+        "A": {"shares_outstanding": 123, "market_cap": 999},
+        "B": {"shares_outstanding": None, "market_cap": 1000},
+        "C": {},
+        # D absent entirely
+    }
+    last_price = np.array([10.0, 4.0, 1.0, 1.0])
+    got = shares_outstanding_vector(tickers, info, last_price)
+    assert got[0] == 123
+    assert got[1] == int(1000 / 4.0)  # market-cap fallback, int-truncated
+    assert np.isnan(got[2]) and np.isnan(got[3])
