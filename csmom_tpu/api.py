@@ -8,6 +8,8 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from csmom_tpu.panel import ingest
 from csmom_tpu.panel.calendar import (
     month_end_segments,
@@ -57,3 +59,145 @@ def monthly_price_panel(data_dir: str, tickers, field: str = "adj_close"):
         name="monthly_volume",
     )
     return prices, volume
+
+
+def synthetic_minute_frame(daily_df, minutes_per_day: int = 390, seed: int = 0):
+    """Synthetic 1-minute bars from daily OHLCV, as a canonical long frame.
+
+    Vectorized replacement for the reference's per-minute dict-append loop
+    (``data_io.py:251-300``, its third-hottest loop): same construction —
+    linear open->close path x (1 + N(0, 5e-4)) noise, sin^2 U-curve volume —
+    via one ``synthetic_minute_bars`` call per universe.
+    """
+    import pandas as pd
+
+    from csmom_tpu.panel.synthetic import synthetic_minute_bars
+
+    if daily_df is None or len(daily_df) == 0:
+        return pd.DataFrame(columns=["datetime", "ticker", "price", "volume"])
+
+    tickers = sorted(daily_df["ticker"].unique())
+    days = np.sort(daily_df["date"].unique())
+    open_p = ingest.long_to_panel(daily_df, "open", "date", tickers, days)
+    close_p = ingest.long_to_panel(daily_df, "close", "date", tickers, days)
+    vol_p = ingest.long_to_panel(daily_df, "volume", "date", tickers, days)
+
+    ok = np.isfinite(open_p.values) & np.isfinite(close_p.values)
+    vols = np.where(np.isfinite(vol_p.values) & (vol_p.values > 0), vol_p.values, 1.0)
+    prices, volumes = synthetic_minute_bars(
+        np.nan_to_num(open_p.values), np.nan_to_num(close_p.values), vols,
+        minutes_per_day=minutes_per_day, seed=seed,
+    )
+
+    minute_offsets = (
+        np.timedelta64(9 * 60 + 30, "m") + np.arange(minutes_per_day) * np.timedelta64(1, "m")
+    )
+    stamps = days.astype("datetime64[D]")[None, :, None] + minute_offsets[None, None, :]
+    A, D, T = prices.shape
+    keep = np.broadcast_to(ok[:, :, None], (A, D, T))
+    tick = np.broadcast_to(np.asarray(tickers, dtype=object)[:, None, None], (A, D, T))
+    return pd.DataFrame(
+        {
+            "datetime": np.broadcast_to(stamps, (A, D, T))[keep],
+            "ticker": tick[keep],
+            "price": prices[keep],
+            "volume": volumes[keep].astype(float),
+        }
+    )
+
+
+def daily_risk_maps(daily_df, tickers):
+    """Per-asset ADV and daily-return vol vectors with reference fallbacks.
+
+    Mirrors the sidecar maps of ``run_demo.py:96-125``: ADV = mean daily
+    volume (fallback 100,000 when missing or <= 0); vol = std (ddof=1) of
+    daily pct_change of adj_close (fallback 0.02).  An asset absent from the
+    daily frame entirely gets both fallbacks — exactly what happens to AAPL
+    in the reference's own run, where its daily cache fails to load but its
+    intraday cache trades.
+    """
+    from csmom_tpu.backtest.event import DEFAULT_ADV, DEFAULT_VOL
+
+    adv = np.full(len(tickers), DEFAULT_ADV)
+    vol = np.full(len(tickers), DEFAULT_VOL)
+    if len(daily_df):
+        adv_s = daily_df.groupby("ticker")["volume"].mean()
+        ret = daily_df.groupby("ticker")["adj_close"].pct_change()
+        vol_s = ret.groupby(daily_df["ticker"]).std()
+        for i, t in enumerate(tickers):
+            a = adv_s.get(t, np.nan)
+            if np.isfinite(a) and a > 0:
+                adv[i] = float(a)
+            v = vol_s.get(t, np.nan)
+            if np.isfinite(v) and v > 0:
+                vol[i] = float(v)
+    return adv, vol
+
+
+def intraday_pipeline(
+    minute_df,
+    daily_df,
+    window_minutes: int = 30,
+    n_splits: int = 3,
+    alpha: float = 1.0,
+    size_shares: int = 50,
+    threshold: float = 1e-5,
+    cash0: float = 1_000_000.0,
+    dtype=np.float64,
+):
+    """Minute bars -> features -> ridge scores -> event backtest.
+
+    The panel-world equivalent of ``intraday_pipeline`` + ``backtest_run``
+    (``run_demo.py:81-191``).  Returns (EventResult, RidgeFit, compact,
+    dense_score, dense_price, dense_valid).
+    """
+    from csmom_tpu.signals.intraday import compact_minutes, minute_features, next_row_return
+    from csmom_tpu.models import ridge_time_series_cv
+    from csmom_tpu.backtest.event import event_backtest
+
+    if minute_df is None or len(minute_df) == 0:
+        # reference behaviour: no live intraday data -> synthesize minutes
+        # from daily bars (run_demo.py:82-84 -> data_io.py:251-300)
+        minute_df = synthetic_minute_frame(daily_df)
+        if len(minute_df) == 0:
+            raise ValueError(
+                "intraday_pipeline: no intraday rows and no daily bars to "
+                "synthesize a fallback from"
+            )
+    compact = compact_minutes(minute_df)
+    price = jnp.asarray(compact.price, dtype)
+    volume = jnp.asarray(compact.volume, dtype)
+    row_valid = jnp.asarray(compact.row_valid)
+
+    feats, feat_valid = minute_features(price, volume, row_valid, window=window_minutes)
+    y, y_valid = next_row_return(price, feat_valid)
+    fit = ridge_time_series_cv(feats, y, y_valid, n_splits=n_splits, alpha=alpha)
+
+    # scatter compacted rows onto the global minute axis; padded/non-model
+    # rows are routed to a spill column that is sliced off
+    A, R = compact.price.shape
+    T = len(compact.times)
+    rows = jnp.arange(A)[:, None]
+    cols = jnp.where(y_valid, jnp.asarray(compact.time_idx), T)
+
+    def scatter(vals, fillv=np.nan):
+        out = jnp.full((A, T + 1), fillv, dtype)
+        out = out.at[rows, cols].set(vals.astype(dtype))
+        return out[:, :T]
+
+    dense_score = scatter(fit.scores)
+    dense_price = scatter(price)
+    dense_valid = jnp.zeros((A, T + 1), bool).at[rows, cols].set(y_valid)[:, :T]
+
+    adv, vol = daily_risk_maps(daily_df, compact.tickers)
+    result = event_backtest(
+        dense_price,
+        dense_valid,
+        jnp.nan_to_num(dense_score),
+        jnp.asarray(adv, dtype),
+        jnp.asarray(vol, dtype),
+        size_shares=size_shares,
+        threshold=threshold,
+        cash0=cash0,
+    )
+    return result, fit, compact, dense_score, dense_price, dense_valid

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from csmom_tpu.ops.ranking import decile_assign_panel
 from csmom_tpu.signals.momentum import momentum_dynamic, monthly_returns
+from csmom_tpu.signals.turnover import volume_tercile_labels
 from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat
 
 
@@ -62,11 +63,13 @@ def volume_double_sort(
     ret, ret_valid = monthly_returns(prices, mask)
     mom, mom_valid = momentum_dynamic(prices, mask, lookback, skip)
     mom_labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
-    # independent sort: volume terciles over assets with BOTH signals live,
-    # so the two sorts cover the same universe at each date
+    # independent sort: momentum decile edges use every mom-valid asset
+    # (turnover-less names still shape the breakpoints); the volume tercile
+    # sort is restricted to assets with both signals live, and intersection
+    # cells below require membership in both sorts
     both = mom_valid & turnover_valid
-    vol_labels, _ = decile_assign_panel(
-        jnp.where(both, turnover, jnp.nan), both, n_bins=n_vol_bins, mode=mode
+    vol_labels, _ = volume_tercile_labels(
+        jnp.where(both, turnover, jnp.nan), both, n_vol_bins=n_vol_bins, mode=mode
     )
 
     next_ret = jnp.roll(ret, -1, axis=1)

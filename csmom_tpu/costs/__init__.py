@@ -4,7 +4,14 @@ from csmom_tpu.costs.impact import (
     square_root_impact,
     market_fill,
     limit_fill,
-    spread_cost,
+    long_short_weights,
+    turnover_cost,
 )
 
-__all__ = ["square_root_impact", "market_fill", "limit_fill", "spread_cost"]
+__all__ = [
+    "square_root_impact",
+    "market_fill",
+    "limit_fill",
+    "long_short_weights",
+    "turnover_cost",
+]

@@ -58,11 +58,33 @@ def limit_fill(key, price, size_shares, adv_shares, volatility, aggressiveness=0
     return filled, executed, slip
 
 
-def spread_cost(weights_turnover, half_spread=0.0005):
-    """Portfolio-level linear spread cost: sum |dw| * half_spread.
+def long_short_weights(labels, counts, n_bins: int):
+    """Equal-weight long-short portfolio weights from decile labels.
 
-    For the monthly engine, costs enter in weight-turnover terms (BASELINE
-    config 3: 'decile long-short with txn costs'): a month that replaces the
-    full long and short legs pays ~4 * half_spread.
+    ``w[a, t] = +1/n_top`` for top-decile members, ``-1/n_bot`` for bottom,
+    0 otherwise; both legs zero when either extreme decile is empty.
+
+    Args:
+      labels: i32[A, M] decile ids (-1 invalid).
+      counts: i32[B, M] members per decile (``MonthlyResult.decile_counts``).
     """
-    return jnp.sum(jnp.abs(weights_turnover), axis=-2) * half_spread
+    top_n = counts[n_bins - 1]
+    bot_n = counts[0]
+    live = (top_n > 0) & (bot_n > 0)
+    w_top = jnp.where((labels == n_bins - 1) & live[None, :], 1.0 / jnp.maximum(top_n, 1), 0.0)
+    w_bot = jnp.where((labels == 0) & live[None, :], 1.0 / jnp.maximum(bot_n, 1), 0.0)
+    return w_top - w_bot
+
+
+def turnover_cost(weights, half_spread=0.0005):
+    """Linear transaction cost of rebalancing a weight panel.
+
+    ``cost[t] = half_spread * sum_a |w[a, t] - w[a, t-1]|`` — the standard
+    weight-turnover cost charge (BASELINE config 3: 'decile long-short with
+    txn costs').  A month that replaces both full legs pays ~4*half_spread.
+
+    Args:
+      weights: f[A, M] portfolio weights (asset axis leading).
+    """
+    prev = jnp.roll(weights, 1, axis=-1).at[..., 0].set(0.0)
+    return jnp.sum(jnp.abs(weights - prev), axis=-2) * half_spread

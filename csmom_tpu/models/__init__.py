@@ -1,0 +1,5 @@
+"""Predictive models: closed-form linear/ridge regression with time-series CV."""
+
+from csmom_tpu.models.ridge import ridge_time_series_cv, RidgeFit
+
+__all__ = ["ridge_time_series_cv", "RidgeFit"]

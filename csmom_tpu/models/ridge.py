@@ -1,0 +1,140 @@
+"""Closed-form ridge regression with expanding-window time-series CV.
+
+Reference: ``train_ridge_time_series`` (``/root/reference/src/models.py:8-22``)
+— StandardScaler fit on the full passed-in X (pre-CV, so folds share scaling
+stats; SURVEY §2.1.4 documents the leak as by-design), sklearn
+``TimeSeriesSplit(n_splits)`` expanding-window folds collecting per-fold MSE,
+and a final ``Ridge(alpha)`` refit on everything.
+
+TPU-native form: no sklearn, no row iteration.  With 5 features the normal
+equations are a 6x6 solve; every reduction (scaler moments, Gram matrices,
+fold MSEs) is a masked einsum over the padded ``[A, R, F]`` feature tensor.
+Fold membership is pure index arithmetic on the *global row ordinal* — the
+position each valid row would occupy in the reference's
+sort-by-(ticker, datetime) flattening — so the expanding folds are masks,
+not slices, and the whole fit (scaler + n_splits folds + final model +
+full-history scoring) is one jit call.
+
+Matches sklearn numerically to ~1e-12 in f64: Ridge(alpha, fit_intercept
+=True) solves the centered system ``(Xc'Xc + alpha*I) w = Xc'y``; the
+TimeSeriesSplit fold layout is ``test_size = n // (n_splits+1)`` with fold i
+testing ``[n - (n_splits-i)*test_size, +test_size)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RidgeFit:
+    coef: jnp.ndarray        # f[F] on scaled features
+    intercept: jnp.ndarray   # f[] scalar
+    scale_mean: jnp.ndarray  # f[F] scaler mean (ddof=0 std below)
+    scale_std: jnp.ndarray   # f[F]
+    cv_mse: jnp.ndarray      # f[n_splits]
+    scores: jnp.ndarray      # f[A, R] predictions over every valid row
+    n_train: jnp.ndarray     # i32 number of training rows
+
+
+def _masked_ridge(Xs, y, w, alpha):
+    """Solve Ridge(alpha, fit_intercept=True) over rows weighted by w (0/1).
+
+    Returns (coef f[F], intercept f[]).
+    """
+    n = jnp.maximum(jnp.sum(w), 1.0)
+    xbar = jnp.einsum("r,rf->f", w, Xs) / n
+    ybar = jnp.sum(w * y) / n
+    Xc = (Xs - xbar) * w[:, None]
+    yc = (y - ybar) * w
+    G = Xc.T @ Xc + alpha * jnp.eye(Xs.shape[1], dtype=Xs.dtype)
+    b = Xc.T @ yc
+    coef = jnp.linalg.solve(G, b)
+    intercept = ybar - xbar @ coef
+    return coef, intercept
+
+
+@partial(jax.jit, static_argnames=("n_splits", "train_frac_small"))
+def ridge_time_series_cv(
+    features,
+    y,
+    valid,
+    n_splits: int = 3,
+    alpha: float = 1.0,
+    train_frac: float = 0.7,
+    train_frac_small: float = 0.6,
+    small_threshold: int = 100,
+) -> RidgeFit:
+    """Scale -> expanding-window CV -> final ridge -> score full history.
+
+    Args:
+      features: f[A, R, F] compacted feature tensor (padded rows arbitrary).
+      y: f[A, R] next-row return labels.
+      valid: bool[A, R] modeling rows (features and label all defined).
+      n_splits: CV folds (reference runs 3, models.py called at run_demo:140).
+      alpha: ridge penalty.
+      train_frac: leading fraction of rows used for training — the driver
+        trains on the first 70% (60% when n <= 100) of rows in
+        (ticker, datetime) order and scores everything (run_demo.py:139-147).
+
+    Returns RidgeFit; ``scores`` covers every valid row (the by-design
+    "score the training span too" behaviour of the demo).
+    """
+    A, R, F = features.shape
+    Xf = jnp.nan_to_num(features.reshape(A * R, F))
+    yf = jnp.nan_to_num(y.reshape(A * R))
+    vf = valid.reshape(A * R)
+
+    # global row ordinal in (asset, row) order == reference row order
+    ordinal = jnp.cumsum(vf) - 1
+    n_total = jnp.sum(vf)
+    frac = jnp.where(n_total > small_threshold, train_frac, train_frac_small)
+    n_train = jnp.floor(n_total * frac).astype(jnp.int32)
+    train = vf & (ordinal < n_train)
+
+    # scaler fit on the training block only (models.py:9-10 receives X[:split])
+    w_tr = train.astype(Xf.dtype)
+    n_tr = jnp.maximum(jnp.sum(w_tr), 1.0)
+    mean = jnp.einsum("r,rf->f", w_tr, Xf) / n_tr
+    var = jnp.einsum("r,rf->f", w_tr, (Xf - mean) ** 2) / n_tr
+    std = jnp.sqrt(var)
+    # sklearn maps zero-variance features to scale 1; a constant column can
+    # leave ~eps**2 variance from float accumulation, so compare relative to
+    # the feature magnitude rather than exact zero
+    tiny = 1e-12 * jnp.maximum(jnp.abs(mean), 1.0)
+    std = jnp.where(std > tiny, std, 1.0)
+    Xs = (Xf - mean) / std
+
+    # sklearn TimeSeriesSplit over the n_train training rows
+    test_size = n_train // (n_splits + 1)
+
+    def fold(i):
+        test_start = n_train - (n_splits - i) * test_size
+        tr = train & (ordinal < test_start)
+        te = train & (ordinal >= test_start) & (ordinal < test_start + test_size)
+        coef, icept = _masked_ridge(Xs, yf, tr.astype(Xf.dtype), alpha)
+        pred = Xs @ coef + icept
+        wte = te.astype(Xf.dtype)
+        mse = jnp.sum(wte * (pred - yf) ** 2) / jnp.maximum(jnp.sum(wte), 1.0)
+        return mse
+
+    cv_mse = jnp.stack([fold(i) for i in range(n_splits)])
+
+    coef, icept = _masked_ridge(Xs, yf, w_tr, alpha)
+    scores = (Xs @ coef + icept).reshape(A, R)
+    scores = jnp.where(valid, scores, jnp.nan)
+
+    return RidgeFit(
+        coef=coef,
+        intercept=icept,
+        scale_mean=mean,
+        scale_std=std,
+        cv_mse=cv_mse,
+        scores=scores,
+        n_train=n_train,
+    )

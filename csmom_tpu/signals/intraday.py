@@ -1,0 +1,137 @@
+"""Intraday minute-bar features.
+
+Reference: ``compute_intraday_features_minute``
+(``/root/reference/src/features.py:110-143``): per ticker sorted by time —
+1-minute return, rolling-5 return sum, tick-rule signed volume, rolling-30
+volume sums, and a volume z-score against rolling-60 moments (std NaN -> 1).
+
+Row semantics matter for parity: every reference window is over *observed
+rows* of that ticker, not calendar minutes — a ticker missing a minute simply
+has a shorter series (the shipped caches range 2,597-2,729 bars per name).
+So features are computed on a **compacted layout** ``[A, R]``: row j of
+asset a is a's j-th observed bar, padded to the max row count, with
+``row_valid[a, j] = j < n_rows[a]``.  Windows become plain contiguous
+trailing windows (the masked rolling kernels), exactly matching pandas
+``groupby(ticker).rolling``.  A companion ``time_idx[A, R]`` maps each row
+back to the global minute axis for the event engine.
+
+The compaction itself is one argsort per asset done host-side at ingest; all
+feature math is jit on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from csmom_tpu.ops.rolling import rolling_sum, rolling_mean, rolling_std
+
+
+@dataclasses.dataclass(frozen=True)
+class CompactMinutePanel:
+    """Per-asset compacted minute bars + mapping to the global minute axis."""
+
+    price: np.ndarray     # f[A, R]
+    volume: np.ndarray    # f[A, R]
+    time_idx: np.ndarray  # i32[A, R] global minute index of each row
+    row_valid: np.ndarray # bool[A, R]
+    tickers: tuple
+    times: np.ndarray     # datetime64[T] global minute axis (union)
+
+    @property
+    def n_rows(self):
+        return self.row_valid.sum(axis=1)
+
+
+def compact_minutes(df, tickers=None) -> CompactMinutePanel:
+    """Long intraday frame -> compacted per-asset row layout.
+
+    ``df`` columns: datetime, ticker, price, volume (canonical intraday
+    schema).  Host-side; runs once per dataset.
+    """
+    if tickers is None:
+        tickers = sorted(df["ticker"].unique())
+    times = np.sort(df["datetime"].unique())
+
+    groups = {t: g.sort_values("datetime") for t, g in df.groupby("ticker")}
+    R = max((len(g) for g in groups.values()), default=0)
+    A = len(tickers)
+    price = np.full((A, R), np.nan)
+    volume = np.full((A, R), np.nan)
+    time_idx = np.zeros((A, R), dtype=np.int32)
+    row_valid = np.zeros((A, R), dtype=bool)
+    for a, t in enumerate(tickers):
+        g = groups.get(t)
+        if g is None:
+            continue
+        n = len(g)
+        price[a, :n] = g["price"].values
+        volume[a, :n] = g["volume"].values
+        time_idx[a, :n] = np.searchsorted(times, g["datetime"].values)
+        row_valid[a, :n] = True
+    return CompactMinutePanel(
+        price=price, volume=volume, time_idx=time_idx, row_valid=row_valid,
+        tickers=tuple(tickers), times=times,
+    )
+
+
+FEATURE_NAMES = ("ret_1m", "ret_5m", "vol_roll_sum", "vol_zscore", "signed_vol_roll")
+
+
+@partial(jax.jit, static_argnames=("window",))
+def minute_features(price, volume, row_valid, window: int = 30):
+    """All reference minute features over a compacted [A, R] layout.
+
+    Returns:
+      features: f[A, R, 5] in FEATURE_NAMES order.
+      feat_valid: bool[A, R] rows where every feature is defined (the panel
+        equivalent of the driver's ``feats.dropna()`` at ``run_demo.py:127``
+        — in practice each asset's first row, where ret_1m is NaN).
+    """
+    prev_p = jnp.roll(price, 1, axis=1)
+    prev_valid = jnp.roll(row_valid, 1, axis=1).at[:, 0].set(False)
+    ret_valid = row_valid & prev_valid
+    ret_1m = jnp.where(ret_valid, price / jnp.where(ret_valid, prev_p, 1.0) - 1.0, jnp.nan)
+
+    ret_5m, ret5_valid = rolling_sum(ret_1m, ret_valid, 5, 1)
+
+    # tick rule: sign of the price change, 0 on the first row (fillna(0),
+    # features.py:128); the zero IS a valid observation for the rolling sum
+    tick = jnp.where(ret_valid, jnp.sign(price - prev_p), 0.0)
+    signed_vol = tick * volume
+    signed_vol = jnp.where(row_valid, jnp.nan_to_num(signed_vol), jnp.nan)
+
+    vol_roll, _ = rolling_sum(volume, row_valid, window, 1)
+    signed_roll, _ = rolling_sum(signed_vol, row_valid, window, 1)
+
+    v_mean, _ = rolling_mean(vol_roll, row_valid, 60, 1)
+    v_std, v_std_valid = rolling_std(vol_roll, row_valid, 60, 1, ddof=1)
+    v_std = jnp.where(v_std_valid, v_std, 1.0)  # std NaN -> 1.0 (features.py:135)
+    zscore = (vol_roll - v_mean) / v_std
+
+    features = jnp.stack([ret_1m, ret_5m, vol_roll, zscore, signed_roll], axis=-1)
+    feat_valid = row_valid & ret_valid & ret5_valid
+    return features, feat_valid
+
+
+@jax.jit
+def next_row_return(price, feat_valid):
+    """Training label: next-row return over *surviving* rows.
+
+    The driver computes ``shift(-1)`` per ticker *after* dropping NaN feature
+    rows (``run_demo.py:129-131``), i.e. over the compacted surviving-row
+    sequence.  Survivors are a contiguous tail per asset (row 0 is the only
+    casualty), so the next surviving row is simply row j+1.
+
+    Returns (y f[A, R], y_valid bool[A, R]); the last surviving row of each
+    asset is invalid (its next_ret would be NaN and is dropped, run_demo:131).
+    """
+    nxt_p = jnp.roll(price, -1, axis=1)
+    nxt_valid = jnp.roll(feat_valid, -1, axis=1).at[:, -1].set(False)
+    y_valid = feat_valid & nxt_valid
+    y = jnp.where(y_valid, nxt_p / jnp.where(y_valid, price, 1.0) - 1.0, jnp.nan)
+    return y, y_valid

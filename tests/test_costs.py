@@ -37,6 +37,32 @@ def test_market_fill_vectorized():
     assert float(imp[2]) == 0.0  # zero-ADV guard
 
 
+def test_long_short_weights_and_turnover_cost(rng):
+    from csmom_tpu.costs import long_short_weights, turnover_cost
+    from csmom_tpu.backtest import monthly_spread_backtest
+    from csmom_tpu.backtest.monthly import net_of_costs
+
+    prices = 50 * np.exp(np.cumsum(rng.normal(0.004, 0.07, size=(30, 48)), axis=1))
+    res = monthly_spread_backtest(prices, np.isfinite(prices))
+    w = np.asarray(long_short_weights(res.labels, res.decile_counts, 10))
+    valid = np.asarray(res.spread_valid)
+    # weights sum to ~0 (dollar-neutral) and each live leg to +/-1
+    live = np.where(valid)[0]
+    np.testing.assert_allclose(w[:, live].sum(axis=0), 0.0, atol=1e-12)
+    np.testing.assert_allclose(np.abs(w[:, live]).sum(axis=0), 2.0, atol=1e-12)
+
+    cost = np.asarray(turnover_cost(w, half_spread=0.0005))
+    # oracle: manual |dw| sum
+    prev = np.concatenate([np.zeros((w.shape[0], 1)), w[:, :-1]], axis=1)
+    want = np.abs(w - prev).sum(axis=0) * 0.0005
+    np.testing.assert_allclose(cost, want, rtol=1e-12)
+
+    net, net_mean, net_sharpe = net_of_costs(res, half_spread=0.0005)
+    gross = np.asarray(res.spread)[valid]
+    assert float(net_mean) < float(res.mean_spread)  # costs strictly reduce
+    np.testing.assert_allclose(np.asarray(net)[valid], gross - cost[valid], rtol=1e-10)
+
+
 def test_limit_fill_probabilities():
     key = jax.random.PRNGKey(0)
     keys = jax.random.split(key, 2000)

@@ -1,0 +1,112 @@
+"""Event engine: loop-oracle equivalence + the shipped golden fingerprint."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from csmom_tpu.backtest.event import event_backtest, trades_dataframe
+from csmom_tpu.costs import market_fill
+from tests.conftest import DEMO_TICKERS, MEASURED_TICKERS, requires_reference, REFERENCE_DATA
+
+
+def oracle_event_loop(price, valid, score, adv, vol, size=50, thr=1e-5, cash0=1e6):
+    """Reference SimpleEventBacktester semantics (backtester.py:20-65) as a
+    plain Python loop over the dense panel."""
+    A, T = price.shape
+    positions = np.zeros(A, dtype=int)
+    cash = cash0
+    last_pv = None
+    pnl = []
+    trades = []
+    last_price = np.full(A, np.nan)
+    for t in range(T):
+        if not valid[:, t].any():
+            continue
+        for a in range(A):
+            if valid[a, t]:
+                s = score[a, t]
+                if s > thr or s < -thr:
+                    side = 1 if s > thr else -1
+                    ep, imp = market_fill(price[a, t], size, adv[a], vol[a], side)
+                    positions[a] += side * size
+                    cash -= float(ep) * side * size
+                    trades.append((t, a, side * size, float(ep), float(imp), s))
+        for a in range(A):
+            if valid[a, t]:
+                last_price[a] = price[a, t]
+        pv = cash + np.nansum(np.where(np.isfinite(last_price), positions * last_price, 0.0))
+        pnl.append(0.0 if last_pv is None else pv - last_pv)
+        last_pv = pv
+    return np.array(pnl), trades, positions, cash
+
+
+def _scenario(rng, A=5, T=120):
+    price = 100 * np.exp(np.cumsum(rng.normal(0, 1e-3, size=(A, T)), axis=1))
+    valid = rng.random((A, T)) > 0.2
+    valid[:, 0] = [True, True, False, False, True]  # staggered starts
+    score = rng.normal(0, 1e-4, size=(A, T))
+    score[np.abs(score) < 2e-5] = 0.0  # exercise the threshold edge
+    adv = np.array([1e5, 2e6, 1e5, 5e4, 1e7])
+    vol = np.array([0.02, 0.4, 0.02, 0.01, 0.15])
+    price[~valid] = np.nan
+    return price, valid, score, adv, vol
+
+
+def test_matches_loop_oracle(rng):
+    price, valid, score, adv, vol = _scenario(rng)
+    res = event_backtest(price, valid, np.nan_to_num(score), adv, vol)
+    pnl_o, trades_o, pos_o, cash_o = oracle_event_loop(price, valid, score, adv, vol)
+
+    got_pnl = np.asarray(res.pnl)[np.asarray(res.bar_mask)]
+    np.testing.assert_allclose(got_pnl, pnl_o, rtol=1e-9, atol=1e-8)
+    assert int(res.n_trades) == len(trades_o)
+    np.testing.assert_array_equal(np.asarray(res.positions)[:, -1], pos_o)
+    assert abs(float(res.cash[-1]) - cash_o) < 1e-6
+    assert abs(float(res.total_pnl) - pnl_o.sum()) < 1e-6
+
+
+def test_no_trades_flat_pnl(rng):
+    price, valid, score, adv, vol = _scenario(rng)
+    res = event_backtest(price, valid, np.zeros_like(price), adv, vol)
+    assert int(res.n_trades) == 0
+    np.testing.assert_allclose(np.asarray(res.pnl), 0.0, atol=1e-12)
+
+
+@requires_reference
+def test_golden_fingerprint():
+    """SURVEY §2 row 17 / BASELINE.md: the shipped results/trades.csv is exactly
+    reproducible — 28,020 trades (17,433 buys / 10,587 sells), net notional
+    $90,084,558.39, sum(impact) 0.14418347, total PnL $765,431.87, and the
+    ridge CV MSEs.  Daily maps use 19 tickers (the reference's own AAPL cache
+    bug), intraday all 20."""
+    from csmom_tpu.api import intraday_pipeline
+    from csmom_tpu.panel.ingest import load_daily, load_intraday
+
+    minute_df = load_intraday(REFERENCE_DATA, DEMO_TICKERS)
+    daily_df = load_daily(REFERENCE_DATA, MEASURED_TICKERS)
+    res, fit, compact, dense_score, dense_price, dense_valid = intraday_pipeline(
+        minute_df, daily_df
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(fit.cv_mse), [2.971e-07, 1.801e-06, 3.346e-07], rtol=1e-3
+    )
+    assert int(res.n_trades) == 28_020
+    assert int(res.n_buys) == 17_433
+    assert int(res.n_sells) == 10_587
+    assert abs(float(res.net_notional) - 90_084_558.39) < 0.01
+    assert abs(float(res.total_pnl) - 765_431.87) < 0.01
+    impact_sum = float(
+        np.asarray(res.impact) @ np.abs(np.asarray(res.trade_side)).sum(axis=1)
+    )
+    assert abs(impact_sum - 0.14418347) < 1e-7
+
+    # trade log matches the shipped golden CSV row-for-row
+    golden = pd.read_csv(f"{REFERENCE_DATA}/../results/trades.csv")
+    ours = trades_dataframe(res, compact.tickers, compact.times, np.asarray(dense_score))
+    assert len(ours) == len(golden)
+    np.testing.assert_array_equal(ours["ticker"].values, golden["ticker"].values)
+    np.testing.assert_array_equal(ours["size"].values, golden["size"].values)
+    np.testing.assert_allclose(ours["price"].values, golden["price"].values, rtol=1e-9)
+    np.testing.assert_allclose(ours["impact"].values, golden["impact"].values, rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(ours["score"].values, golden["score"].values, rtol=1e-6, atol=1e-12)

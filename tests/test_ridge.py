@@ -1,0 +1,76 @@
+"""Closed-form ridge + TimeSeriesSplit CV vs sklearn (the reference's stack)."""
+
+import numpy as np
+import pytest
+
+sklearn = pytest.importorskip("sklearn")
+from sklearn.linear_model import Ridge
+from sklearn.metrics import mean_squared_error
+from sklearn.model_selection import TimeSeriesSplit
+from sklearn.preprocessing import StandardScaler
+
+from csmom_tpu.models import ridge_time_series_cv
+
+
+def reference_train(X, y, n_splits=3, alpha=1.0):
+    """models.py:8-22 re-derived with sklearn."""
+    scaler = StandardScaler()
+    Xs = scaler.fit_transform(X)
+    mses = []
+    for tr, te in TimeSeriesSplit(n_splits=n_splits).split(Xs):
+        m = Ridge(alpha=alpha).fit(Xs[tr], y[tr])
+        mses.append(mean_squared_error(y[te], m.predict(Xs[te])))
+    final = Ridge(alpha=alpha).fit(Xs, y)
+    return final, scaler, mses
+
+
+def _padded(rng, A=3, R=400, F=5, hole_frac=0.1):
+    """Build a padded [A, R, F] tensor + the flat (asset-major) row view."""
+    valid = rng.random((A, R)) > hole_frac
+    valid[:, -1] = False
+    X = rng.normal(size=(A, R, F)) * rng.uniform(0.5, 3, size=F)
+    y = rng.normal(scale=1e-3, size=(A, R))
+    X[~valid] = np.nan
+    y[~valid] = np.nan
+    flatX = X.reshape(-1, F)[valid.reshape(-1)]
+    flaty = y.reshape(-1)[valid.reshape(-1)]
+    return X, y, valid, flatX, flaty
+
+
+def test_matches_sklearn_end_to_end(rng):
+    X, y, valid, flatX, flaty = _padded(rng)
+    n = len(flatX)
+    split = int(n * 0.7)
+
+    fit = ridge_time_series_cv(X, y, valid, n_splits=3, alpha=1.0)
+    final, scaler, mses = reference_train(flatX[:split], flaty[:split])
+
+    assert int(fit.n_train) == split
+    np.testing.assert_allclose(np.asarray(fit.cv_mse), mses, rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(fit.scale_mean), scaler.mean_, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(fit.scale_std), scaler.scale_, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(fit.coef), final.coef_, rtol=1e-8)
+    assert abs(float(fit.intercept) - final.intercept_) < 1e-12
+
+    # full-history scoring (incl. training span, run_demo.py:144-147)
+    want_scores = final.predict(scaler.transform(flatX))
+    got_scores = np.asarray(fit.scores).reshape(-1)[valid.reshape(-1)]
+    np.testing.assert_allclose(got_scores, want_scores, rtol=1e-8, atol=1e-14)
+
+
+def test_small_sample_uses_60_percent(rng):
+    X, y, valid, flatX, _ = _padded(rng, A=1, R=90, hole_frac=0.0)
+    # mark only 80 rows valid -> n <= 100 -> 60% train frac
+    valid[:, 80:] = False
+    X[:, 80:] = np.nan
+    fit = ridge_time_series_cv(X, y, valid, n_splits=3)
+    assert int(fit.n_train) == int(80 * 0.6)
+
+
+def test_zero_variance_feature(rng):
+    X, y, valid, _, _ = _padded(rng)
+    X[..., 2] = 1.234  # constant feature -> sklearn scale_=1, coef ~ 0
+    X[~valid] = np.nan
+    fit = ridge_time_series_cv(X, y, valid)
+    assert float(np.asarray(fit.scale_std)[2]) == 1.0
+    assert np.isfinite(np.asarray(fit.coef)).all()
