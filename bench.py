@@ -1,0 +1,140 @@
+"""Headline benchmark.
+
+Primary metric: event-backtest throughput on the reference's own golden
+workload — the shipped 20-ticker x ~2,728-minute panel that takes the
+reference's Python event loop 18.4 s (~148 bar-groups/s, measured; BASELINE
+.md) on one CPU core.  Same features, same scores, same fills; ours is the
+jit-compiled panel engine.
+
+Also reported (in "extra"): the north-star J x K grid — all 16
+Jegadeesh-Titman cells on a 3000-stock x 60-year monthly panel in one
+compiled call (target < 10 s on a v5e-8; BASELINE.json).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+REFERENCE_DATA = "/root/reference/data"
+BASELINE_GROUPS_PER_SEC = 148.3  # measured: 18.4 s / 2,728 datetime groups
+DEMO_TICKERS = [
+    "AAPL", "MSFT", "AMZN", "GOOGL", "NVDA", "TSLA", "META", "JPM", "BAC", "WMT",
+    "PG", "KO", "DIS", "CSCO", "ORCL", "INTC", "AMD", "NFLX", "C", "GS",
+]
+
+
+def _golden_inputs(dtype):
+    """Dense minute panels for the event engine, from the shipped caches (or a
+    synthesized same-shape workload when the reference data is absent)."""
+    import jax.numpy as jnp
+
+    from csmom_tpu.api import intraday_pipeline, synthetic_minute_frame
+    from csmom_tpu.panel.ingest import load_daily, load_intraday
+
+    if os.path.isdir(REFERENCE_DATA):
+        minute_df = load_intraday(REFERENCE_DATA, DEMO_TICKERS)
+        daily_df = load_daily(REFERENCE_DATA, [t for t in DEMO_TICKERS if t != "AAPL"])
+    else:  # pragma: no cover
+        from csmom_tpu.panel.synthetic import synthetic_daily_panel
+
+        daily = synthetic_daily_panel(20, 7, seed=0)
+        daily_df = None
+        minute_df = synthetic_minute_frame(
+            __import__("pandas").DataFrame(
+                {
+                    "date": np.repeat(daily.times, 20),
+                    "ticker": np.tile(daily.tickers, 7),
+                    "open": daily.values.T.ravel(),
+                    "close": daily.values.T.ravel(),
+                    "volume": 1e6,
+                }
+            )
+        )
+    res, fit, compact, dense_score, dense_price, dense_valid = intraday_pipeline(
+        minute_df, daily_df, dtype=dtype
+    )
+    from csmom_tpu.api import daily_risk_maps
+
+    adv, vol = daily_risk_maps(daily_df, compact.tickers)
+    return (
+        jnp.asarray(dense_price, dtype),
+        jnp.asarray(dense_valid),
+        jnp.nan_to_num(jnp.asarray(dense_score, dtype)),
+        jnp.asarray(adv, dtype),
+        jnp.asarray(vol, dtype),
+        int(res.n_trades),
+    )
+
+
+def main():
+    import jax
+
+    from csmom_tpu.backtest.event import event_backtest
+    from csmom_tpu.backtest.grid import jk_grid_backtest
+    from csmom_tpu.panel.calendar import month_end_aggregate, month_end_segments
+    from csmom_tpu.panel.synthetic import synthetic_daily_panel
+
+    platform = jax.devices()[0].platform
+    dtype = np.float32 if platform != "cpu" else np.float64
+
+    price, valid, score, adv, vol, n_trades = _golden_inputs(dtype)
+    n_bars = int(np.asarray(valid).any(axis=0).sum())
+
+    run = lambda: jax.block_until_ready(
+        event_backtest(price, valid, score, adv, vol).total_pnl
+    )
+    run()  # compile
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run()
+    dt = (time.perf_counter() - t0) / reps
+    groups_per_sec = n_bars / dt
+
+    # north-star grid: 16 cells, 3000 stocks x 60 years
+    panel = synthetic_daily_panel(3000, 15120, seed=7, listing_gaps=True)
+    seg, ends = month_end_segments(panel.times)
+    v, m = panel.device(dtype)
+    pm, mm = month_end_aggregate(v, m, seg, len(ends))
+    Js = np.array([3, 6, 9, 12])
+    Ks = np.array([3, 6, 9, 12])
+    g = lambda mode: jax.block_until_ready(
+        jk_grid_backtest(pm, mm, Js, Ks, skip=1, mode=mode).mean_spread
+    )
+    g("rank")
+    t0 = time.perf_counter()
+    g("rank")
+    grid_rank_s = time.perf_counter() - t0
+    g("qcut")
+    t0 = time.perf_counter()
+    g("qcut")
+    grid_qcut_s = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            {
+                "metric": "intraday_event_backtest_bar_groups_per_sec",
+                "value": round(groups_per_sec, 1),
+                "unit": "bar_groups/s",
+                "vs_baseline": round(groups_per_sec / BASELINE_GROUPS_PER_SEC, 1),
+                "extra": {
+                    "platform": platform,
+                    "workload": f"golden 20x{n_bars} minute panel, {n_trades} trades",
+                    "event_backtest_wall_s": round(dt, 6),
+                    "reference_wall_s": 18.4,
+                    "grid16_3000x60yr_rank_s": round(grid_rank_s, 4),
+                    "grid16_3000x60yr_qcut_s": round(grid_qcut_s, 4),
+                    "north_star_target_s": 10.0,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

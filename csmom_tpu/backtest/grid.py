@@ -48,19 +48,12 @@ class GridResult:
     tstat: jnp.ndarray         # f[nJ, nK]
 
 
-def _cohort_spreads(labels, ret, ret_valid, n_bins: int, max_hold: int):
-    """Forward spread of each formation cohort at horizons 1..max_hold.
+def _cohort_partial_sums(labels, ret, ret_valid, n_bins: int, max_hold: int):
+    """Shard-local sums/counts for each cohort x horizon.
 
-    Args:
-      labels: i32[A, M] decile ids at formation date s (-1 invalid).
-      ret:    f[A, M] month returns (month t = return over month t).
-      ret_valid: bool[A, M].
-
-    Returns:
-      (R f[M, H], R_valid bool[M, H]) where R[s, h-1] is the equal-weighted
-      top-minus-bottom return of the cohort formed at s, h months after
-      formation; valid iff both extreme deciles have >=1 member with a live
-      return that month.
+    Returns ``(sums f[2, M, H], counts f[2, M, H])`` over the (local) asset
+    axis, side 0 = bottom decile, side 1 = top.  A distributed run psums
+    these over the asset mesh axis before ``_finalize_cohorts``.
     """
     A, M = ret.shape
     top = labels == (n_bins - 1)
@@ -74,19 +67,96 @@ def _cohort_spreads(labels, ret, ret_valid, n_bins: int, max_hold: int):
         # months rolled past the end are dead
         alive = jnp.arange(M) < (M - h)
         v_h = v_h & alive[None, :]
+
         def side(m):
             mem = m & v_h
-            cnt = jnp.sum(mem, axis=0)
-            s = jnp.sum(jnp.where(mem, r_h, 0.0), axis=0)
-            return s / jnp.maximum(cnt, 1), cnt > 0
-        top_r, top_ok = side(top)
-        bot_r, bot_ok = side(bot)
-        return top_r - bot_r, top_ok & bot_ok
+            return jnp.sum(jnp.where(mem, r_h, 0.0), axis=0), jnp.sum(mem, axis=0)
+
+        bs, bn = side(bot)
+        ts, tn = side(top)
+        # counts must stay exact integers through the psum: bf16 panels would
+        # round counts > 256, so promote to at least f32 (exact to 2^24)
+        count_dtype = jnp.promote_types(rf.dtype, jnp.float32)
+        return jnp.stack([bs, ts]), jnp.stack([bn, tn]).astype(count_dtype)
 
     cols = [at_horizon(h) for h in range(1, max_hold + 1)]
-    R = jnp.stack([c[0] for c in cols], axis=1)
-    R_valid = jnp.stack([c[1] for c in cols], axis=1)
+    sums = jnp.stack([c[0] for c in cols], axis=-1)    # [2, M, H]
+    counts = jnp.stack([c[1] for c in cols], axis=-1)  # [2, M, H]
+    return sums, counts
+
+
+def _finalize_cohorts(sums, counts):
+    """(possibly psum'd) partials -> (R f[M, H], R_valid bool[M, H])."""
+    means = sums / jnp.maximum(counts, 1.0)
+    ok = counts > 0
+    R = means[1] - means[0]
+    R_valid = ok[1] & ok[0]
     return R, R_valid
+
+
+def _cohort_spreads(labels, ret, ret_valid, n_bins: int, max_hold: int):
+    """Forward spread of each formation cohort at horizons 1..max_hold.
+
+    ``R[s, h-1]`` is the equal-weighted top-minus-bottom return of the
+    cohort formed at s, h months after formation; valid iff both extreme
+    deciles have >=1 member with a live return that month.
+    """
+    return _finalize_cohorts(*_cohort_partial_sums(labels, ret, ret_valid, n_bins, max_hold))
+
+
+def _holding_month_spreads(R, R_valid, Ks, max_hold: int):
+    """Cohort tensor -> per-(J, K) overlap-averaged spreads by holding month.
+
+    Re-indexes cohorts by holding month (``D[j, m, h] = R[j, m-(h+1), h]``),
+    prefix-sums over the horizon axis, and gathers each K — the JT 1/K
+    overlap.  A month is live only when all K cohorts exist.  Shared by the
+    single-device and sharded engines (their outputs must stay bit-equal).
+
+    Args:
+      R: f[nJ, M, H]; R_valid: bool[nJ, M, H]; Ks: i32[nK].
+
+    Returns (spreads f[nJ, nK, M] NaN-filled, live bool[nJ, nK, M]).
+    """
+    nJ, M, H = R.shape
+    src = jnp.arange(M)[:, None] - (jnp.arange(H)[None, :] + 1)
+    in_range = src >= 0
+    src_c = jnp.clip(src, 0, M - 1)
+    D = R[:, src_c, jnp.arange(H)[None, :]]
+    D_valid = R_valid[:, src_c, jnp.arange(H)[None, :]] & in_range[None, :, :]
+
+    Dz = jnp.where(D_valid, D, 0.0)
+    csum = jnp.cumsum(Dz, axis=2)
+    cvalid = jnp.cumsum(D_valid.astype(jnp.int32), axis=2)
+
+    k_idx = jnp.clip(Ks - 1, 0, H - 1)
+    spreads = csum[:, :, k_idx] / jnp.maximum(Ks[None, None, :], 1)
+    live = cvalid[:, :, k_idx] == Ks[None, None, :]
+    spreads = jnp.transpose(spreads, (0, 2, 1))      # [nJ, nK, M]
+    live = jnp.transpose(live, (0, 2, 1))
+    return jnp.where(live, spreads, jnp.nan), live
+
+
+def validate_grid_args(Ks, max_hold):
+    """Shared host-side guard: the static horizon bound must cover max(Ks)."""
+    import numpy as np
+
+    if isinstance(Ks, jax.core.Tracer):
+        if max_hold is None:
+            raise ValueError(
+                "grid backtest called with traced Ks and no max_hold: the "
+                "static cohort-horizon bound cannot be inferred from a tracer, "
+                "and a too-small default would silently invalidate K > "
+                "max_hold columns — pass max_hold explicitly (>= max(Ks))"
+            )
+        return max_hold
+    if max_hold is None:
+        return int(np.max(Ks))
+    if int(np.max(Ks)) > max_hold:
+        raise ValueError(
+            f"max(Ks)={int(np.max(Ks))} exceeds max_hold={max_hold}; raise "
+            "max_hold (the static cohort-horizon bound) to cover every K"
+        )
+    return max_hold
 
 
 def jk_grid_backtest(
@@ -112,22 +182,7 @@ def jk_grid_backtest(
       mode: ranking mode ('qcut' parity / 'rank' fast).
       max_hold: static horizon bound (defaults to max(Ks) when Ks is concrete).
     """
-    import numpy as np
-
-    if isinstance(Ks, jax.core.Tracer) and max_hold is None:
-        raise ValueError(
-            "jk_grid_backtest called with traced Ks and no max_hold: the "
-            "static cohort-horizon bound cannot be inferred from a tracer, "
-            "and a too-small default would silently invalidate K > max_hold "
-            "columns — pass max_hold explicitly (>= max(Ks))"
-        )
-    if max_hold is None:
-        max_hold = int(np.max(Ks))
-    if not isinstance(Ks, jax.core.Tracer) and int(np.max(Ks)) > max_hold:
-        raise ValueError(
-            f"max(Ks)={int(np.max(Ks))} exceeds max_hold={max_hold}; raise "
-            "max_hold (the static cohort-horizon bound) to cover every K"
-        )
+    max_hold = validate_grid_args(Ks, max_hold)
     return _jk_grid_backtest(
         prices, mask, Js, Ks, skip=skip, n_bins=n_bins, mode=mode,
         max_hold=max_hold, freq=freq,
@@ -148,26 +203,7 @@ def _jk_grid_backtest(
         return _cohort_spreads(labels, ret, ret_valid, n_bins, max_hold)
 
     R, R_valid = jax.vmap(per_J)(Js)  # [nJ, M, H], [nJ, M, H]
-
-    # re-index by holding month: D[j, m, h] = R[j, m-(h+1), h]
-    nJ, M, H = R.shape
-    src = jnp.arange(M)[:, None] - (jnp.arange(H)[None, :] + 1)
-    in_range = src >= 0
-    src_c = jnp.clip(src, 0, M - 1)
-    D = R[:, src_c, jnp.arange(H)[None, :]]
-    D_valid = R_valid[:, src_c, jnp.arange(H)[None, :]] & in_range[None, :, :]
-
-    # prefix sums over the horizon axis -> any K is a gather
-    Dz = jnp.where(D_valid, D, 0.0)
-    csum = jnp.cumsum(Dz, axis=2)
-    cvalid = jnp.cumsum(D_valid.astype(jnp.int32), axis=2)
-
-    k_idx = jnp.clip(Ks - 1, 0, H - 1)
-    spreads = csum[:, :, k_idx] / jnp.maximum(Ks[None, None, :], 1)
-    all_live = cvalid[:, :, k_idx] == Ks[None, None, :]
-    spreads = jnp.transpose(spreads, (0, 2, 1))      # [nJ, nK, M]
-    spread_valid = jnp.transpose(all_live, (0, 2, 1))
-    spreads = jnp.where(spread_valid, spreads, jnp.nan)
+    spreads, spread_valid = _holding_month_spreads(R, R_valid, Ks, max_hold)
 
     return GridResult(
         spreads=spreads,

@@ -52,20 +52,32 @@ class MonthlyResult:
     tstat: jnp.ndarray         # scalar
 
 
-def decile_portfolio_returns(next_ret, next_valid, labels, n_bins: int):
-    """Equal-weighted mean next-period return per (decile, date).
+def decile_partial_sums(next_ret, next_valid, labels, n_bins: int):
+    """Per-(decile, date) sums and counts over the (local) asset axis.
 
-    One-hot membership matmul instead of groupby: ``member[b, a, t]`` is a
-    0/1 mask; sums reduce over assets.  Returns ``(means f[B, M],
-    counts i32[B, M])``.
+    One-hot membership matmul instead of groupby.  Returns
+    ``(sums f[B, M], counts i32[B, M])`` — the shard-local partials that a
+    distributed run ``psum``s over the asset mesh axis before ``decile_means``
+    divides (the only reduction the portfolio step needs).
     """
     bins = jnp.arange(n_bins, dtype=labels.dtype)
     member = (labels[None, :, :] == bins[:, None, None]) & next_valid[None, :, :]
     r = jnp.where(next_valid, jnp.nan_to_num(next_ret), 0.0)
     sums = jnp.sum(member * r[None, :, :], axis=1)
     counts = jnp.sum(member, axis=1)
-    means = jnp.where(counts > 0, sums / jnp.maximum(counts, 1), jnp.nan)
-    return means, counts.astype(jnp.int32)
+    return sums, counts.astype(jnp.int32)
+
+
+def decile_means(sums, counts):
+    """Finalize per-decile equal-weighted means from (possibly psum'd) partials."""
+    return jnp.where(counts > 0, sums / jnp.maximum(counts, 1), jnp.nan)
+
+
+def decile_portfolio_returns(next_ret, next_valid, labels, n_bins: int):
+    """Equal-weighted mean next-period return per (decile, date):
+    ``(means f[B, M], counts i32[B, M])``."""
+    sums, counts = decile_partial_sums(next_ret, next_valid, labels, n_bins)
+    return decile_means(sums, counts), counts
 
 
 @partial(jax.jit, static_argnames=("lookback", "skip", "n_bins", "mode", "freq"))

@@ -1,0 +1,30 @@
+"""Device-mesh parallelism.
+
+The reference is single-threaded (SURVEY §2 rows 13-15: no parallelism, no
+communication backend).  Here distribution is first-class:
+
+- the **asset axis** is the scaling axis (thousands of names): sharded over
+  the mesh's ``'assets'`` axis; every signal/backtest kernel is per-asset
+  independent except the cross-sectional rank;
+- the **grid axis** (J x K parameter cells) shards over an optional
+  ``'grid'`` mesh axis — embarrassingly parallel;
+- the **time axis** stays replicated (even 60 years of months is tiny);
+  time-serial dependencies are prefix sums, not sequential loops;
+- the only collectives are an ``all_gather`` of the [A, T] signal for the
+  rank (the one truly global op) and ``psum`` for portfolio reductions —
+  both ride ICI on a real pod, and the same code runs multi-host over DCN
+  via ``jax.distributed.initialize`` + the process-spanning mesh.
+"""
+
+from csmom_tpu.parallel.mesh import make_mesh, auto_mesh
+from csmom_tpu.parallel.collectives import (
+    sharded_monthly_spread_backtest,
+    sharded_jk_grid_backtest,
+)
+
+__all__ = [
+    "make_mesh",
+    "auto_mesh",
+    "sharded_monthly_spread_backtest",
+    "sharded_jk_grid_backtest",
+]

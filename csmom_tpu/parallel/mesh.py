@@ -1,0 +1,49 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(devices=None, grid_axis: int = 1, axis_names=("grid", "assets")) -> Mesh:
+    """Build a 2D (grid, assets) mesh from a flat device list.
+
+    ``grid_axis`` devices are dedicated to parameter-grid parallelism; the
+    rest shard the asset axis.  ``grid_axis=1`` degenerates to a pure
+    asset-sharded mesh (the common case on one slice).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % grid_axis != 0:
+        raise ValueError(f"{n} devices not divisible by grid_axis={grid_axis}")
+    arr = np.asarray(devices).reshape(grid_axis, n // grid_axis)
+    return Mesh(arr, axis_names)
+
+
+def auto_mesh(n_devices: int | None = None, prefer_grid: bool = False) -> Mesh:
+    """Mesh over the first ``n_devices`` devices; optionally split a grid axis
+    of 2 when the device count is even and ``prefer_grid`` is set."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    grid = 2 if (prefer_grid and len(devices) % 2 == 0 and len(devices) > 1) else 1
+    return make_mesh(devices, grid_axis=grid)
+
+
+def pad_assets(values, mask, n_shards: int):
+    """Pad the leading asset axis to a multiple of the shard count.
+
+    Padded lanes are masked-out NaN rows, so every kernel treats them as
+    never-observed assets; results are unchanged (host-side helper).
+    """
+    A = values.shape[0]
+    pad = (-A) % n_shards
+    if pad == 0:
+        return values, mask, A
+    vp = np.concatenate([values, np.full((pad,) + values.shape[1:], np.nan, values.dtype)])
+    mp = np.concatenate([mask, np.zeros((pad,) + mask.shape[1:], bool)])
+    return vp, mp, A
