@@ -120,7 +120,7 @@ def daily_risk_maps(daily_df, tickers):
 
     adv = np.full(len(tickers), DEFAULT_ADV)
     vol = np.full(len(tickers), DEFAULT_VOL)
-    if len(daily_df):
+    if daily_df is not None and len(daily_df):
         adv_s = daily_df.groupby("ticker")["volume"].mean()
         ret = daily_df.groupby("ticker")["adj_close"].pct_change()
         vol_s = ret.groupby(daily_df["ticker"]).std()

@@ -1,11 +1,16 @@
 """Backtest engines: vectorized monthly decile engine, J x K grid, event engine."""
 
-from csmom_tpu.backtest.monthly import monthly_spread_backtest, MonthlyResult
+from csmom_tpu.backtest.monthly import (
+    monthly_spread_backtest,
+    sector_neutral_backtest,
+    MonthlyResult,
+)
 from csmom_tpu.backtest.grid import jk_grid_backtest, GridResult
 from csmom_tpu.backtest.double_sort import volume_double_sort, DoubleSortResult
 
 __all__ = [
     "monthly_spread_backtest",
+    "sector_neutral_backtest",
     "MonthlyResult",
     "jk_grid_backtest",
     "GridResult",

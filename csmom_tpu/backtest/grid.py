@@ -104,7 +104,7 @@ def _cohort_spreads(labels, ret, ret_valid, n_bins: int, max_hold: int):
     return _finalize_cohorts(*_cohort_partial_sums(labels, ret, ret_valid, n_bins, max_hold))
 
 
-def _holding_month_spreads(R, R_valid, Ks, max_hold: int):
+def _holding_month_spreads(R, R_valid, Ks):
     """Cohort tensor -> per-(J, K) overlap-averaged spreads by holding month.
 
     Re-indexes cohorts by holding month (``D[j, m, h] = R[j, m-(h+1), h]``),
@@ -203,7 +203,7 @@ def _jk_grid_backtest(
         return _cohort_spreads(labels, ret, ret_valid, n_bins, max_hold)
 
     R, R_valid = jax.vmap(per_J)(Js)  # [nJ, M, H], [nJ, M, H]
-    spreads, spread_valid = _holding_month_spreads(R, R_valid, Ks, max_hold)
+    spreads, spread_valid = _holding_month_spreads(R, R_valid, Ks)
 
     return GridResult(
         spreads=spreads,

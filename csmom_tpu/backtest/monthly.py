@@ -31,7 +31,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from csmom_tpu.ops.ranking import decile_assign_panel
+from csmom_tpu.ops.ranking import decile_assign_panel, sector_decile_assign_panel
 from csmom_tpu.signals.momentum import momentum, monthly_returns
 from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat
 from csmom_tpu.costs.impact import long_short_weights, turnover_cost
@@ -80,6 +80,32 @@ def decile_portfolio_returns(next_ret, next_valid, labels, n_bins: int):
     return decile_means(sums, counts), counts
 
 
+def _assemble_result(ret, ret_valid, labels, n_bins: int, freq: int) -> MonthlyResult:
+    """Shared tail of the monthly engines: align next-month returns to the
+    formation date, pool decile means, and wrap the spread stats.  Formation
+    validity is carried entirely by ``labels`` (>= 0 == ranked that date), so
+    the plain and sector-neutral engines stay bit-identical here."""
+    next_ret = jnp.roll(ret, -1, axis=1)
+    next_valid = jnp.roll(ret_valid, -1, axis=1).at[:, -1].set(False)
+    next_valid = next_valid & (labels >= 0)
+
+    means, counts = decile_portfolio_returns(next_ret, next_valid, labels, n_bins)
+    spread = means[n_bins - 1] - means[0]
+    spread_valid = (counts[n_bins - 1] > 0) & (counts[0] > 0)
+    spread = jnp.where(spread_valid, spread, jnp.nan)
+
+    return MonthlyResult(
+        spread=spread,
+        spread_valid=spread_valid,
+        decile_means=means,
+        decile_counts=counts,
+        labels=labels,
+        mean_spread=masked_mean(spread, spread_valid),
+        ann_sharpe=sharpe(spread, spread_valid, freq_per_year=freq),
+        tstat=t_stat(spread, spread_valid),
+    )
+
+
 @partial(jax.jit, static_argnames=("lookback", "skip", "n_bins", "mode", "freq"))
 def monthly_spread_backtest(
     prices,
@@ -104,27 +130,41 @@ def monthly_spread_backtest(
     ret, ret_valid = monthly_returns(prices, mask)
     mom, mom_valid = momentum(prices, mask, lookback=lookback, skip=skip)
     labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
+    return _assemble_result(ret, ret_valid, labels, n_bins, freq)
 
-    # next-month return aligned to the formation date
-    next_ret = jnp.roll(ret, -1, axis=1)
-    next_valid = jnp.roll(ret_valid, -1, axis=1).at[:, -1].set(False)
-    next_valid = next_valid & mom_valid
 
-    means, counts = decile_portfolio_returns(next_ret, next_valid, labels, n_bins)
-    spread = means[n_bins - 1] - means[0]
-    spread_valid = (counts[n_bins - 1] > 0) & (counts[0] > 0)
-    spread = jnp.where(spread_valid, spread, jnp.nan)
+@partial(jax.jit, static_argnames=("n_sectors", "lookback", "skip", "n_bins", "mode", "freq"))
+def sector_neutral_backtest(
+    prices,
+    mask,
+    sector_ids,
+    n_sectors: int,
+    lookback: int = 12,
+    skip: int = 1,
+    n_bins: int = 10,
+    mode: str = "qcut",
+    freq: int = 12,
+) -> MonthlyResult:
+    """Monthly decile backtest with sector-neutral ranking (BASELINE config 3).
 
-    return MonthlyResult(
-        spread=spread,
-        spread_valid=spread_valid,
-        decile_means=means,
-        decile_counts=counts,
-        labels=labels,
-        mean_spread=masked_mean(spread, spread_valid),
-        ann_sharpe=sharpe(spread, spread_valid, freq_per_year=freq),
-        tstat=t_stat(spread, spread_valid),
+    Identical to :func:`monthly_spread_backtest` except the formation-date
+    bins come from :func:`~csmom_tpu.ops.ranking.sector_decile_assign_panel`:
+    each asset is ranked only within its sector, and the pooled top/bottom
+    bins across sectors form the long-short legs, so the spread carries no
+    net sector tilt.  The reference has no sector machinery at all (its
+    universe is 20 hand-picked large caps, ``run_demo.py:15-16``); this is
+    the BASELINE.json config-3 extension expressed the panel way.
+
+    ``sector_ids`` is i32[A] in ``[0, n_sectors)``; negative ids mark
+    unclassified assets, which are excluded from ranking (like masked
+    lanes).
+    """
+    ret, ret_valid = monthly_returns(prices, mask)
+    mom, mom_valid = momentum(prices, mask, lookback=lookback, skip=skip)
+    labels, _ = sector_decile_assign_panel(
+        mom, mom_valid, sector_ids, n_sectors, n_bins=n_bins, mode=mode
     )
+    return _assemble_result(ret, ret_valid, labels, n_bins, freq)
 
 
 @partial(jax.jit, static_argnames=("n_bins", "freq"))

@@ -11,7 +11,12 @@ from csmom_tpu.ops.rolling import (
     rolling_std,
     rolling_count,
 )
-from csmom_tpu.ops.ranking import decile_assign, decile_assign_panel
+from csmom_tpu.ops.ranking import (
+    decile_assign,
+    decile_assign_panel,
+    sector_decile_assign,
+    sector_decile_assign_panel,
+)
 
 __all__ = [
     "rolling_sum",
@@ -20,4 +25,6 @@ __all__ = [
     "rolling_count",
     "decile_assign",
     "decile_assign_panel",
+    "sector_decile_assign",
+    "sector_decile_assign_panel",
 ]

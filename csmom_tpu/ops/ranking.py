@@ -151,3 +151,58 @@ def decile_assign_panel(x, valid, n_bins: int = 10, mode: str = "qcut"):
         out_axes=(1, 0),
     )(x, valid)
     return labels_t, n_eff
+
+
+@partial(jax.jit, static_argnames=("n_sectors", "n_bins", "mode"))
+def sector_decile_assign(x, valid, sector_ids, n_sectors: int, n_bins: int = 10,
+                         mode: str = "qcut"):
+    """Sector-neutral cross-sectional bins for one date (BASELINE config 3).
+
+    Ranks each asset only against peers in its own sector: the quantile
+    edges are recomputed per sector over the sector's valid lanes, exactly
+    as a pandas ``groupby('sector').transform(qcut)`` would.  The pooled
+    label space is shared across sectors (bin b of sector s and bin b of
+    sector s' both map to label b), which is what makes the downstream
+    long-short "sector-neutral": the top-bin portfolio holds every sector's
+    local winners in proportion to sector breadth.
+
+    Args:
+      x: f[A] signal values.
+      valid: bool[A].
+      sector_ids: i32[A] in ``[0, n_sectors)``; negative = unclassified
+        (treated as invalid, like a masked lane).
+      n_sectors: static sector count.
+
+    Returns:
+      (labels i32[A] with -1 at masked/unclassified lanes,
+       n_bins_effective i32[n_sectors] per sector)
+    """
+    sectors = jnp.arange(n_sectors, dtype=sector_ids.dtype)
+
+    def per_sector(s):
+        return decile_assign(x, valid & (sector_ids == s), n_bins=n_bins, mode=mode)
+
+    labels_s, n_eff = jax.vmap(per_sector)(sectors)  # [S, A], [S]
+    a_idx = jnp.arange(x.shape[0])
+    own = labels_s[jnp.clip(sector_ids, 0, n_sectors - 1), a_idx]
+    labels = jnp.where(valid & (sector_ids >= 0), own, -1)
+    return labels, n_eff
+
+
+@partial(jax.jit, static_argnames=("n_sectors", "n_bins", "mode"))
+def sector_decile_assign_panel(x, valid, sector_ids, n_sectors: int,
+                               n_bins: int = 10, mode: str = "qcut"):
+    """``sector_decile_assign`` vmapped over the time axis of an ``[A, T]``
+    panel (sector membership is static over time, as in CRSP-style SIC
+    classification snapshots).
+
+    Returns ``(labels i32[A, T], n_bins_effective i32[n_sectors, T])``.
+    """
+    labels_t, n_eff = jax.vmap(
+        lambda xv, mv: sector_decile_assign(
+            xv, mv, sector_ids, n_sectors, n_bins=n_bins, mode=mode
+        ),
+        in_axes=1,
+        out_axes=(1, 1),
+    )(x, valid)
+    return labels_t, n_eff

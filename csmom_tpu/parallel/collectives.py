@@ -142,7 +142,7 @@ def sharded_jk_grid_backtest(
         sums = lax.psum(sums, "assets")
         counts = lax.psum(counts, "assets")
         R, R_valid = jax.vmap(_finalize_cohorts)(sums, counts)
-        return _holding_month_spreads(R, R_valid, Ks_all, H)
+        return _holding_month_spreads(R, R_valid, Ks_all)
 
     fn = shard_map(
         local_fn,

@@ -1,0 +1,138 @@
+"""Sector-neutral ranking & backtest vs a pandas groupby-qcut oracle.
+
+The oracle is what a pandas user would write for BASELINE config 3:
+``df.groupby(['date', 'sector'])['mom'].transform(qcut)`` with the
+reference's qcut semantics (duplicates='drop'), then pooled decile means.
+"""
+
+import numpy as np
+import pandas as pd
+
+from csmom_tpu.backtest import monthly_spread_backtest, sector_neutral_backtest
+from csmom_tpu.ops import sector_decile_assign, sector_decile_assign_panel
+
+from tests.test_ranking import oracle_deciles
+
+
+def oracle_sector_deciles(values, sector_ids, n_sectors, n=10):
+    out = np.full(len(values), -1, dtype=int)
+    for s in range(n_sectors):
+        sel = (sector_ids == s) & np.isfinite(values)
+        if not sel.any():
+            continue
+        sub = np.where(sel, values, np.nan)
+        out[sel] = oracle_deciles(sub, n)[sel]
+    return out
+
+
+def test_single_date_vs_oracle(rng):
+    for trial in range(50):
+        a = int(rng.integers(6, 60))
+        n_sectors = int(rng.integers(1, 5))
+        vals = rng.choice([np.nan, 0.0, 1.0, *rng.normal(size=6)], size=a)
+        sectors = rng.integers(-1, n_sectors, size=a).astype(np.int32)
+        valid = np.isfinite(vals)
+        got, n_eff = sector_decile_assign(vals, valid, sectors, n_sectors)
+        want = oracle_sector_deciles(
+            np.where(sectors >= 0, vals, np.nan), sectors, n_sectors
+        )
+        np.testing.assert_array_equal(np.asarray(got), want)
+        assert n_eff.shape == (n_sectors,)
+
+
+def test_unclassified_assets_excluded(rng):
+    vals = rng.normal(size=30)
+    sectors = np.full(30, -1, dtype=np.int32)
+    got, _ = sector_decile_assign(vals, np.isfinite(vals), sectors, 3)
+    assert (np.asarray(got) == -1).all()
+
+
+def test_one_sector_equals_plain_deciles(rng):
+    """With a single sector covering everything, sector-neutral == plain."""
+    vals = rng.normal(size=40)
+    vals[rng.random(40) < 0.2] = np.nan
+    valid = np.isfinite(vals)
+    sectors = np.zeros(40, dtype=np.int32)
+    got, _ = sector_decile_assign(vals, valid, sectors, 1)
+    np.testing.assert_array_equal(np.asarray(got), oracle_deciles(vals))
+
+
+def test_panel_shapes(rng):
+    x = rng.normal(size=(24, 10))
+    x[rng.random(x.shape) < 0.2] = np.nan
+    valid = np.isfinite(x)
+    sectors = rng.integers(0, 3, size=24).astype(np.int32)
+    labels, n_eff = sector_decile_assign_panel(x, valid, sectors, 3, n_bins=5)
+    assert labels.shape == (24, 10)
+    assert n_eff.shape == (3, 10)
+    for t in range(10):
+        want = oracle_sector_deciles(x[:, t], sectors, 3, n=5)
+        np.testing.assert_array_equal(np.asarray(labels[:, t]), want)
+
+
+def _toy_prices(rng, a=30, m=40):
+    prices = 50 * np.exp(np.cumsum(rng.normal(0.005, 0.06, size=(a, m)), axis=1))
+    prices[rng.random((a, m)) < 0.05] = np.nan
+    return prices, np.isfinite(prices)
+
+
+def test_backtest_one_sector_matches_plain(rng):
+    prices, mask = _toy_prices(rng)
+    sectors = np.zeros(prices.shape[0], dtype=np.int32)
+    plain = monthly_spread_backtest(prices, mask, lookback=6, skip=1, n_bins=5)
+    neut = sector_neutral_backtest(prices, mask, sectors, 1, lookback=6, skip=1, n_bins=5)
+    np.testing.assert_allclose(
+        np.asarray(plain.spread)[np.asarray(plain.spread_valid)],
+        np.asarray(neut.spread)[np.asarray(neut.spread_valid)],
+        rtol=1e-12,
+    )
+
+
+def test_backtest_sector_neutral_oracle(rng):
+    """Full sector-neutral spread vs a hand-rolled pandas-style oracle."""
+    prices, mask = _toy_prices(rng, a=36, m=30)
+    sectors = (np.arange(36) % 3).astype(np.int32)
+    n_bins = 3
+    res = sector_neutral_backtest(
+        prices, mask, sectors, 3, lookback=4, skip=1, n_bins=n_bins
+    )
+
+    # oracle: monthly returns, momentum, per-sector qcut labels, pooled means
+    from csmom_tpu.signals.momentum import momentum, monthly_returns
+
+    ret, ret_valid = monthly_returns(prices, mask)
+    mom, mom_valid = momentum(prices, mask, lookback=4, skip=1)
+    ret, ret_valid = np.asarray(ret), np.asarray(ret_valid)
+    mom, mom_valid = np.asarray(mom), np.asarray(mom_valid)
+    A, M = prices.shape
+    for t in range(M - 1):
+        vals = np.where(mom_valid[:, t], mom[:, t], np.nan)
+        labels = oracle_sector_deciles(vals, sectors, 3, n=n_bins)
+        nxt_ok = ret_valid[:, t + 1] & (labels >= 0)
+        top = nxt_ok & (labels == n_bins - 1)
+        bot = nxt_ok & (labels == 0)
+        if top.any() and bot.any():
+            want = ret[top, t + 1].mean() - ret[bot, t + 1].mean()
+            assert bool(np.asarray(res.spread_valid)[t])
+            np.testing.assert_allclose(np.asarray(res.spread)[t], want, rtol=1e-10)
+        else:
+            assert not bool(np.asarray(res.spread_valid)[t])
+
+
+def test_sector_neutrality_property(rng):
+    """Long and short legs hold equal counts of each sector's local extreme
+    bins when sectors are balanced and fully valid (no net sector tilt)."""
+    a, m = 40, 24
+    prices = 50 * np.exp(np.cumsum(rng.normal(0.004, 0.05, size=(a, m)), axis=1))
+    mask = np.isfinite(prices)
+    sectors = (np.arange(a) % 4).astype(np.int32)
+    res = sector_neutral_backtest(prices, mask, sectors, 4, lookback=3, skip=1, n_bins=2)
+    labels = np.asarray(res.labels)
+    for t in range(m):
+        if not np.asarray(res.spread_valid)[t]:
+            continue
+        for s in range(4):
+            in_s = sectors == s
+            n_top = ((labels[:, t] == 1) & in_s).sum()
+            n_bot = ((labels[:, t] == 0) & in_s).sum()
+            assert abs(int(n_top) - int(n_bot)) <= 1
