@@ -1,5 +1,20 @@
-"""Performance analytics: Sharpe, t-stats, decile tables, result schemas."""
+"""Performance analytics: Sharpe, t-stats, bootstrap CIs, result schemas."""
 
 from csmom_tpu.analytics.stats import sharpe, masked_mean, masked_std, t_stat
+from csmom_tpu.analytics.bootstrap import (
+    block_bootstrap,
+    block_bootstrap_grid,
+    circular_block_indices,
+    BootstrapResult,
+)
 
-__all__ = ["sharpe", "masked_mean", "masked_std", "t_stat"]
+__all__ = [
+    "sharpe",
+    "masked_mean",
+    "masked_std",
+    "t_stat",
+    "block_bootstrap",
+    "block_bootstrap_grid",
+    "circular_block_indices",
+    "BootstrapResult",
+]

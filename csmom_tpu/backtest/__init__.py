@@ -7,6 +7,11 @@ from csmom_tpu.backtest.monthly import (
 )
 from csmom_tpu.backtest.grid import jk_grid_backtest, GridResult
 from csmom_tpu.backtest.double_sort import volume_double_sort, DoubleSortResult
+from csmom_tpu.backtest.walkforward import (
+    walk_forward_select,
+    walk_forward_grid_backtest,
+    WalkForwardResult,
+)
 
 __all__ = [
     "monthly_spread_backtest",
@@ -16,4 +21,7 @@ __all__ = [
     "GridResult",
     "volume_double_sort",
     "DoubleSortResult",
+    "walk_forward_select",
+    "walk_forward_grid_backtest",
+    "WalkForwardResult",
 ]

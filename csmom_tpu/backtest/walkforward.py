@@ -1,0 +1,132 @@
+"""Walk-forward (J, K) hyperparameter sweep (BASELINE config 5).
+
+Out-of-sample strategy selection: at every month m, pick the grid cell
+with the best annualized Sharpe over all *prior* months (expanding window),
+and realize that cell's month-m spread.  The reference has no model
+selection at all (one hardcoded J=12/K=1 cell, ``run_demo.py:32``); this is
+the standard antidote to grid-level lookahead when reporting a single
+tradable series from a J x K sweep.
+
+TPU-first: no re-running of backtests per split.  The grid engine already
+returns every cell's full spread series in one call; expanding-window
+statistics for *all* months are prefix sums (``cumsum`` over time of x,
+x^2 and the live mask), so the entire sweep — selection at every month for
+every cell — is O(G * M) fused elementwise work on top of one grid call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from csmom_tpu.analytics.stats import masked_mean, sharpe, t_stat
+from csmom_tpu.backtest.grid import jk_grid_backtest, validate_grid_args
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class WalkForwardResult:
+    """Out-of-sample selection path and its realized spread series."""
+
+    choice: jnp.ndarray        # i32[M] flat grid-cell index chosen at month m (-1 = none eligible)
+    insample_sharpe: jnp.ndarray  # f[G, M] expanding-window Sharpe used for selection
+    oos_spread: jnp.ndarray    # f[M] realized spread of the chosen cell
+    oos_valid: jnp.ndarray     # bool[M]
+    mean_spread: jnp.ndarray   # scalar (masked over oos_valid)
+    ann_sharpe: jnp.ndarray    # scalar
+    tstat: jnp.ndarray         # scalar
+
+
+def _expanding_sharpe(x, live, freq: int):
+    """f[G, M] annualized Sharpe of each series over months [0, m) (strictly
+    prior — the month-m value is not in its own selection window).
+
+    NaN where fewer than 2 live prior months or zero variance, matching
+    ``analytics.stats.sharpe`` semantics on the same window.
+    """
+    xf = jnp.where(live, jnp.nan_to_num(x), 0.0)
+    n = jnp.cumsum(live, axis=-1).astype(xf.dtype)
+    s = jnp.cumsum(xf, axis=-1)
+    ss = jnp.cumsum(xf * xf, axis=-1)
+    # shift right: stats at m cover months 0..m-1
+    pad = lambda a: jnp.concatenate([jnp.zeros_like(a[..., :1]), a[..., :-1]], axis=-1)
+    n, s, ss = pad(n), pad(s), pad(ss)
+    mean = s / jnp.maximum(n, 1.0)
+    var = (ss - n * mean * mean) / jnp.maximum(n - 1.0, 1.0)
+    ok = (n >= 2) & (var > 0)
+    sh = jnp.where(ok, mean / jnp.sqrt(jnp.where(ok, var, 1.0)) * jnp.sqrt(float(freq)), jnp.nan)
+    return sh, n
+
+
+@partial(jax.jit, static_argnames=("min_months", "freq"))
+def walk_forward_select(
+    spreads,
+    spread_valid,
+    min_months: int = 24,
+    freq: int = 12,
+) -> WalkForwardResult:
+    """Select among pre-computed spread series, strictly out-of-sample.
+
+    Args:
+      spreads: f[..., M] grid of spread series (leading axes flattened into
+        one cell axis G).
+      spread_valid: bool[..., M].
+      min_months: minimum live prior months before a cell is eligible; until
+        any cell qualifies the OOS series is invalid (warmup).
+      freq: periods per year for annualization.
+    """
+    M = spreads.shape[-1]
+    x = spreads.reshape(-1, M)
+    live = spread_valid.reshape(-1, M)
+
+    sh, n_prior = _expanding_sharpe(x, live, freq)
+    eligible = (n_prior >= min_months) & jnp.isfinite(sh)
+    score = jnp.where(eligible, sh, -jnp.inf)
+    any_eligible = jnp.any(eligible, axis=0)
+    choice = jnp.where(any_eligible, jnp.argmax(score, axis=0), -1).astype(jnp.int32)
+
+    cols = jnp.arange(M)
+    chosen = jnp.clip(choice, 0, x.shape[0] - 1)
+    oos_valid = any_eligible & live[chosen, cols]
+    oos = jnp.where(oos_valid, x[chosen, cols], jnp.nan)
+
+    return WalkForwardResult(
+        choice=choice,
+        insample_sharpe=sh,
+        oos_spread=oos,
+        oos_valid=oos_valid,
+        mean_spread=masked_mean(oos, oos_valid),
+        ann_sharpe=sharpe(oos, oos_valid, freq_per_year=freq),
+        tstat=t_stat(oos, oos_valid),
+    )
+
+
+def walk_forward_grid_backtest(
+    prices,
+    mask,
+    Js,
+    Ks,
+    skip: int = 1,
+    n_bins: int = 10,
+    mode: str = "qcut",
+    max_hold: int | None = None,
+    min_months: int = 24,
+    freq: int = 12,
+):
+    """End-to-end walk-forward sweep: one grid call + one selection pass.
+
+    Returns ``(WalkForwardResult, GridResult)``; the chosen flat index maps
+    to (J, K) as ``choice // len(Ks), choice % len(Ks)``.
+    """
+    max_hold = validate_grid_args(Ks, max_hold)
+    grid = jk_grid_backtest(
+        prices, mask, Js, Ks, skip=skip, n_bins=n_bins, mode=mode,
+        max_hold=max_hold, freq=freq,
+    )
+    wf = walk_forward_select(
+        grid.spreads, grid.spread_valid, min_months=min_months, freq=freq
+    )
+    return wf, grid

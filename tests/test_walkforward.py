@@ -1,0 +1,80 @@
+"""Walk-forward selection vs a per-month numpy loop oracle."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from csmom_tpu.backtest import walk_forward_select, walk_forward_grid_backtest
+from tests.test_bootstrap import np_sharpe
+
+
+def oracle_select(x, live, min_months, freq=12):
+    G, M = x.shape
+    choice = np.full(M, -1, dtype=int)
+    oos = np.full(M, np.nan)
+    for m in range(M):
+        best, best_sh = -1, -np.inf
+        for g in range(G):
+            prior = live[g, :m]
+            if prior.sum() < min_months:
+                continue
+            sh = np_sharpe(x[g, :m], prior, freq)
+            if np.isfinite(sh) and sh > best_sh:
+                best, best_sh = g, sh
+        choice[m] = best
+        if best >= 0 and live[best, m]:
+            oos[m] = x[best, m]
+    return choice, oos
+
+
+def test_matches_oracle(rng):
+    G, M = 6, 80
+    x = rng.normal(0.003, 0.04, size=(G, M))
+    live = rng.random((G, M)) > 0.1
+    x = np.where(live, x, np.nan)
+    res = walk_forward_select(jnp.asarray(x), jnp.asarray(live), min_months=12)
+    choice, oos = oracle_select(x, live, 12)
+    np.testing.assert_array_equal(np.asarray(res.choice), choice)
+    np.testing.assert_allclose(np.asarray(res.oos_spread), oos, rtol=1e-9)
+    np.testing.assert_array_equal(np.asarray(res.oos_valid), np.isfinite(oos))
+
+
+def test_warmup_all_invalid(rng):
+    x = rng.normal(size=(3, 30))
+    live = np.ones((3, 30), dtype=bool)
+    res = walk_forward_select(jnp.asarray(x), jnp.asarray(live), min_months=24)
+    assert (np.asarray(res.choice)[:24] == -1).all()
+    assert not np.asarray(res.oos_valid)[:24].any()
+    assert np.asarray(res.oos_valid)[25:].all()
+
+
+def test_selection_prefers_dominant_cell(rng):
+    """A cell with strictly better risk-adjusted returns gets picked once
+    eligible."""
+    M = 60
+    good = np.full(M, 0.02) + rng.normal(0, 0.001, M)
+    bad = rng.normal(0.0, 0.05, size=(4, M))
+    x = np.vstack([bad, good[None, :]])
+    live = np.ones_like(x, dtype=bool)
+    res = walk_forward_select(jnp.asarray(x), jnp.asarray(live), min_months=12)
+    assert (np.asarray(res.choice)[13:] == 4).all()
+
+
+def test_end_to_end_grid_sweep(rng):
+    A, M = 24, 70
+    prices = 50 * np.exp(np.cumsum(rng.normal(0.004, 0.06, size=(A, M)), axis=1))
+    mask = np.isfinite(prices)
+    Js = np.array([3, 6], dtype=np.int32)
+    Ks = np.array([1, 3], dtype=np.int32)
+    wf, grid = walk_forward_grid_backtest(prices, mask, Js, Ks, min_months=12, n_bins=5)
+    assert wf.insample_sharpe.shape == (4, M)
+    choice, oos = oracle_select(
+        np.asarray(grid.spreads).reshape(4, M),
+        np.asarray(grid.spread_valid).reshape(4, M),
+        12,
+    )
+    np.testing.assert_array_equal(np.asarray(wf.choice), choice)
+    np.testing.assert_allclose(
+        np.asarray(wf.oos_spread)[np.asarray(wf.oos_valid)],
+        oos[np.isfinite(oos)],
+        rtol=1e-9,
+    )
