@@ -1,0 +1,12 @@
+"""Engine backends behind one API.
+
+The north-star constraint (BASELINE.json) is a ``backend='tpu'`` path
+*alongside* a pandas engine, both behind the same interface, so the CLI,
+results schema and analytics are backend-agnostic.  ``run_monthly`` is that
+interface; ``pandas_engine`` is the reference-semantics CPU engine.
+"""
+
+from csmom_tpu.backends.dispatch import run_monthly, MonthlyReport
+from csmom_tpu.backends.pandas_engine import monthly_spread_backtest_pandas
+
+__all__ = ["run_monthly", "MonthlyReport", "monthly_spread_backtest_pandas"]

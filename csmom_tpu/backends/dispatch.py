@@ -1,0 +1,99 @@
+"""One monthly-backtest API over two engines: ``backend='tpu' | 'pandas'``.
+
+The north-star constraint: the accelerated path lands *behind* the existing
+interface so callers (CLI, analytics, plots) never branch on engine.  Both
+engines consume a :class:`~csmom_tpu.panel.panel.Panel` and return the same
+:class:`MonthlyReport` host-side schema; the golden-parity test pins them to
+each other.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from csmom_tpu.panel.panel import Panel
+
+
+@dataclasses.dataclass(frozen=True)
+class MonthlyReport:
+    """Backend-agnostic monthly backtest report (host types only).
+
+    The results schema mirrors what the reference prints/plots
+    (``run_demo.py:72-79``): the spread series, its mean / annualized Sharpe,
+    plus the decile detail the paper tabulates.
+    """
+
+    times: np.ndarray          # [M] month-end timestamps
+    spread: np.ndarray         # f[M], NaN = invalid month
+    decile_means: np.ndarray   # f[n_bins, M]
+    decile_counts: np.ndarray  # i[n_bins, M]
+    labels: np.ndarray         # i[A, M], -1 invalid
+    mean_spread: float
+    ann_sharpe: float
+    tstat: float
+    backend: str
+
+    def spread_series(self):
+        """The spread as a pandas Series (reference's ``spread`` variable,
+        ``run_demo.py:60-67``)."""
+        import pandas as pd
+
+        return pd.Series(self.spread, index=self.times, name="spread").dropna()
+
+
+def run_monthly(
+    panel: Panel,
+    lookback: int = 12,
+    skip: int = 1,
+    n_bins: int = 10,
+    mode: str = "qcut",
+    freq: int = 12,
+    backend: str = "tpu",
+) -> MonthlyReport:
+    """Run the monthly decile backtest on the requested engine.
+
+    Args:
+      panel: month-end price Panel [A, M].
+      backend: ``'tpu'`` (jit-compiled panel engine, the default) or
+        ``'pandas'`` (reference-semantics CPU engine).
+      mode: ranking mode, TPU engine only ('qcut' parity / 'rank' fast).
+    """
+    if backend == "tpu":
+        from csmom_tpu.backtest import monthly_spread_backtest
+
+        v, m = panel.device()
+        res = monthly_spread_backtest(
+            v, m, lookback=lookback, skip=skip, n_bins=n_bins, mode=mode, freq=freq
+        )
+        spread = np.where(np.asarray(res.spread_valid), np.asarray(res.spread), np.nan)
+        return MonthlyReport(
+            times=panel.times,
+            spread=spread,
+            decile_means=np.asarray(res.decile_means),
+            decile_counts=np.asarray(res.decile_counts),
+            labels=np.asarray(res.labels),
+            mean_spread=float(res.mean_spread),
+            ann_sharpe=float(res.ann_sharpe),
+            tstat=float(res.tstat),
+            backend="tpu",
+        )
+    if backend == "pandas":
+        from csmom_tpu.backends.pandas_engine import monthly_spread_backtest_pandas
+
+        res = monthly_spread_backtest_pandas(
+            panel.to_dataframe(), lookback=lookback, skip=skip, n_bins=n_bins, freq=freq
+        )
+        return MonthlyReport(
+            times=panel.times,
+            spread=res.spread.to_numpy(),
+            decile_means=res.decile_means.to_numpy(),
+            decile_counts=res.decile_counts.to_numpy(),
+            labels=res.labels.to_numpy(),
+            mean_spread=res.mean_spread,
+            ann_sharpe=res.ann_sharpe,
+            tstat=res.tstat,
+            backend="pandas",
+        )
+    raise ValueError(f"unknown backend {backend!r} (expected 'tpu' or 'pandas')")
