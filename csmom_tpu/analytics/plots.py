@@ -1,0 +1,84 @@
+"""Artifact writers: the reference's result files, same names, same schema.
+
+The reference emits three artifacts (``run_demo.py:79,183-189``):
+``results/monthly_mom_cum.png`` (cumulative spread growth),
+``results/intraday_cum_pnl.png`` (cumulative event-backtest PnL) and
+``results/trades.csv`` (header ``datetime,ticker,size,price,impact,score``).
+Keeping names and schemas identical means a reference user's downstream
+tooling keeps working unchanged.
+
+Plot style: single-series line charts — one hue, thin 2px line, recessive
+grid, neutral ink for text, no legend (the title names the series).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_LINE = "#3b82b4"   # single categorical hue
+_INK = "#333333"
+_GRID = "#dddddd"
+
+
+def ensure_dir(path: str) -> str:
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def _line_plot(x, y, title: str, ylabel: str, out_path: str):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(9, 4.5))
+    ax.plot(x, y, color=_LINE, linewidth=2)
+    ax.set_title(title, color=_INK)
+    ax.set_ylabel(ylabel, color=_INK)
+    ax.grid(True, color=_GRID, linewidth=0.6)
+    for spine in ("top", "right"):
+        ax.spines[spine].set_visible(False)
+    ax.tick_params(colors=_INK)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path
+
+
+def save_monthly_cum_plot(times, spread, results_dir: str,
+                          fname: str = "monthly_mom_cum.png") -> str:
+    """Cumulative growth of the monthly spread, ``(1+r).cumprod()``
+    (``run_demo.py:75-79``), over valid months only."""
+    ensure_dir(results_dir)
+    valid = np.isfinite(np.asarray(spread, dtype=float))
+    cum = np.cumprod(1.0 + np.asarray(spread, dtype=float)[valid])
+    return _line_plot(
+        np.asarray(times)[valid], cum,
+        "Monthly momentum: cumulative spread growth",
+        "growth of $1",
+        os.path.join(results_dir, fname),
+    )
+
+
+def save_intraday_pnl_plot(times, pnl, results_dir: str,
+                           fname: str = "intraday_cum_pnl.png") -> str:
+    """Cumulative minute PnL, ``pnl.cumsum()`` (``run_demo.py:186-188``)."""
+    ensure_dir(results_dir)
+    return _line_plot(
+        np.asarray(times), np.cumsum(np.asarray(pnl, dtype=float)),
+        "Intraday event backtest: cumulative PnL",
+        "PnL ($)",
+        os.path.join(results_dir, fname),
+    )
+
+
+def save_trades_csv(trades_df, results_dir: str, fname: str = "trades.csv") -> str:
+    """Write the trade log with the reference's exact header
+    (``results/trades.csv:1``: datetime,ticker,size,price,impact,score)."""
+    ensure_dir(results_dir)
+    cols = ["datetime", "ticker", "size", "price", "impact", "score"]
+    out = os.path.join(results_dir, fname)
+    trades_df.loc[:, cols].to_csv(out, index=False)
+    return out

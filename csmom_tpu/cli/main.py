@@ -1,22 +1,230 @@
-"""``csmom`` CLI entry point.
+"""``csmom`` CLI: run / replicate / grid / sweep / intraday / bench.
 
 The reference has no CLI at all — its driver hardcodes every parameter
-(``/root/reference/run_demo.py:193-207``).  This module grows the
-run/replicate/grid/sweep subcommands as the framework lands; for now it
-reports the package version and available subcommands.
+(``/root/reference/run_demo.py:193-207``).  Each subcommand here covers one
+stage of that driver with the constants exposed as flags, defaults equal to
+the reference's values (see ``csmom_tpu.config``), and the same artifacts
+written to ``--out`` (monthly_mom_cum.png / intraday_cum_pnl.png /
+trades.csv — identical names and schemas).
+
+``--config file.toml`` loads a :class:`~csmom_tpu.config.RunConfig`; flags
+given on the command line override the file.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import sys
+
+from csmom_tpu.config import RunConfig, load_config
+from csmom_tpu.utils.logging import get_logger
+
+log = get_logger("cli")
+
+
+def _load_cfg(args) -> RunConfig:
+    cfg = load_config(args.config) if args.config else RunConfig()
+    if getattr(args, "backend", None):
+        cfg = dataclasses.replace(cfg, backend=args.backend)
+    if getattr(args, "out", None):
+        cfg = dataclasses.replace(cfg, results_dir=args.out)
+    if getattr(args, "data_dir", None):
+        cfg = dataclasses.replace(
+            cfg, universe=dataclasses.replace(cfg.universe, data_dir=args.data_dir)
+        )
+    mom = cfg.momentum
+    for field in ("lookback", "skip", "n_bins", "mode"):
+        v = getattr(args, field, None)
+        if v is not None:
+            mom = dataclasses.replace(mom, **{field: v})
+    return dataclasses.replace(cfg, momentum=mom)
+
+
+def _price_panel(cfg: RunConfig):
+    from csmom_tpu.api import monthly_price_panel
+
+    return monthly_price_panel(cfg.universe.data_dir, list(cfg.universe.tickers))
+
+
+def cmd_replicate(args) -> int:
+    """Monthly momentum replication (the reference's ``monthly_replication``,
+    ``run_demo.py:31-79``) on either backend."""
+    cfg = _load_cfg(args)
+    prices, _volume = _price_panel(cfg)
+
+    from csmom_tpu.backends import run_monthly
+
+    rep = run_monthly(
+        prices,
+        lookback=cfg.momentum.lookback,
+        skip=cfg.momentum.skip,
+        n_bins=cfg.momentum.n_bins,
+        mode=cfg.momentum.mode,
+        backend=cfg.backend,
+    )
+    print(f"Mean monthly spread: {rep.mean_spread:.6f}")
+    print(f"Annualized Sharpe:   {rep.ann_sharpe:.4f}")
+    print(f"t-stat:              {rep.tstat:.3f}")
+
+    from csmom_tpu.analytics.plots import save_monthly_cum_plot
+
+    out = save_monthly_cum_plot(prices.times, rep.spread, cfg.results_dir)
+    log.info("wrote %s", out)
+    return 0
+
+
+def cmd_grid(args) -> int:
+    """Full J x K grid in one compiled call; prints the mean/Sharpe tables."""
+    import numpy as np
+
+    cfg = _load_cfg(args)
+    Js = [int(j) for j in args.js.split(",")] if args.js else list(cfg.grid.Js)
+    Ks = [int(k) for k in args.ks.split(",")] if args.ks else list(cfg.grid.Ks)
+    prices, _ = _price_panel(cfg)
+
+    from csmom_tpu.backtest import jk_grid_backtest
+
+    v, m = prices.device()
+    res = jk_grid_backtest(
+        v, m, np.asarray(Js), np.asarray(Ks),
+        skip=cfg.momentum.skip, n_bins=cfg.momentum.n_bins, mode=cfg.momentum.mode,
+    )
+
+    def table(name, grid):
+        print(f"\n{name} (rows J={Js}, cols K={Ks})")
+        for i, J in enumerate(Js):
+            row = "  ".join(f"{float(grid[i, j]):9.4f}" for j in range(len(Ks)))
+            print(f"  J={J:>2}  {row}")
+
+    table("mean monthly spread", np.asarray(res.mean_spread))
+    table("annualized Sharpe", np.asarray(res.ann_sharpe))
+    table("t-stat", np.asarray(res.tstat))
+    return 0
+
+
+def cmd_sweep(args) -> int:
+    """Walk-forward (J, K) selection: out-of-sample series from the grid."""
+    import numpy as np
+
+    cfg = _load_cfg(args)
+    Js = [int(j) for j in args.js.split(",")] if args.js else list(cfg.grid.Js)
+    Ks = [int(k) for k in args.ks.split(",")] if args.ks else list(cfg.grid.Ks)
+    prices, _ = _price_panel(cfg)
+
+    from csmom_tpu.backtest import walk_forward_grid_backtest
+
+    wf, _grid = walk_forward_grid_backtest(
+        np.asarray(prices.values), np.asarray(prices.mask),
+        np.asarray(Js), np.asarray(Ks),
+        skip=cfg.momentum.skip, n_bins=cfg.momentum.n_bins, mode=cfg.momentum.mode,
+        min_months=args.min_months or cfg.grid.walk_forward_min_months,
+    )
+    choice = np.asarray(wf.choice)
+    live = choice >= 0
+    picked = [(Js[c // len(Ks)], Ks[c % len(Ks)]) for c in choice[live]]
+    print(f"OOS months:        {int(np.asarray(wf.oos_valid).sum())}")
+    print(f"OOS mean spread:   {float(wf.mean_spread):.6f}")
+    print(f"OOS ann. Sharpe:   {float(wf.ann_sharpe):.4f}")
+    if picked:
+        from collections import Counter
+
+        top = Counter(picked).most_common(3)
+        print("Most-selected cells:", ", ".join(f"J={j}/K={k} x{n}" for (j, k), n in top))
+    return 0
+
+
+def cmd_intraday(args) -> int:
+    """Intraday pipeline + event backtest (``run_demo.py:81-191``): features,
+    ridge CV, per-minute fills; writes trades.csv + intraday_cum_pnl.png."""
+    import numpy as np
+
+    cfg = _load_cfg(args)
+    from csmom_tpu.api import intraday_pipeline
+    from csmom_tpu.panel.ingest import load_daily, load_intraday
+
+    tickers = list(cfg.universe.tickers)
+    minute_df = load_intraday(cfg.universe.data_dir, tickers)
+    daily_df = load_daily(cfg.universe.data_dir, tickers)
+    res, fit, compact, dense_score, _p, _v = intraday_pipeline(
+        minute_df, daily_df,
+        window_minutes=cfg.intraday.window_minutes,
+        n_splits=cfg.intraday.n_splits,
+        alpha=cfg.intraday.alpha,
+        size_shares=cfg.intraday.size_shares,
+        threshold=cfg.intraday.threshold,
+        cash0=cfg.intraday.cash0,
+    )
+    print(f"CV MSEs:     {[f'{m:.3g}' for m in np.asarray(fit.cv_mse)]}")
+    print(f"Trades:      {int(res.n_trades)} "
+          f"({int(res.n_buys)} buys / {int(res.n_sells)} sells)")
+    print(f"Total PnL:   ${float(res.total_pnl):,.2f}")
+
+    from csmom_tpu.analytics.plots import save_intraday_pnl_plot, save_trades_csv
+    from csmom_tpu.backtest.event import trades_dataframe
+
+    trades = trades_dataframe(
+        res, compact.tickers, compact.times, np.asarray(dense_score),
+        size_shares=cfg.intraday.size_shares,
+    )
+    out_csv = save_trades_csv(trades, cfg.results_dir)
+    bar = np.asarray(res.bar_mask)
+    out_png = save_intraday_pnl_plot(
+        np.asarray(compact.times)[bar], np.asarray(res.pnl)[bar], cfg.results_dir
+    )
+    log.info("wrote %s and %s", out_csv, out_png)
+    return 0
+
+
+def cmd_run(args) -> int:
+    """Full demo: replicate + intraday, like the reference's ``main()``."""
+    rc = cmd_replicate(args)
+    if rc:
+        return rc
+    return cmd_intraday(args)
+
+
+def cmd_bench(args) -> int:
+    """Run the headline benchmark (same as ``python bench.py``)."""
+    import subprocess
+
+    return subprocess.call([sys.executable, "bench.py"])
+
+
+def _add_common(p):
+    p.add_argument("--config", help="TOML RunConfig file")
+    p.add_argument("--data-dir", help="CSV cache directory")
+    p.add_argument("--out", help="results directory")
+    p.add_argument("--backend", choices=["tpu", "pandas"])
+    p.add_argument("--lookback", type=int, help="formation months J")
+    p.add_argument("--skip", type=int, help="skip months")
+    p.add_argument("--n-bins", dest="n_bins", type=int)
+    p.add_argument("--mode", choices=["qcut", "rank"])
 
 
 def build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(prog="csmom", description=__doc__)
     from csmom_tpu import __version__
 
+    p = argparse.ArgumentParser(prog="csmom", description=__doc__)
     p.add_argument("--version", action="version", version=f"csmom_tpu {__version__}")
-    p.add_subparsers(dest="command")
+    sub = p.add_subparsers(dest="command")
+
+    for name, fn, extra in (
+        ("run", cmd_run, ()),
+        ("replicate", cmd_replicate, ()),
+        ("grid", cmd_grid, ("js", "ks")),
+        ("sweep", cmd_sweep, ("js", "ks", "min_months")),
+        ("intraday", cmd_intraday, ()),
+        ("bench", cmd_bench, ()),
+    ):
+        sp = sub.add_parser(name, help=(fn.__doc__ or "").splitlines()[0])
+        _add_common(sp)
+        if "js" in extra:
+            sp.add_argument("--js", help="comma-separated J values")
+            sp.add_argument("--ks", help="comma-separated K values")
+        if "min_months" in extra:
+            sp.add_argument("--min-months", dest="min_months", type=int)
+        sp.set_defaults(fn=fn)
     return p
 
 
@@ -24,7 +232,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if not getattr(args, "command", None):
         build_parser().print_help()
-    return 0
+        return 0
+    return args.fn(args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,106 @@
+"""Config loading + CLI subcommand smoke tests (CPU, pandas backend)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from csmom_tpu.config import RunConfig, load_config, DEFAULT_TICKERS
+from csmom_tpu.cli.main import build_parser, main
+
+from tests.conftest import REFERENCE_DATA, requires_reference
+
+
+def test_defaults_are_reference_constants():
+    cfg = RunConfig()
+    assert tuple(cfg.universe.tickers) == DEFAULT_TICKERS
+    assert (cfg.momentum.lookback, cfg.momentum.skip) == (12, 1)
+    assert cfg.intraday.size_shares == 50
+    assert cfg.intraday.threshold == 1e-5
+    assert cfg.intraday.cash0 == 1_000_000.0
+    assert cfg.costs.impact_k == 0.1
+    assert cfg.costs.spread == 0.001
+    assert cfg.results_dir == "results"
+
+
+def test_load_toml_roundtrip(tmp_path):
+    p = tmp_path / "run.toml"
+    p.write_text(
+        """
+backend = "pandas"
+results_dir = "out"
+
+[universe]
+tickers = ["AAPL", "MSFT"]
+data_dir = "/data"
+
+[momentum]
+lookback = 6
+skip = 0
+
+[grid]
+Js = [3, 6]
+Ks = [1]
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.backend == "pandas"
+    assert cfg.results_dir == "out"
+    assert cfg.universe.tickers == ("AAPL", "MSFT")
+    assert cfg.momentum.lookback == 6 and cfg.momentum.skip == 0
+    assert cfg.grid.Js == (3, 6) and cfg.grid.Ks == (1,)
+    # untouched sections keep reference defaults
+    assert cfg.intraday.window_minutes == 30
+
+
+def test_load_toml_unknown_key_raises(tmp_path):
+    p = tmp_path / "bad.toml"
+    p.write_text("[momentum]\nlookbak = 6\n")
+    with pytest.raises(ValueError, match="lookbak"):
+        load_config(str(p))
+    p2 = tmp_path / "bad2.toml"
+    p2.write_text("backnd = 'tpu'\n")
+    with pytest.raises(ValueError, match="backnd"):
+        load_config(str(p2))
+
+
+def test_parser_subcommands():
+    p = build_parser()
+    args = p.parse_args(["replicate", "--backend", "pandas", "--lookback", "6"])
+    assert args.command == "replicate" and args.lookback == 6
+    args = p.parse_args(["grid", "--js", "3,6", "--ks", "1"])
+    assert args.js == "3,6"
+    args = p.parse_args(["sweep", "--min-months", "12"])
+    assert args.min_months == 12
+
+
+def test_no_command_prints_help(capsys):
+    assert main([]) == 0
+    assert "replicate" in capsys.readouterr().out
+
+
+@requires_reference
+def test_cli_replicate_pandas(tmp_path, capsys):
+    rc = main([
+        "replicate", "--data-dir", REFERENCE_DATA, "--out", str(tmp_path),
+        "--backend", "pandas",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Mean monthly spread" in out
+    assert os.path.exists(tmp_path / "monthly_mom_cum.png")
+
+
+@requires_reference
+def test_cli_replicate_flag_overrides(tmp_path, capsys):
+    main([
+        "replicate", "--data-dir", REFERENCE_DATA, "--out", str(tmp_path),
+        "--backend", "pandas", "--lookback", "6", "--skip", "0",
+    ])
+    out6 = capsys.readouterr().out
+    main([
+        "replicate", "--data-dir", REFERENCE_DATA, "--out", str(tmp_path),
+        "--backend", "pandas",
+    ])
+    out12 = capsys.readouterr().out
+    assert out6 != out12
