@@ -8,6 +8,12 @@ from csmom_tpu.panel.ingest import (
     long_to_panel,
 )
 from csmom_tpu.panel.calendar import month_end_segments, month_end_aggregate
+from csmom_tpu.panel.fetch import (
+    fetch_daily,
+    fetch_intraday,
+    get_shares_info,
+    cache_path,
+)
 
 __all__ = [
     "Panel",
@@ -17,4 +23,8 @@ __all__ = [
     "long_to_panel",
     "month_end_segments",
     "month_end_aggregate",
+    "fetch_daily",
+    "fetch_intraday",
+    "get_shares_info",
+    "cache_path",
 ]

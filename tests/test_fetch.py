@@ -1,0 +1,148 @@
+"""Fetch layer: injectable backend, cache roundtrip, fault isolation."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from csmom_tpu.panel.fetch import (
+    cache_path,
+    fetch_daily,
+    fetch_intraday,
+    get_shares_info,
+    CACHE_VERSION,
+)
+from tests.conftest import REFERENCE_DATA, requires_reference
+
+
+def fake_daily_vendor(ticker, start, end):
+    """yfinance-shaped daily frame: datetime index, title-case columns."""
+    idx = pd.date_range(start, periods=40, freq="B")
+    base = {"A": 100.0, "B": 50.0, "C": 20.0}.get(ticker, 10.0)
+    close = base + np.arange(40) * 0.5
+    return pd.DataFrame(
+        {
+            "Open": close - 0.2,
+            "High": close + 0.3,
+            "Low": close - 0.4,
+            "Close": close,
+            "Adj Close": close * 0.99,
+            "Volume": 1_000_000 + np.arange(40),
+        },
+        index=idx,
+    )
+
+
+def fake_intraday_vendor(ticker, period, interval):
+    idx = pd.date_range("2025-01-02 09:30", periods=30, freq="min")
+    return pd.DataFrame(
+        {"Close": 100 + np.arange(30) * 0.01, "Volume": 500 + np.arange(30)},
+        index=idx,
+    )
+
+
+def test_fetch_daily_writes_versioned_cache(tmp_path):
+    df = fetch_daily(["A", "B"], data_dir=str(tmp_path), fetcher=fake_daily_vendor)
+    assert set(df.ticker) == {"A", "B"}
+    assert len(df) == 80
+    assert list(df.columns) == [
+        "date", "ticker", "open", "high", "low", "close", "adj_close", "volume"
+    ]
+    p = cache_path(str(tmp_path), "A", "daily")
+    first = open(p).readline()
+    assert CACHE_VERSION in first
+
+
+def test_cache_roundtrip_identical(tmp_path):
+    """A cache written by this fetcher always re-reads to the same frame —
+    the §2.1.1 bug class (write-ok/read-zero) is structurally excluded."""
+    df1 = fetch_daily(["A"], data_dir=str(tmp_path), fetcher=fake_daily_vendor)
+
+    def exploding(t, s, e):
+        raise AssertionError("network must not be touched on cache hit")
+
+    df2 = fetch_daily(["A"], data_dir=str(tmp_path), fetcher=exploding)
+    pd.testing.assert_frame_equal(df1, df2)
+
+
+def test_force_refresh_busts_cache(tmp_path):
+    fetch_daily(["A"], data_dir=str(tmp_path), fetcher=fake_daily_vendor)
+    calls = []
+
+    def counting(t, s, e):
+        calls.append(t)
+        return fake_daily_vendor(t, s, e)
+
+    fetch_daily(["A"], data_dir=str(tmp_path), force_refresh=True, fetcher=counting)
+    assert calls == ["A"]
+
+
+def test_per_ticker_fault_isolation(tmp_path):
+    """One failing ticker is skipped with a warning, not fatal
+    (data_io.py:173-175 behaviour)."""
+
+    def flaky(t, s, e):
+        if t == "BAD":
+            raise ConnectionError("boom")
+        return fake_daily_vendor(t, s, e)
+
+    df = fetch_daily(["A", "BAD", "B"], data_dir=str(tmp_path), fetcher=flaky)
+    assert set(df.ticker) == {"A", "B"}
+
+
+def test_empty_universe_returns_schema_frame(tmp_path):
+    df = fetch_daily([], data_dir=str(tmp_path))
+    assert len(df) == 0
+    assert "adj_close" in df.columns
+
+
+def test_corrupt_cache_is_loud_not_silent(tmp_path):
+    p = cache_path(str(tmp_path), "A", "daily")
+    with open(p, "w") as f:
+        f.write("garbage,header\nonly,junk\n")
+    # per-ticker isolation turns the raise into a skip-with-warning;
+    # the ticker must NOT come back with silently-zero rows
+    df = fetch_daily(["A"], data_dir=str(tmp_path), fetcher=None)
+    assert len(df) == 0
+
+
+def test_fetch_intraday_roundtrip(tmp_path):
+    df = fetch_intraday(["A"], data_dir=str(tmp_path), fetcher=fake_intraday_vendor)
+    assert list(df.columns) == ["datetime", "ticker", "price", "volume"]
+    assert len(df) == 30
+    df2 = fetch_intraday(["A"], data_dir=str(tmp_path),
+                         fetcher=lambda *a: (_ for _ in ()).throw(AssertionError()))
+    pd.testing.assert_frame_equal(df, df2)
+
+
+@requires_reference
+def test_reference_caches_are_valid_cache_hits(tmp_path):
+    """The reference's shipped data/ dir (both dialects) is directly usable
+    as a cache directory — including AAPL's dialect-B file."""
+    df = fetch_daily(["AAPL", "AMD"], data_dir=REFERENCE_DATA,
+                     fetcher=lambda *a: (_ for _ in ()).throw(AssertionError()))
+    assert (df.ticker == "AAPL").sum() > 1700
+    assert (df.ticker == "AMD").sum() > 1700
+
+
+def test_get_shares_info_injection_and_isolation():
+    def info(t):
+        if t == "BAD":
+            raise KeyError("no info")
+        return {"sharesOutstanding": 1000, "marketCap": 5000}
+
+    out = get_shares_info(["A", "BAD"], info_fn=info)
+    assert out["A"] == {"shares_outstanding": 1000, "market_cap": 5000}
+    assert out["BAD"] == {"shares_outstanding": None, "market_cap": None}
+
+
+def test_multiindex_vendor_columns(tmp_path):
+    """Modern yfinance returns MultiIndex (field, ticker) columns."""
+
+    def mi_vendor(t, s, e):
+        df = fake_daily_vendor(t, s, e)
+        df.columns = pd.MultiIndex.from_product([df.columns, [t]])
+        return df
+
+    df = fetch_daily(["A"], data_dir=str(tmp_path), fetcher=mi_vendor)
+    assert len(df) == 40
+    assert df["adj_close"].notna().all()
