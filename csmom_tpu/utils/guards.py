@@ -1,0 +1,66 @@
+"""Numerical guards (SURVEY §5: the TPU-native stand-in for sanitizers).
+
+The reference's failure mode is silent row-dropping; a dense-panel engine's
+failure mode is silent NaN/Inf propagation.  Two tools:
+
+- ``validate_panel`` — host-side ingest gate: mask/value consistency, no
+  Inf, monotone time axis.  Bad *assets* are maskable (fault isolation at
+  universe level); a malformed panel raises.
+- ``checked(fn)`` — ``jax.experimental.checkify`` wrapper adding float
+  (NaN/Inf) and index OOB checks inside a jitted kernel; returns
+  ``(err, out)`` with ``err.throw()`` re-raising on the host.  Used in
+  tests and debug runs; production paths run the unchecked kernel (checkify
+  inserts real ops, so it is opt-in by construction).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from csmom_tpu.utils.logging import get_logger
+
+log = get_logger("guards")
+
+
+def validate_panel(values, mask, times=None, name: str = "panel") -> None:
+    """Raise ValueError on structural problems; warn on maskable ones.
+
+    Checks: shapes match; no +-Inf anywhere; no non-finite value where
+    mask=True (NaN under mask is the convention, NaN *over* mask poisons
+    reductions); times (if given) strictly increasing and length-matched.
+    """
+    values = np.asarray(values)
+    mask = np.asarray(mask)
+    if values.shape != mask.shape:
+        raise ValueError(f"{name}: values{values.shape} vs mask{mask.shape}")
+    if np.isinf(values).any():
+        raise ValueError(f"{name}: contains Inf (corrupt ingest?)")
+    bad = mask & ~np.isfinite(values)
+    if bad.any():
+        a_bad = np.unique(np.nonzero(bad)[0])
+        raise ValueError(
+            f"{name}: {int(bad.sum())} masked-valid slots hold non-finite "
+            f"values (asset rows {a_bad[:10].tolist()}...)"
+        )
+    if times is not None:
+        times = np.asarray(times)
+        if len(times) != values.shape[-1]:
+            raise ValueError(f"{name}: {len(times)} times vs T={values.shape[-1]}")
+        if len(times) > 1 and not (times[1:] > times[:-1]).all():
+            raise ValueError(f"{name}: time axis not strictly increasing")
+    dead = ~mask.any(axis=-1)
+    if dead.any():
+        log.warning("%s: %d asset(s) fully masked (dead lanes)", name, int(dead.sum()))
+
+
+def checked(fn, errors=None):
+    """Wrap ``fn`` with checkify float+index error tracking.
+
+    Returns a function ``g(*args) -> (err, out)``; call ``err.throw()`` to
+    surface the first failed check as a Python exception.
+    """
+    from jax.experimental import checkify
+
+    if errors is None:
+        errors = checkify.float_checks | checkify.index_checks
+    return checkify.checkify(fn, errors=errors)

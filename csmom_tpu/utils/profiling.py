@@ -1,0 +1,57 @@
+"""Tracing / profiling utilities (SURVEY §5: absent in the reference).
+
+Two layers, both zero-cost when unused:
+
+- ``wall(fn, *args)`` — wall-clock a compiled call correctly: JAX dispatch
+  is async, so a naive ``time.time()`` pair measures only the enqueue;
+  every timing here closes over ``block_until_ready``.  This is the timing
+  discipline behind every number in BASELINE.md / bench.py.
+- ``trace(label, out_dir=...)`` — a context manager that wraps
+  ``jax.profiler.trace`` (Perfetto/XPlane dump viewable in Perfetto or
+  TensorBoard) when given a directory, and always logs the wall time of the
+  block under its label.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+from csmom_tpu.utils.logging import get_logger
+
+log = get_logger("profiling")
+
+
+def wall(fn, *args, warmup: int = 0, **kwargs):
+    """Execute ``fn(*args, **kwargs)``, blocking on all outputs; return
+    ``(result, seconds)``.  ``warmup`` extra untimed calls first (compile +
+    cache effects)."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args, **kwargs))
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - t0
+
+
+@contextlib.contextmanager
+def trace(label: str, out_dir: str | None = None):
+    """Time (and optionally profile) a block.
+
+    With ``out_dir``, wraps the block in ``jax.profiler.trace`` producing a
+    Perfetto-compatible dump; without, it is just a labelled wall timer.
+    NOTE: ops dispatched inside the block are only awaited if the caller
+    blocks; for exact kernel walls use :func:`wall`.
+    """
+    ctx = (
+        jax.profiler.trace(out_dir, create_perfetto_trace=True)
+        if out_dir
+        else contextlib.nullcontext()
+    )
+    t0 = time.perf_counter()
+    with ctx:
+        yield
+    dt = time.perf_counter() - t0
+    log.info("%s: %.4fs%s", label, dt, f" (trace -> {out_dir})" if out_dir else "")

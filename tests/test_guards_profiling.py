@@ -1,0 +1,72 @@
+"""Guards + profiling utilities."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from csmom_tpu.utils import wall, trace, validate_panel, checked
+
+
+def test_wall_blocks_and_times():
+    x = jnp.ones((256, 256))
+    out, dt = wall(lambda a: a @ a, x, warmup=1)
+    assert out.shape == (256, 256)
+    assert dt >= 0
+
+
+def test_trace_context_logs(capsys):
+    with trace("unit-test-block"):
+        _ = jnp.arange(10).sum()
+    assert "unit-test-block" in capsys.readouterr().err
+
+
+def test_validate_panel_ok():
+    v = np.array([[1.0, np.nan], [2.0, 3.0]])
+    m = np.isfinite(v)
+    validate_panel(v, m, times=np.array([1, 2]))
+
+
+def test_validate_panel_shape_mismatch():
+    with pytest.raises(ValueError, match="vs mask"):
+        validate_panel(np.ones((2, 3)), np.ones((2, 2), bool))
+
+
+def test_validate_panel_inf():
+    v = np.array([[1.0, np.inf]])
+    with pytest.raises(ValueError, match="Inf"):
+        validate_panel(v, np.isfinite(v))
+
+
+def test_validate_panel_nan_under_valid_mask():
+    v = np.array([[1.0, np.nan]])
+    m = np.array([[True, True]])
+    with pytest.raises(ValueError, match="non-finite"):
+        validate_panel(v, m)
+
+
+def test_validate_panel_bad_times():
+    v = np.ones((1, 3))
+    with pytest.raises(ValueError, match="increasing"):
+        validate_panel(v, np.ones((1, 3), bool), times=np.array([3, 2, 1]))
+
+
+def test_validate_panel_dead_lane_warns(capsys):
+    v = np.full((2, 2), np.nan)
+    v[0] = 1.0
+    validate_panel(v, np.isfinite(v))
+    assert "fully masked" in capsys.readouterr().err
+
+
+def test_checked_catches_nan():
+    import jax
+
+    def div(a, b):
+        return a / b
+
+    g = jax.jit(checked(div))
+    err, out = g(jnp.float32(1.0), jnp.float32(0.0))
+    with pytest.raises(Exception):
+        err.throw()
+    err2, out2 = g(jnp.float32(1.0), jnp.float32(2.0))
+    err2.throw()  # no error
+    assert float(out2) == 0.5
