@@ -1,5 +1,8 @@
 """Guards + profiling utilities."""
 
+import contextlib
+import logging
+
 import numpy as np
 import jax.numpy as jnp
 import pytest
@@ -14,10 +17,31 @@ def test_wall_blocks_and_times():
     assert dt >= 0
 
 
-def test_trace_context_logs(capsys):
-    with trace("unit-test-block"):
-        _ = jnp.arange(10).sum()
-    assert "unit-test-block" in capsys.readouterr().err
+class _ListHandler(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.msgs = []
+
+    def emit(self, record):
+        self.msgs.append(record.getMessage())
+
+
+@contextlib.contextmanager
+def _captured_logs():
+    h = _ListHandler()
+    root = logging.getLogger("csmom_tpu")
+    root.addHandler(h)
+    try:
+        yield h.msgs
+    finally:
+        root.removeHandler(h)
+
+
+def test_trace_context_logs():
+    with _captured_logs() as msgs:
+        with trace("unit-test-block"):
+            _ = jnp.arange(10).sum()
+    assert any("unit-test-block" in m for m in msgs)
 
 
 def test_validate_panel_ok():
@@ -50,11 +74,12 @@ def test_validate_panel_bad_times():
         validate_panel(v, np.ones((1, 3), bool), times=np.array([3, 2, 1]))
 
 
-def test_validate_panel_dead_lane_warns(capsys):
+def test_validate_panel_dead_lane_warns():
     v = np.full((2, 2), np.nan)
     v[0] = 1.0
-    validate_panel(v, np.isfinite(v))
-    assert "fully masked" in capsys.readouterr().err
+    with _captured_logs() as msgs:
+        validate_panel(v, np.isfinite(v))
+    assert any("fully masked" in m for m in msgs)
 
 
 def test_checked_catches_nan():
