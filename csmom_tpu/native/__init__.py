@@ -1,0 +1,103 @@
+"""Native runtime components (C++ via ctypes).
+
+The compute path is JAX/XLA; the runtime around it — here, the CSV ingest
+hot loop — is native C++ (``fastcsv.cpp``), compiled on first use with the
+system toolchain into a per-version cached shared object and bound through
+``ctypes`` (this image ships no pybind11).  Every native entry point has a
+pure-Python fallback, so the package works even without a compiler;
+``parse_price_csv_native`` returns None in that case and callers fall back.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+from csmom_tpu.utils.logging import get_logger
+
+log = get_logger("native")
+
+_SRC = os.path.join(os.path.dirname(__file__), "fastcsv.cpp")
+_LIB = None
+_LIB_FAILED = False
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("CSMOM_NATIVE_CACHE") or os.path.join(
+        tempfile.gettempdir(), "csmom_native"
+    )
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def _build() -> str | None:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f"fastcsv_{tag}.so")
+    if os.path.exists(out):
+        return out
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", out]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception as e:  # no compiler / failed build -> Python fallback
+        log.warning("native build failed (%s); using Python ingest fallback", e)
+        return None
+    return out
+
+
+def get_lib():
+    """Load (building if needed) the native library; None when unavailable."""
+    global _LIB, _LIB_FAILED
+    if _LIB is not None or _LIB_FAILED:
+        return _LIB
+    path = _build()
+    if path is None:
+        _LIB_FAILED = True
+        return None
+    lib = ctypes.CDLL(path)
+    lib.fastcsv_count_rows.argtypes = [ctypes.c_char_p]
+    lib.fastcsv_count_rows.restype = ctypes.c_longlong
+    lib.fastcsv_parse.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_longlong,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.fastcsv_parse.restype = ctypes.c_longlong
+    _LIB = lib
+    return _LIB
+
+
+def parse_price_csv_native(path: str, n_cols: int):
+    """Parse a price CSV's data rows natively.
+
+    Returns ``(epoch_ns i64[R], values f64[R, n_cols])`` or None when the
+    native library is unavailable (callers use the pandas path then).
+    Preamble/junk rows (both reference cache dialects) are skipped by the
+    same first-cell-is-a-date rule as ``panel.ingest.read_price_csv``.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    cap = lib.fastcsv_count_rows(path.encode())
+    if cap < 0:
+        raise FileNotFoundError(path)
+    cap = max(int(cap), 1)
+    epochs = np.empty(cap, dtype=np.int64)
+    values = np.empty((cap, n_cols), dtype=np.float64)
+    rows = lib.fastcsv_parse(
+        path.encode(),
+        cap,
+        n_cols,
+        epochs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    if rows < 0:
+        raise OSError(f"native parse failed for {path}")
+    return epochs[:rows], values[:rows]

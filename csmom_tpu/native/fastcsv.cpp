@@ -1,0 +1,189 @@
+// Fast CSV price-bar parser (native runtime component).
+//
+// The reference's ingest is pandas read_csv + defensive renaming
+// (/root/reference/src/data_io.py:23-129).  This parser covers the hot
+// ingest path of the rebuild — fixed-layout price CSVs (a timestamp first
+// column, numeric columns after) in either cache dialect — in a single
+// pass with zero Python-object churn, feeding numpy buffers directly.
+//
+// Contract (mirrors panel/ingest.py::read_price_csv semantics):
+//   - rows whose first cell does not start with a digit are preamble/junk
+//     and are skipped (dialect A junk ticker row, dialect B Ticker/Date
+//     rows, the header itself);
+//   - timestamps: "YYYY-MM-DD", optionally " HH:MM[:SS]", optionally a
+//     "+HH:MM"/"-HH:MM" UTC offset (normalized to UTC) — the formats
+//     yfinance caches actually contain;
+//   - empty/unparseable numeric cells become NaN;
+//   - short rows are padded with NaN, long rows truncated to n_cols.
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this image).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+// days from civil date to days since 1970-01-01 (Howard Hinnant's algorithm)
+inline int64_t days_from_civil(int y, int m, int d) {
+    y -= m <= 2;
+    const int era_base = (y >= 0 ? y : y - 399) / 400;
+    const unsigned yoe = static_cast<unsigned>(y - era_base * 400);
+    const unsigned doy = (153u * (m + (m > 2 ? -3 : 9)) + 2u) / 5u + d - 1u;
+    const unsigned doe = yoe * 365u + yoe / 4u - yoe / 100u + doy;
+    return static_cast<int64_t>(era_base) * 146097 + static_cast<int64_t>(doe) - 719468;
+}
+
+// parse up to `width` digits; returns -1 on non-digit
+inline int parse_digits(const char*& p, const char* end, int width) {
+    int v = 0, n = 0;
+    while (p < end && n < width && *p >= '0' && *p <= '9') {
+        v = v * 10 + (*p - '0');
+        ++p;
+        ++n;
+    }
+    return n ? v : -1;
+}
+
+// timestamp cell -> epoch nanoseconds (UTC); returns false if not a date
+bool parse_timestamp(const char* s, const char* end, int64_t* out_ns) {
+    const char* p = s;
+    int y = parse_digits(p, end, 4);
+    if (y < 1000 || p >= end || *p != '-') return false;
+    ++p;
+    int mo = parse_digits(p, end, 2);
+    if (mo < 1 || mo > 12 || p >= end || *p != '-') return false;
+    ++p;
+    int d = parse_digits(p, end, 2);
+    if (d < 1 || d > 31) return false;
+
+    int64_t sec = days_from_civil(y, mo, d) * 86400;
+    if (p < end && (*p == ' ' || *p == 'T')) {
+        ++p;
+        int hh = parse_digits(p, end, 2);
+        if (hh < 0 || p >= end || *p != ':') return false;
+        ++p;
+        int mi = parse_digits(p, end, 2);
+        if (mi < 0) return false;
+        int ss = 0;
+        if (p < end && *p == ':') {
+            ++p;
+            ss = parse_digits(p, end, 2);
+            if (ss < 0) return false;
+        }
+        sec += hh * 3600 + mi * 60 + ss;
+        // fractional seconds: skip
+        if (p < end && *p == '.') {
+            ++p;
+            while (p < end && *p >= '0' && *p <= '9') ++p;
+        }
+        // UTC offset
+        if (p < end && (*p == '+' || *p == '-')) {
+            int sign = (*p == '-') ? -1 : 1;
+            ++p;
+            int oh = parse_digits(p, end, 2);
+            int om = 0;
+            if (p < end && *p == ':') {
+                ++p;
+                om = parse_digits(p, end, 2);
+            }
+            if (oh >= 0) sec -= sign * (oh * 3600 + om * 60);
+        }
+    }
+    *out_ns = sec * 1000000000LL;
+    return true;
+}
+
+// one numeric cell [s, end) -> double (NaN on empty/garbage)
+inline double parse_cell(const char* s, const char* end) {
+    while (s < end && (*s == ' ' || *s == '"')) ++s;
+    while (end > s && (end[-1] == ' ' || end[-1] == '"' || end[-1] == '\r')) --end;
+    if (s >= end) return NAN;
+    char buf[64];
+    size_t n = static_cast<size_t>(end - s);
+    if (n >= sizeof(buf)) return NAN;
+    memcpy(buf, s, n);
+    buf[n] = '\0';
+    char* q = nullptr;
+    double v = strtod(buf, &q);
+    if (q == buf) return NAN;
+    return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Upper bound on data rows (= newline count); -1 if the file can't be read.
+long long fastcsv_count_rows(const char* path) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    long long lines = 0;
+    char buf[1 << 16];
+    size_t got;
+    while ((got = fread(buf, 1, sizeof(buf), f)) > 0)
+        for (size_t i = 0; i < got; ++i)
+            if (buf[i] == '\n') ++lines;
+    fclose(f);
+    return lines + 1;
+}
+
+// Parse `path` into epoch_ns[max_rows] and values[max_rows * n_cols]
+// (row-major).  Returns the number of data rows written, or -1 on I/O
+// error.  Preamble rows (first cell not starting with a digit) and '#'
+// comment lines are skipped.
+long long fastcsv_parse(const char* path, long long max_rows, int n_cols,
+                        int64_t* epoch_ns, double* values) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    fseek(f, 0, SEEK_END);
+    long sz = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    char* data = static_cast<char*>(malloc(static_cast<size_t>(sz) + 1));
+    if (!data) {
+        fclose(f);
+        return -1;
+    }
+    size_t got = fread(data, 1, static_cast<size_t>(sz), f);
+    fclose(f);
+    data[got] = '\0';
+
+    long long rows = 0;
+    const char* p = data;
+    const char* file_end = data + got;
+    while (p < file_end && rows < max_rows) {
+        const char* line_end = static_cast<const char*>(memchr(p, '\n', file_end - p));
+        if (!line_end) line_end = file_end;
+
+        if (p < line_end && *p != '#') {
+            const char* cell_end =
+                static_cast<const char*>(memchr(p, ',', line_end - p));
+            if (!cell_end) cell_end = line_end;
+            int64_t ns;
+            if (*p >= '0' && *p <= '9' && parse_timestamp(p, cell_end, &ns)) {
+                epoch_ns[rows] = ns;
+                double* row = values + rows * n_cols;
+                const char* q = (cell_end < line_end) ? cell_end + 1 : line_end;
+                for (int c = 0; c < n_cols; ++c) {
+                    if (q > line_end) {
+                        row[c] = NAN;
+                        continue;
+                    }
+                    const char* next =
+                        static_cast<const char*>(memchr(q, ',', line_end - q));
+                    if (!next) next = line_end;
+                    row[c] = parse_cell(q, next);
+                    q = next + 1;
+                }
+                ++rows;
+            }
+        }
+        p = line_end + 1;
+    }
+    free(data);
+    return rows;
+}
+
+}  // extern "C"

@@ -69,14 +69,26 @@ def _strip_preamble(raw: pd.DataFrame) -> pd.DataFrame:
     return raw.iloc[keep_from:]
 
 
-def read_price_csv(path: str, ticker: str, kind: str = "daily") -> pd.DataFrame:
+def read_price_csv(path: str, ticker: str, kind: str = "daily",
+                   engine: str = "auto") -> pd.DataFrame:
     """Read one cached CSV (either dialect) into the canonical long schema.
 
     Unlike the reference's ``_normalize_daily_columns`` (``data_io.py:23-73``),
     the timestamp is always taken from the *first column* once the preamble is
     stripped — which is what both dialects actually put there — rather than
     from a column literally named ``Date``.
+
+    ``engine``: 'auto' (native C++ parser when available, else pandas),
+    'native' (require the C++ parser), or 'pandas'.  Both engines produce
+    identical frames (pinned by tests/test_native.py).
     """
+    if engine in ("auto", "native"):
+        out = _read_native(path, ticker, kind)
+        if out is not None:
+            return out
+        if engine == "native":
+            raise RuntimeError("native CSV engine unavailable (no compiler?)")
+
     raw = pd.read_csv(path, low_memory=False, dtype=str)
     cols = [str(c).strip() for c in raw.columns]
     body = _strip_preamble(raw)
@@ -93,6 +105,11 @@ def read_price_csv(path: str, ticker: str, kind: str = "daily") -> pd.DataFrame:
         if canon and pos > 0:
             out[canon] = pd.to_numeric(body.iloc[:, pos], errors="coerce")
 
+    return _canonize(out, kind, ticker)
+
+
+def _canonize(out: pd.DataFrame, kind: str, ticker: str) -> pd.DataFrame:
+    """Shared schema tail for both CSV engines."""
     if kind == "daily":
         if "adj_close" not in out:
             # dialect B ships no Adj Close; yfinance's Close there is already
@@ -110,12 +127,53 @@ def read_price_csv(path: str, ticker: str, kind: str = "daily") -> pd.DataFrame:
     return _finalize(out, INTRADAY_SCHEMA, "datetime", ticker)
 
 
+def _read_native(path: str, ticker: str, kind: str) -> pd.DataFrame | None:
+    """C++ fast path: header sniffed host-side, data rows parsed natively.
+
+    Returns None when the native library can't be built/loaded so the
+    caller falls back to pandas.
+    """
+    from csmom_tpu.native import parse_price_csv_native
+
+    try:
+        with open(path, "r") as f:
+            header = f.readline()
+            if header.startswith("#"):  # versioned fetch-cache marker line
+                header = f.readline()
+    except OSError:
+        return None
+    cols = [c.strip() for c in header.rstrip("\r\n").split(",")]
+    if len(cols) < 2:
+        return None
+    try:
+        parsed = parse_price_csv_native(path, len(cols) - 1)
+    except Exception as e:  # pragma: no cover - defensive
+        log.warning("native parse failed for %s (%r); pandas fallback", path, e)
+        return None
+    if parsed is None:
+        return None
+    epochs, values = parsed
+
+    time_col = "date" if kind == "daily" else "datetime"
+    out = pd.DataFrame({time_col: pd.to_datetime(epochs, unit="ns")})
+    for pos, col in enumerate(cols):
+        canon = _FIELD_ALIASES.get(col.lower())
+        if canon and pos > 0:
+            out[canon] = values[:, pos - 1]
+    return _canonize(out, kind, ticker)
+
+
 def _finalize(out: pd.DataFrame, schema, time_col: str, ticker: str) -> pd.DataFrame:
     for c in schema:
         if c not in out:
             out[c] = np.nan
     out["ticker"] = ticker
     out = out.dropna(subset=[time_col])
+    # uniform engine-independent dtypes: ns timestamps, f64 numerics
+    out[time_col] = out[time_col].astype("datetime64[ns]")
+    for c in schema:
+        if c not in (time_col, "ticker"):
+            out[c] = out[c].astype(np.float64)
     return out[schema].reset_index(drop=True)
 
 
