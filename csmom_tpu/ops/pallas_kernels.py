@@ -1,0 +1,99 @@
+"""Pallas TPU kernels for the portfolio-aggregation hot op.
+
+The XLA implementation of :func:`csmom_tpu.backtest.monthly.
+decile_partial_sums` materializes a one-hot membership tensor
+``[B, A, M]`` (10x the panel) before reducing over assets.  XLA usually
+fuses it, but at north-star scale (A=3000, M=720, B=10, and x16 grid cells
+under vmap) the fusion boundary with the surrounding roll/where ops is
+fragile.  This kernel computes the same ``(sums, counts)`` with an explicit
+tiling: stream ``[block_a, block_t]`` tiles of (labels, returns) through
+VMEM once, accumulate all B bins into a resident ``[B, block_t]`` output
+tile — O(A*M) HBM traffic, no [B, A, M] intermediate ever exists.
+
+Contract (same as the XLA version):
+  labels i32[A, M] with -1 meaning "not a member of any bin" (invalid lanes
+  are pre-folded into -1 by the caller); ret f32[A, M] pre-zeroed at
+  invalid slots.  Returns (sums f32[B, M], counts f32[B, M]).
+
+The asset axis is the *last* grid dimension, so consecutive grid steps
+revisit the same output tile (sequential TPU grid), which makes the
+accumulate-across-tiles pattern valid; the first asset-tile initializes.
+``interpret=True`` runs the same kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(lab_ref, ret_ref, sums_ref, counts_ref, *, n_bins: int):
+    a_tile = pl.program_id(1)
+
+    @pl.when(a_tile == 0)
+    def _init():
+        sums_ref[...] = jnp.zeros_like(sums_ref)
+        counts_ref[...] = jnp.zeros_like(counts_ref)
+
+    lab = lab_ref[...]
+    ret = ret_ref[...]
+    for b in range(n_bins):  # static unroll: B rows of the resident tile
+        mem = (lab == b).astype(ret.dtype)
+        sums_ref[b, :] += jnp.sum(ret * mem, axis=0)
+        counts_ref[b, :] += jnp.sum(mem, axis=0)
+
+
+@partial(jax.jit, static_argnames=("n_bins", "block_a", "block_t", "interpret"))
+def decile_partial_sums_pallas(
+    ret,
+    labels,
+    n_bins: int = 10,
+    block_a: int = 256,
+    block_t: int = 128,
+    interpret: bool = False,
+):
+    """Fused per-(bin, date) sums/counts over the asset axis.
+
+    Args:
+      ret: f[A, M] next-period returns, zeroed where invalid.
+      labels: i32[A, M] bin ids, -1 where unranked/invalid.
+      n_bins: number of bins B.
+      block_a/block_t: VMEM tile sizes (asset x time).
+      interpret: run in pallas interpreter mode (CPU tests).
+
+    Returns (sums f[B, M], counts f[B, M]) with counts in ret's dtype.
+    """
+    A, M = ret.shape
+    dt = ret.dtype
+    block_a = min(block_a, max(A, 8))
+    block_t = min(block_t, max(M, 128))
+    pad_a = (-A) % block_a
+    pad_t = (-M) % block_t
+    if pad_a or pad_t:
+        # padded lanes carry label -1 / ret 0 -> contribute to no bin
+        labels = jnp.pad(labels, ((0, pad_a), (0, pad_t)), constant_values=-1)
+        ret = jnp.pad(ret, ((0, pad_a), (0, pad_t)))
+    Ap, Mp = ret.shape
+
+    grid = (Mp // block_t, Ap // block_a)
+    sums, counts = pl.pallas_call(
+        partial(_kernel, n_bins=n_bins),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_a, block_t), lambda t, a: (a, t)),
+            pl.BlockSpec((block_a, block_t), lambda t, a: (a, t)),
+        ],
+        out_specs=[
+            pl.BlockSpec((n_bins, block_t), lambda t, a: (0, t)),
+            pl.BlockSpec((n_bins, block_t), lambda t, a: (0, t)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_bins, Mp), dt),
+            jax.ShapeDtypeStruct((n_bins, Mp), dt),
+        ],
+        interpret=interpret,
+    )(labels, ret)
+    return sums[:, :M], counts[:, :M]
