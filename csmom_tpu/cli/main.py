@@ -67,6 +67,22 @@ def cmd_replicate(args) -> int:
     print(f"Annualized Sharpe:   {rep.ann_sharpe:.4f}")
     print(f"t-stat:              {rep.tstat:.3f}")
 
+    if getattr(args, "bootstrap", None):
+        import jax
+        import numpy as np
+
+        from csmom_tpu.analytics import block_bootstrap
+
+        bs = block_bootstrap(
+            rep.spread, np.isfinite(rep.spread), jax.random.PRNGKey(0),
+            n_samples=args.bootstrap, block_len=args.block_len or 6,
+        )
+        mlo, mhi = np.asarray(bs.mean_ci)
+        slo, shi = np.asarray(bs.sharpe_ci)
+        print(f"95% CI mean:         [{mlo:.6f}, {mhi:.6f}]  "
+              f"({args.bootstrap} block-bootstrap resamples)")
+        print(f"95% CI Sharpe:       [{slo:.4f}, {shi:.4f}]")
+
     from csmom_tpu.analytics.plots import save_monthly_cum_plot
 
     out = save_monthly_cum_plot(prices.times, rep.spread, cfg.results_dir)
@@ -210,8 +226,8 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command")
 
     for name, fn, extra in (
-        ("run", cmd_run, ()),
-        ("replicate", cmd_replicate, ()),
+        ("run", cmd_run, ("bootstrap",)),
+        ("replicate", cmd_replicate, ("bootstrap",)),
         ("grid", cmd_grid, ("js", "ks")),
         ("sweep", cmd_sweep, ("js", "ks", "min_months")),
         ("intraday", cmd_intraday, ()),
@@ -224,6 +240,10 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--ks", help="comma-separated K values")
         if "min_months" in extra:
             sp.add_argument("--min-months", dest="min_months", type=int)
+        if "bootstrap" in extra:
+            sp.add_argument("--bootstrap", type=int, metavar="N",
+                            help="print block-bootstrap 95%% CIs from N resamples")
+            sp.add_argument("--block-len", dest="block_len", type=int)
         sp.set_defaults(fn=fn)
     return p
 

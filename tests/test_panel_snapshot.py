@@ -1,0 +1,37 @@
+"""Panel snapshot save/load (checkpoint/resume, SURVEY §5)."""
+
+import numpy as np
+import pytest
+
+from csmom_tpu.panel.panel import Panel
+
+
+def _panel(rng):
+    v = rng.normal(size=(5, 8))
+    v[0, :3] = np.nan
+    times = np.array([np.datetime64("2020-01-31") + 31 * i for i in range(8)])
+    return Panel.from_dense(v, [f"T{i}" for i in range(5)], times, name="px")
+
+
+def test_roundtrip_exact(tmp_path, rng):
+    p = _panel(rng)
+    path = str(tmp_path / "snap.npz")
+    p.save(path)
+    q = Panel.load(path)
+    np.testing.assert_array_equal(p.values, q.values)
+    np.testing.assert_array_equal(p.mask, q.mask)
+    assert p.tickers == q.tickers
+    np.testing.assert_array_equal(p.times, q.times)
+    assert p.name == q.name
+
+
+def test_future_version_is_loud(tmp_path, rng):
+    p = _panel(rng)
+    path = str(tmp_path / "snap.npz")
+    p.save(path)
+    with np.load(path, allow_pickle=True) as z:
+        data = {k: z[k] for k in z.files}
+    data["__version__"] = np.int64(99)
+    np.savez_compressed(path, **data)
+    with pytest.raises(ValueError, match="version 99"):
+        Panel.load(path)
