@@ -8,17 +8,20 @@ framework represents market data as dense **masked panels** — ``f32[A, T]``
 arrays (assets x time) resident in accelerator HBM — and expresses all
 strategy logic as pure, jit-compiled functions over those panels:
 
-- ``panel``     ingest (CSV dialect repair, calendar alignment), Panel container
-- ``ops``       masked rolling windows, scans, cross-sectional ranking kernels
+- ``panel``     ingest (CSV dialect repair, calendar alignment), Panel container,
+                cache-first fetch layer, synthetic generators
+- ``ops``       masked rolling windows, cross-sectional ranking (exact
+                pandas-qcut parity + fast rank mode), Pallas TPU kernels
 - ``signals``   momentum (J, skip), turnover, intraday minute features
-- ``ranking``   decile assignment (exact pandas-qcut parity + fast rank mode)
 - ``models``    closed-form ridge regression with expanding-window time-series CV
 - ``costs``     square-root market impact, spread, fill models
-- ``backtest``  vectorized monthly decile engine, J x K grid, event-driven engine
-- ``analytics`` sharpe, t-stats, decile tables, results schemas
+- ``backtest``  vectorized monthly decile engine, J x K grid, double sort,
+                walk-forward sweep, event-driven engine
+- ``analytics`` sharpe, t-stats, block bootstrap, artifact writers
 - ``parallel``  device-mesh sharding (shard_map), distributed rank, collectives
-- ``strategy``  Strategy protocol; 'tpu' (JAX) and 'pandas' backends behind one API
-- ``cli``       run / replicate / grid / sweep commands
+- ``backends``  one API over the 'tpu' (JAX) and 'pandas' engines
+- ``native``    C++ runtime components (fast CSV parser via ctypes)
+- ``cli``       run / replicate / grid / sweep / intraday / bench commands
 - ``utils``     structured logging, profiling, error guards
 
 The parameter grid (J x K lookback/holding) is a ``vmap`` axis; the asset axis

@@ -60,7 +60,7 @@ class EventResult:
     net_notional: jnp.ndarray # f[] sum of signed fill notional
 
 
-@partial(jax.jit, static_argnames=("size_shares",))
+@partial(jax.jit, static_argnames=("size_shares", "latency_bars"))
 def event_backtest(
     price,
     valid,
@@ -71,6 +71,7 @@ def event_backtest(
     threshold: float = 1e-5,
     cash0: float = 1_000_000.0,
     spread: float = 0.001,
+    latency_bars: int = 0,
 ) -> EventResult:
     """Run the event backtest over a dense minute panel.
 
@@ -84,6 +85,13 @@ def event_backtest(
       vol: f[A] daily return volatility (fallbacks pre-applied).
       size_shares: fixed order size (run_demo.py:180 uses 50).
       threshold: trade when |score| > threshold, strictly.
+      latency_bars: order-to-fill delay in bars.  0 = same-bar fill, the
+        reference's (only) behaviour — it stores ``latency_ms`` but never
+        reads it (``backtester.py:8,14``, SURVEY §2.1.7).  With L > 0 an
+        order decided at row t executes at the asset's first event row
+        >= t+L, at *that* row's price (decision score, delayed execution);
+        orders with no remaining event row are dropped unfilled.  The trade
+        log keeps decision timestamps; positions/cash move at fill time.
     """
     A, T = price.shape
     dtype = price.dtype
@@ -97,19 +105,47 @@ def event_backtest(
     impact = square_root_impact(
         jnp.asarray(float(size_shares), dtype), adv.astype(dtype), vol.astype(dtype)
     )
+
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    if latency_bars > 0:
+        # first event row at or after t, per asset (reverse running min)
+        nxt = jax.lax.associative_scan(
+            jnp.minimum, jnp.where(valid, t_idx[None, :], T), axis=1, reverse=True
+        )
+        target = jnp.clip(t_idx + latency_bars, 0, T - 1)
+        fill_idx = nxt[:, target]                          # i32[A, T]
+        fillable = traded & (t_idx[None, :] + latency_bars <= T - 1) & (fill_idx < T)
+        side = jnp.where(fillable, side, 0)
+        traded = side != 0
+        fill_idx = jnp.clip(fill_idx, 0, T - 1)
+        exec_base = jnp.take_along_axis(jnp.nan_to_num(price), fill_idx, axis=1)
+    else:
+        fill_idx = jnp.broadcast_to(t_idx[None, :], (A, T))
+        exec_base = jnp.nan_to_num(price)
+
     fill = jnp.where(
         traded,
-        jnp.nan_to_num(price) * (1.0 + side * (spread / 2.0 + impact[:, None])),
+        exec_base * (1.0 + side * (spread / 2.0 + impact[:, None])),
         0.0,
     )
 
-    shares = side * size_shares                       # i32[A, T]
-    positions = jnp.cumsum(shares, axis=1)
-    flow = jnp.sum(fill * shares.astype(dtype), axis=0)   # signed notional per bar
+    shares = side * size_shares                       # i32[A, T] at decision rows
+    if latency_bars > 0:
+        # settle at fill time: scatter-add shares/notional onto fill rows
+        rows = jnp.arange(A)[:, None]
+        shares_settle = jnp.zeros((A, T), jnp.int32).at[rows, fill_idx].add(shares)
+        notional_settle = (
+            jnp.zeros((A, T), dtype).at[rows, fill_idx].add(fill * shares.astype(dtype))
+        )
+    else:
+        shares_settle = shares
+        notional_settle = fill * shares.astype(dtype)
+
+    positions = jnp.cumsum(shares_settle, axis=1)
+    flow = jnp.sum(notional_settle, axis=0)           # signed notional per bar
     cash = cash0 - jnp.cumsum(flow)
 
     # forward-filled mark price: last observed row price at or before t
-    t_idx = jnp.arange(T, dtype=jnp.int32)
     obs = jnp.where(valid, t_idx[None, :], -1)
     last_obs = jax.lax.associative_scan(jnp.maximum, obs, axis=1)
     mark = jnp.take_along_axis(

@@ -1,0 +1,117 @@
+"""Latency simulation in the event engine vs a numpy loop oracle.
+
+The reference stores ``latency_ms`` but never uses it (backtester.py:8,14,
+SURVEY §2.1.7); this extension makes the delay real: decision at row t,
+execution at the first event row >= t+L at that row's price.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from csmom_tpu.backtest.event import event_backtest
+from csmom_tpu.costs import market_fill
+
+
+def _workload(rng, a=6, t=40):
+    price = 50 + np.cumsum(rng.normal(0, 0.2, size=(a, t)), axis=1)
+    valid = rng.random((a, t)) > 0.3
+    price = np.where(valid, price, np.nan)
+    score = np.where(valid, rng.normal(0, 1e-3, size=(a, t)), 0.0)
+    adv = np.full(a, 1e5)
+    vol = np.full(a, 0.02)
+    return price, valid, score, adv, vol
+
+
+def oracle_latency(price, valid, score, adv, vol, L, size=50, thr=1e-5, cash0=1e6):
+    A, T = price.shape
+    fills = []  # (fill_t, a, side, exec_price)
+    for a in range(A):
+        for t in range(T):
+            if not valid[a, t]:
+                continue
+            s = score[a, t]
+            if not (s > thr or s < -thr):
+                continue
+            side = 1 if s > thr else -1
+            # first event row >= t+L
+            ft = None
+            if t + L <= T - 1:
+                for u in range(t + L, T):
+                    if valid[a, u]:
+                        ft = u
+                        break
+            if ft is None:
+                continue
+            ep, _ = market_fill(price[a, ft], size, adv[a], vol[a], side)
+            fills.append((ft, a, side, float(ep)))
+
+    positions = np.zeros((A, T), dtype=int)
+    notional = np.zeros(T)
+    for ft, a, side, ep in fills:
+        positions[a, ft:] += side * size
+        notional[ft] += ep * side * size
+    cash = cash0 - np.cumsum(notional)
+
+    last_price = np.full(A, np.nan)
+    pv = np.zeros(T)
+    for t in range(T):
+        for a in range(A):
+            if valid[a, t]:
+                last_price[a] = price[a, t]
+        marks = np.where(np.isfinite(last_price), last_price, 0.0)
+        pv[t] = cash[t] + (positions[:, t] * marks).sum()
+    return positions, cash, pv
+
+
+def test_latency_zero_unchanged(rng):
+    """latency_bars=0 must be byte-identical to the parity path."""
+    price, valid, score, adv, vol = _workload(rng)
+    base = event_backtest(jnp.asarray(price), jnp.asarray(valid),
+                          jnp.asarray(score), jnp.asarray(adv), jnp.asarray(vol))
+    lat0 = event_backtest(jnp.asarray(price), jnp.asarray(valid),
+                          jnp.asarray(score), jnp.asarray(adv), jnp.asarray(vol),
+                          latency_bars=0)
+    np.testing.assert_array_equal(np.asarray(base.positions), np.asarray(lat0.positions))
+    np.testing.assert_array_equal(np.asarray(base.cash), np.asarray(lat0.cash))
+    np.testing.assert_array_equal(np.asarray(base.pnl), np.asarray(lat0.pnl))
+
+
+def test_latency_matches_oracle(rng):
+    for L in (1, 3, 7):
+        price, valid, score, adv, vol = _workload(rng)
+        res = event_backtest(jnp.asarray(price), jnp.asarray(valid),
+                             jnp.asarray(score), jnp.asarray(adv), jnp.asarray(vol),
+                             latency_bars=L)
+        w_pos, w_cash, w_pv = oracle_latency(price, valid, score, adv, vol, L)
+        np.testing.assert_array_equal(np.asarray(res.positions), w_pos)
+        np.testing.assert_allclose(np.asarray(res.cash), w_cash, rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(res.portfolio_value), w_pv, rtol=1e-12)
+
+
+def test_late_orders_dropped(rng):
+    """Orders within the last L rows can never fill."""
+    price, valid, score, adv, vol = _workload(rng, a=3, t=12)
+    L = 100  # > T: nothing fills
+    res = event_backtest(jnp.asarray(price), jnp.asarray(valid),
+                         jnp.asarray(score), jnp.asarray(adv), jnp.asarray(vol),
+                         latency_bars=L)
+    assert int(res.n_trades) == 0
+    assert (np.asarray(res.positions) == 0).all()
+    np.testing.assert_allclose(np.asarray(res.cash), 1e6)
+
+
+def test_latency_costs_pnl_on_trend(rng):
+    """On a strongly trending tape with momentum-sign scores, delayed fills
+    execute at worse prices; realized cash spent on buys must be higher."""
+    a, t = 4, 60
+    price = 50 * np.exp(np.outer(np.ones(a), np.linspace(0, 0.2, t)))
+    valid = np.ones((a, t), dtype=bool)
+    score = np.full((a, t), 1e-3)  # always buy
+    adv = np.full(a, 1e5)
+    vol = np.full(a, 0.02)
+    r0 = event_backtest(jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+                        jnp.asarray(adv), jnp.asarray(vol), latency_bars=0)
+    r5 = event_backtest(jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+                        jnp.asarray(adv), jnp.asarray(vol), latency_bars=5)
+    # same number of shares bought per surviving order, later+pricier fills
+    assert float(r5.net_notional) / int(r5.n_trades) > float(r0.net_notional) / int(r0.n_trades)
