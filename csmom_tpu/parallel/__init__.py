@@ -21,10 +21,12 @@ from csmom_tpu.parallel.collectives import (
     sharded_monthly_spread_backtest,
     sharded_jk_grid_backtest,
 )
+from csmom_tpu.parallel.bootstrap import sharded_block_bootstrap
 
 __all__ = [
     "make_mesh",
     "auto_mesh",
     "sharded_monthly_spread_backtest",
     "sharded_jk_grid_backtest",
+    "sharded_block_bootstrap",
 ]

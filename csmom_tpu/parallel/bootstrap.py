@@ -1,0 +1,86 @@
+"""Sharded block bootstrap: the resample batch axis over the device mesh.
+
+SURVEY §2 row 14(c): bootstrap resamples are the framework's third
+parallelism axis (after assets and grid cells).  Resamples are
+embarrassingly parallel — each is an independent gather + reduction over the
+same T-month series — so the sample axis shards with **zero collectives**:
+each device draws its own slice of the sample axis locally (the same
+``circular_block_indices`` under a per-shard fold of the key would change
+draws, so the full index matrix is computed identically everywhere and each
+shard slices its rows), evaluates its resamples, and only the final
+percentile step gathers the S-vector of scalars (bytes, not panels).
+
+Equality with the single-device :func:`csmom_tpu.analytics.block_bootstrap`
+is pinned by tests on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from csmom_tpu.analytics.bootstrap import BootstrapResult, circular_block_indices
+from csmom_tpu.analytics.stats import masked_mean, sharpe
+
+
+def sharded_block_bootstrap(
+    returns,
+    valid,
+    key,
+    mesh,
+    n_samples: int = 1000,
+    block_len: int = 6,
+    freq: int = 12,
+    ci_level: float = 0.95,
+    axis_name: str = "assets",
+) -> BootstrapResult:
+    """Block bootstrap with the sample axis sharded over ``mesh[axis_name]``.
+
+    ``n_samples`` must divide by the mesh axis size.  Draws are identical to
+    the single-device path (same key -> same index matrix), so results match
+    :func:`csmom_tpu.analytics.block_bootstrap` exactly — the device count
+    changes wall-clock, never statistics.
+    """
+    n_shards = mesh.shape[axis_name]
+    if n_samples % n_shards:
+        raise ValueError(
+            f"n_samples={n_samples} not divisible by mesh axis "
+            f"{axis_name!r} size {n_shards}"
+        )
+    T = returns.shape[-1]
+    idx = circular_block_indices(key, n_samples, T, block_len)
+
+    @partial(jax.jit, static_argnames=())
+    def run(returns, valid, idx):
+        def local_fn(r, v, idx_l):
+            rs = r[0][idx_l]          # [S_local, T]
+            vs = v[0][idx_l]
+            return (
+                masked_mean(rs, vs)[None],
+                sharpe(rs, vs, freq_per_year=freq)[None],
+            )
+
+        spec_rep = P()
+        means_l, sharpes_l = shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(spec_rep, spec_rep, P(axis_name)),
+            out_specs=(P(None, axis_name), P(None, axis_name)),
+        )(returns[None, :], valid[None, :], idx)
+        return means_l[0], sharpes_l[0]
+
+    means, sharpes = run(jnp.asarray(returns), jnp.asarray(valid), idx)
+    alpha = (1.0 - ci_level) / 2.0
+    q = jnp.array([alpha, 1.0 - alpha])
+    return BootstrapResult(
+        mean_samples=means,
+        sharpe_samples=sharpes,
+        mean_point=masked_mean(jnp.asarray(returns), jnp.asarray(valid)),
+        sharpe_point=sharpe(jnp.asarray(returns), jnp.asarray(valid), freq_per_year=freq),
+        mean_ci=jnp.nanquantile(means, q),
+        sharpe_ci=jnp.nanquantile(sharpes, q),
+    )
