@@ -60,7 +60,7 @@ class EventResult:
     net_notional: jnp.ndarray # f[] sum of signed fill notional
 
 
-@partial(jax.jit, static_argnames=("size_shares", "latency_bars"))
+@partial(jax.jit, static_argnames=("size_shares", "latency_bars", "order_type"))
 def event_backtest(
     price,
     valid,
@@ -72,6 +72,9 @@ def event_backtest(
     cash0: float = 1_000_000.0,
     spread: float = 0.001,
     latency_bars: int = 0,
+    order_type: str = "market",
+    aggressiveness: float = 0.5,
+    fill_key=None,
 ) -> EventResult:
     """Run the event backtest over a dense minute panel.
 
@@ -92,6 +95,14 @@ def event_backtest(
         >= t+L, at *that* row's price (decision score, delayed execution);
         orders with no remaining event row are dropped unfilled.  The trade
         log keeps decision timestamps; positions/cash move at fill time.
+      order_type: 'market' (parity path) or 'limit' — the reference ships
+        ``simulate_limit_fill`` as dead code (``execution_models.py:14-22``,
+        zero call sites); here it is a live mode with its exact semantics:
+        fill probability ``(0.2 + 0.7*agg) * (1 - 0.5*min(1, size/ADV))``
+        per order, executed price ``price * (1 - 0.5*agg*spread)``, unfilled
+        orders dropped.  Requires ``fill_key`` (explicit PRNG, unlike the
+        reference's unseeded global numpy RNG).
+      aggressiveness: limit-order aggressiveness in [0, 1].
     """
     A, T = price.shape
     dtype = price.dtype
@@ -101,6 +112,20 @@ def event_backtest(
         jnp.where(valid & (score < -threshold), -1, 0),
     ).astype(jnp.int32)
     traded = side != 0
+
+    if order_type == "limit":
+        if fill_key is None:
+            raise ValueError("order_type='limit' requires fill_key")
+        p_fill = (0.2 + 0.7 * aggressiveness) * (
+            1.0 - 0.5 * jnp.minimum(
+                1.0, float(size_shares) / jnp.maximum(1.0, adv.astype(dtype))
+            )
+        )
+        u = jax.random.uniform(fill_key, (A, T), dtype)
+        side = jnp.where(u < p_fill[:, None], side, 0)
+        traded = side != 0
+    elif order_type != "market":
+        raise ValueError(f"unknown order_type {order_type!r}")
 
     impact = square_root_impact(
         jnp.asarray(float(size_shares), dtype), adv.astype(dtype), vol.astype(dtype)
@@ -123,11 +148,15 @@ def event_backtest(
         fill_idx = jnp.broadcast_to(t_idx[None, :], (A, T))
         exec_base = jnp.nan_to_num(price)
 
-    fill = jnp.where(
-        traded,
-        exec_base * (1.0 + side * (spread / 2.0 + impact[:, None])),
-        0.0,
-    )
+    if order_type == "limit":
+        # reference limit semantics: side-independent price improvement
+        fill = jnp.where(traded, exec_base * (1.0 - 0.5 * aggressiveness * spread), 0.0)
+    else:
+        fill = jnp.where(
+            traded,
+            exec_base * (1.0 + side * (spread / 2.0 + impact[:, None])),
+            0.0,
+        )
 
     shares = side * size_shares                       # i32[A, T] at decision rows
     if latency_bars > 0:

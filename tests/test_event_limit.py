@@ -1,0 +1,60 @@
+"""Limit-order mode of the event engine (reference's dead code made live)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from csmom_tpu.backtest.event import event_backtest
+from tests.test_event_latency import _workload
+
+
+def test_limit_requires_key(rng):
+    price, valid, score, adv, vol = _workload(rng)
+    with pytest.raises(ValueError, match="fill_key"):
+        event_backtest(jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+                       jnp.asarray(adv), jnp.asarray(vol), order_type="limit")
+
+
+def test_limit_matches_numpy_oracle(rng):
+    price, valid, score, adv, vol = _workload(rng, a=5, t=50)
+    key = jax.random.PRNGKey(42)
+    agg, spread, size, thr = 0.7, 0.001, 50, 1e-5
+    res = event_backtest(jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+                         jnp.asarray(adv), jnp.asarray(vol),
+                         order_type="limit", aggressiveness=agg, fill_key=key)
+
+    # oracle: same uniforms (same key/shape/dtype), reference formulas
+    u = np.asarray(jax.random.uniform(key, price.shape, jnp.asarray(price).dtype))
+    p_fill = (0.2 + 0.7 * agg) * (1 - 0.5 * np.minimum(1.0, size / np.maximum(1.0, adv)))
+    side = np.where(valid & (score > thr), 1, np.where(valid & (score < -thr), -1, 0))
+    side = np.where(u < p_fill[:, None], side, 0)
+    fillp = np.where(side != 0, np.nan_to_num(price) * (1 - 0.5 * agg * spread), 0.0)
+    positions = np.cumsum(side * size, axis=1)
+    cash = 1e6 - np.cumsum((fillp * side * size).sum(axis=0))
+
+    np.testing.assert_array_equal(np.asarray(res.positions), positions)
+    np.testing.assert_allclose(np.asarray(res.cash), cash, rtol=1e-12)
+    assert int(res.n_trades) == int((side != 0).sum())
+
+
+def test_limit_fills_subset_of_market(rng):
+    price, valid, score, adv, vol = _workload(rng, a=8, t=60)
+    mkt = event_backtest(jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+                         jnp.asarray(adv), jnp.asarray(vol))
+    lim = event_backtest(jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+                         jnp.asarray(adv), jnp.asarray(vol),
+                         order_type="limit", aggressiveness=0.5,
+                         fill_key=jax.random.PRNGKey(0))
+    ms, ls = np.asarray(mkt.trade_side), np.asarray(lim.trade_side)
+    assert 0 < int(lim.n_trades) < int(mkt.n_trades)
+    # every limit fill is a market order that survived the draw
+    assert ((ls != 0) <= (ms != 0)).all()
+    np.testing.assert_array_equal(ls[ls != 0], ms[ls != 0])
+
+
+def test_unknown_order_type_raises(rng):
+    price, valid, score, adv, vol = _workload(rng)
+    with pytest.raises(ValueError, match="order_type"):
+        event_backtest(jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+                       jnp.asarray(adv), jnp.asarray(vol), order_type="iceberg")
