@@ -60,7 +60,7 @@ class EventResult:
     net_notional: jnp.ndarray # f[] sum of signed fill notional
 
 
-@partial(jax.jit, static_argnames=("size_shares", "latency_bars", "order_type"))
+@partial(jax.jit, static_argnames=("size_shares", "latency_bars", "order_type", "axis_name"))
 def event_backtest(
     price,
     valid,
@@ -75,6 +75,7 @@ def event_backtest(
     order_type: str = "market",
     aggressiveness: float = 0.5,
     fill_key=None,
+    axis_name: str | None = None,
 ) -> EventResult:
     """Run the event backtest over a dense minute panel.
 
@@ -103,9 +104,14 @@ def event_backtest(
         orders dropped.  Requires ``fill_key`` (explicit PRNG, unlike the
         reference's unseeded global numpy RNG).
       aggressiveness: limit-order aggressiveness in [0, 1].
+      axis_name: when called inside ``shard_map`` with the asset axis
+        sharded, the mesh axis to ``psum`` the cross-asset reductions over
+        (order flow, marks, trade counts); None = single-device.  See
+        :func:`csmom_tpu.parallel.sharded_event_backtest`.
     """
     A, T = price.shape
     dtype = price.dtype
+    allsum = (lambda x: jax.lax.psum(x, axis_name)) if axis_name else (lambda x: x)
 
     side = jnp.where(
         valid & (score > threshold), 1,
@@ -171,7 +177,7 @@ def event_backtest(
         notional_settle = fill * shares.astype(dtype)
 
     positions = jnp.cumsum(shares_settle, axis=1)
-    flow = jnp.sum(notional_settle, axis=0)           # signed notional per bar
+    flow = allsum(jnp.sum(notional_settle, axis=0))   # signed notional per bar
     cash = cash0 - jnp.cumsum(flow)
 
     # forward-filled mark price: last observed row price at or before t
@@ -182,10 +188,10 @@ def event_backtest(
     )
     mark = jnp.where(last_obs >= 0, mark, 0.0)  # pre-history marks at 0 (backtester.py:57)
 
-    pv = cash + jnp.sum(positions.astype(dtype) * mark, axis=0)
+    pv = cash + allsum(jnp.sum(positions.astype(dtype) * mark, axis=0))
 
     # per-bar PnL over bar timestamps only; first bar = 0 (backtester.py:59-62)
-    bar_mask = jnp.any(valid, axis=0)
+    bar_mask = allsum(jnp.sum(valid, axis=0)) > 0
     # pv of the previous bar: gather pv at the last bar index < t
     obs_bar = jnp.where(bar_mask, t_idx, -1)
     last_bar = jax.lax.associative_scan(jnp.maximum, obs_bar)
@@ -193,7 +199,7 @@ def event_backtest(
     pv_prev = jnp.where(prev_bar >= 0, pv[jnp.clip(prev_bar, 0, T - 1)], pv)
     pnl = jnp.where(bar_mask & (prev_bar >= 0), pv - pv_prev, 0.0)
 
-    n_trades = jnp.sum(traded)
+    n_trades = allsum(jnp.sum(traded))
     return EventResult(
         pnl=pnl,
         bar_mask=bar_mask,
@@ -205,8 +211,8 @@ def event_backtest(
         impact=impact,
         total_pnl=jnp.sum(pnl),
         n_trades=n_trades.astype(jnp.int32),
-        n_buys=jnp.sum(side > 0).astype(jnp.int32),
-        n_sells=jnp.sum(side < 0).astype(jnp.int32),
+        n_buys=allsum(jnp.sum(side > 0)).astype(jnp.int32),
+        n_sells=allsum(jnp.sum(side < 0)).astype(jnp.int32),
         net_notional=jnp.sum(flow),
     )
 

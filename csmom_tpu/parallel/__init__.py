@@ -22,6 +22,7 @@ from csmom_tpu.parallel.collectives import (
     sharded_jk_grid_backtest,
 )
 from csmom_tpu.parallel.bootstrap import sharded_block_bootstrap
+from csmom_tpu.parallel.event import sharded_event_backtest
 
 __all__ = [
     "make_mesh",
@@ -29,4 +30,5 @@ __all__ = [
     "sharded_monthly_spread_backtest",
     "sharded_jk_grid_backtest",
     "sharded_block_bootstrap",
+    "sharded_event_backtest",
 ]

@@ -1,0 +1,76 @@
+"""Sharded event backtest: the intraday engine over the asset mesh axis.
+
+The event engine is per-asset independent except three global reductions —
+signed order flow per bar (cash ledger), the mark-to-market sum (portfolio
+value) and trade counts — all ``psum``s of [T]-vectors or scalars, so
+sharding the minute panel's asset axis costs 3 small collectives per call
+and no resharding.  Equality with the single-device engine is pinned on the
+CPU mesh (tests/test_sharded_event.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from csmom_tpu.backtest.event import EventResult, event_backtest
+
+
+def sharded_event_backtest(
+    price,
+    valid,
+    score,
+    adv,
+    vol,
+    mesh,
+    axis_name: str = "assets",
+    **kwargs,
+) -> EventResult:
+    """Run :func:`csmom_tpu.backtest.event.event_backtest` with the asset
+    axis sharded over ``mesh[axis_name]``.
+
+    A must divide by the mesh axis size (pad with dead lanes via
+    :func:`csmom_tpu.parallel.mesh.pad_assets` — a lane with ``valid=False``
+    everywhere never trades and never marks).  ``fill_key`` (limit mode) is
+    replicated, so every shard draws the same [A_local, T]-block of uniforms
+    it would draw single-device only if the key is folded per shard; to keep
+    draws identical to the single-device engine, limit mode is not supported
+    sharded (raise) — use the market path, which is deterministic.
+    """
+    if kwargs.get("order_type") == "limit":
+        raise NotImplementedError(
+            "limit mode is per-order random; shard-invariant draws need a "
+            "counter-based per-(asset,bar) key design — run it single-device"
+        )
+    A = price.shape[0]
+    n_shards = mesh.shape[axis_name]
+    if A % n_shards:
+        raise ValueError(f"A={A} not divisible by {n_shards} shards; pad_assets first")
+
+    fn = shard_map(
+        partial(event_backtest, axis_name=axis_name, **kwargs),
+        mesh=mesh,
+        in_specs=(
+            P(axis_name, None), P(axis_name, None), P(axis_name, None),
+            P(axis_name), P(axis_name),
+        ),
+        out_specs=EventResult(
+            pnl=P(),
+            bar_mask=P(),
+            portfolio_value=P(),
+            cash=P(),
+            positions=P(axis_name, None),
+            trade_side=P(axis_name, None),
+            exec_price=P(axis_name, None),
+            impact=P(axis_name),
+            total_pnl=P(),
+            n_trades=P(),
+            n_buys=P(),
+            n_sells=P(),
+            net_notional=P(),
+        ),
+    )
+    return fn(price, valid, score, adv, vol)

@@ -1,0 +1,79 @@
+"""Sharded event engine == single-device engine, on the 8-device CPU mesh."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from csmom_tpu.backtest.event import event_backtest
+from csmom_tpu.parallel import make_mesh, sharded_event_backtest
+from csmom_tpu.parallel.mesh import pad_assets
+
+from tests.test_event_latency import _workload
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(jax.devices()[:8], grid_axis=1)
+
+
+def _compare(res_d, res_l, A):
+    np.testing.assert_allclose(np.asarray(res_d.cash), np.asarray(res_l.cash), rtol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(res_d.portfolio_value), np.asarray(res_l.portfolio_value), rtol=1e-12
+    )
+    np.testing.assert_allclose(np.asarray(res_d.pnl), np.asarray(res_l.pnl), rtol=1e-9, atol=1e-9)
+    np.testing.assert_array_equal(
+        np.asarray(res_d.positions)[:A], np.asarray(res_l.positions)
+    )
+    np.testing.assert_array_equal(np.asarray(res_d.bar_mask), np.asarray(res_l.bar_mask))
+    assert int(res_d.n_trades) == int(res_l.n_trades)
+    assert int(res_d.n_buys) == int(res_l.n_buys)
+    np.testing.assert_allclose(
+        float(res_d.net_notional), float(res_l.net_notional), rtol=1e-12
+    )
+
+
+def test_matches_single_device(rng, mesh):
+    price, valid, score, adv, vol = _workload(rng, a=12, t=50)
+    local = event_backtest(jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+                           jnp.asarray(adv), jnp.asarray(vol))
+    pv, mv, A = pad_assets(price, valid, 8)
+    sc = np.zeros_like(pv)
+    sc[:12] = score
+    advp = np.concatenate([adv, np.full(pv.shape[0] - 12, 1e5)])
+    volp = np.concatenate([vol, np.full(pv.shape[0] - 12, 0.02)])
+    dist = sharded_event_backtest(
+        jnp.asarray(pv), jnp.asarray(mv), jnp.asarray(sc),
+        jnp.asarray(advp), jnp.asarray(volp), mesh,
+    )
+    _compare(dist, local, 12)
+
+
+def test_matches_with_latency(rng, mesh):
+    price, valid, score, adv, vol = _workload(rng, a=16, t=40)
+    local = event_backtest(jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+                           jnp.asarray(adv), jnp.asarray(vol), latency_bars=3)
+    dist = sharded_event_backtest(
+        jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+        jnp.asarray(adv), jnp.asarray(vol), mesh, latency_bars=3,
+    )
+    _compare(dist, local, 16)
+
+
+def test_limit_mode_raises(rng, mesh):
+    price, valid, score, adv, vol = _workload(rng, a=8, t=20)
+    with pytest.raises(NotImplementedError, match="limit"):
+        sharded_event_backtest(
+            jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+            jnp.asarray(adv), jnp.asarray(vol), mesh, order_type="limit",
+        )
+
+
+def test_indivisible_assets_raise(rng, mesh):
+    price, valid, score, adv, vol = _workload(rng, a=9, t=20)
+    with pytest.raises(ValueError, match="pad_assets"):
+        sharded_event_backtest(
+            jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+            jnp.asarray(adv), jnp.asarray(vol), mesh,
+        )
