@@ -60,6 +60,23 @@ class EventResult:
     net_notional: jnp.ndarray # f[] sum of signed fill notional
 
 
+def threshold_sides(valid, score, threshold):
+    """Order sides from thresholded scores: +1/-1 when |score| > threshold
+    strictly, at valid event rows only (backtester.py:29-32)."""
+    return jnp.where(
+        valid & (score > threshold), 1,
+        jnp.where(valid & (score < -threshold), -1, 0),
+    ).astype(jnp.int32)
+
+
+def market_fill_prices(exec_base, side, traded, impact, spread):
+    """Market-order fill prices: ``price * (1 + side*(spread/2 + impact))``
+    where traded, 0 elsewhere (execution_models.py:9-12)."""
+    return jnp.where(
+        traded, exec_base * (1.0 + side * (spread / 2.0 + impact[:, None])), 0.0
+    )
+
+
 @partial(jax.jit, static_argnames=("size_shares", "latency_bars", "order_type", "axis_name"))
 def event_backtest(
     price,
@@ -113,10 +130,7 @@ def event_backtest(
     dtype = price.dtype
     allsum = (lambda x: jax.lax.psum(x, axis_name)) if axis_name else (lambda x: x)
 
-    side = jnp.where(
-        valid & (score > threshold), 1,
-        jnp.where(valid & (score < -threshold), -1, 0),
-    ).astype(jnp.int32)
+    side = threshold_sides(valid, score, threshold)
     traded = side != 0
 
     if order_type == "limit":
@@ -158,11 +172,7 @@ def event_backtest(
         # reference limit semantics: side-independent price improvement
         fill = jnp.where(traded, exec_base * (1.0 - 0.5 * aggressiveness * spread), 0.0)
     else:
-        fill = jnp.where(
-            traded,
-            exec_base * (1.0 + side * (spread / 2.0 + impact[:, None])),
-            0.0,
-        )
+        fill = market_fill_prices(exec_base, side, traded, impact, spread)
 
     shares = side * size_shares                       # i32[A, T] at decision rows
     if latency_bars > 0:

@@ -8,8 +8,11 @@ communication backend).  Here distribution is first-class:
   independent except the cross-sectional rank;
 - the **grid axis** (J x K parameter cells) shards over an optional
   ``'grid'`` mesh axis — embarrassingly parallel;
-- the **time axis** stays replicated (even 60 years of months is tiny);
-  time-serial dependencies are prefix sums, not sequential loops;
+- the **time axis** is replicated for the monthly engines (60 years of
+  months is tiny) but shardable for the minute-bar event engine: the
+  engine's time-serial dependencies are all prefix ops, so the sequence
+  axis splits into per-device blocks with small carry exchanges
+  (``event_time`` — the framework's sequence parallelism);
 - the only collectives are an ``all_gather`` of the [A, T] signal for the
   rank (the one truly global op) and ``psum`` for portfolio reductions —
   both ride ICI on a real pod, and the same code runs multi-host over DCN
@@ -23,6 +26,7 @@ from csmom_tpu.parallel.collectives import (
 )
 from csmom_tpu.parallel.bootstrap import sharded_block_bootstrap
 from csmom_tpu.parallel.event import sharded_event_backtest
+from csmom_tpu.parallel.event_time import time_sharded_event_backtest
 
 __all__ = [
     "make_mesh",
@@ -31,4 +35,5 @@ __all__ = [
     "sharded_jk_grid_backtest",
     "sharded_block_bootstrap",
     "sharded_event_backtest",
+    "time_sharded_event_backtest",
 ]

@@ -1,0 +1,272 @@
+"""Sequence-parallel event backtest: the minute axis sharded over the mesh.
+
+The single-device engine (:mod:`csmom_tpu.backtest.event`) is already a
+panel program whose only time-serial dependencies are prefix ops: the
+position book and cash ledger are cumulative sums, the mark price is a
+running "last observed" (associative max), and PnL differences portfolio
+value at consecutive bars.  Every one of those admits a *blocked scan*:
+each device computes its local prefix over its time block, exchanges one
+small per-block carry (an ``all_gather`` over the ``'time'`` mesh axis),
+and adds the exclusive prefix of the earlier blocks' carries.  This is the
+framework's sequence parallelism — the direct analogue of sharding a
+transformer's sequence axis, with prefix carries in place of a KV ring —
+and it composes with the asset axis for a full 2D sharding of the minute
+panel.
+
+Per-call carries (for an [A, T] panel on an (assets=a, time=t) mesh):
+
+- position book:   i32[A/a] block trade sum        -> all_gather [t, A/a]
+- cash ledger:     one f64 block flow sum          -> all_gather [t]
+- mark price:      (bool[A/a], f[A/a]) last price observed in block
+- portfolio value: (bool, f) last bar's PV in block
+- trade counters:  5 scalars (psum)
+
+Nothing scales with T; all carries ride ICI.  Cross-asset reductions
+(order flow, marks, bar occupancy, counters) additionally ``psum`` over
+the asset axis exactly as in the 1D asset-sharded engine
+(:mod:`csmom_tpu.parallel.event`).
+
+Reference semantics pinned: ``SimpleEventBacktester``
+(``/root/reference/src/backtester.py:20-65``) via bit-level equality with
+:func:`csmom_tpu.backtest.event.event_backtest` on the CPU mesh
+(tests/test_sequence_parallel.py).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from csmom_tpu.backtest.event import EventResult, market_fill_prices, threshold_sides
+from csmom_tpu.costs.impact import square_root_impact
+
+
+def pad_time(price, valid, score, n_shards: int):
+    """Pad the trailing time axis to a multiple of the shard count.
+
+    Padded columns are ``valid=False`` NaN minutes: no bar, no trade, no
+    mark refresh — results over the original columns are unchanged.
+    Returns ``(price, valid, score, T_original)`` (host-side helper,
+    mirror of :func:`csmom_tpu.parallel.mesh.pad_assets`).
+    """
+    T = price.shape[1]
+    pad = (-T) % n_shards
+    if pad == 0:
+        return price, valid, score, T
+    ppad = np.full(price.shape[:1] + (pad,), np.nan, dtype=price.dtype)
+    spad = np.zeros(score.shape[:1] + (pad,), dtype=score.dtype)
+    mpad = np.zeros(valid.shape[:1] + (pad,), dtype=bool)
+    return (
+        np.concatenate([price, ppad], axis=1),
+        np.concatenate([valid, mpad], axis=1),
+        np.concatenate([score, spad], axis=1),
+        T,
+    )
+
+
+def _exclusive_prefix_sum(block_total, axis_name: str):
+    """Sum of this quantity over all earlier blocks along ``axis_name``."""
+    g = lax.all_gather(block_total, axis_name)          # [nb, ...]
+    i = lax.axis_index(axis_name)
+    nb = g.shape[0]
+    m = (jnp.arange(nb) < i).reshape((nb,) + (1,) * (g.ndim - 1))
+    return jnp.sum(jnp.where(m, g, 0), axis=0)
+
+
+def _carry_from_left(has_blk, val_blk, axis_name: str):
+    """Rightmost earlier block's value: ``(exists, value)`` per element.
+
+    ``has_blk``/``val_blk`` are this block's carry (did the block observe
+    the quantity; its last value).  Returns, for each element, whether any
+    earlier block observed it and the most recent such value — the
+    exclusive prefix of a "take the right operand if set" monoid.
+    """
+    has_g = lax.all_gather(has_blk, axis_name)          # [nb, X]
+    val_g = lax.all_gather(val_blk, axis_name)          # [nb, X]
+    i = lax.axis_index(axis_name)
+    nb = has_g.shape[0]
+    idx = jnp.arange(nb)
+    cand = jnp.where(has_g & (idx[:, None] < i), idx[:, None], -1)
+    jbest = jnp.max(cand, axis=0)                       # [X]
+    val = jnp.take_along_axis(val_g, jnp.clip(jbest, 0, nb - 1)[None, :], axis=0)[0]
+    return jbest >= 0, val
+
+
+@lru_cache(maxsize=32)
+def _compiled(mesh, time_axis, asset_axis, size_shares, threshold, cash0, spread):
+    """Build + jit the sharded program once per (mesh, axes, params)."""
+    asum = (lambda x: lax.psum(x, asset_axis)) if asset_axis else (lambda x: x)
+
+    def local_fn(price, valid, score, adv, vol):
+        A_l, T_l = price.shape
+        dtype = price.dtype
+
+        # ---- block-local order generation + fills (backtester.py:25-44),
+        #      shared helpers pin semantics to the single-device engine ----
+        side = threshold_sides(valid, score, threshold)
+        traded = side != 0
+        impact = square_root_impact(
+            jnp.asarray(float(size_shares), dtype), adv.astype(dtype), vol.astype(dtype)
+        )
+        exec_base = jnp.nan_to_num(price)
+        fill = market_fill_prices(exec_base, side, traded, impact, spread)
+        shares = side * size_shares
+        notional = fill * shares.astype(dtype)
+
+        # ---- position book: blocked cumsum + position carry ----
+        pos_local = jnp.cumsum(shares, axis=1)
+        positions = pos_local + _exclusive_prefix_sum(pos_local[:, -1], time_axis)[:, None]
+
+        # ---- cash ledger: blocked cumsum of cross-asset order flow ----
+        flow = asum(jnp.sum(notional, axis=0))          # [T_l]
+        cum_flow = jnp.cumsum(flow)
+        cash = cash0 - (cum_flow + _exclusive_prefix_sum(cum_flow[-1], time_axis))
+
+        # ---- mark price: blocked last-observed + (has, price) carry ----
+        t_loc = jnp.arange(T_l, dtype=jnp.int32)
+        obs = jnp.where(valid, t_loc[None, :], -1)
+        last_obs = lax.associative_scan(jnp.maximum, obs, axis=1)
+        mark_local = jnp.take_along_axis(exec_base, jnp.clip(last_obs, 0, T_l - 1), axis=1)
+        blk_has = last_obs[:, -1] >= 0
+        blk_price = jnp.take_along_axis(
+            exec_base, jnp.clip(last_obs[:, -1:], 0, T_l - 1), axis=1
+        )[:, 0]
+        prev_has, prev_price = _carry_from_left(
+            blk_has, jnp.where(blk_has, blk_price, 0.0), time_axis
+        )
+        mark = jnp.where(
+            last_obs >= 0,
+            mark_local,
+            jnp.where(prev_has[:, None], prev_price[:, None], 0.0),
+        )
+
+        pv = cash + asum(jnp.sum(positions.astype(dtype) * mark, axis=0))
+
+        # ---- per-bar PnL: blocked prev-bar gather + (has, pv) carry ----
+        bar_mask = asum(jnp.sum(valid, axis=0)) > 0
+        obs_bar = jnp.where(bar_mask, t_loc, -1)
+        last_bar = lax.associative_scan(jnp.maximum, obs_bar)
+        prev_bar = jnp.where(bar_mask, jnp.roll(last_bar, 1).at[0].set(-1), -1)
+        pv_prev = pv[jnp.clip(prev_bar, 0, T_l - 1)]
+        blk_has_bar = last_bar[-1:] >= 0
+        blk_pv = jnp.where(blk_has_bar, pv[jnp.clip(last_bar[-1:], 0, T_l - 1)], 0.0)
+        pv_carry_has, pv_carry = _carry_from_left(blk_has_bar, blk_pv, time_axis)
+        pnl = jnp.where(
+            bar_mask,
+            jnp.where(
+                prev_bar >= 0,
+                pv - pv_prev,
+                jnp.where(pv_carry_has[0], pv - pv_carry[0], 0.0),
+            ),
+            0.0,
+        )
+
+        tsum = lambda x: lax.psum(x, time_axis)
+        return EventResult(
+            pnl=pnl,
+            bar_mask=bar_mask,
+            portfolio_value=pv,
+            cash=cash,
+            positions=positions,
+            trade_side=side.astype(jnp.int8),
+            exec_price=fill,
+            impact=impact,
+            total_pnl=tsum(jnp.sum(pnl)),
+            n_trades=tsum(asum(jnp.sum(traded))).astype(jnp.int32),
+            n_buys=tsum(asum(jnp.sum(side > 0))).astype(jnp.int32),
+            n_sells=tsum(asum(jnp.sum(side < 0))).astype(jnp.int32),
+            net_notional=tsum(jnp.sum(flow)),
+        )
+
+    aspec = asset_axis  # None -> unsharded axis
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            P(aspec, time_axis), P(aspec, time_axis), P(aspec, time_axis),
+            P(aspec), P(aspec),
+        ),
+        out_specs=EventResult(
+            pnl=P(time_axis),
+            bar_mask=P(time_axis),
+            portfolio_value=P(time_axis),
+            cash=P(time_axis),
+            positions=P(aspec, time_axis),
+            trade_side=P(aspec, time_axis),
+            exec_price=P(aspec, time_axis),
+            impact=P(aspec),
+            total_pnl=P(),
+            n_trades=P(),
+            n_buys=P(),
+            n_sells=P(),
+            net_notional=P(),
+        ),
+    )
+    return jax.jit(fn)
+
+
+def time_sharded_event_backtest(
+    price,
+    valid,
+    score,
+    adv,
+    vol,
+    mesh: Mesh,
+    time_axis: str = "time",
+    asset_axis: str | None = None,
+    size_shares: int = 50,
+    threshold: float = 1e-5,
+    cash0: float = 1_000_000.0,
+    spread: float = 0.001,
+    latency_bars: int = 0,
+    order_type: str = "market",
+) -> EventResult:
+    """Run the event backtest with the minute axis sharded over
+    ``mesh[time_axis]`` (and optionally assets over ``mesh[asset_axis]``).
+
+    T must divide by the time-shard count (:func:`pad_time`), and A by the
+    asset-shard count when ``asset_axis`` is given
+    (:func:`csmom_tpu.parallel.mesh.pad_assets`).  Build a 2D mesh with
+    ``make_mesh(devices, grid_axis=a, axis_names=('assets', 'time'))``.
+    The compiled program is cached per (mesh, axes, scalar params).
+
+    Only the deterministic market path is supported sharded: latency
+    fills can land in a later time block (a halo exchange, not a prefix
+    carry) and limit-mode PRNG draws are not shard-invariant — run those
+    single-device or asset-sharded (latency) instead.
+    """
+    if order_type != "market":
+        raise NotImplementedError(
+            "time-sharded engine supports order_type='market' only; limit "
+            "draws are not shard-invariant across time blocks"
+        )
+    if latency_bars != 0:
+        raise NotImplementedError(
+            "latency fills cross time-block boundaries (halo, not prefix "
+            "carry); use the single-device or asset-sharded engine"
+        )
+    A, T = price.shape
+    if time_axis not in mesh.shape:
+        raise ValueError(
+            f"mesh has axes {tuple(mesh.shape)}, no {time_axis!r}; build it "
+            "with make_mesh(devices, grid_axis=a, axis_names=('assets', 'time'))"
+        )
+    nt = mesh.shape[time_axis]
+    if T % nt:
+        raise ValueError(f"T={T} not divisible by {nt} time shards; pad_time first")
+    if asset_axis is not None:
+        na = mesh.shape[asset_axis]
+        if A % na:
+            raise ValueError(f"A={A} not divisible by {na} asset shards; pad_assets first")
+
+    fn = _compiled(
+        mesh, time_axis, asset_axis, int(size_shares), float(threshold),
+        float(cash0), float(spread),
+    )
+    return fn(price, valid, score, adv, vol)
