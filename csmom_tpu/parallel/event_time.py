@@ -16,7 +16,7 @@ panel.
 Per-call carries (for an [A, T] panel on an (assets=a, time=t) mesh):
 
 - position book:   i32[A/a] block trade sum        -> all_gather [t, A/a]
-- cash ledger:     one f64 block flow sum          -> all_gather [t]
+- cash ledger:     one block flow sum (price dtype) -> all_gather [t]
 - mark price:      (bool[A/a], f[A/a]) last price observed in block
 - portfolio value: (bool, f) last bar's PV in block
 - trade counters:  5 scalars (psum)
@@ -27,9 +27,11 @@ the asset axis exactly as in the 1D asset-sharded engine
 (:mod:`csmom_tpu.parallel.event`).
 
 Reference semantics pinned: ``SimpleEventBacktester``
-(``/root/reference/src/backtester.py:20-65``) via bit-level equality with
+(``/root/reference/src/backtester.py:20-65``) via equality with
 :func:`csmom_tpu.backtest.event.event_backtest` on the CPU mesh
-(tests/test_sequence_parallel.py).
+(tests/test_sequence_parallel.py) — integer state (positions, sides) is
+exact; float state matches to tight tolerance (blocked summation changes
+fp association, so it is not bit-identical).
 """
 
 from __future__ import annotations
