@@ -51,6 +51,8 @@ def run_monthly(
     mode: str = "qcut",
     freq: int = 12,
     backend: str = "tpu",
+    strategy=None,
+    **panels,
 ) -> MonthlyReport:
     """Run the monthly decile backtest on the requested engine.
 
@@ -59,14 +61,32 @@ def run_monthly(
       backend: ``'tpu'`` (jit-compiled panel engine, the default) or
         ``'pandas'`` (reference-semantics CPU engine).
       mode: ranking mode, TPU engine only ('qcut' parity / 'rank' fast).
+      strategy: optional :class:`csmom_tpu.strategy.Strategy` plugin; when
+        None the reference's momentum signal (``lookback``/``skip``) runs.
+        Extra ``**panels`` (e.g. ``volumes=``) are forwarded to its
+        ``signal``.  Either engine ranks the plugged-in scores through the
+        same tail, so callers never branch on signal choice.
     """
+    if strategy is None and panels:
+        raise TypeError(
+            f"unexpected keyword arguments {sorted(panels)} — extra panels are "
+            "only forwarded to a strategy plugin (did you misspell a parameter, "
+            "or forget strategy=?)"
+        )
     if backend == "tpu":
         from csmom_tpu.backtest import monthly_spread_backtest
 
         v, m = panel.device()
-        res = monthly_spread_backtest(
-            v, m, lookback=lookback, skip=skip, n_bins=n_bins, mode=mode, freq=freq
-        )
+        if strategy is not None:
+            from csmom_tpu.strategy import strategy_backtest
+
+            res = strategy_backtest(
+                v, m, strategy, n_bins=n_bins, mode=mode, freq=freq, **panels
+            )
+        else:
+            res = monthly_spread_backtest(
+                v, m, lookback=lookback, skip=skip, n_bins=n_bins, mode=mode, freq=freq
+            )
         spread = np.where(np.asarray(res.spread_valid), np.asarray(res.spread), np.nan)
         return MonthlyReport(
             times=panel.times,
@@ -80,11 +100,19 @@ def run_monthly(
             backend="tpu",
         )
     if backend == "pandas":
-        from csmom_tpu.backends.pandas_engine import monthly_spread_backtest_pandas
+        if strategy is not None:
+            from csmom_tpu.strategy import strategy_backtest_pandas
 
-        res = monthly_spread_backtest_pandas(
-            panel.to_dataframe(), lookback=lookback, skip=skip, n_bins=n_bins, freq=freq
-        )
+            res = strategy_backtest_pandas(
+                panel.to_dataframe(), strategy, n_bins=n_bins, freq=freq, **panels
+            )
+        else:
+            from csmom_tpu.backends.pandas_engine import monthly_spread_backtest_pandas
+
+            res = monthly_spread_backtest_pandas(
+                panel.to_dataframe(), lookback=lookback, skip=skip, n_bins=n_bins,
+                freq=freq,
+            )
         return MonthlyReport(
             times=panel.times,
             spread=res.spread.to_numpy(),

@@ -101,13 +101,29 @@ def monthly_spread_backtest_pandas(
       prices: wide [A x M] month-end price frame (NaN = no observation),
         e.g. ``Panel.to_dataframe()``.
     """
+    mom = _momentum_frame(prices, lookback, skip)
+    return spread_from_scores_pandas(prices, mom, n_bins=n_bins, freq=freq)
+
+
+def spread_from_scores_pandas(
+    prices: pd.DataFrame,
+    scores: pd.DataFrame,
+    n_bins: int = 10,
+    freq: int = 12,
+) -> PandasMonthlyResult:
+    """Ranking/portfolio tail shared by every strategy on this engine:
+    per-date qcut deciles of ``scores`` -> equal-weighted next-month decile
+    means -> top-minus-bottom spread (``run_demo.py:46-73`` semantics).
+
+    ``scores`` is wide [A x M], NaN = not rankable that date (the Strategy
+    plugin boundary's contract; see :mod:`csmom_tpu.strategy`).
+    """
     ret = prices.pct_change(axis=1)
     # calendar-aligned validity: both consecutive month-ends present
     both = prices.notna() & prices.shift(1, axis=1).notna()
     ret = ret.where(both)
 
-    mom = _momentum_frame(prices, lookback, skip)
-    labels = mom.apply(lambda col: _qcut_labels_1d(col, n_bins), axis=0)
+    labels = scores.apply(lambda col: _qcut_labels_1d(col, n_bins), axis=0)
 
     next_ret = ret.shift(-1, axis=1)
     bins = range(n_bins)

@@ -47,14 +47,51 @@ def _price_panel(cfg: RunConfig):
     return monthly_price_panel(cfg.universe.data_dir, list(cfg.universe.tickers))
 
 
+def _parse_strategy(args, cfg):
+    """``--strategy name [--strategy-arg k=v ...]`` -> Strategy | None.
+
+    Config/flag momentum params flow through: any ``lookback``/``skip``
+    field the strategy class declares defaults to the resolved
+    ``cfg.momentum`` value unless an explicit ``--strategy-arg`` overrides
+    it — so ``--lookback 6 --strategy momentum`` really runs J=6.
+    """
+    name = getattr(args, "strategy", None)
+    if not name:
+        return None
+    import ast
+    import dataclasses
+
+    from csmom_tpu.strategy import available_strategies, make_strategy
+
+    params = {}
+    for kv in getattr(args, "strategy_arg", None) or []:
+        k, _, v = kv.partition("=")
+        try:
+            params[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            params[k] = v
+    cls = available_strategies().get(name)
+    if cls is not None:
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        for fld in ("lookback", "skip"):
+            if fld in field_names and fld not in params:
+                params[fld] = getattr(cfg.momentum, fld)
+    return make_strategy(name, **params)
+
+
 def cmd_replicate(args) -> int:
     """Monthly momentum replication (the reference's ``monthly_replication``,
-    ``run_demo.py:31-79``) on either backend."""
+    ``run_demo.py:31-79``) on either backend; ``--strategy`` swaps the
+    ranked signal without touching the engine."""
     cfg = _load_cfg(args)
-    prices, _volume = _price_panel(cfg)
+    prices, volume = _price_panel(cfg)
 
     from csmom_tpu.backends import run_monthly
 
+    strategy = _parse_strategy(args, cfg)
+    panels = {}
+    if strategy is not None:
+        panels = {"volumes": volume.values, "volumes_mask": volume.mask}
     rep = run_monthly(
         prices,
         lookback=cfg.momentum.lookback,
@@ -62,6 +99,8 @@ def cmd_replicate(args) -> int:
         n_bins=cfg.momentum.n_bins,
         mode=cfg.momentum.mode,
         backend=cfg.backend,
+        strategy=strategy,
+        **panels,
     )
     print(f"Mean monthly spread: {rep.mean_spread:.6f}")
     print(f"Annualized Sharpe:   {rep.ann_sharpe:.4f}")
@@ -226,8 +265,8 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command")
 
     for name, fn, extra in (
-        ("run", cmd_run, ("bootstrap",)),
-        ("replicate", cmd_replicate, ("bootstrap",)),
+        ("run", cmd_run, ("bootstrap", "strategy")),
+        ("replicate", cmd_replicate, ("bootstrap", "strategy")),
         ("grid", cmd_grid, ("js", "ks")),
         ("sweep", cmd_sweep, ("js", "ks", "min_months")),
         ("intraday", cmd_intraday, ()),
@@ -244,6 +283,13 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--bootstrap", type=int, metavar="N",
                             help="print block-bootstrap 95%% CIs from N resamples")
             sp.add_argument("--block-len", dest="block_len", type=int)
+        if "strategy" in extra:
+            sp.add_argument("--strategy",
+                            help="registered strategy plugin to rank instead of "
+                                 "the built-in momentum path")
+            sp.add_argument("--strategy-arg", dest="strategy_arg",
+                            action="append", metavar="K=V",
+                            help="strategy parameter, repeatable")
         sp.set_defaults(fn=fn)
     return p
 

@@ -1,0 +1,31 @@
+"""Strategy plugin boundary: pluggable cross-sectional signals over one
+shared ranking/portfolio engine (both backends).  See ``base.py``."""
+
+from csmom_tpu.strategy.base import (
+    Strategy,
+    available_strategies,
+    make_strategy,
+    register_strategy,
+    xs_zscore,
+)
+from csmom_tpu.strategy.builtin import (
+    Momentum,
+    Reversal,
+    VolumeZMomentum,
+    ZScoreCombo,
+)
+from csmom_tpu.strategy.engine import strategy_backtest, strategy_backtest_pandas
+
+__all__ = [
+    "Strategy",
+    "available_strategies",
+    "make_strategy",
+    "register_strategy",
+    "xs_zscore",
+    "Momentum",
+    "Reversal",
+    "VolumeZMomentum",
+    "ZScoreCombo",
+    "strategy_backtest",
+    "strategy_backtest_pandas",
+]

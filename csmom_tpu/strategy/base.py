@@ -1,0 +1,119 @@
+"""The ``Strategy`` plugin boundary.
+
+The reference hardwires its one signal into the driver: the decile sort at
+``/root/reference/run_demo.py:46`` ranks the ``mom_J`` column produced by
+``compute_monthly_momentum_from_daily`` and nothing else can be ranked
+without editing the driver.  The north star (BASELINE.json) requires the
+accelerated engines to land *behind a Strategy plugin boundary* so the CLI,
+results schema, and analytics never change when the signal does.
+
+A :class:`Strategy` is a frozen, hashable dataclass whose :meth:`signal`
+is a pure JAX function over the masked month-end panel::
+
+    score, valid = strategy.signal(prices, mask, **panels)
+
+``prices``/``mask`` are the ``f[A, M]`` / ``bool[A, M]`` panel pair; extra
+named panels (e.g. ``volumes``) are passed through by the engine.  Because
+strategies are hashable they ride as static jit arguments: each strategy
+(with its parameters) compiles once, and the engine's ranking/portfolio
+tail is shared by every strategy on both backends.
+
+User plugins register with :func:`register_strategy` and become available
+to the CLI/config layer by name via :func:`make_strategy`.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+
+import jax.numpy as jnp
+
+__all__ = [
+    "Strategy",
+    "register_strategy",
+    "make_strategy",
+    "available_strategies",
+    "xs_zscore",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Strategy(abc.ABC):
+    """Base class for cross-sectional strategies (frozen == jit-static)."""
+
+    @abc.abstractmethod
+    def signal(self, prices, mask, **panels):
+        """Formation-date scores over the panel.
+
+        Args:
+          prices: f[A, M] month-end prices (NaN at masked slots).
+          mask: bool[A, M] observation mask.
+          **panels: extra named data panels (engine passes them through
+            verbatim; a strategy uses what it needs and ignores the rest).
+
+        Returns:
+          ``(score f[A, M], valid bool[A, M])`` — higher score = ranked
+          into a higher decile (long leg).  Invalid slots are excluded
+          from the cross-sectional sort, like the reference's NaN
+          ``mom_J`` rows dropped at ``run_demo.py:41``.
+        """
+
+    @property
+    def label(self) -> str:
+        """Human-readable id (registry name + non-default params)."""
+        fields = dataclasses.fields(self)
+        parts = [
+            f"{f.name}={getattr(self, f.name)!r}"
+            for f in fields
+            if getattr(self, f.name) != f.default
+        ]
+        return f"{type(self).__name__}({', '.join(parts)})"
+
+
+_REGISTRY: dict[str, type[Strategy]] = {}
+
+
+def register_strategy(name: str):
+    """Class decorator: expose a Strategy to the CLI/config layer by name."""
+
+    def deco(cls):
+        if not (isinstance(cls, type) and issubclass(cls, Strategy)):
+            raise TypeError(f"{cls!r} is not a Strategy subclass")
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def make_strategy(name: str, **params) -> Strategy:
+    """Instantiate a registered strategy by name with keyword params."""
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown strategy {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+    return cls(**params)
+
+
+def available_strategies() -> dict[str, type[Strategy]]:
+    return dict(_REGISTRY)
+
+
+def xs_zscore(score, valid):
+    """Cross-sectional z-score per date over the masked asset axis.
+
+    Monotone within each date, so ranking a z-scored signal yields the same
+    deciles as the raw signal — its purpose is to make *combinations* of
+    signals scale-free (each component contributes in units of
+    cross-sectional standard deviations).
+    """
+    v = valid
+    n = jnp.maximum(jnp.sum(v, axis=0), 1)
+    x = jnp.where(v, jnp.nan_to_num(score), 0.0)
+    mu = jnp.sum(x, axis=0) / n
+    var = jnp.sum(jnp.where(v, (x - mu[None, :]) ** 2, 0.0), axis=0) / n
+    sd = jnp.sqrt(var)
+    z = jnp.where(sd[None, :] > 0, (x - mu[None, :]) / jnp.where(sd == 0, 1.0, sd)[None, :], 0.0)
+    return jnp.where(v, z, jnp.nan)

@@ -1,0 +1,132 @@
+"""Built-in strategies.
+
+``Momentum`` is the reference implementation — the signal the reference's
+driver hardwires (``/root/reference/run_demo.py:32``: J=12, skip=1 momentum
+ranked at ``:46``).  The others are standard cross-sectional signals from
+the same literature, expressed over the identical panel so they demonstrate
+the plugin boundary: none of them required touching an engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from csmom_tpu.signals.momentum import momentum
+from csmom_tpu.strategy.base import Strategy, register_strategy, xs_zscore
+
+__all__ = [
+    "Momentum",
+    "Reversal",
+    "VolumeZMomentum",
+    "ZScoreCombo",
+]
+
+
+@register_strategy("momentum")
+@dataclasses.dataclass(frozen=True)
+class Momentum(Strategy):
+    """Compounded (J, skip) price momentum — the reference's signal
+    (``features.py:5-57`` semantics; first valid value at month J+skip+1)."""
+
+    lookback: int = 12
+    skip: int = 1
+
+    def signal(self, prices, mask, **panels):
+        return momentum(prices, mask, lookback=self.lookback, skip=self.skip)
+
+
+@register_strategy("reversal")
+@dataclasses.dataclass(frozen=True)
+class Reversal(Strategy):
+    """Short-term reversal: negative of the trailing ``lookback``-month
+    return (Jegadeesh 1990's 1-month contrarian signal by default)."""
+
+    lookback: int = 1
+    skip: int = 0
+
+    def signal(self, prices, mask, **panels):
+        mom, valid = momentum(prices, mask, lookback=self.lookback, skip=self.skip)
+        return jnp.where(valid, -mom, jnp.nan), valid
+
+
+@register_strategy("volume_z_momentum")
+@dataclasses.dataclass(frozen=True)
+class VolumeZMomentum(Strategy):
+    """Momentum tilted by trailing volume — a one-score rendering of
+    Lee–Swaminathan's finding that high-volume winners outperform
+    (``LeSw00.pdf`` §III.B; the reference computes the turnover leg at
+    ``features.py:60-107`` but never ranks on it).
+
+    ``score = z(momentum) + gamma * z(mean trailing volume)`` with both
+    legs z-scored per date; requires the engine to be given a ``volumes``
+    panel (month-summed volume, as :func:`csmom_tpu.api.monthly_price_panel`
+    produces).
+    """
+
+    lookback: int = 12
+    skip: int = 1
+    vol_lookback: int = 3
+    gamma: float = 0.5
+
+    def signal(self, prices, mask, *, volumes=None, volumes_mask=None, **panels):
+        if volumes is None:
+            raise ValueError("VolumeZMomentum needs a volumes= panel")
+        mom, mom_valid = momentum(prices, mask, lookback=self.lookback, skip=self.skip)
+        # fallback mask excludes zeros: segment-summed volume panels store
+        # 0.0 (not NaN) at never-observed slots (see monthly_price_panel's
+        # phantom-zero note), and a pre-listing zero must not enter the
+        # trailing mean — pass volumes_mask to count true zero-volume months
+        vm = (
+            volumes_mask
+            if volumes_mask is not None
+            else jnp.isfinite(volumes) & (volumes > 0)
+        )
+
+        # trailing mean volume over vol_lookback months (all present)
+        v = jnp.where(vm, jnp.nan_to_num(volumes), 0.0)
+        csum = jnp.cumsum(v, axis=1)
+        ccnt = jnp.cumsum(vm.astype(v.dtype), axis=1)
+        L = self.vol_lookback
+        prev = jnp.pad(csum, ((0, 0), (L, 0)))[:, : csum.shape[1]]
+        prevc = jnp.pad(ccnt, ((0, 0), (L, 0)))[:, : ccnt.shape[1]]
+        win_cnt = ccnt - prevc
+        vol_avg = (csum - prev) / jnp.maximum(win_cnt, 1)
+        vol_valid = win_cnt >= L
+
+        valid = mom_valid & vol_valid
+        score = xs_zscore(mom, valid) + self.gamma * xs_zscore(
+            jnp.log1p(jnp.maximum(vol_avg, 0.0)), valid
+        )
+        return jnp.where(valid, score, jnp.nan), valid
+
+
+@register_strategy("zscore_combo")
+@dataclasses.dataclass(frozen=True)
+class ZScoreCombo(Strategy):
+    """Weighted sum of cross-sectionally z-scored component strategies.
+
+    ``components`` is a tuple of ``(Strategy, weight)`` pairs (tuple so the
+    combo stays hashable/jit-static).  A slot is valid only where every
+    component is valid — matching how the reference's dropna would treat a
+    multi-column signal frame.
+    """
+
+    components: tuple = ()
+
+    def signal(self, prices, mask, **panels):
+        if not self.components:
+            raise ValueError("ZScoreCombo needs at least one component")
+        total = None
+        valid = None
+        outs = [
+            (s.signal(prices, mask, **panels), w) for s, w in self.components
+        ]
+        for (score, v), _w in outs:
+            valid = v if valid is None else (valid & v)
+        for (score, v), w in outs:
+            z = xs_zscore(jnp.where(valid, score, jnp.nan), valid)
+            contrib = w * jnp.where(valid, z, 0.0)
+            total = contrib if total is None else total + contrib
+        return jnp.where(valid, total, jnp.nan), valid

@@ -1,0 +1,183 @@
+"""Strategy plugin boundary tests.
+
+Pins the north-star constraint (BASELINE.json): plugged-in strategies run
+through the unmodified ranking/portfolio engines on both backends, and the
+built-in ``Momentum`` strategy is bit-identical to the dedicated momentum
+engine.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from csmom_tpu.backends import run_monthly
+from csmom_tpu.backtest import monthly_spread_backtest
+from csmom_tpu.ops.ranking import decile_assign_panel
+from csmom_tpu.panel.panel import Panel
+from csmom_tpu.signals.momentum import momentum
+from csmom_tpu.strategy import (
+    Momentum,
+    Reversal,
+    Strategy,
+    VolumeZMomentum,
+    ZScoreCombo,
+    available_strategies,
+    make_strategy,
+    register_strategy,
+    strategy_backtest,
+    strategy_backtest_pandas,
+)
+
+
+def _toy(rng, a=30, m=48, gaps=False):
+    prices = 50 * np.exp(np.cumsum(rng.normal(0.004, 0.06, size=(a, m)), axis=1))
+    prices[: a // 5, : m // 4] = np.nan  # late listings
+    if gaps:
+        prices[rng.random((a, m)) < 0.03] = np.nan
+    mask = np.isfinite(prices)
+    return prices, mask
+
+
+def _panel(prices):
+    a, m = prices.shape
+    times = np.array([np.datetime64("2000-01-31") + 31 * i for i in range(m)])
+    return Panel.from_dense(prices, [f"T{i:03d}" for i in range(a)], times)
+
+
+def test_momentum_strategy_matches_dedicated_engine(rng):
+    prices, mask = _toy(rng)
+    ded = monthly_spread_backtest(prices, mask, lookback=6, skip=1, n_bins=5)
+    via = strategy_backtest(prices, mask, Momentum(lookback=6, skip=1), n_bins=5)
+    np.testing.assert_array_equal(np.asarray(ded.labels), np.asarray(via.labels))
+    np.testing.assert_allclose(
+        np.asarray(ded.spread), np.asarray(via.spread), equal_nan=True
+    )
+    assert float(ded.ann_sharpe) == float(via.ann_sharpe)
+
+
+def test_reversal_is_negated_momentum_ranks(rng):
+    prices, mask = _toy(rng)
+    res = strategy_backtest(prices, mask, Reversal(lookback=1, skip=0), n_bins=5)
+    mom, valid = momentum(prices, mask, lookback=1, skip=0)
+    labels, _ = decile_assign_panel(
+        jnp.where(valid, -mom, jnp.nan), valid, n_bins=5
+    )
+    np.testing.assert_array_equal(np.asarray(res.labels), np.asarray(labels))
+
+
+def test_zscore_combo_single_component_same_deciles(rng):
+    """z-scoring is monotone per date -> identical decile labels."""
+    prices, mask = _toy(rng, gaps=True)
+    combo = ZScoreCombo(components=((Momentum(lookback=6, skip=1), 1.0),))
+    via = strategy_backtest(prices, mask, combo, n_bins=5)
+    ded = monthly_spread_backtest(prices, mask, lookback=6, skip=1, n_bins=5)
+    np.testing.assert_array_equal(np.asarray(via.labels), np.asarray(ded.labels))
+
+
+def test_volume_z_momentum_gamma_zero_matches_momentum(rng):
+    prices, mask = _toy(rng)
+    volumes = rng.lognormal(10, 1, size=prices.shape)
+    vm = mask.copy()
+    strat = VolumeZMomentum(lookback=6, skip=1, vol_lookback=3, gamma=0.0)
+    via = strategy_backtest(
+        prices, mask, strat, n_bins=5, volumes=volumes, volumes_mask=vm
+    )
+    # gamma=0 leaves the z-scored momentum, monotone per date; but validity
+    # additionally requires a full 3-month volume window
+    mom, valid = momentum(prices, mask, lookback=6, skip=1)
+    score, svalid = strat.signal(
+        jnp.asarray(prices), jnp.asarray(mask),
+        volumes=jnp.asarray(volumes), volumes_mask=jnp.asarray(vm),
+    )
+    labels, _ = decile_assign_panel(score, svalid, n_bins=5)
+    np.testing.assert_array_equal(np.asarray(via.labels), np.asarray(labels))
+    # on fully observed volume, the extra requirement only trims the first
+    # vol_lookback months
+    sv = np.asarray(svalid)
+    np.testing.assert_array_equal(sv[:, 3:], np.asarray(valid)[:, 3:])
+
+
+def test_volume_z_momentum_requires_volumes(rng):
+    prices, mask = _toy(rng)
+    with pytest.raises(ValueError, match="volumes"):
+        VolumeZMomentum().signal(jnp.asarray(prices), jnp.asarray(mask))
+
+
+def test_cross_backend_parity_custom_strategy(rng):
+    """The same plugged-in strategy gives identical deciles/spreads through
+    the TPU engine and the pandas tail."""
+    prices, mask = _toy(rng)
+    panel = _panel(prices)
+    strat = Reversal(lookback=3, skip=1)
+    tpu = run_monthly(panel, n_bins=5, backend="tpu", strategy=strat)
+    pdr = run_monthly(panel, n_bins=5, backend="pandas", strategy=strat)
+    np.testing.assert_array_equal(tpu.labels, pdr.labels)
+    np.testing.assert_allclose(tpu.spread, pdr.spread, rtol=1e-9, equal_nan=True)
+    np.testing.assert_allclose(tpu.ann_sharpe, pdr.ann_sharpe, rtol=1e-9)
+
+
+def test_run_monthly_rejects_stray_kwargs_without_strategy(rng):
+    """Typos must not be silently swallowed by the panels pass-through."""
+    prices, _ = _toy(rng)
+    with pytest.raises(TypeError, match="lokback"):
+        run_monthly(_panel(prices), lokback=6)
+
+
+def test_cli_momentum_params_flow_into_strategy():
+    """--lookback/--skip (and config momentum params) reach a --strategy
+    instance unless --strategy-arg overrides them."""
+    import argparse
+    import dataclasses as dc
+
+    from csmom_tpu.cli.main import _parse_strategy
+    from csmom_tpu.config import RunConfig
+
+    cfg = RunConfig()
+    ns = argparse.Namespace(strategy="momentum", strategy_arg=None)
+    cfg6 = dc.replace(cfg, momentum=dc.replace(cfg.momentum, lookback=6, skip=2))
+    assert _parse_strategy(ns, cfg6) == Momentum(lookback=6, skip=2)
+    ns2 = argparse.Namespace(strategy="momentum", strategy_arg=["lookback=9"])
+    assert _parse_strategy(ns2, cfg6) == Momentum(lookback=9, skip=2)
+    assert _parse_strategy(argparse.Namespace(strategy=None), cfg6) is None
+
+
+def test_volume_fallback_mask_excludes_phantom_zeros(rng):
+    """segment-summed volume panels store 0.0 at unobserved slots; the
+    fallback mask must not rank those months."""
+    prices, mask = _toy(rng, a=20, m=30)
+    volumes = rng.lognormal(10, 1, size=prices.shape)
+    volumes[:, :10] = 0.0  # phantom pre-listing zeros, no mask given
+    strat = VolumeZMomentum(lookback=3, skip=1, vol_lookback=3)
+    _, valid = strat.signal(
+        jnp.asarray(prices), jnp.asarray(mask), volumes=jnp.asarray(volumes)
+    )
+    # windows overlapping the phantom region are invalid
+    assert not np.asarray(valid)[:, :12].any()
+
+
+def test_registry_roundtrip_and_unknown():
+    s = make_strategy("momentum", lookback=9, skip=2)
+    assert s == Momentum(lookback=9, skip=2)
+    assert "reversal" in available_strategies()
+    with pytest.raises(KeyError, match="unknown strategy"):
+        make_strategy("nope")
+
+
+def test_user_registered_strategy_runs_through_engine(rng):
+    @register_strategy("test_price_level")
+    @dataclasses.dataclass(frozen=True)
+    class PriceLevel(Strategy):
+        """Rank directly on price level (a deliberately silly plugin)."""
+
+        def signal(self, prices, mask, **panels):
+            return jnp.where(mask, prices, jnp.nan), mask
+
+    prices, mask = _toy(rng)
+    res = strategy_backtest(prices, mask, make_strategy("test_price_level"), n_bins=5)
+    # every observed month ranks (no warmup for this signal)
+    labels = np.asarray(res.labels)
+    assert (labels[mask] >= 0).all()
+    pdr = strategy_backtest_pandas(_panel(prices).to_dataframe(), PriceLevel(), n_bins=5)
+    np.testing.assert_array_equal(labels, pdr.labels.to_numpy())
