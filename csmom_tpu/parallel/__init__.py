@@ -19,7 +19,13 @@ communication backend).  Here distribution is first-class:
   via ``jax.distributed.initialize`` + the process-spanning mesh.
 """
 
-from csmom_tpu.parallel.mesh import make_mesh, auto_mesh
+from csmom_tpu.parallel.mesh import (
+    auto_mesh,
+    distributed_init,
+    make_hybrid_mesh,
+    make_mesh,
+    mesh_topology,
+)
 from csmom_tpu.parallel.collectives import (
     sharded_monthly_spread_backtest,
     sharded_jk_grid_backtest,
@@ -31,6 +37,9 @@ from csmom_tpu.parallel.event_time import time_sharded_event_backtest
 __all__ = [
     "make_mesh",
     "auto_mesh",
+    "make_hybrid_mesh",
+    "mesh_topology",
+    "distributed_init",
     "sharded_monthly_spread_backtest",
     "sharded_jk_grid_backtest",
     "sharded_block_bootstrap",

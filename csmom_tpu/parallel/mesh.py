@@ -1,6 +1,18 @@
-"""Mesh construction helpers."""
+"""Mesh construction helpers (single-slice and multi-host hybrid).
+
+Multi-host layout principle (the scaling-book recipe, SURVEY §2 row 15):
+axes that need collectives stay on fast links, axes that don't can cross
+slow ones.  Here the asset axis is the only one with communication (one
+``all_gather`` for the cross-sectional rank + ``psum``s for portfolio
+reductions), so it must ride **ICI** — i.e. stay within one host/slice.
+The grid and bootstrap axes are embarrassingly parallel (zero collectives),
+so they span **DCN** across hosts for free.  :func:`make_hybrid_mesh`
+encodes exactly that placement.
+"""
 
 from __future__ import annotations
+
+import collections
 
 import numpy as np
 
@@ -32,6 +44,108 @@ def auto_mesh(n_devices: int | None = None, prefer_grid: bool = False) -> Mesh:
         devices = devices[:n_devices]
     grid = 2 if (prefer_grid and len(devices) % 2 == 0 and len(devices) > 1) else 1
     return make_mesh(devices, grid_axis=grid)
+
+
+def distributed_init(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join a multi-host run via ``jax.distributed.initialize``.
+
+    The reference has no distributed anything (SURVEY §2 row 15); this is
+    the rebuild's equivalent of an NCCL/MPI bootstrap: after it returns,
+    ``jax.devices()`` spans every process and meshes built from it run XLA
+    collectives over ICI within a slice and DCN between slices.
+
+    MUST run before any JAX computation touches the backend (jax's own
+    contract for ``distributed.initialize``).  Arguments are optional:
+    jax auto-detects TPU pods, SLURM, and Open MPI.  Returns True when the
+    distributed service came up, False for a plain single-process run
+    (no cluster environment and no coordinator given) or when the service
+    is already up (e.g. the launcher initialized it).  Genuine
+    initialization failures — including calling this after the backend
+    already initialized — propagate.
+    """
+    if jax.distributed.is_initialized():
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except ValueError as e:
+        # auto-detection found no cluster env and no coordinator was given:
+        # a normal single-process run, not an error
+        if coordinator_address is None and "coordinator_address" in str(e):
+            return False
+        raise
+
+
+def _group_by_host(devices, n_hosts: int | None):
+    """Split a flat device list into per-host rows.
+
+    Real multi-process runs group by ``device.process_index`` (each row is
+    one host's ICI domain).  When every device reports the same process
+    (single host, or a CPU-simulated mesh), an explicit ``n_hosts`` splits
+    the list evenly to emulate the topology for tests.
+    """
+    by_proc = collections.defaultdict(list)
+    for d in devices:
+        by_proc[getattr(d, "process_index", 0)].append(d)
+    if len(by_proc) > 1:
+        rows = [by_proc[p] for p in sorted(by_proc)]
+        sizes = {len(r) for r in rows}
+        if len(sizes) != 1:
+            raise ValueError(f"uneven devices per host: {sorted(sizes)}")
+        if n_hosts is not None and n_hosts != len(rows):
+            raise ValueError(f"n_hosts={n_hosts} but {len(rows)} processes present")
+        return rows
+    n = len(devices)
+    n_hosts = n_hosts or 1
+    if n % n_hosts != 0:
+        raise ValueError(f"{n} devices not divisible by n_hosts={n_hosts}")
+    per = n // n_hosts
+    return [list(devices[i * per : (i + 1) * per]) for i in range(n_hosts)]
+
+
+def make_hybrid_mesh(
+    devices=None,
+    n_hosts: int | None = None,
+    axis_names=("grid", "assets"),
+) -> Mesh:
+    """2D hybrid mesh: first axis spans hosts (DCN), second stays ICI-local.
+
+    ``axis_names[0]`` names the collective-free axis (parameter grid,
+    bootstrap resamples, walk-forward folds — anything embarrassingly
+    parallel) and gets one mesh slot per host, so its traffic is zero and
+    DCN latency is irrelevant.  ``axis_names[1]`` is the asset axis whose
+    all_gather/psum collectives then never leave a host's ICI domain.
+
+    On a single host this degenerates to ``make_mesh(grid_axis=1)`` unless
+    ``n_hosts`` explicitly simulates a topology (the CPU-mesh test path).
+    """
+    if devices is None:
+        devices = jax.devices()
+    rows = _group_by_host(devices, n_hosts)
+    return Mesh(np.asarray(rows), axis_names)
+
+
+def mesh_topology(mesh: Mesh) -> dict:
+    """Describe which mesh axes cross process (DCN) boundaries — the thing
+    to assert in tests and log at startup."""
+    arr = mesh.devices
+    out = {}
+    for ax, name in enumerate(mesh.axis_names):
+        moved = np.moveaxis(arr, ax, 0)
+        crosses = any(
+            len({getattr(d, "process_index", 0) for d in col}) > 1
+            for col in np.reshape(moved, (moved.shape[0], -1)).T
+        )
+        out[name] = {"size": arr.shape[ax], "crosses_hosts": bool(crosses)}
+    return out
 
 
 def pad_assets(values, mask, n_shards: int):

@@ -59,17 +59,6 @@ class Strategy(abc.ABC):
           ``mom_J`` rows dropped at ``run_demo.py:41``.
         """
 
-    @property
-    def label(self) -> str:
-        """Human-readable id (registry name + non-default params)."""
-        fields = dataclasses.fields(self)
-        parts = [
-            f"{f.name}={getattr(self, f.name)!r}"
-            for f in fields
-            if getattr(self, f.name) != f.default
-        ]
-        return f"{type(self).__name__}({', '.join(parts)})"
-
 
 _REGISTRY: dict[str, type[Strategy]] = {}
 

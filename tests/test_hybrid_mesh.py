@@ -1,0 +1,134 @@
+"""Multi-host hybrid mesh (DCN x ICI) on the mocked 8-device CPU mesh.
+
+Simulates 2 hosts x 4 chips: the collective-free grid/bootstrap axis spans
+"hosts" while the asset axis (all_gather + psum) stays host-local, and the
+sharded engines still match the single-device engines exactly.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from csmom_tpu.backtest import jk_grid_backtest
+from csmom_tpu.parallel import (
+    make_hybrid_mesh,
+    mesh_topology,
+    distributed_init,
+    sharded_jk_grid_backtest,
+)
+from csmom_tpu.parallel.mesh import _group_by_host, pad_assets
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    if len(jax.devices()) < 8:
+        pytest.skip("8 virtual CPU devices not configured")
+    return jax.devices()[:8]
+
+
+def _panel(rng, A=29, M=72):
+    prices = 50 * np.exp(np.cumsum(rng.normal(0.003, 0.07, size=(A, M)), axis=1))
+    prices[:5, :12] = np.nan
+    mask = np.isfinite(prices)
+    return prices, mask
+
+
+def test_hybrid_mesh_shape_and_grouping(eight_devices):
+    mesh = make_hybrid_mesh(eight_devices, n_hosts=2)
+    assert dict(mesh.shape) == {"grid": 2, "assets": 4}
+    # each "host" row is a contiguous block of the device list (ICI domain)
+    assert list(mesh.devices[0]) == list(eight_devices[:4])
+    assert list(mesh.devices[1]) == list(eight_devices[4:])
+    topo = mesh_topology(mesh)
+    assert topo["grid"]["size"] == 2 and topo["assets"]["size"] == 4
+    # simulated hosts share one process, so nothing truly crosses
+    assert not topo["assets"]["crosses_hosts"]
+
+
+def test_group_by_host_uses_process_index():
+    """Real multi-process grouping: rows follow device.process_index."""
+
+    @dataclasses.dataclass
+    class FakeDev:
+        id: int
+        process_index: int
+
+    devs = [FakeDev(i, i % 2) for i in range(8)]  # interleaved processes
+    rows = _group_by_host(devs, None)
+    assert [d.process_index for d in rows[0]] == [0] * 4
+    assert [d.process_index for d in rows[1]] == [1] * 4
+    with pytest.raises(ValueError, match="n_hosts=3"):
+        _group_by_host(devs, 3)
+    uneven = [FakeDev(0, 0), FakeDev(1, 0), FakeDev(2, 1)]
+    with pytest.raises(ValueError, match="uneven"):
+        _group_by_host(uneven, None)
+
+
+def test_group_by_host_single_process_split():
+    @dataclasses.dataclass
+    class FakeDev:
+        id: int
+        process_index: int = 0
+
+    devs = [FakeDev(i) for i in range(6)]
+    rows = _group_by_host(devs, 3)
+    assert [len(r) for r in rows] == [2, 2, 2]
+    with pytest.raises(ValueError, match="not divisible"):
+        _group_by_host(devs, 4)
+
+
+def test_grid_engine_on_hybrid_mesh_matches_single(rng, eight_devices):
+    """2 simulated hosts x 4 chips: J cells across 'hosts', assets within."""
+    prices, mask = _panel(rng)
+    mesh = make_hybrid_mesh(eight_devices, n_hosts=2)
+    pv, mv, _ = pad_assets(prices, mask, mesh.shape["assets"])
+
+    Js = np.array([6, 12])
+    Ks = np.array([1, 3, 6])
+    spreads, live, mean, sh, ts = sharded_jk_grid_backtest(pv, mv, Js, Ks, mesh)
+    single = jk_grid_backtest(prices, mask, Js, Ks)
+
+    np.testing.assert_array_equal(np.asarray(live), np.asarray(single.spread_valid))
+    np.testing.assert_allclose(
+        np.asarray(spreads)[np.asarray(live)],
+        np.asarray(single.spreads)[np.asarray(single.spread_valid)],
+        rtol=1e-11,
+    )
+    np.testing.assert_allclose(np.asarray(sh), np.asarray(single.ann_sharpe),
+                               rtol=1e-10, equal_nan=True)
+
+
+def test_distributed_init_single_process_and_errors(monkeypatch):
+    """No cluster env -> False; real failures propagate; already-up -> False.
+
+    jax.distributed.initialize is monkeypatched: really initializing (or
+    running cluster auto-detection) inside a sandboxed test process would
+    touch the network/backend.
+    """
+    calls = {}
+
+    def fake_initialize(coordinator_address=None, num_processes=None, process_id=None):
+        calls["args"] = (coordinator_address, num_processes, process_id)
+        raise ValueError("coordinator_address should be defined.")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    assert distributed_init() is False  # plain single-process run
+    assert calls["args"] == (None, None, None)
+
+    # an explicit coordinator means the same error is a genuine failure
+    with pytest.raises(ValueError, match="coordinator_address"):
+        distributed_init(coordinator_address="10.0.0.1:1234")
+
+    def boom(**kw):
+        raise RuntimeError("backend already initialized")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with pytest.raises(RuntimeError, match="already initialized"):
+        distributed_init()
+
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    assert distributed_init() is False  # launcher brought the service up

@@ -82,5 +82,6 @@ def test_custom_tiling(rng):
         n_bins=10, block_a=128, block_t=128, interpret=True,
     )
     ws, wc = _xla(labels, ret_z, 10)
-    np.testing.assert_allclose(np.asarray(sums), ws, rtol=1e-12)
+    # blocked accumulation reorders the sum vs XLA: tolerance, not equality
+    np.testing.assert_allclose(np.asarray(sums), ws, rtol=1e-10)
     np.testing.assert_allclose(np.asarray(counts), wc)
