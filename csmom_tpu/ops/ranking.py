@@ -33,26 +33,40 @@ import jax.numpy as jnp
 _BIG = jnp.inf
 
 
-def _ordinal_ranks(x, valid):
-    """1-based ordinal ranks among valid lanes (ties by position),
-    matching ``Series.rank(method='first')``.
+def _rank_labels(x, valid, n_bins: int):
+    """The reference's fallback binning: ``floor(pct_rank * n_bins)`` capped
+    at ``n_bins-1`` (``run_demo.py:26-29``), ties by position like
+    ``Series.rank(method='first')``.
 
-    The inverse permutation comes from a second argsort rather than a
-    scatter: TPU scatters serialize, and the sort is ~6x faster here."""
+    One argsort only.  Bin ``k``'s lowest member sits at 1-based ordinal
+    rank ``ceil(k*n/B)``, so a lane's label equals how many of the B-1
+    boundary pairs ``(value, position)`` it lexicographically dominates —
+    O(A*B) elementwise compares instead of the inverse permutation (a
+    second argsort; TPU scatters serialize and are worse still), which
+    makes rank mode strictly cheaper than the qcut parity path.
+
+    Documented deviation (rank mode is the fast path, qcut the parity
+    mode): boundaries use *exact integer* arithmetic, while the reference
+    evaluates ``floor((r/n)*B)`` in float64, whose rounding can misplace a
+    boundary by one lane when ``k*n/B`` is an exact integer that ``r/n``
+    cannot represent (e.g. B=100, n=50, rank 29).  For the reference's
+    only bin count, B=10, the two agree for every n up to at least 20,000
+    assets (checked exhaustively); larger B may differ on ~1 boundary lane
+    per affected date, and the exact-arithmetic answer is the intended
+    binning."""
+    A = x.shape[0]
     key = jnp.where(valid, x, _BIG)
     order = jnp.argsort(key, stable=True)  # invalid lanes sort last
-    inverse = jnp.argsort(order)           # exact inverse (order is a permutation)
-    return (inverse + 1).astype(jnp.int32)
-
-
-def _rank_labels(x, valid, n_bins: int):
-    """The reference's fallback binning: ``floor(pct_rank * n)`` capped at
-    ``n-1`` (``run_demo.py:26-29``)."""
-    n_valid = jnp.sum(valid)
-    ranks = _ordinal_ranks(x, valid)
-    pct = ranks.astype(x.dtype) / jnp.maximum(n_valid, 1)
-    labels = jnp.floor(pct * n_bins).astype(jnp.int32)
-    labels = jnp.where(labels == n_bins, n_bins - 1, labels)
+    n = jnp.sum(valid).astype(jnp.int32)
+    k = jnp.arange(1, n_bins, dtype=jnp.int32)
+    r_k = (k * n + n_bins - 1) // n_bins   # ceil(k*n/B): label >= k iff rank >= r_k
+    b = order[jnp.clip(r_k - 1, 0, A - 1)]  # boundary lanes, one per bin edge
+    v = key[b]
+    pos = jnp.arange(A, dtype=b.dtype)
+    ge = (key[:, None] > v[None, :]) | (
+        (key[:, None] == v[None, :]) & (pos[:, None] >= b[None, :])
+    )
+    labels = jnp.sum(ge, axis=1).astype(jnp.int32)
     return jnp.where(valid, labels, -1)
 
 

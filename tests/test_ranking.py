@@ -92,6 +92,29 @@ def test_rank_mode_matches_reference_fallback(rng):
     np.testing.assert_array_equal(np.asarray(got), bins.astype(int).values)
 
 
+def test_rank_mode_fuzz_ties_masks_small_n(rng):
+    """Rank mode vs the pandas fallback formula under heavy ties, masked
+    lanes, and tiny/degenerate cross-sections (exercises the boundary-pair
+    formulation's tie-breaks)."""
+    for trial in range(200):
+        a = int(rng.integers(1, 60))
+        vals = rng.choice(
+            [np.nan, 0.0, 0.0, 1.0, 1.0, -2.5, *rng.normal(size=3)], size=a
+        )
+        valid = np.isfinite(vals)
+        for n_bins in (3, 10):
+            got, _ = decile_assign(vals, valid, n_bins=n_bins, mode="rank")
+            got = np.asarray(got)
+            if not valid.any():
+                assert (got == -1).all()
+                continue
+            ranks = pd.Series(vals).rank(method="first", pct=True)
+            bins = np.floor(ranks * n_bins)
+            bins[bins == n_bins] = n_bins - 1
+            np.testing.assert_array_equal(got[valid], bins[valid].astype(int))
+            assert (got[~valid] == -1).all()
+
+
 def test_panel_vmap(rng):
     x = rng.normal(size=(20, 15))
     x[rng.random(x.shape) < 0.2] = np.nan
