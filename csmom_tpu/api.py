@@ -144,15 +144,28 @@ def intraday_pipeline(
     threshold: float = 1e-5,
     cash0: float = 1_000_000.0,
     dtype=np.float64,
+    model: str = "ridge",
+    l1_ratio: float = 0.5,
 ):
-    """Minute bars -> features -> ridge scores -> event backtest.
+    """Minute bars -> features -> model scores -> event backtest.
 
     The panel-world equivalent of ``intraday_pipeline`` + ``backtest_run``
-    (``run_demo.py:81-191``).  Returns (EventResult, RidgeFit, compact,
-    dense_score, dense_price, dense_valid).
+    (``run_demo.py:81-191``).  ``model`` selects the score model:
+    ``'ridge'`` (the reference's, ``models.py:8-22``) or ``'elastic_net'``
+    / ``'lasso'`` (sparse extensions; ``alpha``/``l1_ratio`` apply).
+    Note the scales differ: ridge's ``alpha`` is the reference's 1.0, but
+    the elastic-net objective is per-row and minute returns are ~1e-4, so
+    useful l1 penalties live around 1e-9..1e-7 (larger zeroes every
+    coefficient and the strategy goes flat).
+    Returns (EventResult, RidgeFit, compact, dense_score, dense_price,
+    dense_valid).
     """
     from csmom_tpu.signals.intraday import compact_minutes, minute_features, next_row_return
-    from csmom_tpu.models import ridge_time_series_cv
+    from csmom_tpu.models import (
+        as_ridge_fit,
+        elastic_net_time_series_cv,
+        ridge_time_series_cv,
+    )
     from csmom_tpu.backtest.event import event_backtest
 
     if minute_df is None or len(minute_df) == 0:
@@ -171,7 +184,27 @@ def intraday_pipeline(
 
     feats, feat_valid = minute_features(price, volume, row_valid, window=window_minutes)
     y, y_valid = next_row_return(price, feat_valid)
-    fit = ridge_time_series_cv(feats, y, y_valid, n_splits=n_splits, alpha=alpha)
+    if model == "ridge":
+        fit = ridge_time_series_cv(feats, y, y_valid, n_splits=n_splits, alpha=alpha)
+    elif model in ("elastic_net", "lasso"):
+        enet = elastic_net_time_series_cv(
+            feats, y, y_valid, n_splits=n_splits, alpha=alpha,
+            l1_ratio=1.0 if model == "lasso" else l1_ratio,
+        )
+        if int(enet.n_nonzero) == 0:
+            import logging
+
+            logging.getLogger("csmom_tpu.api").warning(
+                "%s with alpha=%g zeroed every coefficient — scores are the "
+                "intercept only and the strategy will be (nearly) flat; "
+                "minute-return labels are ~1e-4, so useful l1 penalties are "
+                "~1e-9..1e-7", model, alpha,
+            )
+        fit = as_ridge_fit(enet)
+    else:
+        raise ValueError(
+            f"unknown model {model!r} (expected 'ridge', 'elastic_net', or 'lasso')"
+        )
 
     # scatter compacted rows onto the global minute axis; padded/non-model
     # rows are routed to a spill column that is sliced off

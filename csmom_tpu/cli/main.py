@@ -191,7 +191,8 @@ def cmd_sweep(args) -> int:
 
 def cmd_intraday(args) -> int:
     """Intraday pipeline + event backtest (``run_demo.py:81-191``): features,
-    ridge CV, per-minute fills; writes trades.csv + intraday_cum_pnl.png."""
+    linear-model CV (--model ridge|elastic_net|lasso), per-minute fills;
+    writes trades.csv + intraday_cum_pnl.png."""
     import numpy as np
 
     cfg = _load_cfg(args)
@@ -201,14 +202,29 @@ def cmd_intraday(args) -> int:
     tickers = list(cfg.universe.tickers)
     minute_df = load_intraday(cfg.universe.data_dir, tickers)
     daily_df = load_daily(cfg.universe.data_dir, tickers)
+    model = getattr(args, "model", None) or "ridge"
+    if getattr(args, "alpha", None) is not None:
+        alpha = args.alpha
+    elif model == "ridge":
+        alpha = cfg.intraday.alpha
+    else:
+        # l1 penalties live on the per-row objective scale (~1e-4 minute
+        # returns), not the ridge scale — a ridge-sized default would zero
+        # every coefficient (see intraday_pipeline's docstring)
+        alpha = 1e-8
+    extra = {}
+    if getattr(args, "l1_ratio", None) is not None:
+        extra["l1_ratio"] = args.l1_ratio
     res, fit, compact, dense_score, _p, _v = intraday_pipeline(
         minute_df, daily_df,
         window_minutes=cfg.intraday.window_minutes,
         n_splits=cfg.intraday.n_splits,
-        alpha=cfg.intraday.alpha,
+        alpha=alpha,
         size_shares=cfg.intraday.size_shares,
         threshold=cfg.intraday.threshold,
         cash0=cfg.intraday.cash0,
+        model=model,
+        **extra,
     )
     print(f"CV MSEs:     {[f'{m:.3g}' for m in np.asarray(fit.cv_mse)]}")
     print(f"Trades:      {int(res.n_trades)} "
@@ -269,7 +285,7 @@ def build_parser() -> argparse.ArgumentParser:
         ("replicate", cmd_replicate, ("bootstrap", "strategy")),
         ("grid", cmd_grid, ("js", "ks")),
         ("sweep", cmd_sweep, ("js", "ks", "min_months")),
-        ("intraday", cmd_intraday, ()),
+        ("intraday", cmd_intraday, ("model",)),
         ("bench", cmd_bench, ()),
     ):
         sp = sub.add_parser(name, help=(fn.__doc__ or "").splitlines()[0])
@@ -283,6 +299,12 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--bootstrap", type=int, metavar="N",
                             help="print block-bootstrap 95%% CIs from N resamples")
             sp.add_argument("--block-len", dest="block_len", type=int)
+        if "model" in extra:
+            sp.add_argument("--model", choices=["ridge", "elastic_net", "lasso"],
+                            help="score model (default: ridge, the reference's)")
+            sp.add_argument("--alpha", type=float, help="regularization strength")
+            sp.add_argument("--l1-ratio", dest="l1_ratio", type=float,
+                            help="elastic-net l1 ratio (default 0.5)")
         if "strategy" in extra:
             sp.add_argument("--strategy",
                             help="registered strategy plugin to rank instead of "

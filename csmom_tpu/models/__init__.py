@@ -1,5 +1,17 @@
-"""Predictive models: closed-form linear/ridge regression with time-series CV."""
+"""Predictive models: linear family (ridge closed-form, elastic-net/lasso
+via FISTA) with expanding-window time-series CV."""
 
 from csmom_tpu.models.ridge import ridge_time_series_cv, RidgeFit
+from csmom_tpu.models.elastic_net import (
+    ElasticNetFit,
+    as_ridge_fit,
+    elastic_net_time_series_cv,
+)
 
-__all__ = ["ridge_time_series_cv", "RidgeFit"]
+__all__ = [
+    "ridge_time_series_cv",
+    "RidgeFit",
+    "elastic_net_time_series_cv",
+    "ElasticNetFit",
+    "as_ridge_fit",
+]

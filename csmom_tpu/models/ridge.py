@@ -59,31 +59,30 @@ def _masked_ridge(Xs, y, w, alpha):
     return coef, intercept
 
 
-@partial(jax.jit, static_argnames=("n_splits", "train_frac_small"))
-def ridge_time_series_cv(
+def time_series_cv_harness(
     features,
     y,
     valid,
-    n_splits: int = 3,
-    alpha: float = 1.0,
-    train_frac: float = 0.7,
-    train_frac_small: float = 0.6,
-    small_threshold: int = 100,
-) -> RidgeFit:
-    """Scale -> expanding-window CV -> final ridge -> score full history.
+    solver,
+    n_splits: int,
+    train_frac: float,
+    train_frac_small: float,
+    small_threshold: int,
+):
+    """Shared prepare -> scale -> expanding-CV -> final-fit -> score harness.
 
-    Args:
-      features: f[A, R, F] compacted feature tensor (padded rows arbitrary).
-      y: f[A, R] next-row return labels.
-      valid: bool[A, R] modeling rows (features and label all defined).
-      n_splits: CV folds (reference runs 3, models.py called at run_demo:140).
-      alpha: ridge penalty.
-      train_frac: leading fraction of rows used for training — the driver
-        trains on the first 70% (60% when n <= 100) of rows in
-        (ticker, datetime) order and scores everything (run_demo.py:139-147).
+    The one implementation of the reference pipeline's modeling scaffold
+    (``run_demo.py:139-147`` + ``models.py:8-22``) used by every linear
+    model: flatten to the global (ticker, datetime) row order, train on the
+    leading ``train_frac`` of valid rows, fit the scaler on that training
+    block, run ``TimeSeriesSplit``-layout expanding folds, refit on the
+    full training block, score the entire history.
 
-    Returns RidgeFit; ``scores`` covers every valid row (the by-design
-    "score the training span too" behaviour of the demo).
+    ``solver(Xs, yf, w)`` fits one model on rows weighted by w (0/1) and
+    returns ``(coef f[F], intercept f[])``; it is called per fold and for
+    the final fit, so any model that can fit a weighted row set plugs in.
+
+    Returns ``(coef, intercept, mean, std, cv_mse, scores, n_train)``.
     """
     A, R, F = features.shape
     Xf = jnp.nan_to_num(features.reshape(A * R, F))
@@ -117,7 +116,7 @@ def ridge_time_series_cv(
         test_start = n_train - (n_splits - i) * test_size
         tr = train & (ordinal < test_start)
         te = train & (ordinal >= test_start) & (ordinal < test_start + test_size)
-        coef, icept = _masked_ridge(Xs, yf, tr.astype(Xf.dtype), alpha)
+        coef, icept = solver(Xs, yf, tr.astype(Xf.dtype))
         pred = Xs @ coef + icept
         wte = te.astype(Xf.dtype)
         mse = jnp.sum(wte * (pred - yf) ** 2) / jnp.maximum(jnp.sum(wte), 1.0)
@@ -125,10 +124,44 @@ def ridge_time_series_cv(
 
     cv_mse = jnp.stack([fold(i) for i in range(n_splits)])
 
-    coef, icept = _masked_ridge(Xs, yf, w_tr, alpha)
+    coef, icept = solver(Xs, yf, w_tr)
     scores = (Xs @ coef + icept).reshape(A, R)
     scores = jnp.where(valid, scores, jnp.nan)
+    return coef, icept, mean, std, cv_mse, scores, n_train
 
+
+@partial(jax.jit, static_argnames=("n_splits", "train_frac_small"))
+def ridge_time_series_cv(
+    features,
+    y,
+    valid,
+    n_splits: int = 3,
+    alpha: float = 1.0,
+    train_frac: float = 0.7,
+    train_frac_small: float = 0.6,
+    small_threshold: int = 100,
+) -> RidgeFit:
+    """Scale -> expanding-window CV -> final ridge -> score full history.
+
+    Args:
+      features: f[A, R, F] compacted feature tensor (padded rows arbitrary).
+      y: f[A, R] next-row return labels.
+      valid: bool[A, R] modeling rows (features and label all defined).
+      n_splits: CV folds (reference runs 3, models.py called at run_demo:140).
+      alpha: ridge penalty.
+      train_frac: leading fraction of rows used for training — the driver
+        trains on the first 70% (60% when n <= 100) of rows in
+        (ticker, datetime) order and scores everything (run_demo.py:139-147).
+
+    Returns RidgeFit; ``scores`` covers every valid row (the by-design
+    "score the training span too" behaviour of the demo).
+    """
+    coef, icept, mean, std, cv_mse, scores, n_train = time_series_cv_harness(
+        features, y, valid,
+        solver=lambda Xs, yf, w: _masked_ridge(Xs, yf, w, alpha),
+        n_splits=n_splits, train_frac=train_frac,
+        train_frac_small=train_frac_small, small_threshold=small_threshold,
+    )
     return RidgeFit(
         coef=coef,
         intercept=icept,
