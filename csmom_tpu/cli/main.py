@@ -106,6 +106,13 @@ def cmd_replicate(args) -> int:
     print(f"Annualized Sharpe:   {rep.ann_sharpe:.4f}")
     print(f"t-stat:              {rep.tstat:.3f}")
 
+    if getattr(args, "tables", False):
+        from csmom_tpu.analytics.tables import decile_table
+
+        print("\nPer-decile performance (R1 = losers):")
+        print(decile_table(rep.decile_means, rep.decile_counts,
+                           rep.spread).round(4).to_string())
+
     if getattr(args, "bootstrap", None):
         import jax
         import numpy as np
@@ -146,15 +153,75 @@ def cmd_grid(args) -> int:
         skip=cfg.momentum.skip, n_bins=cfg.momentum.n_bins, mode=cfg.momentum.mode,
     )
 
-    def table(name, grid):
-        print(f"\n{name} (rows J={Js}, cols K={Ks})")
-        for i, J in enumerate(Js):
-            row = "  ".join(f"{float(grid[i, j]):9.4f}" for j in range(len(Ks)))
-            print(f"  J={J:>2}  {row}")
+    from csmom_tpu.analytics.tables import jk_grid_table
 
-    table("mean monthly spread", np.asarray(res.mean_spread))
-    table("annualized Sharpe", np.asarray(res.ann_sharpe))
-    table("t-stat", np.asarray(res.tstat))
+    mean_df, tstat_df, sharpe_df = jk_grid_table(res.spreads, res.spread_valid, Js, Ks)
+    for name, df in (("mean monthly spread", mean_df),
+                     ("t-stat", tstat_df),
+                     ("annualized Sharpe", sharpe_df)):
+        print(f"\n{name}:")
+        print(df.round(4).to_string())
+    return 0
+
+
+def cmd_doublesort(args) -> int:
+    """Momentum spread within volume terciles (Lee-Swaminathan Table II;
+    the turnover leg the reference computes but never ranks on,
+    ``features.py:60-107`` / SURVEY item 6)."""
+    import numpy as np
+
+    cfg = _load_cfg(args)
+    prices, volume = _price_panel(cfg)
+
+    from csmom_tpu.analytics.tables import double_sort_table
+    from csmom_tpu.backtest import volume_double_sort
+    from csmom_tpu.panel.fetch import get_shares_info
+    from csmom_tpu.signals.turnover import (
+        shares_outstanding_vector,
+        turnover_features,
+    )
+
+    shares_info = get_shares_info(list(prices.tickers)) if args.fetch_shares else {}
+    pv = np.asarray(prices.values)
+    # each asset's last *finite* price (not the final column, which is NaN
+    # for names that stopped trading) keeps the market_cap/price fallback
+    # usable for every asset
+    finite = np.isfinite(pv)
+    last_idx = pv.shape[1] - 1 - np.argmax(finite[:, ::-1], axis=1)
+    last_price = np.where(
+        finite.any(axis=1), pv[np.arange(pv.shape[0]), last_idx], np.nan
+    )
+    shares = np.asarray(shares_outstanding_vector(prices.tickers, shares_info,
+                                                  last_price))
+    known = np.isfinite(shares)
+    if not known.any():
+        # offline runs have no shares metadata (get_shares_info is a network
+        # fetch); trailing share volume is the standard proxy — within a
+        # cross-section it sorts identically to turnover whenever float
+        # counts are comparable
+        print("note: no shares-outstanding metadata (run with --fetch-shares "
+              "for true turnover); sorting on trailing average volume instead")
+        shares = np.ones(len(prices.tickers))
+    elif not known.all():
+        missing = [t for t, k in zip(prices.tickers, known) if not k]
+        print(f"note: no shares metadata for {len(missing)} ticker(s) "
+              f"({', '.join(missing[:5])}{'...' if len(missing) > 5 else ''}) — "
+              "they are excluded from the volume terciles")
+    turn_lb = args.turnover_lookback or cfg.momentum.turnover_lookback
+    turn, turn_valid = turnover_features(
+        np.asarray(volume.values), np.asarray(volume.mask), shares,
+        lookback=turn_lb,
+    )["turn_avg"]
+    res = volume_double_sort(
+        pv, np.asarray(prices.mask),
+        np.asarray(turn), np.asarray(turn_valid),
+        lookback=cfg.momentum.lookback, skip=cfg.momentum.skip,
+        n_bins=cfg.momentum.n_bins, mode=cfg.momentum.mode,
+    )
+    print("Momentum spread by volume tercile "
+          f"(J={cfg.momentum.lookback}, skip={cfg.momentum.skip}, "
+          f"turnover avg over {turn_lb} months):")
+    print(double_sort_table(res).round(4).to_string())
     return 0
 
 
@@ -281,9 +348,10 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command")
 
     for name, fn, extra in (
-        ("run", cmd_run, ("bootstrap", "strategy")),
-        ("replicate", cmd_replicate, ("bootstrap", "strategy")),
+        ("run", cmd_run, ("bootstrap", "strategy", "tables")),
+        ("replicate", cmd_replicate, ("bootstrap", "strategy", "tables")),
         ("grid", cmd_grid, ("js", "ks")),
+        ("doublesort", cmd_doublesort, ("doublesort",)),
         ("sweep", cmd_sweep, ("js", "ks", "min_months")),
         ("intraday", cmd_intraday, ("model",)),
         ("bench", cmd_bench, ()),
@@ -299,6 +367,19 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--bootstrap", type=int, metavar="N",
                             help="print block-bootstrap 95%% CIs from N resamples")
             sp.add_argument("--block-len", dest="block_len", type=int)
+        if "tables" in extra:
+            sp.add_argument("--tables", action="store_true",
+                            help="print the paper-style per-decile table")
+        if "doublesort" in extra:
+            sp.add_argument("--fetch-shares", dest="fetch_shares",
+                            action="store_true",
+                            help="fetch shares outstanding for true turnover "
+                                 "(network); default uses a volume proxy")
+            sp.add_argument("--turnover-lookback", dest="turnover_lookback",
+                            type=int,
+                            help="months averaged into the volume sort "
+                                 "(default: config's 3; use J for the "
+                                 "paper's formation-period turnover)")
         if "model" in extra:
             sp.add_argument("--model", choices=["ridge", "elastic_net", "lasso"],
                             help="score model (default: ridge, the reference's)")

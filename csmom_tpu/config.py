@@ -43,6 +43,7 @@ class MomentumConfig:
     n_bins: int = 10
     mode: str = "qcut"          # 'qcut' parity | 'rank' fast
     holding: int = 1            # K (reference holds 1 month)
+    turnover_lookback: int = 3  # turn_avg window (features.py:60 lookback=3)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,76 @@
+"""Paper-style table builders vs hand-computed stats."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from csmom_tpu.analytics.tables import decile_table, double_sort_table, jk_grid_table
+
+
+def _stats(x):
+    x = x[np.isfinite(x)]
+    mean = x.mean()
+    sd = x.std(ddof=1)
+    return mean, mean * 12 / (sd * np.sqrt(12)), mean / (sd / np.sqrt(len(x)))
+
+
+def test_decile_table_stats(rng):
+    B, M = 5, 60
+    means = rng.normal(0.005, 0.03, size=(B, M))
+    counts = rng.integers(1, 8, size=(B, M))
+    counts[1, :10] = 0  # decile 2 empty for 10 months
+    means[counts == 0] = np.nan
+    spread = means[B - 1] - means[0]
+
+    df = decile_table(means, counts, spread)
+    assert list(df.index) == ["R1", "R2", "R3", "R4", "R5", "R5-R1"]
+
+    m, s, t = _stats(means[1][counts[1] > 0])
+    row = df.loc["R2"]
+    np.testing.assert_allclose([row.mean_ret, row.ann_sharpe, row.t_stat], [m, s, t])
+    assert row.months == M - 10
+    np.testing.assert_allclose(df.loc["R5-R1"].mean_ret, _stats(spread)[0])
+    assert np.isnan(df.loc["R5-R1"].avg_members)
+    np.testing.assert_allclose(df.loc["R1"].avg_members, counts[0].mean())
+
+
+def test_jk_grid_table(rng):
+    Js, Ks, M = [3, 6], [1, 3, 6], 48
+    spreads = rng.normal(0.004, 0.02, size=(2, 3, M))
+    live = rng.random((2, 3, M)) > 0.1
+    spreads[~live] = np.nan
+
+    mean_df, tstat_df, sharpe_df = jk_grid_table(spreads, live, Js, Ks)
+    assert list(mean_df.index) == Js and list(mean_df.columns) == Ks
+    m, s, t = _stats(spreads[1, 2][live[1, 2]])
+    np.testing.assert_allclose(mean_df.loc[6, 6], m)
+    np.testing.assert_allclose(tstat_df.loc[6, 6], t)
+    np.testing.assert_allclose(sharpe_df.loc[6, 6], s)
+
+
+def test_double_sort_table(rng):
+    class DS:
+        spreads = rng.normal(0.005, 0.02, size=(3, 40))
+        spread_valid = np.ones((3, 40), bool)
+
+    DS.spread_valid[0, :5] = False
+    df = double_sort_table(DS)
+    assert list(df.index) == ["V1 (low)", "V2", "V3 (high)", "V3-V1"]
+    m, _, _ = _stats(DS.spreads[2])
+    np.testing.assert_allclose(df.loc["V3 (high)"].mean_ret, m)
+    both = DS.spread_valid[2] & DS.spread_valid[0]
+    md, _, _ = _stats((DS.spreads[2] - DS.spreads[0])[both])
+    np.testing.assert_allclose(df.loc["V3-V1"].mean_ret, md)
+
+
+def test_cli_doublesort_and_tables_run():
+    """End-to-end CLI smoke on the shipped caches (CPU/pandas-safe paths)."""
+    import os
+
+    if not os.path.isdir("/root/reference/data"):
+        pytest.skip("reference data not mounted")
+    from csmom_tpu.cli.main import main
+
+    assert main(["doublesort", "--data-dir", "/root/reference/data"]) == 0
+    assert main(["replicate", "--data-dir", "/root/reference/data",
+                 "--backend", "pandas", "--tables", "--out", "/tmp/cli_tables"]) == 0
