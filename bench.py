@@ -1,4 +1,4 @@
-"""Headline benchmark.
+"""Headline benchmark (supervisor + child).
 
 Primary metric: event-backtest throughput on the reference's own golden
 workload — the shipped 20-ticker x ~2,728-minute panel that takes the
@@ -8,25 +8,53 @@ jit-compiled panel engine.
 
 Also reported (in "extra"): the north-star J x K grid — all 16
 Jegadeesh-Titman cells on a 3000-stock x 60-year monthly panel in one
-compiled call (target < 10 s on a v5e-8; BASELINE.json).
+compiled call (target < 10 s on a v5e-8; BASELINE.json) — plus a
+flops/bytes model of the grid so "fast" is quantified, and the on-platform
+golden trade count vs the 28,020-trade reference fingerprint.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+Robustness (round-1 failure mode): the TPU ('axon') backend in this image
+can raise UNAVAILABLE *or hang* at init.  The supervisor therefore
+
+  1. probes backend init in a subprocess with a hard timeout,
+  2. runs the real benchmark in a child pinned to the chosen platform,
+  3. falls back to CPU (reduced grid size, recorded in extra) on failure,
+  4. ALWAYS prints exactly one JSON line on stdout:
+     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 REFERENCE_DATA = "/root/reference/data"
 BASELINE_GROUPS_PER_SEC = 148.3  # measured: 18.4 s / 2,728 datetime groups
+GOLDEN_TRADES = 28_020           # results/trades.csv fingerprint (SURVEY §2 row 17)
+GOLDEN_TRADE_TOL = 4             # documented f32 tolerance: ~2 of 54k threshold
+                                 # crossings sit within one f32 ulp of 1e-5
 DEMO_TICKERS = [
     "AAPL", "MSFT", "AMZN", "GOOGL", "NVDA", "TSLA", "META", "JPM", "BAC", "WMT",
     "PG", "KO", "DIS", "CSCO", "ORCL", "INTC", "AMD", "NFLX", "C", "GS",
 ]
 
+# One total wall-clock budget, spent top-down so the CPU fallback always has
+# room to run and print its JSON line before any external (driver) timeout:
+# probe <= 150s, default-platform child <= what's left minus the CPU
+# reserve, CPU child <= what's left.
+TOTAL_BUDGET_S = int(os.environ.get("CSMOM_BENCH_BUDGET", "1500"))
+PROBE_TIMEOUT_S = int(os.environ.get("CSMOM_BENCH_PROBE_TIMEOUT", "150"))
+CPU_RESERVE_S = 420   # observed CPU child wall: ~130s; generous margin
+_DEADLINE = time.monotonic() + TOTAL_BUDGET_S
+
+
+def _remaining() -> float:
+    return max(30.0, _DEADLINE - time.monotonic())
+
+
+# ---------------------------------------------------------------- child ----
 
 def _golden_inputs(dtype):
     """Dense minute panels for the event engine, from the shipped caches (or a
@@ -71,8 +99,13 @@ def _golden_inputs(dtype):
     )
 
 
-def main():
+def child_main():
     import jax
+
+    if os.environ.get("CSMOM_BENCH_FORCE_CPU"):
+        # env JAX_PLATFORMS=cpu is set too, but this image's sitecustomize can
+        # capture env before us; config.update is the post-import override
+        jax.config.update("jax_platforms", "cpu")
 
     from csmom_tpu.backtest.event import event_backtest
     from csmom_tpu.backtest.grid import jk_grid_backtest
@@ -80,8 +113,12 @@ def main():
     from csmom_tpu.panel.synthetic import synthetic_daily_panel
 
     platform = jax.devices()[0].platform
-    dtype = np.float32 if platform != "cpu" else np.float64
+    on_cpu = platform == "cpu"
+    if on_cpu:
+        jax.config.update("jax_enable_x64", True)
+    dtype = np.float64 if on_cpu else np.float32
 
+    # -- golden event workload (the headline metric) ------------------------
     price, valid, score, adv, vol, n_trades = _golden_inputs(dtype)
     n_bars = int(np.asarray(valid).any(axis=0).sum())
 
@@ -96,27 +133,71 @@ def main():
     dt = (time.perf_counter() - t0) / reps
     groups_per_sec = n_bars / dt
 
-    # north-star grid: 16 cells, 3000 stocks x 60 years
-    panel = synthetic_daily_panel(3000, 15120, seed=7, listing_gaps=True)
+    # -- north-star grid: 16 cells; full 3000 x 60yr on the accelerator,
+    #    reduced (recorded) on the CPU fallback so the fallback still
+    #    completes inside the driver timeout --------------------------------
+    if on_cpu:
+        A, T, grid_reps = 512, 3780, 2  # 512 stocks x 15 yr
+    else:
+        A, T, grid_reps = 3000, 15120, 5  # the north-star workload
+    panel = synthetic_daily_panel(A, T, seed=7, listing_gaps=True)
     seg, ends = month_end_segments(panel.times)
     v, m = panel.device(dtype)
     pm, mm = month_end_aggregate(v, m, seg, len(ends))
+    M = len(ends)
     Js = np.array([3, 6, 9, 12])
     Ks = np.array([3, 6, 9, 12])
-    g = lambda mode: jax.block_until_ready(
-        jk_grid_backtest(pm, mm, Js, Ks, skip=1, mode=mode).mean_spread
+    g = lambda mode, impl="xla": jax.block_until_ready(
+        jk_grid_backtest(pm, mm, Js, Ks, skip=1, mode=mode, impl=impl).mean_spread
     )
 
-    def timed(mode, reps=5):
-        g(mode)  # compile + warm the tunnel
+    def timed(mode, impl="xla"):
+        g(mode, impl)  # compile + warm the tunnel
         t0 = time.perf_counter()
-        for _ in range(reps):
-            g(mode)
-        return (time.perf_counter() - t0) / reps
+        for _ in range(grid_reps):
+            g(mode, impl)
+        return (time.perf_counter() - t0) / grid_reps
 
     grid_rank_s = timed("rank")
     grid_qcut_s = timed("qcut")
+    # the fused Pallas cohort kernel only makes sense compiled on the TPU;
+    # off-TPU it runs in interpreter mode (correctness tests), far too slow
+    # to time at this scale
+    grid_pallas_s = None if on_cpu else timed("rank", "pallas")
 
+    # simple cost model of the grid's dominant stage (cohort partial sums:
+    # nJ x H horizon-shifted masked reductions over the [A, M] panel) so the
+    # wall time maps to achieved bandwidth/flops, not vibes
+    nJ, H = len(Js), int(Ks.max())
+    itemsize = np.dtype(dtype).itemsize
+    grid_bytes = nJ * H * 3 * A * M * itemsize     # labels+ret+valid reads/horizon
+    grid_flops = nJ * H * 6 * A * M                # cmp+select+2 FMA per side
+
+    extra = {
+        "platform": platform,
+        "workload": f"golden 20x{n_bars} minute panel, "
+                    f"{n_trades} trades ({np.dtype(dtype).name})",
+        "event_backtest_wall_s": round(dt, 6),
+        "reference_wall_s": 18.4,
+        # on-platform golden gate: native-dtype trade count vs the reference
+        # fingerprint (exact in f64; documented +/-4 tolerance in f32)
+        "golden_trades": n_trades,
+        "golden_trades_ref": GOLDEN_TRADES,
+        "golden_ok": abs(n_trades - GOLDEN_TRADES) <= GOLDEN_TRADE_TOL,
+        "grid_workload": f"16 cells, {A} stocks x {T} days ({M} months)",
+        "grid_is_north_star_size": (A, T) == (3000, 15120),
+        "grid16_rank_s": round(grid_rank_s, 4),
+        "grid16_qcut_s": round(grid_qcut_s, 4),
+        "grid16_rank_pallas_s": (None if grid_pallas_s is None
+                                 else round(grid_pallas_s, 4)),
+        "north_star_target_s": 10.0,
+        "north_star_met": bool(
+            (A, T) == (3000, 15120) and grid_rank_s < 10.0
+        ),
+        "grid_model_gbytes": round(grid_bytes / 1e9, 3),
+        "grid_achieved_gbps": round(grid_bytes / grid_rank_s / 1e9, 1),
+        "grid_achieved_gflops": round(grid_flops / grid_rank_s / 1e9, 1),
+    }
     print(
         json.dumps(
             {
@@ -124,23 +205,99 @@ def main():
                 "value": round(groups_per_sec, 1),
                 "unit": "bar_groups/s",
                 "vs_baseline": round(groups_per_sec / BASELINE_GROUPS_PER_SEC, 1),
-                "extra": {
-                    "platform": platform,
-                    # f32 on TPU flips ~2 of 54k |score|>1e-5 threshold
-                    # crossings vs the f64 golden run (28,020 trades, matched
-                    # exactly by tests/test_event_backtest.py::test_golden_fingerprint)
-                    "workload": f"golden 20x{n_bars} minute panel, "
-                                f"{n_trades} trades ({dtype.__name__})",
-                    "event_backtest_wall_s": round(dt, 6),
-                    "reference_wall_s": 18.4,
-                    "grid16_3000x60yr_rank_s": round(grid_rank_s, 4),
-                    "grid16_3000x60yr_qcut_s": round(grid_qcut_s, 4),
-                    "north_star_target_s": 10.0,
-                },
+                "extra": extra,
+            }
+        )
+    )
+
+
+# ----------------------------------------------------------- supervisor ----
+
+def _probe_default_backend():
+    """True iff the default jax backend initializes in a subprocess within
+    the probe timeout (the axon TPU plugin can hang, not just raise)."""
+    code = "import jax; d = jax.devices(); print(d[0].platform)"
+    timeout = min(PROBE_TIMEOUT_S, _remaining() - CPU_RESERVE_S - 60)
+    if timeout < 10:
+        return False, "no budget left for a probe"
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timeout after {int(timeout)}s"
+    if p.returncode == 0:
+        return True, (p.stdout.strip().splitlines() or ["?"])[-1]
+    return False, (p.stderr or "")[-400:]
+
+
+def _parse_json_line(stdout: str):
+    for line in reversed((stdout or "").strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in obj and "value" in obj:
+            return obj
+    return None
+
+
+def _run_child(force_cpu: bool):
+    env = dict(os.environ)
+    env["CSMOM_BENCH_CHILD"] = "1"
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["CSMOM_BENCH_FORCE_CPU"] = "1"
+        timeout = _remaining()
+    else:
+        # leave the CPU fallback enough budget to still run and print
+        timeout = _remaining() - CPU_RESERVE_S
+    if timeout < 60:
+        return None, "no budget left for this attempt"
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        return _parse_json_line(out), f"child timeout after {int(timeout)}s"
+    obj = _parse_json_line(p.stdout)
+    if obj is not None:
+        return obj, None
+    return None, f"rc={p.returncode}: {(p.stderr or '')[-400:]}"
+
+
+def main():
+    ok, info = _probe_default_backend()
+    errors = [] if ok else [f"default backend probe failed: {info}"]
+    for force_cpu in ([False, True] if ok else [True]):
+        obj, err = _run_child(force_cpu)
+        if obj is not None:
+            print(json.dumps(obj))
+            return
+        errors.append(f"{'cpu' if force_cpu else 'default'} child: {err}")
+    # last resort: still emit a parseable line so the driver records *something*
+    print(
+        json.dumps(
+            {
+                "metric": "intraday_event_backtest_bar_groups_per_sec",
+                "value": 0.0,
+                "unit": "bar_groups/s",
+                "vs_baseline": 0.0,
+                "extra": {"error": "all benchmark attempts failed",
+                          "attempts": errors},
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("CSMOM_BENCH_CHILD"):
+        child_main()
+    else:
+        main()

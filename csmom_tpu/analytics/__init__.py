@@ -1,6 +1,12 @@
 """Performance analytics: Sharpe, t-stats, bootstrap CIs, result schemas."""
 
-from csmom_tpu.analytics.stats import sharpe, masked_mean, masked_std, t_stat
+from csmom_tpu.analytics.stats import (
+    sharpe,
+    masked_mean,
+    masked_std,
+    t_stat,
+    nw_t_stat,
+)
 from csmom_tpu.analytics.bootstrap import (
     block_bootstrap,
     block_bootstrap_grid,
@@ -13,6 +19,7 @@ __all__ = [
     "masked_mean",
     "masked_std",
     "t_stat",
+    "nw_t_stat",
     "block_bootstrap",
     "block_bootstrap_grid",
     "circular_block_indices",

@@ -16,7 +16,7 @@ from __future__ import annotations
 import numpy as np
 import pandas as pd
 
-__all__ = ["decile_table", "jk_grid_table", "double_sort_table"]
+__all__ = ["decile_table", "jk_grid_table", "jk_grid_ci_table", "double_sort_table"]
 
 
 def _masked_rows(x, valid):
@@ -25,17 +25,21 @@ def _masked_rows(x, valid):
     return x, v
 
 
-def _row_stats(series, valid, freq: int):
-    """mean / ann. Sharpe / t-stat over the valid months of one series.
+def _row_stats(series, valid, freq: int, nw_lags=None):
+    """mean / ann. Sharpe / t-stats over the valid months of one series.
 
     Delegates to :mod:`csmom_tpu.analytics.stats` — the same kernels the
     engines use for their reported scalars — so a table row can never
-    disagree with the engine result it renders."""
-    from csmom_tpu.analytics.stats import masked_mean, sharpe, t_stat
+    disagree with the engine result it renders.  ``t_stat_nw`` is the
+    Newey–West statistic (the form the replicated paper's Tables I–II
+    quote); ``nw_lags=None`` uses the automatic bandwidth, a K-cell passes
+    its holding period."""
+    from csmom_tpu.analytics.stats import masked_mean, nw_t_stat, sharpe, t_stat
 
     return {
         "mean_ret": float(masked_mean(series, valid)),
         "ann_sharpe": float(sharpe(series, valid, freq_per_year=freq)),
+        "t_stat_nw": float(nw_t_stat(series, valid, lags=nw_lags)),
         "t_stat": float(t_stat(series, valid)),
         "months": int(valid.sum()),
     }
@@ -79,7 +83,10 @@ def jk_grid_table(spreads, live, Js, Ks, freq: int = 12):
       live: bool[nJ, nK, M].
 
     Returns ``(mean_df, tstat_df, sharpe_df)`` — DataFrames indexed by J
-    with K columns.
+    with K columns.  ``tstat_df`` holds Newey–West t-stats with lag = K
+    (overlapping K-month holding makes the spreads serially correlated by
+    construction, so the iid t-stat overstates significance exactly where
+    the paper's tables need it).
     """
     spreads = np.asarray(spreads, dtype=float)
     live = np.asarray(live, dtype=bool)
@@ -90,9 +97,10 @@ def jk_grid_table(spreads, live, Js, Ks, freq: int = 12):
     shp = np.full_like(mean, np.nan)
     for i in range(len(Js)):
         for j in range(len(Ks)):
-            r = _row_stats(*_masked_rows(spreads[i, j], live[i, j]), freq)
+            r = _row_stats(*_masked_rows(spreads[i, j], live[i, j]), freq,
+                           nw_lags=Ks[j])
             mean[i, j], tstat[i, j], shp[i, j] = (
-                r["mean_ret"], r["t_stat"], r["ann_sharpe"]
+                r["mean_ret"], r["t_stat_nw"], r["ann_sharpe"]
             )
     idx = pd.Index(Js, name="J")
     cols = pd.Index(Ks, name="K")
@@ -100,6 +108,42 @@ def jk_grid_table(spreads, live, Js, Ks, freq: int = 12):
         pd.DataFrame(mean, index=idx, columns=cols),
         pd.DataFrame(tstat, index=idx, columns=cols),
         pd.DataFrame(shp, index=idx, columns=cols),
+    )
+
+
+def jk_grid_ci_table(spreads, live, Js, Ks, key=None, n_samples: int = 200,
+                     block_len: int = 6, freq: int = 12, ci_level: float = 0.95):
+    """Block-bootstrap mean-spread CIs for every grid cell (default grid
+    inference alongside the NW t-stats).
+
+    Args:
+      spreads: f[nJ, nK, M] (``GridResult.spreads``).
+      live: bool[nJ, nK, M].
+      key: jax PRNG key (defaults to ``PRNGKey(0)`` for reproducible tables).
+
+    Returns ``(lo_df, hi_df)`` — the central ``ci_level`` percentile
+    interval of the bootstrapped mean monthly spread, indexed by J with K
+    columns (resamples synchronized across cells, see
+    :func:`analytics.bootstrap.block_bootstrap_grid`).
+    """
+    import jax
+
+    from csmom_tpu.analytics.bootstrap import block_bootstrap_grid
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    spreads = np.nan_to_num(np.asarray(spreads, dtype=float))
+    live = np.asarray(live, dtype=bool)
+    res = block_bootstrap_grid(
+        spreads, live, key, n_samples=n_samples, block_len=block_len,
+        freq=freq, ci_level=ci_level,
+    )
+    ci = np.asarray(res.mean_ci)  # [2, nJ, nK]
+    idx = pd.Index([int(j) for j in np.asarray(Js)], name="J")
+    cols = pd.Index([int(k) for k in np.asarray(Ks)], name="K")
+    return (
+        pd.DataFrame(ci[0], index=idx, columns=cols),
+        pd.DataFrame(ci[1], index=idx, columns=cols),
     )
 
 

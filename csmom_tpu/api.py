@@ -139,7 +139,7 @@ def intraday_pipeline(
     daily_df,
     window_minutes: int = 30,
     n_splits: int = 3,
-    alpha: float = 1.0,
+    alpha: float | None = None,
     size_shares: int = 50,
     threshold: float = 1e-5,
     cash0: float = 1_000_000.0,
@@ -156,7 +156,9 @@ def intraday_pipeline(
     Note the scales differ: ridge's ``alpha`` is the reference's 1.0, but
     the elastic-net objective is per-row and minute returns are ~1e-4, so
     useful l1 penalties live around 1e-9..1e-7 (larger zeroes every
-    coefficient and the strategy goes flat).
+    coefficient and the strategy goes flat).  ``alpha=None`` therefore
+    resolves per model — 1.0 for ridge (``run_demo.py:140``), 1e-8 for
+    elastic_net/lasso — so API and CLI callers get the same sane defaults.
     Returns (EventResult, RidgeFit, compact, dense_score, dense_price,
     dense_valid).
     """
@@ -177,6 +179,8 @@ def intraday_pipeline(
                 "intraday_pipeline: no intraday rows and no daily bars to "
                 "synthesize a fallback from"
             )
+    if alpha is None:
+        alpha = 1.0 if model == "ridge" else 1e-8
     compact = compact_minutes(minute_df)
     price = jnp.asarray(compact.price, dtype)
     volume = jnp.asarray(compact.volume, dtype)

@@ -33,6 +33,7 @@ class MonthlyReport:
     mean_spread: float
     ann_sharpe: float
     tstat: float
+    tstat_nw: float
     backend: str
 
     def spread_series(self):
@@ -73,6 +74,18 @@ def run_monthly(
             "only forwarded to a strategy plugin (did you misspell a parameter, "
             "or forget strategy=?)"
         )
+    if strategy is not None and panels:
+        from csmom_tpu.strategy import consumed_panels
+
+        allowed = consumed_panels(strategy)
+        unknown = sorted(set(panels) - allowed)
+        if unknown:
+            raise TypeError(
+                f"panel kwarg(s) {unknown} match no signal parameter of "
+                f"{type(strategy).__name__} (accepts: {sorted(allowed) or None}) "
+                "— misspelled? A strategy's **panels catch-all exists to ignore "
+                "panels other strategies need, not to swallow typos."
+            )
     if backend == "tpu":
         from csmom_tpu.backtest import monthly_spread_backtest
 
@@ -97,6 +110,7 @@ def run_monthly(
             mean_spread=float(res.mean_spread),
             ann_sharpe=float(res.ann_sharpe),
             tstat=float(res.tstat),
+            tstat_nw=float(res.tstat_nw),
             backend="tpu",
         )
     if backend == "pandas":
@@ -122,6 +136,7 @@ def run_monthly(
             mean_spread=res.mean_spread,
             ann_sharpe=res.ann_sharpe,
             tstat=res.tstat,
+            tstat_nw=res.tstat_nw,
             backend="pandas",
         )
     raise ValueError(f"unknown backend {backend!r} (expected 'tpu' or 'pandas')")

@@ -42,6 +42,32 @@ class PandasMonthlyResult:
     mean_spread: float
     ann_sharpe: float
     tstat: float
+    tstat_nw: float
+
+
+def _nw_tstat_1d(sv: np.ndarray, lags: int | None = None) -> float:
+    """Newey–West (Bartlett) t-stat of the mean of a dense 1-d series.
+
+    Independent numpy implementation of the convention documented in
+    :func:`csmom_tpu.analytics.stats.nw_t_stat` (gammas normalized by n, no
+    small-sample correction, automatic bandwidth floor(4*(n/100)^(2/9)) when
+    ``lags`` is None) — serving as the host-side oracle the backend-parity
+    tests compare the kernel against.
+    """
+    sv = np.asarray(sv, dtype=float)
+    n = len(sv)
+    if n < 2:
+        return float("nan")
+    u = sv - sv.mean()
+    L = int(np.floor(4.0 * (n / 100.0) ** (2.0 / 9.0))) if lags is None else int(lags)
+    L = min(L, n - 1)
+    lrv = float(u @ u) / n
+    for lag in range(1, L + 1):
+        w = 1.0 - lag / (L + 1.0)
+        lrv += 2.0 * w * float(u[lag:] @ u[:-lag]) / n
+    if lrv <= 0:
+        return float("nan")
+    return float(sv.mean() / np.sqrt(lrv / n))
 
 
 def _qcut_labels_1d(vals: pd.Series, n_bins: int) -> pd.Series:
@@ -162,4 +188,5 @@ def spread_from_scores_pandas(
         mean_spread=mean_spread,
         ann_sharpe=ann_sharpe,
         tstat=tstat,
+        tstat_nw=_nw_tstat_1d(sv.to_numpy()),
     )

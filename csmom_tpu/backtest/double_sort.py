@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from csmom_tpu.ops.ranking import decile_assign_panel
 from csmom_tpu.signals.momentum import momentum_dynamic, monthly_returns
 from csmom_tpu.signals.turnover import volume_tercile_labels
-from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat
+from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat, nw_t_stat
 
 
 @jax.tree_util.register_dataclass
@@ -33,7 +33,8 @@ class DoubleSortResult:
     spread_valid: jnp.ndarray  # bool[V, M]
     mean_spread: jnp.ndarray   # f[V]
     ann_sharpe: jnp.ndarray    # f[V]
-    tstat: jnp.ndarray         # f[V]
+    tstat: jnp.ndarray         # f[V] plain iid t-stat
+    tstat_nw: jnp.ndarray      # f[V] Newey–West t-stat (paper Table II form)
     cell_counts: jnp.ndarray   # i32[V, 2, M] members in (bottom, top) cells
 
 
@@ -100,5 +101,6 @@ def volume_double_sort(
         mean_spread=masked_mean(spreads, valids),
         ann_sharpe=sharpe(spreads, valids, freq_per_year=freq),
         tstat=t_stat(spreads, valids),
+        tstat_nw=nw_t_stat(spreads, valids),
         cell_counts=counts,
     )

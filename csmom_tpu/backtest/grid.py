@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from csmom_tpu.ops.ranking import decile_assign_panel
 from csmom_tpu.signals.momentum import momentum_dynamic, monthly_returns
-from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat
+from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat, nw_t_stat
 
 
 @jax.tree_util.register_dataclass
@@ -45,16 +45,35 @@ class GridResult:
     spread_valid: jnp.ndarray  # bool[nJ, nK, M] (all K cohorts live)
     mean_spread: jnp.ndarray   # f[nJ, nK]
     ann_sharpe: jnp.ndarray    # f[nJ, nK]
-    tstat: jnp.ndarray         # f[nJ, nK]
+    tstat: jnp.ndarray         # f[nJ, nK] plain iid t-stat (oracle-matched)
+    tstat_nw: jnp.ndarray      # f[nJ, nK] Newey–West t-stat, lag = K (the
+                               # reported inference: K-overlap spreads are
+                               # serially correlated by construction)
 
 
-def _cohort_partial_sums(labels, ret, ret_valid, n_bins: int, max_hold: int):
+def _cohort_partial_sums(labels, ret, ret_valid, n_bins: int, max_hold: int,
+                         impl: str = "xla"):
     """Shard-local sums/counts for each cohort x horizon.
 
     Returns ``(sums f[2, M, H], counts f[2, M, H])`` over the (local) asset
     axis, side 0 = bottom decile, side 1 = top.  A distributed run psums
     these over the asset mesh axis before ``_finalize_cohorts``.
+
+    ``impl='pallas'`` streams tiles through the fused VMEM kernel
+    (:func:`csmom_tpu.ops.pallas_kernels.cohort_partial_sums_pallas`) —
+    O(A*M) HBM traffic independent of H, vs the H rolled panel copies the
+    XLA form materializes between fusion boundaries.  Interpreter mode off
+    TPU keeps tests portable.
     """
+    if impl == "pallas":
+        import jax as _jax
+
+        from csmom_tpu.ops.pallas_kernels import cohort_partial_sums_pallas
+
+        return cohort_partial_sums_pallas(
+            ret, ret_valid, labels, n_bins=n_bins, max_hold=max_hold,
+            interpret=_jax.default_backend() != "tpu",
+        )
     A, M = ret.shape
     top = labels == (n_bins - 1)
     bot = labels == 0
@@ -94,14 +113,17 @@ def _finalize_cohorts(sums, counts):
     return R, R_valid
 
 
-def _cohort_spreads(labels, ret, ret_valid, n_bins: int, max_hold: int):
+def _cohort_spreads(labels, ret, ret_valid, n_bins: int, max_hold: int,
+                    impl: str = "xla"):
     """Forward spread of each formation cohort at horizons 1..max_hold.
 
     ``R[s, h-1]`` is the equal-weighted top-minus-bottom return of the
     cohort formed at s, h months after formation; valid iff both extreme
     deciles have >=1 member with a live return that month.
     """
-    return _finalize_cohorts(*_cohort_partial_sums(labels, ret, ret_valid, n_bins, max_hold))
+    return _finalize_cohorts(
+        *_cohort_partial_sums(labels, ret, ret_valid, n_bins, max_hold, impl=impl)
+    )
 
 
 def _holding_month_spreads(R, R_valid, Ks):
@@ -169,6 +191,7 @@ def jk_grid_backtest(
     mode: str = "qcut",
     max_hold: int | None = None,
     freq: int = 12,
+    impl: str = "xla",
 ) -> GridResult:
     """Run the full J x K momentum grid in one compiled call.
 
@@ -181,17 +204,18 @@ def jk_grid_backtest(
       n_bins: quantile bins.
       mode: ranking mode ('qcut' parity / 'rank' fast).
       max_hold: static horizon bound (defaults to max(Ks) when Ks is concrete).
+      impl: cohort-aggregation implementation ('xla' / 'pallas' fused kernel).
     """
     max_hold = validate_grid_args(Ks, max_hold)
     return _jk_grid_backtest(
         prices, mask, Js, Ks, skip=skip, n_bins=n_bins, mode=mode,
-        max_hold=max_hold, freq=freq,
+        max_hold=max_hold, freq=freq, impl=impl,
     )
 
 
-@partial(jax.jit, static_argnames=("n_bins", "mode", "max_hold", "freq"))
+@partial(jax.jit, static_argnames=("n_bins", "mode", "max_hold", "freq", "impl"))
 def _jk_grid_backtest(
-    prices, mask, Js, Ks, skip, n_bins, mode, max_hold, freq
+    prices, mask, Js, Ks, skip, n_bins, mode, max_hold, freq, impl="xla"
 ) -> GridResult:
     Js = jnp.asarray(Js)
     Ks = jnp.asarray(Ks)
@@ -200,7 +224,7 @@ def _jk_grid_backtest(
     def per_J(J):
         mom, mom_valid = momentum_dynamic(prices, mask, J, skip)
         labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
-        return _cohort_spreads(labels, ret, ret_valid, n_bins, max_hold)
+        return _cohort_spreads(labels, ret, ret_valid, n_bins, max_hold, impl=impl)
 
     R, R_valid = jax.vmap(per_J)(Js)  # [nJ, M, H], [nJ, M, H]
     spreads, spread_valid = _holding_month_spreads(R, R_valid, Ks)
@@ -211,4 +235,6 @@ def _jk_grid_backtest(
         mean_spread=masked_mean(spreads, spread_valid),
         ann_sharpe=sharpe(spreads, spread_valid, freq_per_year=freq),
         tstat=t_stat(spreads, spread_valid),
+        tstat_nw=nw_t_stat(spreads, spread_valid, lags=Ks[None, :],
+                           max_lag=max_hold),
     )

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from csmom_tpu.ops.ranking import decile_assign_panel, sector_decile_assign_panel
 from csmom_tpu.signals.momentum import momentum, monthly_returns
-from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat
+from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat, nw_t_stat
 from csmom_tpu.costs.impact import long_short_weights, turnover_cost
 
 
@@ -49,7 +49,9 @@ class MonthlyResult:
     labels: jnp.ndarray        # i32[A, M] decile id at formation, -1 invalid
     mean_spread: jnp.ndarray   # scalar
     ann_sharpe: jnp.ndarray    # scalar
-    tstat: jnp.ndarray         # scalar
+    tstat: jnp.ndarray         # scalar plain iid t-stat (oracle-matched)
+    tstat_nw: jnp.ndarray      # scalar Newey–West t-stat (auto bandwidth) —
+                               # the inference the replicated paper quotes
 
 
 def decile_partial_sums(next_ret, next_valid, labels, n_bins: int,
@@ -124,6 +126,7 @@ def _assemble_result(ret, ret_valid, labels, n_bins: int, freq: int,
         mean_spread=masked_mean(spread, spread_valid),
         ann_sharpe=sharpe(spread, spread_valid, freq_per_year=freq),
         tstat=t_stat(spread, spread_valid),
+        tstat_nw=nw_t_stat(spread, spread_valid),
     )
 
 

@@ -22,7 +22,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from csmom_tpu.analytics.stats import masked_mean, sharpe, t_stat
+from csmom_tpu.analytics.stats import masked_mean, sharpe, t_stat, nw_t_stat
 from csmom_tpu.backtest.grid import jk_grid_backtest, validate_grid_args
 
 
@@ -37,7 +37,8 @@ class WalkForwardResult:
     oos_valid: jnp.ndarray     # bool[M]
     mean_spread: jnp.ndarray   # scalar (masked over oos_valid)
     ann_sharpe: jnp.ndarray    # scalar
-    tstat: jnp.ndarray         # scalar
+    tstat: jnp.ndarray         # scalar plain iid t-stat
+    tstat_nw: jnp.ndarray      # scalar Newey–West t-stat (auto bandwidth)
 
 
 def _expanding_sharpe(x, live, freq: int):
@@ -101,6 +102,7 @@ def walk_forward_select(
         mean_spread=masked_mean(oos, oos_valid),
         ann_sharpe=sharpe(oos, oos_valid, freq_per_year=freq),
         tstat=t_stat(oos, oos_valid),
+        tstat_nw=nw_t_stat(oos, oos_valid),
     )
 
 

@@ -34,11 +34,14 @@ def _load_cfg(args) -> RunConfig:
             cfg, universe=dataclasses.replace(cfg.universe, data_dir=args.data_dir)
         )
     mom = cfg.momentum
+    explicit = set(cfg.explicit_momentum)  # config-file keys (load_config)
     for field in ("lookback", "skip", "n_bins", "mode"):
         v = getattr(args, field, None)
         if v is not None:
             mom = dataclasses.replace(mom, **{field: v})
-    return dataclasses.replace(cfg, momentum=mom)
+            explicit.add(field)
+    return dataclasses.replace(cfg, momentum=mom,
+                               explicit_momentum=tuple(sorted(explicit)))
 
 
 def _price_panel(cfg: RunConfig):
@@ -50,10 +53,12 @@ def _price_panel(cfg: RunConfig):
 def _parse_strategy(args, cfg):
     """``--strategy name [--strategy-arg k=v ...]`` -> Strategy | None.
 
-    Config/flag momentum params flow through: any ``lookback``/``skip``
-    field the strategy class declares defaults to the resolved
-    ``cfg.momentum`` value unless an explicit ``--strategy-arg`` overrides
-    it — so ``--lookback 6 --strategy momentum`` really runs J=6.
+    Momentum params flow through only when explicitly set: a ``lookback``/
+    ``skip`` the user gave (CLI flag or config file) overrides a strategy
+    field of the same name — so ``--lookback 6 --strategy momentum`` really
+    runs J=6 — but built-in defaults leave each strategy's own defaults
+    alone.  The resolved instance is printed so the parametrization is
+    always visible.
     """
     name = getattr(args, "strategy", None)
     if not name:
@@ -73,10 +78,16 @@ def _parse_strategy(args, cfg):
     cls = available_strategies().get(name)
     if cls is not None:
         field_names = {f.name for f in dataclasses.fields(cls)}
-        for fld in ("lookback", "skip"):
+        # only user-set momentum keys flow through (cfg.explicit_momentum:
+        # config-file keys + CLI flags, recorded by load_config/_load_cfg) —
+        # built-in MomentumConfig defaults must not override a strategy's
+        # own defaults (ADVICE r1 #1)
+        for fld in set(cfg.explicit_momentum) & {"lookback", "skip"}:
             if fld in field_names and fld not in params:
                 params[fld] = getattr(cfg.momentum, fld)
-    return make_strategy(name, **params)
+    strat = make_strategy(name, **params)
+    print(f"strategy: {strat}")
+    return strat
 
 
 def cmd_replicate(args) -> int:
@@ -91,7 +102,13 @@ def cmd_replicate(args) -> int:
     strategy = _parse_strategy(args, cfg)
     panels = {}
     if strategy is not None:
-        panels = {"volumes": volume.values, "volumes_mask": volume.mask}
+        from csmom_tpu.strategy import consumed_panels
+
+        # offer the volume panels, but only forward what this strategy's
+        # signal actually reads (the engine rejects unmatched panel kwargs)
+        offered = {"volumes": volume.values, "volumes_mask": volume.mask}
+        allowed = consumed_panels(strategy)
+        panels = {k: v for k, v in offered.items() if k in allowed}
     rep = run_monthly(
         prices,
         lookback=cfg.momentum.lookback,
@@ -104,7 +121,8 @@ def cmd_replicate(args) -> int:
     )
     print(f"Mean monthly spread: {rep.mean_spread:.6f}")
     print(f"Annualized Sharpe:   {rep.ann_sharpe:.4f}")
-    print(f"t-stat:              {rep.tstat:.3f}")
+    print(f"t-stat (NW):         {rep.tstat_nw:.3f}")
+    print(f"t-stat (iid):        {rep.tstat:.3f}")
 
     if getattr(args, "tables", False):
         from csmom_tpu.analytics.tables import decile_table
@@ -157,10 +175,23 @@ def cmd_grid(args) -> int:
 
     mean_df, tstat_df, sharpe_df = jk_grid_table(res.spreads, res.spread_valid, Js, Ks)
     for name, df in (("mean monthly spread", mean_df),
-                     ("t-stat", tstat_df),
+                     ("Newey-West t-stat (lag=K)", tstat_df),
                      ("annualized Sharpe", sharpe_df)):
         print(f"\n{name}:")
         print(df.round(4).to_string())
+
+    n_boot = args.bootstrap if getattr(args, "bootstrap", None) is not None else 200
+    if n_boot > 0:  # default inference: per-cell block-bootstrap mean CIs
+        from csmom_tpu.analytics.tables import jk_grid_ci_table
+
+        lo_df, hi_df = jk_grid_ci_table(
+            res.spreads, res.spread_valid, Js, Ks,
+            n_samples=n_boot, block_len=getattr(args, "block_len", None) or 6,
+        )
+        for name, df in (("95% CI mean spread, lower", lo_df),
+                         ("95% CI mean spread, upper", hi_df)):
+            print(f"\n{name} ({n_boot} block-bootstrap resamples):")
+            print(df.round(4).to_string())
     return 0
 
 
@@ -350,7 +381,7 @@ def build_parser() -> argparse.ArgumentParser:
     for name, fn, extra in (
         ("run", cmd_run, ("bootstrap", "strategy", "tables")),
         ("replicate", cmd_replicate, ("bootstrap", "strategy", "tables")),
-        ("grid", cmd_grid, ("js", "ks")),
+        ("grid", cmd_grid, ("js", "ks", "bootstrap")),
         ("doublesort", cmd_doublesort, ("doublesort",)),
         ("sweep", cmd_sweep, ("js", "ks", "min_months")),
         ("intraday", cmd_intraday, ("model",)),

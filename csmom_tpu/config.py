@@ -89,6 +89,11 @@ class RunConfig:
     intraday: IntradayConfig = IntradayConfig()
     results_dir: str = "results"   # run_demo.py:12
     backend: str = "tpu"
+    # momentum keys the user explicitly set (config-file keys recorded by
+    # load_config; CLI flags appended by the CLI layer).  Lets consumers —
+    # e.g. strategy parametrization — distinguish "user chose lookback=12"
+    # from "built-in default is 12", without re-parsing the file.
+    explicit_momentum: Sequence[str] = ()
 
 
 _SECTIONS = {
@@ -126,4 +131,5 @@ def load_config(path: str) -> RunConfig:
             kwargs[key] = _build(_SECTIONS[key], val, key)
         else:
             kwargs[key] = val
+    kwargs["explicit_momentum"] = tuple(sorted(raw.get("momentum", {})))
     return RunConfig(**kwargs)

@@ -30,6 +30,109 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
+def _cohort_kernel(lab_ref, ret_cur_ref, ret_nxt_ref, vf_cur_ref, vf_nxt_ref,
+                   sums_ref, counts_ref, *, n_bins: int, max_hold: int,
+                   block_t: int):
+    """Cohort x horizon partial sums for one (time, asset) tile pair.
+
+    For each side (bottom decile 0, top decile B-1) and horizon h=1..H,
+    accumulate ``sum_a member(a, s) * r(a, s+h)`` and the matching counts
+    into the resident ``[2, block_t, H]`` output tile.  The s+h reads are
+    served from a 2-tile VMEM window (current + next time tile), so H must
+    be <= block_t and the caller pads time with >= one full dead tile.
+    """
+    a_tile = pl.program_id(1)
+
+    @pl.when(a_tile == 0)
+    def _init():
+        sums_ref[...] = jnp.zeros_like(sums_ref)
+        counts_ref[...] = jnp.zeros_like(counts_ref)
+
+    lab = lab_ref[...]
+    r_w = jnp.concatenate([ret_cur_ref[...], ret_nxt_ref[...]], axis=1)
+    v_w = jnp.concatenate([vf_cur_ref[...], vf_nxt_ref[...]], axis=1)
+    members = [(lab == 0).astype(r_w.dtype),
+               (lab == (n_bins - 1)).astype(r_w.dtype)]
+    for h in range(1, max_hold + 1):  # static unroll over horizons
+        r_h = r_w[:, h:h + block_t]   # r at s+h, aligned to formation s
+        v_h = v_w[:, h:h + block_t]
+        for side, mem in enumerate(members):
+            sums_ref[side, :, h - 1] += jnp.sum(mem * r_h, axis=0)
+            counts_ref[side, :, h - 1] += jnp.sum(mem * v_h, axis=0)
+
+
+@partial(jax.jit, static_argnames=("n_bins", "max_hold", "block_a", "block_t",
+                                  "interpret"))
+def cohort_partial_sums_pallas(
+    ret,
+    ret_valid,
+    labels,
+    n_bins: int = 10,
+    max_hold: int = 12,
+    block_a: int = 256,
+    block_t: int = 128,
+    interpret: bool = False,
+):
+    """Fused cohort-forward-return aggregation for the J x K grid engine.
+
+    Same contract as the XLA ``backtest.grid._cohort_partial_sums`` (the
+    north-star workload's hot op): for every formation month s and horizon
+    h = 1..max_hold, the sum/count of members' returns h months after
+    formation, for the bottom (side 0) and top (side 1) deciles.  The XLA
+    path materializes H rolled copies of the panel between fusion
+    boundaries; this kernel streams each (labels, ret, valid) tile through
+    VMEM once and reads the shifted months from a resident 2-tile window —
+    O(A*M) HBM traffic independent of H.
+
+    Args:
+      ret: f[A, M] next-month return panel (raw, not pre-shifted).
+      ret_valid: bool[A, M].
+      labels: i32[A, M] decile ids at formation, -1 = unranked.
+      max_hold: H, the static horizon bound (must be <= block_t).
+
+    Returns ``(sums f[2, M, H], counts f[2, M, H])`` — counts in
+    ``promote(ret.dtype, f32)`` exactly like the XLA path (bf16 would round
+    counts past 256).
+    """
+    A, M = ret.shape
+    dt = ret.dtype
+    count_dt = jnp.promote_types(dt, jnp.float32)
+    if max_hold > block_t:
+        raise ValueError(f"max_hold={max_hold} must be <= block_t={block_t}")
+    block_a = min(block_a, max(A, 8))
+
+    rf = jnp.where(ret_valid, jnp.nan_to_num(ret), 0.0).astype(dt)
+    vf = ret_valid.astype(count_dt)
+
+    pad_a = (-A) % block_a
+    # at least one full dead tile beyond the last live month, so the "next
+    # time tile" always exists and months past the end read as invalid
+    pad_t = ((-M) % block_t) + block_t
+    labels = jnp.pad(labels, ((0, pad_a), (0, pad_t)), constant_values=-1)
+    rf = jnp.pad(rf, ((0, pad_a), (0, pad_t)))
+    vf = jnp.pad(vf, ((0, pad_a), (0, pad_t)))
+    Ap, Mp = rf.shape
+
+    n_t_out = Mp // block_t - 1   # output tiles (every month < M is covered)
+    grid = (n_t_out, Ap // block_a)
+    cur = pl.BlockSpec((block_a, block_t), lambda t, a: (a, t))
+    nxt = pl.BlockSpec((block_a, block_t), lambda t, a: (a, t + 1))
+    out = pl.BlockSpec((2, block_t, max_hold), lambda t, a: (0, t, 0))
+    sums, counts = pl.pallas_call(
+        partial(_cohort_kernel, n_bins=n_bins, max_hold=max_hold,
+                block_t=block_t),
+        grid=grid,
+        in_specs=[cur, cur, nxt, cur, nxt],
+        out_specs=[out, out],
+        out_shape=[
+            jax.ShapeDtypeStruct((2, n_t_out * block_t, max_hold), dt),
+            jax.ShapeDtypeStruct((2, n_t_out * block_t, max_hold), count_dt),
+        ],
+        interpret=interpret,
+    )(labels, rf.astype(dt), rf.astype(dt), vf, vf)
+    return sums[:, :M, :], counts[:, :M, :]
+
+
 def _kernel(lab_ref, ret_ref, sums_ref, counts_ref, *, n_bins: int):
     a_tile = pl.program_id(1)
 

@@ -47,7 +47,20 @@ def _local_slice(full, axis_name: str, n_local: int):
 
 
 def _ranked_labels_local(mom_l, momv_l, n_bins, mode, axis_name="assets"):
-    """Distributed cross-sectional rank: gather -> rank -> take local slice."""
+    """Distributed cross-sectional rank.
+
+    ``mode='qcut'``/``'rank'``: gather -> rank -> take the local slice (the
+    O(A) baseline — 12 KB/date at the north star's A=3000).
+    ``mode='rank_hist'``: rank-mode labels via radix-histogram boundary
+    selection (:mod:`csmom_tpu.parallel.histrank`) — communication
+    independent of A, for universes past ~10k assets.
+    """
+    if mode == "rank_hist":
+        from csmom_tpu.parallel.histrank import histogram_rank_labels
+
+        labels = histogram_rank_labels(mom_l, momv_l, n_bins, axis_name)
+        n = lax.psum(jnp.sum(momv_l, axis=0, dtype=jnp.int32), axis_name)
+        return labels, jnp.minimum(n, n_bins)
     mom_f = lax.all_gather(mom_l, axis_name, axis=0, tiled=True)
     momv_f = lax.all_gather(momv_l, axis_name, axis=0, tiled=True)
     labels_f, n_eff = decile_assign_panel(mom_f, momv_f, n_bins=n_bins, mode=mode)

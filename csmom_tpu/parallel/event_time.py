@@ -100,10 +100,140 @@ def _carry_from_left(has_blk, val_blk, axis_name: str):
     return jbest >= 0, val
 
 
+def _latency_settle(price, valid, side, traded, impact, spread, size_shares,
+                    latency_bars, time_axis: str, nt: int):
+    """Latency fills under time sharding: the halo exchange.
+
+    Single-device semantics (``backtest.event``): an order decided at event
+    row t executes at the asset's first event row >= t+L at *that* row's
+    price; no such row -> dropped.  Sharded, a fill lands in one of three
+    places, each with its own delivery mechanism:
+
+      1. this block            -> local scatter-add;
+      2. the next block        -> one ``ppermute`` halo: the neighbor's
+         next-event-index and price blocks come left, the settled
+         (shares, notional) buffer goes right;
+      3. two-plus blocks ahead -> every such order from one (block, asset)
+         fills at the *same* row (the asset's first event >= the next-next
+         block start) at the same price, so they aggregate into per-asset
+         (shares, notional) totals exchanged via one ``all_gather`` of
+         [n_blocks, A_l] summaries; each block scatter-adds the totals
+         whose fill row lands in its range.
+
+    Requires L <= block length (a fill target then never skips past the
+    next block).  Returns ``(side, traded, fill, settle_shares,
+    settle_notional)`` — side/traded with dropped orders zeroed, fill =
+    per-decision exec price (reference keeps the trade log at decision
+    timestamps), settle_* on fill rows.
+    """
+    A_l, T_l = price.shape
+    dtype = price.dtype
+    L = latency_bars
+    BIG = jnp.int32(2 ** 30)
+    blk = lax.axis_index(time_axis)
+    t_loc = jnp.arange(T_l, dtype=jnp.int32)
+    rows = jnp.arange(A_l)[:, None]
+    pz = jnp.nan_to_num(price)
+    cost = spread / 2.0 + impact[:, None]              # [A_l, 1]
+
+    # local first event at/after each slot (T_l sentinel = none)
+    nxt_loc = lax.associative_scan(
+        jnp.minimum, jnp.where(valid, t_loc[None, :], T_l), axis=1, reverse=True
+    )
+
+    # per-block first event + its price -> faraway carry [nt, A_l]
+    first_idx = nxt_loc[:, 0]
+    has_first = first_idx < T_l
+    first_price = jnp.take_along_axis(
+        pz, jnp.clip(first_idx, 0, T_l - 1)[:, None], axis=1
+    )[:, 0]
+    first_glob = jnp.where(has_first, blk * T_l + first_idx, BIG)
+    g_idx = lax.all_gather(first_glob, time_axis)       # [nt, A_l]
+    g_price = lax.all_gather(jnp.where(has_first, first_price, 0.0), time_axis)
+    # first event in blocks >= blk+2, with its price
+    b_ids = jnp.arange(nt, dtype=jnp.int32)
+    m2 = (b_ids >= blk + 2)[:, None]
+    fut_idx = jnp.min(jnp.where(m2, g_idx, BIG), axis=0)           # [A_l]
+    fut_arg = jnp.argmin(jnp.where(m2, g_idx, BIG), axis=0)
+    fut_price = jnp.take_along_axis(g_price, fut_arg[None, :], axis=0)[0]
+
+    # right halo: neighbor blk+1's next-event indices and prices
+    perm_left = [(i, i - 1) for i in range(1, nt)]      # data moves to lower blk
+    nxt_r = lax.ppermute(nxt_loc, time_axis, perm_left)
+    price_r = lax.ppermute(pz, time_axis, perm_left)
+    halo_ok = lax.ppermute(jnp.ones((), jnp.int32), time_axis, perm_left) > 0
+
+    # resolve each decision's fill row / price ---------------------------
+    t_glob = blk * T_l + t_loc
+    target = t_glob + L                                 # [T_l] global
+    tgt_loc = target - blk * T_l                        # = t_loc + L
+    loc_ok = tgt_loc <= T_l - 1
+    nxt1 = nxt_loc[:, jnp.clip(tgt_loc, 0, T_l - 1)]    # [A_l, T_l]
+    case1 = loc_ok[None, :] & (nxt1 < T_l)
+    t2 = jnp.clip(target - (blk + 1) * T_l, 0, T_l - 1)
+    nxt2 = nxt_r[:, t2]                                 # [A_l, T_l]
+    case2 = ~case1 & halo_ok & (nxt2 < T_l)
+    case3 = ~case1 & ~case2 & (fut_idx < BIG)[:, None]
+    filled = case1 | case2 | case3
+
+    side = jnp.where(traded & filled, side, 0)          # drop unfilled
+    traded = side != 0
+    price1 = jnp.take_along_axis(pz, jnp.clip(nxt1, 0, T_l - 1), axis=1)
+    price2 = jnp.take_along_axis(price_r, jnp.clip(nxt2, 0, T_l - 1), axis=1)
+    exec_base = jnp.where(case1, price1,
+                          jnp.where(case2, price2, fut_price[:, None]))
+    fill = jnp.where(traded, exec_base * (1.0 + side * cost), 0.0)
+    shares = side * size_shares
+    notional = fill * shares.astype(dtype)
+
+    # deliver settles ----------------------------------------------------
+    dump = jnp.int32(T_l)                               # spill column
+    def scatter(idx, mask, vals, dt):
+        buf = jnp.zeros((A_l, T_l + 1), dt)
+        return buf.at[rows, jnp.where(mask, idx, dump)].add(
+            jnp.where(mask, vals, jnp.zeros((), dt))
+        )[:, :T_l]
+
+    m1 = case1 & traded
+    settle_sh = scatter(nxt1, m1, shares, shares.dtype)
+    settle_no = scatter(nxt1, m1, notional, dtype)
+
+    m2d = case2 & traded
+    buf_sh = scatter(nxt2, m2d, shares, shares.dtype)
+    buf_no = scatter(nxt2, m2d, notional, dtype)
+    perm_right = [(i, i + 1) for i in range(nt - 1)]
+    settle_sh = settle_sh + lax.ppermute(buf_sh, time_axis, perm_right)
+    settle_no = settle_no + lax.ppermute(buf_no, time_axis, perm_right)
+
+    m3 = case3 & traded
+    far_sh = jnp.sum(jnp.where(m3, shares, 0), axis=1,
+                     dtype=shares.dtype)                          # [A_l]
+    far_no = jnp.sum(jnp.where(m3, notional, 0.0), axis=1)
+    gf_sh = lax.all_gather(far_sh, time_axis)                     # [nt, A_l]
+    gf_no = lax.all_gather(far_no, time_axis)
+    gf_row = lax.all_gather(jnp.where(fut_idx < BIG, fut_idx, BIG), time_axis)
+    mine = (gf_row >= blk * T_l) & (gf_row < (blk + 1) * T_l)     # [nt, A_l]
+    row_loc = jnp.where(mine, gf_row - blk * T_l, dump)
+    for j in range(nt):  # nt is small and static; scatter one source block at a time
+        settle_sh = jnp.concatenate(
+            [settle_sh, jnp.zeros((A_l, 1), settle_sh.dtype)], axis=1
+        ).at[rows[:, 0], row_loc[j]].add(
+            jnp.where(mine[j], gf_sh[j], 0)
+        )[:, :T_l]
+        settle_no = jnp.concatenate(
+            [settle_no, jnp.zeros((A_l, 1), dtype)], axis=1
+        ).at[rows[:, 0], row_loc[j]].add(
+            jnp.where(mine[j], gf_no[j], 0.0)
+        )[:, :T_l]
+    return side, traded, fill, settle_sh, settle_no
+
+
 @lru_cache(maxsize=32)
-def _compiled(mesh, time_axis, asset_axis, size_shares, threshold, cash0, spread):
+def _compiled(mesh, time_axis, asset_axis, size_shares, threshold, cash0, spread,
+              latency_bars=0):
     """Build + jit the sharded program once per (mesh, axes, params)."""
     asum = (lambda x: lax.psum(x, asset_axis)) if asset_axis else (lambda x: x)
+    nt = mesh.shape[time_axis]
 
     def local_fn(price, valid, score, adv, vol):
         A_l, T_l = price.shape
@@ -116,28 +246,37 @@ def _compiled(mesh, time_axis, asset_axis, size_shares, threshold, cash0, spread
         impact = square_root_impact(
             jnp.asarray(float(size_shares), dtype), adv.astype(dtype), vol.astype(dtype)
         )
-        exec_base = jnp.nan_to_num(price)
-        fill = market_fill_prices(exec_base, side, traded, impact, spread)
-        shares = side * size_shares
-        notional = fill * shares.astype(dtype)
+        if latency_bars > 0:
+            side, traded, fill, shares_settle, notional_settle = _latency_settle(
+                price, valid, side, traded, impact, spread, size_shares,
+                latency_bars, time_axis, nt,
+            )
+            shares = side * size_shares
+        else:
+            exec_base = jnp.nan_to_num(price)
+            fill = market_fill_prices(exec_base, side, traded, impact, spread)
+            shares = side * size_shares
+            shares_settle = shares
+            notional_settle = fill * shares.astype(dtype)
 
         # ---- position book: blocked cumsum + position carry ----
-        pos_local = jnp.cumsum(shares, axis=1)
+        pos_local = jnp.cumsum(shares_settle, axis=1)
         positions = pos_local + _exclusive_prefix_sum(pos_local[:, -1], time_axis)[:, None]
 
         # ---- cash ledger: blocked cumsum of cross-asset order flow ----
-        flow = asum(jnp.sum(notional, axis=0))          # [T_l]
+        flow = asum(jnp.sum(notional_settle, axis=0))   # [T_l]
         cum_flow = jnp.cumsum(flow)
         cash = cash0 - (cum_flow + _exclusive_prefix_sum(cum_flow[-1], time_axis))
 
         # ---- mark price: blocked last-observed + (has, price) carry ----
+        pz = jnp.nan_to_num(price)
         t_loc = jnp.arange(T_l, dtype=jnp.int32)
         obs = jnp.where(valid, t_loc[None, :], -1)
         last_obs = lax.associative_scan(jnp.maximum, obs, axis=1)
-        mark_local = jnp.take_along_axis(exec_base, jnp.clip(last_obs, 0, T_l - 1), axis=1)
+        mark_local = jnp.take_along_axis(pz, jnp.clip(last_obs, 0, T_l - 1), axis=1)
         blk_has = last_obs[:, -1] >= 0
         blk_price = jnp.take_along_axis(
-            exec_base, jnp.clip(last_obs[:, -1:], 0, T_l - 1), axis=1
+            pz, jnp.clip(last_obs[:, -1:], 0, T_l - 1), axis=1
         )[:, 0]
         prev_has, prev_price = _carry_from_left(
             blk_has, jnp.where(blk_has, blk_price, 0.0), time_axis
@@ -238,20 +377,16 @@ def time_sharded_event_backtest(
     ``make_mesh(devices, grid_axis=a, axis_names=('assets', 'time'))``.
     The compiled program is cached per (mesh, axes, scalar params).
 
-    Only the deterministic market path is supported sharded: latency
-    fills can land in a later time block (a halo exchange, not a prefix
-    carry) and limit-mode PRNG draws are not shard-invariant — run those
-    single-device or asset-sharded (latency) instead.
+    Latency fills are supported for ``latency_bars <= T // n_time_shards``
+    via the halo exchange in :func:`_latency_settle` (neighbor ppermute for
+    next-block fills, aggregated all_gather for farther ones).  Limit mode
+    stays single-device/asset-sharded: its PRNG draws are not
+    shard-invariant across time blocks.
     """
     if order_type != "market":
         raise NotImplementedError(
             "time-sharded engine supports order_type='market' only; limit "
             "draws are not shard-invariant across time blocks"
-        )
-    if latency_bars != 0:
-        raise NotImplementedError(
-            "latency fills cross time-block boundaries (halo, not prefix "
-            "carry); use the single-device or asset-sharded engine"
         )
     A, T = price.shape
     if time_axis not in mesh.shape:
@@ -262,6 +397,12 @@ def time_sharded_event_backtest(
     nt = mesh.shape[time_axis]
     if T % nt:
         raise ValueError(f"T={T} not divisible by {nt} time shards; pad_time first")
+    if latency_bars < 0 or latency_bars > T // nt:
+        raise ValueError(
+            f"latency_bars={latency_bars} exceeds the time-block length "
+            f"{T // nt}; a fill target would skip past the halo neighbor — "
+            "use fewer time shards or the asset-sharded engine"
+        )
     if asset_axis is not None:
         na = mesh.shape[asset_axis]
         if A % na:
@@ -269,6 +410,6 @@ def time_sharded_event_backtest(
 
     fn = _compiled(
         mesh, time_axis, asset_axis, int(size_shares), float(threshold),
-        float(cash0), float(spread),
+        float(cash0), float(spread), int(latency_bars),
     )
     return fn(price, valid, score, adv, vol)

@@ -1,0 +1,132 @@
+"""Distributed cross-sectional rank without the all_gather: radix-histogram
+selection of the decile boundaries.
+
+The baseline distributed rank (``collectives._ranked_labels_local``)
+all_gathers the full ``[A, M]`` signal to every shard and re-ranks it
+redundantly — fine at the north star (A=3,000 is 12 KB/date) but O(A) in
+communication and the one spot the design doesn't scale past ~10k assets
+(VERDICT r1 weak #5).  This module finds the same labels with
+communication independent of A:
+
+1. a lane's rank-mode label is determined by the B-1 *global order
+   statistics* at ranks ``ceil(k*n/B)`` (``ops.ranking._rank_labels``:
+   label = how many boundary (value, position) pairs the lane dominates);
+2. each boundary value is found by radix selection over sortable bit-keys:
+   ``nbits/bpr`` rounds, each psum-ing a ``[R, M, E]`` bucket histogram of
+   the still-candidate lanes — O(M * E * R) bytes per round, no A;
+3. ties at the boundary value resolve by *global lane position* exactly
+   like the single-device stable argsort: count values below, locate the
+   j-th equal lane via an exclusive shard-prefix of per-shard equal
+   counts, and psum the one shard's answer.
+
+Labels are then a shard-local comparison against the B-1 (value, position)
+pairs.  Output is bit-identical to ``decile_assign_panel(mode='rank')`` on
+the gathered panel (property-tested for shard-count invariance in
+tests/test_histrank.py).  qcut mode keeps the all_gather path: its
+linear-interpolated edges (``ops.ranking._qcut_edges``) need two order
+statistics per edge plus pandas' duplicate-edge semantics, and parity mode
+runs at reference scale where the gather is free.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["histogram_rank_labels"]
+
+
+def _sortable_bits(x, valid):
+    """Monotone float -> unsigned-int key map; invalid lanes get the max
+    key.  ``x + 0.0`` first: ``jnp.argsort``'s comparator treats -0.0 and
+    +0.0 as equal (stable tie by position), so they must map to one key,
+    and IEEE addition canonicalizes -0.0 + 0.0 to +0.0."""
+    x = x + 0.0
+    if x.dtype == jnp.float64:
+        ib, ub, nbits = jnp.int64, jnp.uint64, 64
+    else:
+        x = x.astype(jnp.float32)
+        ib, ub, nbits = jnp.int32, jnp.uint32, 32
+    b = lax.bitcast_convert_type(x, ib)
+    u = lax.bitcast_convert_type(b, ub)
+    top = jnp.array(1, ub) << (nbits - 1)
+    flipped = jnp.where(b < 0, ~u, u | top)
+    return jnp.where(valid, flipped, ~jnp.array(0, ub)), nbits
+
+
+def histogram_rank_labels(x_l, valid_l, n_bins: int, axis_name: str,
+                          bits_per_round: int = 4):
+    """Shard-local rank-mode decile labels for an asset-sharded panel.
+
+    Call inside ``shard_map`` with ``x_l/valid_l`` this shard's
+    ``[A_local, M]`` rows (shard i holding global rows
+    ``[i*A_local, (i+1)*A_local)``, as ``P('assets', None)`` lays out).
+
+    Returns ``labels i32[A_local, M]`` (-1 at invalid lanes), equal to the
+    local slice of ``decile_assign_panel(gathered, mode='rank')``.
+    """
+    A_l, M = x_l.shape
+    key, nbits = _sortable_bits(x_l, valid_l)
+    R = 1 << bits_per_round
+    shard = lax.axis_index(axis_name)
+    gpos = shard * A_l + jnp.arange(A_l, dtype=jnp.int32)          # [A_l]
+    n = lax.psum(jnp.sum(valid_l, axis=0, dtype=jnp.int32), axis_name)  # [M]
+    E = n_bins - 1
+    ks = jnp.arange(1, n_bins, dtype=jnp.int32)
+    r_k = (ks[:, None] * n[None, :] + n_bins - 1) // n_bins        # [E, M]
+
+    # --- radix selection of the E boundary key values ------------------
+    prefix = jnp.zeros((E, M), key.dtype)     # high bits fixed so far
+    rank = r_k                                # 1-based rank among candidates
+    for t in range(nbits // bits_per_round):
+        shift = nbits - (t + 1) * bits_per_round
+        bucket = (key >> shift) & (R - 1)                          # [A_l, M]
+        if t == 0:
+            cand = jnp.broadcast_to(valid_l[:, :, None], (A_l, M, E))
+        else:
+            high = key >> (shift + bits_per_round)
+            cand = valid_l[:, :, None] & (
+                high[:, :, None] == prefix.T[None, :, :]
+            )
+        hist = jnp.stack(
+            [jnp.sum(cand & (bucket == b)[:, :, None], axis=0,
+                     dtype=jnp.int32) for b in range(R)], axis=0
+        )                                                          # [R, M, E]
+        hist = lax.psum(hist, axis_name)
+        cum = jnp.cumsum(hist, axis=0)
+        rk = rank.T                                                # [M, E]
+        bstar = jnp.sum(cum < rk[None, :, :], axis=0)              # [M, E]
+        below = jnp.take_along_axis(
+            cum, jnp.clip(bstar - 1, 0, R - 1)[None, :, :], axis=0
+        )[0]
+        rank = (rk - jnp.where(bstar > 0, below, 0)).T
+        prefix = (prefix << bits_per_round) | bstar.T.astype(key.dtype)
+
+    v = prefix.T                                                   # [M, E] boundary bit-keys
+
+    # --- tie resolution: global position of each boundary lane, among
+    #     *bit-identical* keys (the stable argsort's total order) ---------
+    below_v = valid_l[:, :, None] & (key[:, :, None] < v[None, :, :])
+    c_lt = lax.psum(jnp.sum(below_v, axis=0, dtype=jnp.int32), axis_name)
+    eq = valid_l[:, :, None] & (key[:, :, None] == v[None, :, :])  # [A_l, M, E]
+    loc_eq = jnp.sum(eq, axis=0, dtype=jnp.int32)                  # [M, E]
+    g_eq = lax.all_gather(loc_eq, axis_name)                       # [nsh, M, E]
+    sh_ids = jnp.arange(g_eq.shape[0])
+    prev_eq = jnp.sum(
+        jnp.where((sh_ids < shard)[:, None, None], g_eq, 0), axis=0
+    )
+    need_j = r_k.T - c_lt                  # 1-based index among equal lanes
+    local_j = need_j - prev_eq
+    ceq = jnp.cumsum(eq, axis=0)
+    match = eq & (ceq == local_j[None]) & (local_j > 0)[None] \
+        & (local_j <= loc_eq)[None]
+    bpos = lax.psum(
+        jnp.sum(jnp.where(match, gpos[:, None, None], 0), axis=0), axis_name
+    )                                                              # [M, E]
+
+    # --- labels: dominated boundary pairs, exactly _rank_labels' rule
+    #     (bit compares == float compares after zero canonicalization) ---
+    gt = key[:, :, None] > v[None, :, :]
+    ge = gt | (eq & (gpos[:, None, None] >= bpos[None, :, :]))
+    labels = jnp.sum(ge, axis=2).astype(jnp.int32)
+    return jnp.where(valid_l, labels, -1)

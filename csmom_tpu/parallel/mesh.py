@@ -69,6 +69,16 @@ def distributed_init(
     """
     if jax.distributed.is_initialized():
         return False
+    # Let jax's own cluster auto-detection run first (it recognizes
+    # environments no env var announces, e.g. GCE TPU pods via the metadata
+    # server).  Only when it fails with the missing-arguments ValueError do
+    # we classify: no coordinator given and no multi-process markers in the
+    # environment == a plain single-process run (return False); otherwise
+    # the failure is a genuine bootstrap error and propagates.  Unlike the
+    # round-1 code this matches no message wording, and unlike a pure env
+    # pre-check it does not replace jax's detection logic with our own.
+    # RuntimeErrors (e.g. initialize-after-backend-init misuse) always
+    # propagate, per this docstring's contract.
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -76,12 +86,35 @@ def distributed_init(
             process_id=process_id,
         )
         return True
-    except ValueError as e:
-        # auto-detection found no cluster env and no coordinator was given:
-        # a normal single-process run, not an error
-        if coordinator_address is None and "coordinator_address" in str(e):
+    except ValueError:
+        if coordinator_address is None and not _cluster_env_present():
             return False
         raise
+
+
+def _cluster_env_present() -> bool:
+    """Did the environment *intend* a multi-process run?  Used only to
+    classify an ``initialize`` failure as fatal vs "no cluster here".
+    Presence alone is not enough — single-host TPU images set
+    ``TPU_WORKER_HOSTNAMES=localhost`` and MPI launchers export world size
+    1 — so cardinality is checked where the variable carries one."""
+    import os
+
+    for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+              "MEGASCALE_COORDINATOR_ADDRESS"):
+        if os.environ.get(v):
+            return True
+    hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+             if h.strip()]
+    if len(hosts) > 1:
+        return True
+    for v in ("OMPI_COMM_WORLD_SIZE", "PMI_SIZE", "SLURM_NTASKS"):
+        try:
+            if int(os.environ.get(v, "1")) > 1:
+                return True
+        except ValueError:
+            pass
+    return False
 
 
 def _group_by_host(devices, n_hosts: int | None):

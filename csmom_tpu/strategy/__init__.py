@@ -4,6 +4,7 @@ shared ranking/portfolio engine (both backends).  See ``base.py``."""
 from csmom_tpu.strategy.base import (
     Strategy,
     available_strategies,
+    consumed_panels,
     make_strategy,
     register_strategy,
     xs_zscore,
@@ -19,6 +20,7 @@ from csmom_tpu.strategy.engine import strategy_backtest, strategy_backtest_panda
 __all__ = [
     "Strategy",
     "available_strategies",
+    "consumed_panels",
     "make_strategy",
     "register_strategy",
     "xs_zscore",

@@ -34,6 +34,7 @@ __all__ = [
     "register_strategy",
     "make_strategy",
     "available_strategies",
+    "consumed_panels",
     "xs_zscore",
 ]
 
@@ -58,6 +59,30 @@ class Strategy(abc.ABC):
           from the cross-sectional sort, like the reference's NaN
           ``mom_J`` rows dropped at ``run_demo.py:41``.
         """
+
+
+def consumed_panels(strategy) -> frozenset:
+    """Names of extra panels a strategy's ``signal`` can actually read.
+
+    Union of (a) the explicit keyword parameters of its ``signal`` method
+    besides ``prices``/``mask`` (the ``**panels`` catch-all does not count —
+    it exists so strategies can ignore panels other strategies need) and
+    (b) an optional ``panel_names`` attribute for composites that forward
+    panels to components.  The engine uses this to reject forwarded panels
+    that match nothing — a misspelled ``volumes_maks=`` must fail loudly,
+    not be silently swallowed by the catch-all.
+    """
+    import inspect
+
+    params = inspect.signature(type(strategy).signal).parameters
+    names = {
+        n
+        for n, p in params.items()
+        if n not in ("self", "prices", "mask")
+        and p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+    }
+    names |= set(getattr(strategy, "panel_names", ()))
+    return frozenset(names)
 
 
 _REGISTRY: dict[str, type[Strategy]] = {}

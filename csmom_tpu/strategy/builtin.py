@@ -115,6 +115,16 @@ class ZScoreCombo(Strategy):
 
     components: tuple = ()
 
+    @property
+    def panel_names(self):
+        """Panels any component consumes (the combo forwards ``**panels``)."""
+        from csmom_tpu.strategy.base import consumed_panels
+
+        names = set()
+        for s, _w in self.components:
+            names |= consumed_panels(s)
+        return tuple(sorted(names))
+
     def signal(self, prices, mask, **panels):
         if not self.components:
             raise ValueError("ZScoreCombo needs at least one component")

@@ -1,0 +1,149 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+
+import argparse
+
+import numpy as np
+import pytest
+
+from csmom_tpu.backends import run_monthly
+from csmom_tpu.panel.panel import Panel
+from csmom_tpu.strategy import (
+    Momentum,
+    Reversal,
+    VolumeZMomentum,
+    ZScoreCombo,
+    consumed_panels,
+)
+
+
+def _toy_panel(rng, a=20, m=36):
+    prices = 50 * np.exp(np.cumsum(rng.normal(0.004, 0.06, size=(a, m)), axis=1))
+    times = np.array([np.datetime64("2000-01-31") + 31 * i for i in range(m)])
+    return Panel.from_dense(prices, [f"T{i:03d}" for i in range(a)], times)
+
+
+def _vol_panels(rng, a=20, m=36):
+    vols = rng.integers(1_000, 9_000, size=(a, m)).astype(float)
+    return vols, np.ones((a, m), bool)
+
+
+# --- ADVICE #3: stray panel kwargs must not be swallowed by **panels ------
+
+def test_misspelled_panel_kwarg_raises(rng):
+    panel = _toy_panel(rng)
+    vols, vmask = _vol_panels(rng)
+    with pytest.raises(TypeError, match="volumes_maks"):
+        run_monthly(panel, n_bins=5, strategy=VolumeZMomentum(),
+                    volumes=vols, volumes_maks=vmask)
+
+
+def test_declared_panels_accepted(rng):
+    panel = _toy_panel(rng)
+    vols, vmask = _vol_panels(rng)
+    rep = run_monthly(panel, n_bins=5, strategy=VolumeZMomentum(),
+                      volumes=vols, volumes_mask=vmask)
+    assert np.isfinite(rep.spread).any()
+
+
+def test_combo_inherits_component_panels(rng):
+    combo = ZScoreCombo(((Momentum(), 0.5), (VolumeZMomentum(), 0.5)))
+    assert {"volumes", "volumes_mask"} <= set(consumed_panels(combo))
+    panel = _toy_panel(rng)
+    vols, vmask = _vol_panels(rng)
+    rep = run_monthly(panel, n_bins=5, strategy=combo,
+                      volumes=vols, volumes_mask=vmask)
+    assert np.isfinite(rep.spread).any()
+
+
+def test_momentum_does_not_consume_volumes(rng):
+    assert "volumes" not in consumed_panels(Momentum())
+    with pytest.raises(TypeError, match="volumes"):
+        run_monthly(_toy_panel(rng), n_bins=5, strategy=Momentum(),
+                    volumes=_vol_panels(rng)[0])
+
+
+# --- ADVICE #1: CLI must not inject momentum defaults into other
+#     strategies' own defaults ---------------------------------------------
+
+def _cli_args(**kv):
+    ns = argparse.Namespace(strategy=None, strategy_arg=None, lookback=None,
+                            skip=None, config=None, backend=None, out=None,
+                            data_dir=None)
+    for k, v in kv.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_reversal_keeps_its_own_defaults():
+    from csmom_tpu.cli.main import _parse_strategy
+    from csmom_tpu.config import RunConfig
+
+    strat = _parse_strategy(_cli_args(strategy="reversal"), RunConfig())
+    assert isinstance(strat, Reversal)
+    # the documented 1-month Jegadeesh reversal, not a 12-month skip-1 one
+    assert (strat.lookback, strat.skip) == (Reversal().lookback, Reversal().skip)
+
+
+def test_explicit_lookback_still_flows_through():
+    from csmom_tpu.cli.main import _load_cfg, _parse_strategy
+
+    args = _cli_args(strategy="momentum", lookback=6)
+    strat = _parse_strategy(args, _load_cfg(args))
+    assert strat.lookback == 6
+
+
+def test_config_file_momentum_keys_flow_through(tmp_path):
+    from csmom_tpu.cli.main import _parse_strategy
+    from csmom_tpu.config import load_config
+
+    cfg_file = tmp_path / "cfg.toml"
+    cfg_file.write_text("[momentum]\nlookback = 9\n")
+    cfg = load_config(str(cfg_file))
+    strat = _parse_strategy(_cli_args(strategy="momentum",
+                                      config=str(cfg_file)), cfg)
+    assert strat.lookback == 9
+    # but skip (not in the file) stays the strategy's own default
+    assert strat.skip == Momentum().skip
+
+
+# --- ADVICE #4: model-dependent alpha default in the API layer ------------
+
+def test_intraday_alpha_default_resolves_per_model(rng, monkeypatch):
+    import pandas as pd
+
+    import csmom_tpu.models as models
+    from csmom_tpu.api import intraday_pipeline, synthetic_minute_frame
+
+    days = pd.date_range("2024-01-01", periods=3, freq="B")
+    daily_df = pd.DataFrame({
+        "date": np.repeat(days, 2),
+        "ticker": ["AA", "BB"] * len(days),
+        "open": 100.0,
+        "close": 101.0,
+        "adj_close": 101.0,
+        "volume": 1e6,
+    })
+    minute_df = synthetic_minute_frame(daily_df, seed=0)
+
+    seen = {}
+    real = models.elastic_net_time_series_cv
+
+    def spy(*a, **kw):
+        seen["alpha"] = kw.get("alpha")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(models, "elastic_net_time_series_cv", spy)
+    intraday_pipeline(minute_df, daily_df, model="lasso")
+    # the scale-appropriate default (docstring: useful l1 penalties are
+    # ~1e-9..1e-7), not ridge's 1.0 which zeroes every coefficient
+    assert seen["alpha"] == pytest.approx(1e-8)
+
+    real_r = models.ridge_time_series_cv
+
+    def spy_r(*a, **kw):
+        seen["ridge_alpha"] = kw.get("alpha")
+        return real_r(*a, **kw)
+
+    monkeypatch.setattr(models, "ridge_time_series_cv", spy_r)
+    intraday_pipeline(minute_df, daily_df, model="ridge")
+    assert seen["ridge_alpha"] == pytest.approx(1.0)

@@ -35,6 +35,8 @@ def test_backends_agree_gap_free(rng):
     np.testing.assert_allclose(tpu.mean_spread, pdr.mean_spread, rtol=1e-9)
     np.testing.assert_allclose(tpu.ann_sharpe, pdr.ann_sharpe, rtol=1e-9)
     np.testing.assert_allclose(tpu.tstat, pdr.tstat, rtol=1e-9)
+    # NW t-stat: jax kernel vs the pandas engine's independent numpy oracle
+    np.testing.assert_allclose(tpu.tstat_nw, pdr.tstat_nw, rtol=1e-9)
 
 
 def test_backends_agree_with_leading_gaps(rng):

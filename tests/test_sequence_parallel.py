@@ -103,9 +103,10 @@ def test_pad_time_roundtrip(rng):
 def test_unsupported_modes_raise(rng):
     price, valid, score, adv, vol = _scenario(rng, A=4, T=80)
     mesh = make_mesh(grid_axis=1, axis_names=("assets", "time"))
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError, match="latency_bars"):
+        # block length is 80/8 = 10; a fill target would skip the halo
         time_sharded_event_backtest(
-            price, valid, score, adv, vol, mesh, latency_bars=3
+            price, valid, score, adv, vol, mesh, latency_bars=11
         )
     with pytest.raises(NotImplementedError):
         time_sharded_event_backtest(
@@ -115,3 +116,50 @@ def test_unsupported_modes_raise(rng):
         time_sharded_event_backtest(
             price[:, :77], valid[:, :77], score[:, :77], adv, vol, mesh
         )
+
+
+@pytest.mark.parametrize("latency", [1, 3, 10])
+def test_latency_matches_single_device(rng, latency):
+    """Halo-exchange latency fills == single-device latency engine, for
+    fills landing in-block, next-block (halo), and blocks-ahead
+    (aggregated carry).  latency=10 == the block length (80/8), the
+    supported bound."""
+    price, valid, score, adv, vol = _scenario(rng, A=6, T=80)
+    mesh = make_mesh(grid_axis=1, axis_names=("assets", "time"))
+    got = time_sharded_event_backtest(
+        price, valid, np.nan_to_num(score), adv, vol, mesh, latency_bars=latency
+    )
+    ref = event_backtest(price, valid, np.nan_to_num(score), adv, vol,
+                         latency_bars=latency)
+    _assert_equal(got, ref)
+
+
+def test_latency_sparse_assets_cross_many_blocks(rng):
+    """Assets with whole empty blocks: fills must hop 2+ blocks via the
+    aggregated all_gather path, or drop exactly when the single-device
+    engine drops them."""
+    price, valid, score, adv, vol = _scenario(rng, A=5, T=96)
+    # asset 0: no events in blocks 3..6 (cols 36..84); asset 1: nothing
+    # after col 30 (its late orders must drop unfilled)
+    valid[0, 36:84] = False
+    valid[1, 30:] = False
+    price[~valid] = np.nan
+    mesh = make_mesh(grid_axis=1, axis_names=("assets", "time"))
+    got = time_sharded_event_backtest(
+        price, valid, np.nan_to_num(score), adv, vol, mesh, latency_bars=5
+    )
+    ref = event_backtest(price, valid, np.nan_to_num(score), adv, vol,
+                         latency_bars=5)
+    _assert_equal(got, ref)
+
+
+def test_latency_2d_mesh(rng):
+    price, valid, score, adv, vol = _scenario(rng, A=6, T=64)
+    mesh = make_mesh(grid_axis=2, axis_names=("assets", "time"))  # 2 x 4
+    got = time_sharded_event_backtest(
+        price, valid, np.nan_to_num(score), adv, vol, mesh,
+        asset_axis="assets", latency_bars=4,
+    )
+    ref = event_backtest(price, valid, np.nan_to_num(score), adv, vol,
+                         latency_bars=4)
+    _assert_equal(got, ref)

@@ -126,21 +126,32 @@ def test_run_monthly_rejects_stray_kwargs_without_strategy(rng):
 
 
 def test_cli_momentum_params_flow_into_strategy():
-    """--lookback/--skip (and config momentum params) reach a --strategy
-    instance unless --strategy-arg overrides them."""
+    """An *explicitly set* --lookback/--skip reaches a --strategy instance
+    unless --strategy-arg overrides it; built-in MomentumConfig defaults do
+    NOT override a strategy's own defaults (ADVICE r1 #1)."""
     import argparse
     import dataclasses as dc
 
-    from csmom_tpu.cli.main import _parse_strategy
+    from csmom_tpu.cli.main import _load_cfg, _parse_strategy
     from csmom_tpu.config import RunConfig
 
-    cfg = RunConfig()
-    ns = argparse.Namespace(strategy="momentum", strategy_arg=None)
-    cfg6 = dc.replace(cfg, momentum=dc.replace(cfg.momentum, lookback=6, skip=2))
+    ns = argparse.Namespace(strategy="momentum", strategy_arg=None,
+                            lookback=6, skip=2, config=None)
+    cfg6 = _load_cfg(ns)
     assert _parse_strategy(ns, cfg6) == Momentum(lookback=6, skip=2)
-    ns2 = argparse.Namespace(strategy="momentum", strategy_arg=["lookback=9"])
-    assert _parse_strategy(ns2, cfg6) == Momentum(lookback=9, skip=2)
-    assert _parse_strategy(argparse.Namespace(strategy=None), cfg6) is None
+    ns2 = argparse.Namespace(strategy="momentum", strategy_arg=["lookback=9"],
+                             lookback=6, skip=2, config=None)
+    assert _parse_strategy(ns2, _load_cfg(ns2)) == Momentum(lookback=9, skip=2)
+    # no explicit flags/config: the strategy's own defaults stand, even when
+    # cfg.momentum carries non-default (but not user-set) values
+    ns3 = argparse.Namespace(strategy="momentum", strategy_arg=None,
+                             lookback=None, skip=None, config=None)
+    cfg_stale = dc.replace(
+        RunConfig(),
+        momentum=dc.replace(RunConfig().momentum, lookback=6, skip=2),
+    )
+    assert _parse_strategy(ns3, cfg_stale) == Momentum()
+    assert _parse_strategy(argparse.Namespace(strategy=None), cfg_stale) is None
 
 
 def test_volume_fallback_mask_excludes_phantom_zeros(rng):
