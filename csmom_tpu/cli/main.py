@@ -365,6 +365,11 @@ def _add_common(p):
     p.add_argument("--data-dir", help="CSV cache directory")
     p.add_argument("--out", help="results directory")
     p.add_argument("--backend", choices=["tpu", "pandas"])
+    p.add_argument("--platform", choices=["cpu", "tpu", "default"],
+                   help="pin the jax platform before first device use "
+                        "('default' keeps the environment's selection; use "
+                        "'cpu' when the TPU tunnel is unavailable — the env "
+                        "may pin an experimental platform that hangs at init)")
     p.add_argument("--lookback", type=int, help="formation months J")
     p.add_argument("--skip", type=int, help="skip months")
     p.add_argument("--n-bins", dest="n_bins", type=int)
@@ -428,11 +433,27 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _apply_platform(args) -> None:
+    """Pin the jax platform before any device use.
+
+    The env-var route is not enough in images that pin ``JAX_PLATFORMS``
+    and import jax at interpreter start (sitecustomize);
+    ``jax.config.update`` post-import is the override that works.
+    """
+    choice = getattr(args, "platform", None)
+    if choice in (None, "default"):
+        return
+    import jax
+
+    jax.config.update("jax_platforms", choice)
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if not getattr(args, "command", None):
         build_parser().print_help()
         return 0
+    _apply_platform(args)
     return args.fn(args)
 
 

