@@ -163,13 +163,40 @@ def cmd_grid(args) -> int:
     Ks = [int(k) for k in args.ks.split(",")] if args.ks else list(cfg.grid.Ks)
     prices, _ = _price_panel(cfg)
 
-    from csmom_tpu.backtest import jk_grid_backtest
-
     v, m = prices.device()
-    res = jk_grid_backtest(
-        v, m, np.asarray(Js), np.asarray(Ks),
-        skip=cfg.momentum.skip, n_bins=cfg.momentum.n_bins, mode=cfg.momentum.mode,
-    )
+    n_shards = getattr(args, "shards", None) or 0
+    mode = getattr(args, "mode", None) or cfg.momentum.mode
+    if n_shards > 1 or mode == "rank_hist":
+        # distributed grid over an asset-sharded mesh; the only mode that
+        # REQUIRES it is rank_hist (the O(A)-free radix-histogram rank has
+        # no single-device form — its point is the collective pattern)
+        import jax
+
+        from csmom_tpu.parallel import auto_mesh, sharded_jk_grid_backtest
+        from csmom_tpu.parallel.mesh import pad_assets
+
+        n_shards = max(n_shards, 2)
+        n_dev = len(jax.devices())
+        if n_shards > n_dev:
+            print(
+                f"--shards {n_shards} exceeds the {n_dev} visible device(s); "
+                "on CPU set XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{n_shards} before launch", file=sys.stderr,
+            )
+            return 2
+        mesh = auto_mesh(n_shards)
+        pv, mv, _ = pad_assets(np.asarray(v), np.asarray(m), n_shards)
+        res = sharded_jk_grid_backtest(
+            pv, mv, np.asarray(Js), np.asarray(Ks), mesh,
+            skip=cfg.momentum.skip, n_bins=cfg.momentum.n_bins, mode=mode,
+        )
+    else:
+        from csmom_tpu.backtest import jk_grid_backtest
+
+        res = jk_grid_backtest(
+            v, m, np.asarray(Js), np.asarray(Ks),
+            skip=cfg.momentum.skip, n_bins=cfg.momentum.n_bins, mode=mode,
+        )
 
     from csmom_tpu.analytics.tables import jk_grid_table
 
@@ -373,7 +400,11 @@ def _add_common(p):
     p.add_argument("--lookback", type=int, help="formation months J")
     p.add_argument("--skip", type=int, help="skip months")
     p.add_argument("--n-bins", dest="n_bins", type=int)
-    p.add_argument("--mode", choices=["qcut", "rank"])
+    p.add_argument("--mode", choices=["qcut", "rank", "rank_hist"],
+                   help="decile assignment: qcut (pandas parity), rank "
+                        "(fast ordinal), rank_hist (distributed radix-"
+                        "histogram rank — grid command only, implies a "
+                        "sharded mesh)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -397,6 +428,10 @@ def build_parser() -> argparse.ArgumentParser:
         if "js" in extra:
             sp.add_argument("--js", help="comma-separated J values")
             sp.add_argument("--ks", help="comma-separated K values")
+        if name == "grid":
+            sp.add_argument("--shards", type=int, metavar="N",
+                            help="run the grid asset-sharded over an N-device "
+                                 "mesh (required form for --mode rank_hist)")
         if "min_months" in extra:
             sp.add_argument("--min-months", dest="min_months", type=int)
         if "bootstrap" in extra:
@@ -453,6 +488,10 @@ def main(argv=None) -> int:
     if not getattr(args, "command", None):
         build_parser().print_help()
         return 0
+    if getattr(args, "mode", None) == "rank_hist" and args.command != "grid":
+        print("--mode rank_hist is distributed-only: use "
+              "`csmom grid --shards N --mode rank_hist`", file=sys.stderr)
+        return 2
     _apply_platform(args)
     return args.fn(args)
 

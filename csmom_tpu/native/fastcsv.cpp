@@ -6,14 +6,22 @@
 // column, numeric columns after) in either cache dialect — in a single
 // pass with zero Python-object churn, feeding numpy buffers directly.
 //
-// Contract (mirrors panel/ingest.py::read_price_csv semantics):
-//   - rows whose first cell does not start with a digit are preamble/junk
-//     and are skipped (dialect A junk ticker row, dialect B Ticker/Date
-//     rows, the header itself);
-//   - timestamps: "YYYY-MM-DD", optionally " HH:MM[:SS]", optionally a
-//     "+HH:MM"/"-HH:MM" UTC offset (normalized to UTC) — the formats
-//     yfinance caches actually contain;
-//   - empty/unparseable numeric cells become NaN;
+// Contract (mirrors panel/ingest.py::read_price_csv semantics, and is
+// parity-tested cell-for-cell against the pandas engine incl. a CSV
+// fuzzer, tests/test_native.py):
+//   - rows whose first cell (after unquoting/trimming) does not start with
+//     a digit are preamble/junk and are skipped (dialect A junk ticker
+//     row, dialect B Ticker/Date rows, the header itself);
+//   - timestamps: "YYYY-MM-DD", optionally " HH:MM[:SS[.frac]]",
+//     optionally a "+HH:MM"/"-HH:MM" UTC offset (normalized to UTC) — the
+//     formats yfinance caches actually contain.  The whole cell must
+//     parse (pandas' to_datetime(errors='coerce') semantics: trailing
+//     junk -> dropped row, not a half-parsed date);
+//   - cells split on commas OUTSIDE double quotes (RFC-4180 quoting, the
+//     part of it price CSVs can contain; embedded newlines unsupported);
+//   - empty/unparseable numeric cells become NaN; the whole cell must
+//     parse (strtod prefix-parses "12abc" to 12, pandas' to_numeric
+//     coerces it to NaN — full consumption keeps the engines identical);
 //   - short rows are padded with NaN, long rows truncated to n_cols.
 //
 // Exposed via a C ABI for ctypes (no pybind11 in this image).
@@ -47,7 +55,54 @@ inline int parse_digits(const char*& p, const char* end, int width) {
     return n ? v : -1;
 }
 
-// timestamp cell -> epoch nanoseconds (UTC); returns false if not a date
+// Cell trimming with pandas' quote semantics: a double quote is special
+// ONLY at field start (its C parser treats mid-field quotes as literal
+// text).  Strip trailing CR/spaces, then one wrapping quote pair if the
+// field begins with a quote, then surrounding spaces.
+inline void trim_cell(const char*& s, const char*& end) {
+    while (end > s && (end[-1] == '\r' || end[-1] == ' ')) --end;
+    if (end - s >= 2 && *s == '"' && end[-1] == '"') {
+        ++s;
+        --end;
+    }
+    while (s < end && *s == ' ') ++s;
+    while (end > s && end[-1] == ' ') --end;
+}
+
+// next field separator; a field OPENING with a double quote protects
+// commas until its closing quote ("" escapes a literal quote), matching
+// pandas' parser — a quote later in the field is literal and protects
+// nothing
+inline const char* next_sep(const char* p, const char* line_end) {
+    if (p < line_end && *p == '"') {
+        const char* q = p + 1;
+        while (q < line_end) {
+            if (*q == '"') {
+                if (q + 1 < line_end && q[1] == '"') {
+                    q += 2;  // escaped quote
+                    continue;
+                }
+                ++q;  // closing quote
+                break;
+            }
+            ++q;
+        }
+        p = q;
+    }
+    const char* c = static_cast<const char*>(memchr(p, ',', line_end - p));
+    return c ? c : line_end;
+}
+
+// calendar-valid day count (pandas to_datetime rejects e.g. Feb 31)
+inline int days_in_month(int y, int m) {
+    static const int dm[] = {31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31};
+    if (m == 2)
+        return ((y % 4 == 0 && y % 100 != 0) || y % 400 == 0) ? 29 : 28;
+    return dm[m - 1];
+}
+
+// timestamp cell -> epoch nanoseconds (UTC); returns false unless the
+// whole cell is a date (pandas to_datetime coerce semantics)
 bool parse_timestamp(const char* s, const char* end, int64_t* out_ns) {
     const char* p = s;
     int y = parse_digits(p, end, 4);
@@ -57,58 +112,79 @@ bool parse_timestamp(const char* s, const char* end, int64_t* out_ns) {
     if (mo < 1 || mo > 12 || p >= end || *p != '-') return false;
     ++p;
     int d = parse_digits(p, end, 2);
-    if (d < 1 || d > 31) return false;
+    if (d < 1 || d > days_in_month(y, mo)) return false;
 
     int64_t sec = days_from_civil(y, mo, d) * 86400;
+    int64_t frac_ns = 0;
     if (p < end && (*p == ' ' || *p == 'T')) {
         ++p;
         int hh = parse_digits(p, end, 2);
-        if (hh < 0 || p >= end || *p != ':') return false;
+        if (hh < 0 || hh > 23 || p >= end || *p != ':') return false;
         ++p;
         int mi = parse_digits(p, end, 2);
-        if (mi < 0) return false;
+        if (mi < 0 || mi > 59) return false;
         int ss = 0;
         if (p < end && *p == ':') {
             ++p;
             ss = parse_digits(p, end, 2);
-            if (ss < 0) return false;
+            if (ss < 0 || ss > 59) return false;
         }
         sec += hh * 3600 + mi * 60 + ss;
-        // fractional seconds: skip
+        // fractional seconds, kept at ns precision (pandas keeps them too;
+        // dropping them would silently desynchronize the two engines)
         if (p < end && *p == '.') {
             ++p;
-            while (p < end && *p >= '0' && *p <= '9') ++p;
+            int64_t scale = 100000000;  // first digit is 1e8 ns
+            bool any = false;
+            while (p < end && *p >= '0' && *p <= '9') {
+                if (scale > 0) {
+                    frac_ns += (*p - '0') * scale;
+                    scale /= 10;
+                }
+                ++p;
+                any = true;
+            }
+            if (!any) return false;
         }
-        // UTC offset
+        // UTC offset (strict: out-of-range offsets are not timestamps)
         if (p < end && (*p == '+' || *p == '-')) {
             int sign = (*p == '-') ? -1 : 1;
             ++p;
             int oh = parse_digits(p, end, 2);
+            if (oh < 0 || oh > 23) return false;
             int om = 0;
             if (p < end && *p == ':') {
                 ++p;
                 om = parse_digits(p, end, 2);
+                if (om < 0 || om > 59) return false;
             }
-            if (oh >= 0) sec -= sign * (oh * 3600 + om * 60);
+            sec -= sign * (oh * 3600 + om * 60);
         }
     }
-    *out_ns = sec * 1000000000LL;
+    if (p != end) return false;  // trailing junk -> not a timestamp
+    *out_ns = sec * 1000000000LL + frac_ns;
     return true;
 }
 
-// one numeric cell [s, end) -> double (NaN on empty/garbage)
+// one numeric cell [s, end) -> double (NaN on empty/garbage).  The whole
+// cell must be consumed: strtod prefix-parses ("12abc" -> 12) where
+// pandas' to_numeric coerces to NaN, and strtod accepts hex ("0x1f")
+// where pandas does not — both are rejected here for engine parity.
 inline double parse_cell(const char* s, const char* end) {
-    while (s < end && (*s == ' ' || *s == '"')) ++s;
-    while (end > s && (end[-1] == ' ' || end[-1] == '"' || end[-1] == '\r')) --end;
+    trim_cell(s, end);
     if (s >= end) return NAN;
     char buf[64];
     size_t n = static_cast<size_t>(end - s);
     if (n >= sizeof(buf)) return NAN;
     memcpy(buf, s, n);
     buf[n] = '\0';
+    for (const char* h = buf; *h; ++h)
+        if (*h == 'x' || *h == 'X') return NAN;  // hex (strtod-only) -> NaN
     char* q = nullptr;
     double v = strtod(buf, &q);
     if (q == buf) return NAN;
+    while (*q == ' ') ++q;
+    if (*q != '\0') return NAN;
     return v;
 }
 
@@ -158,11 +234,13 @@ long long fastcsv_parse(const char* path, long long max_rows, int n_cols,
         if (!line_end) line_end = file_end;
 
         if (p < line_end && *p != '#') {
-            const char* cell_end =
-                static_cast<const char*>(memchr(p, ',', line_end - p));
-            if (!cell_end) cell_end = line_end;
+            const char* cell_end = next_sep(p, line_end);
+            const char* ts = p;
+            const char* ts_end = cell_end;
+            trim_cell(ts, ts_end);  // pandas unquotes before parsing dates
             int64_t ns;
-            if (*p >= '0' && *p <= '9' && parse_timestamp(p, cell_end, &ns)) {
+            if (ts < ts_end && *ts >= '0' && *ts <= '9' &&
+                parse_timestamp(ts, ts_end, &ns)) {
                 epoch_ns[rows] = ns;
                 double* row = values + rows * n_cols;
                 const char* q = (cell_end < line_end) ? cell_end + 1 : line_end;
@@ -171,9 +249,7 @@ long long fastcsv_parse(const char* path, long long max_rows, int n_cols,
                         row[c] = NAN;
                         continue;
                     }
-                    const char* next =
-                        static_cast<const char*>(memchr(q, ',', line_end - q));
-                    if (!next) next = line_end;
+                    const char* next = next_sep(q, line_end);
                     row[c] = parse_cell(q, next);
                     q = next + 1;
                 }

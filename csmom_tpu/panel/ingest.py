@@ -89,13 +89,22 @@ def read_price_csv(path: str, ticker: str, kind: str = "daily",
         if engine == "native":
             raise RuntimeError("native CSV engine unavailable (no compiler?)")
 
-    raw = pd.read_csv(path, low_memory=False, dtype=str)
+    # index_col=False: without it, a ragged over-long FIRST data row makes
+    # read_csv silently shift the timestamp column into the index (data
+    # corruption); with it, a long first row truncates to the header width
+    # (matching the native engine) and a long later row raises loudly —
+    # caught by the universe-level fault isolation in _load_universe
+    raw = pd.read_csv(path, low_memory=False, dtype=str, index_col=False)
     cols = [str(c).strip() for c in raw.columns]
     body = _strip_preamble(raw)
 
     time_col = "date" if kind == "daily" else "datetime"
     out = pd.DataFrame()
-    out[time_col] = pd.to_datetime(body.iloc[:, 0], errors="coerce", utc=(kind != "daily"))
+    # format="mixed" parses each element independently; the default infers
+    # a format from the first row and NaT-coerces every row that differs,
+    # silently dropping valid data when a file mixes timestamp spellings
+    out[time_col] = pd.to_datetime(body.iloc[:, 0], errors="coerce",
+                                   utc=(kind != "daily"), format="mixed")
     if kind != "daily":
         # store tz-naive UTC timestamps; panels index by absolute instants
         out[time_col] = out[time_col].dt.tz_localize(None)
@@ -142,7 +151,10 @@ def _read_native(path: str, ticker: str, kind: str) -> pd.DataFrame | None:
                 header = f.readline()
     except OSError:
         return None
-    cols = [c.strip() for c in header.rstrip("\r\n").split(",")]
+    # unquote header names the way read_csv does ('"Close"' -> 'Close');
+    # price-cache headers never contain embedded commas, so a plain split
+    # is safe even when names are quoted
+    cols = [c.strip().strip('"').strip() for c in header.rstrip("\r\n").split(",")]
     if len(cols) < 2:
         return None
     try:

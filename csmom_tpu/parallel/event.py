@@ -34,17 +34,11 @@ def sharded_event_backtest(
 
     A must divide by the mesh axis size (pad with dead lanes via
     :func:`csmom_tpu.parallel.mesh.pad_assets` — a lane with ``valid=False``
-    everywhere never trades and never marks).  ``fill_key`` (limit mode) is
-    replicated, so every shard draws the same [A_local, T]-block of uniforms
-    it would draw single-device only if the key is folded per shard; to keep
-    draws identical to the single-device engine, limit mode is not supported
-    sharded (raise) — use the market path, which is deterministic.
+    everywhere never trades and never marks).  Limit mode works sharded:
+    the engine's fill draws are counter-keyed by global (asset, bar) cell
+    (:func:`csmom_tpu.backtest.event.counter_uniform`), so a replicated
+    ``fill_key`` yields exactly the single-device fills on any shard count.
     """
-    if kwargs.get("order_type") == "limit":
-        raise NotImplementedError(
-            "limit mode is per-order random; shard-invariant draws need a "
-            "counter-based per-(asset,bar) key design — run it single-device"
-        )
     A = price.shape[0]
     n_shards = mesh.shape[axis_name]
     if A % n_shards:

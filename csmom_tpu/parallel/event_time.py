@@ -45,7 +45,14 @@ import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from csmom_tpu.backtest.event import EventResult, market_fill_prices, threshold_sides
+from csmom_tpu.backtest.event import (
+    EventResult,
+    counter_uniform,
+    limit_fill_price,
+    limit_fill_probability,
+    market_fill_prices,
+    threshold_sides,
+)
 from csmom_tpu.costs.impact import square_root_impact
 
 
@@ -101,7 +108,7 @@ def _carry_from_left(has_blk, val_blk, axis_name: str):
 
 
 def _latency_settle(price, valid, side, traded, impact, spread, size_shares,
-                    latency_bars, time_axis: str, nt: int):
+                    latency_bars, time_axis: str, nt: int, fill_fn=None):
     """Latency fills under time sharding: the halo exchange.
 
     Single-device semantics (``backtest.event``): an order decided at event
@@ -124,7 +131,9 @@ def _latency_settle(price, valid, side, traded, impact, spread, size_shares,
     next block).  Returns ``(side, traded, fill, settle_shares,
     settle_notional)`` — side/traded with dropped orders zeroed, fill =
     per-decision exec price (reference keeps the trade log at decision
-    timestamps), settle_* on fill rows.
+    timestamps), settle_* on fill rows.  ``fill_fn(exec_base, side)``
+    overrides the market fill-price formula (limit mode's side-independent
+    price improvement); default = market.
     """
     A_l, T_l = price.shape
     dtype = price.dtype
@@ -182,7 +191,9 @@ def _latency_settle(price, valid, side, traded, impact, spread, size_shares,
     price2 = jnp.take_along_axis(price_r, jnp.clip(nxt2, 0, T_l - 1), axis=1)
     exec_base = jnp.where(case1, price1,
                           jnp.where(case2, price2, fut_price[:, None]))
-    fill = jnp.where(traded, exec_base * (1.0 + side * cost), 0.0)
+    if fill_fn is None:
+        fill_fn = lambda eb, s: eb * (1.0 + s * cost)  # market (execution_models.py:9-12)
+    fill = jnp.where(traded, fill_fn(exec_base, side), 0.0)
     shares = side * size_shares
     notional = fill * shares.astype(dtype)
 
@@ -230,12 +241,12 @@ def _latency_settle(price, valid, side, traded, impact, spread, size_shares,
 
 @lru_cache(maxsize=32)
 def _compiled(mesh, time_axis, asset_axis, size_shares, threshold, cash0, spread,
-              latency_bars=0):
+              latency_bars=0, order_type="market", aggressiveness=0.5):
     """Build + jit the sharded program once per (mesh, axes, params)."""
     asum = (lambda x: lax.psum(x, asset_axis)) if asset_axis else (lambda x: x)
     nt = mesh.shape[time_axis]
 
-    def local_fn(price, valid, score, adv, vol):
+    def local_fn(price, valid, score, adv, vol, fill_key):
         A_l, T_l = price.shape
         dtype = price.dtype
 
@@ -243,18 +254,33 @@ def _compiled(mesh, time_axis, asset_axis, size_shares, threshold, cash0, spread
         #      shared helpers pin semantics to the single-device engine ----
         side = threshold_sides(valid, score, threshold)
         traded = side != 0
+        if order_type == "limit":
+            # counter-keyed draws (global cell ids) == single-device stream
+            p_fill = limit_fill_probability(adv, size_shares, aggressiveness, dtype)
+            a_off = lax.axis_index(asset_axis) * A_l if asset_axis else 0
+            t_off = lax.axis_index(time_axis) * T_l
+            u = counter_uniform(fill_key, (A_l, T_l), a_off, t_off, dtype)
+            side = jnp.where(u < p_fill[:, None], side, 0)
+            traded = side != 0
         impact = square_root_impact(
             jnp.asarray(float(size_shares), dtype), adv.astype(dtype), vol.astype(dtype)
+        )
+        limit_fill_fn = (
+            (lambda eb, s: limit_fill_price(eb, aggressiveness, spread))
+            if order_type == "limit" else None
         )
         if latency_bars > 0:
             side, traded, fill, shares_settle, notional_settle = _latency_settle(
                 price, valid, side, traded, impact, spread, size_shares,
-                latency_bars, time_axis, nt,
+                latency_bars, time_axis, nt, fill_fn=limit_fill_fn,
             )
             shares = side * size_shares
         else:
             exec_base = jnp.nan_to_num(price)
-            fill = market_fill_prices(exec_base, side, traded, impact, spread)
+            if order_type == "limit":
+                fill = jnp.where(traded, limit_fill_fn(exec_base, side), 0.0)
+            else:
+                fill = market_fill_prices(exec_base, side, traded, impact, spread)
             shares = side * size_shares
             shares_settle = shares
             notional_settle = fill * shares.astype(dtype)
@@ -331,7 +357,7 @@ def _compiled(mesh, time_axis, asset_axis, size_shares, threshold, cash0, spread
         mesh=mesh,
         in_specs=(
             P(aspec, time_axis), P(aspec, time_axis), P(aspec, time_axis),
-            P(aspec), P(aspec),
+            P(aspec), P(aspec), P(),
         ),
         out_specs=EventResult(
             pnl=P(time_axis),
@@ -367,6 +393,8 @@ def time_sharded_event_backtest(
     spread: float = 0.001,
     latency_bars: int = 0,
     order_type: str = "market",
+    aggressiveness: float = 0.5,
+    fill_key=None,
 ) -> EventResult:
     """Run the event backtest with the minute axis sharded over
     ``mesh[time_axis]`` (and optionally assets over ``mesh[asset_axis]``).
@@ -380,14 +408,16 @@ def time_sharded_event_backtest(
     Latency fills are supported for ``latency_bars <= T // n_time_shards``
     via the halo exchange in :func:`_latency_settle` (neighbor ppermute for
     next-block fills, aggregated all_gather for farther ones).  Limit mode
-    stays single-device/asset-sharded: its PRNG draws are not
-    shard-invariant across time blocks.
+    works sharded: fill draws are counter-keyed by global (asset, bar)
+    cell (:func:`csmom_tpu.backtest.event.counter_uniform`), so the
+    replicated ``fill_key`` reproduces the single-device fills on any
+    (assets x time) layout.
     """
-    if order_type != "market":
-        raise NotImplementedError(
-            "time-sharded engine supports order_type='market' only; limit "
-            "draws are not shard-invariant across time blocks"
-        )
+    if order_type == "limit":
+        if fill_key is None:
+            raise ValueError("order_type='limit' requires fill_key")
+    elif order_type != "market":
+        raise ValueError(f"unknown order_type {order_type!r}")
     A, T = price.shape
     if time_axis not in mesh.shape:
         raise ValueError(
@@ -410,6 +440,9 @@ def time_sharded_event_backtest(
 
     fn = _compiled(
         mesh, time_axis, asset_axis, int(size_shares), float(threshold),
-        float(cash0), float(spread), int(latency_bars),
+        float(cash0), float(spread), int(latency_bars), order_type,
+        float(aggressiveness),
     )
-    return fn(price, valid, score, adv, vol)
+    if fill_key is None:
+        fill_key = jax.random.PRNGKey(0)  # unused dummy in market mode
+    return fn(price, valid, score, adv, vol, fill_key)

@@ -24,8 +24,12 @@ def test_limit_matches_numpy_oracle(rng):
                          jnp.asarray(adv), jnp.asarray(vol),
                          order_type="limit", aggressiveness=agg, fill_key=key)
 
-    # oracle: same uniforms (same key/shape/dtype), reference formulas
-    u = np.asarray(jax.random.uniform(key, price.shape, jnp.asarray(price).dtype))
+    # oracle: same uniforms (the counter-keyed stream is the engine's PRNG
+    # contract — shard-invariance is what's being bought), reference formulas
+    from csmom_tpu.backtest.event import counter_uniform
+
+    u = np.asarray(counter_uniform(key, price.shape, 0, 0,
+                                   jnp.asarray(price).dtype))
     p_fill = (0.2 + 0.7 * agg) * (1 - 0.5 * np.minimum(1.0, size / np.maximum(1.0, adv)))
     side = np.where(valid & (score > thr), 1, np.where(valid & (score < -thr), -1, 0))
     side = np.where(u < p_fill[:, None], side, 0)

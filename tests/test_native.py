@@ -45,7 +45,7 @@ def test_parse_timezone_offsets(tmp_path):
     ts = pd.to_datetime(epochs, unit="ns")
     assert ts[0] == pd.Timestamp("2025-08-18 13:30:00")  # EDT -> UTC
     assert ts[1] == pd.Timestamp("2025-08-18 13:30:00")
-    assert ts[2] == pd.Timestamp("2025-08-18 14:30:00")
+    assert ts[2] == pd.Timestamp("2025-08-18 14:30:00.5")  # frac kept (pandas does)
 
 
 @needs_native
@@ -101,6 +101,152 @@ def test_versioned_cache_header_skipped(tmp_path):
     df = read_price_csv(str(p), "A", kind="daily", engine="native")
     assert len(df) == 1
     assert df.loc[0, "adj_close"] == 1.4
+
+
+# --------------------------------------------------------------- fuzzing ----
+# Property: for any CSV in the price-cache family (ISO-ish timestamps first
+# column, numeric columns after, arbitrary quoting/preamble/line endings),
+# the native and pandas engines emit IDENTICAL canonical frames
+# (VERDICT r2 item 6; the defensive surface being matched is the
+# reference's normalizer, /root/reference/src/data_io.py:23-129).
+
+_TZ_OFFSETS = ["+00:00", "-04:00", "+05:30", "-09:00", "+09:30", "-00:30"]
+
+
+def _fuzz_cell(rng):
+    """One numeric-ish cell: valid floats in many spellings, quoted values,
+    quoted values with embedded commas, junk, empties."""
+    r = rng.random()
+    if r < 0.35:
+        return f"{rng.normal(100, 30):.6f}"
+    if r < 0.45:
+        return f"{rng.normal(0, 1):.3e}"          # scientific
+    if r < 0.50:
+        return f'"{rng.normal(50, 5):.4f}"'       # quoted number
+    if r < 0.56:
+        return f'"{rng.integers(1, 9)},{rng.integers(100, 999)}.{rng.integers(0, 99):02d}"'  # embedded comma -> NaN both
+    if r < 0.62:
+        return ""                                  # empty -> NaN
+    if r < 0.68:
+        return rng.choice(["garbage", "12abc", "N/A", "--", "0x1f", "1.2.3"])
+    if r < 0.74:
+        return f"  {rng.normal(10, 2):.2f}  "      # padded with spaces
+    if r < 0.80:
+        return f"+{rng.random():.5f}"              # explicit plus sign
+    if r < 0.90:
+        return str(rng.integers(0, 10**6))         # integer volume
+    return "nan"
+
+
+def _fuzz_timestamp(rng, kind, day):
+    if rng.random() < 0.12:
+        # out-of-range components: both engines must NaT-drop these rows
+        # (pandas coerces; the native parser validates calendar + clock)
+        return rng.choice([
+            "2024-02-30", "2023-02-29", "2024-13-05", "2024-04-31",
+            "2024-01-02 24:01:00", "2024-01-02 12:60:00",
+            "2024-01-02 12:30:61", "2024-01-02 10:00:00+25:00",
+        ])
+    date = f"2024-{rng.integers(1, 13):02d}-{day:02d}"
+    if kind == "daily":
+        return f'"{date}"' if rng.random() < 0.15 else date
+    sep = "T" if rng.random() < 0.3 else " "
+    t = f"{rng.integers(0, 24):02d}:{rng.integers(0, 60):02d}"
+    if rng.random() < 0.7:
+        t += f":{rng.integers(0, 60):02d}"
+        if rng.random() < 0.3:
+            t += f".{rng.integers(0, 10**6)}"      # fractional seconds
+    s = f"{date}{sep}{t}"
+    if rng.random() < 0.6:
+        s += rng.choice(_TZ_OFFSETS)               # exotic UTC offsets
+    return f'"{s}"' if rng.random() < 0.1 else s
+
+
+def _fuzz_csv(rng, kind):
+    """Random cache-family CSV text + its header column count."""
+    if kind == "daily":
+        header_pool = [
+            ["Date", "Adj Close", "Close", "High", "Low", "Open", "Volume"],
+            ["Price", "Close", "High", "Low", "Open", "Volume"],   # dialect B
+            ["Date", "Close", "Volume"],
+        ]
+    else:
+        header_pool = [
+            ["Datetime", "Close", "Volume"],
+            ["Datetime", "Price", "Volume", "Close"],
+        ]
+    cols = list(header_pool[rng.integers(0, len(header_pool))])
+    if rng.random() < 0.2:
+        cols = [f'"{c}"' for c in cols]            # quoted header names
+    lines = [",".join(cols)]
+    if rng.random() < 0.5:                         # dialect preamble rows
+        lines.append("," + ",".join(["XYZ"] * (len(cols) - 1)))
+    if rng.random() < 0.3:
+        lines.append("Ticker," + ",".join(["XYZ"] * (len(cols) - 1)))
+        lines.append("Date" + "," * (len(cols) - 1))
+    n_rows = int(rng.integers(3, 25))
+    for i in range(n_rows):
+        r = rng.random()
+        if r < 0.08:
+            lines.append(rng.choice(["junk,row,here", "#comment", ""]))
+            continue
+        ts = _fuzz_timestamp(rng, kind, day=min(28, i + 1))
+        n_cells = len(cols) - 1
+        if rng.random() < 0.15:                    # short (ragged) row
+            n_cells = int(rng.integers(0, n_cells))
+        lines.append(",".join([ts] + [_fuzz_cell(rng) for _ in range(n_cells)]))
+    newline = "\r\n" if rng.random() < 0.35 else "\n"
+    return newline.join(lines) + newline, len(cols)
+
+
+@needs_native
+@pytest.mark.parametrize("seed", range(24))
+def test_fuzz_engines_identical(tmp_path, seed):
+    rng = np.random.default_rng(24_000 + seed)
+    kind = "daily" if seed % 2 == 0 else "intraday"
+    text, _ = _fuzz_csv(rng, kind)
+    p = tmp_path / f"F{seed}_{kind}.csv"
+    p.write_bytes(text.encode())
+    nat = read_price_csv(str(p), "F", kind=kind, engine="native")
+    pdf = read_price_csv(str(p), "F", kind=kind, engine="pandas")
+    tcol = "date" if kind == "daily" else "datetime"
+    pd.testing.assert_series_equal(nat[tcol], pdf[tcol], check_exact=True)
+    pd.testing.assert_frame_equal(nat, pdf, rtol=1e-15, atol=0)
+
+
+@needs_native
+def test_long_rows_loud_not_silent(tmp_path):
+    """Rows with MORE fields than the header.  Long FIRST data row: both
+    engines truncate to the header width identically (index_col=False —
+    without it pandas silently shifts the timestamp column into the
+    index).  Long LATER row: pandas raises (ParserError -> universe-level
+    skip), native truncates.  Pinned so a silent divergence cannot creep
+    in unnoticed."""
+    import warnings
+
+    p = tmp_path / "L_daily.csv"
+    p.write_text(
+        "Date,Close,Volume\n"
+        "2020-01-02,1.5,100,999,888\n"   # 2 extra fields, first data row
+        "2020-01-03,1.6,200\n"
+    )
+    nat = read_price_csv(str(p), "L", kind="daily", engine="native")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # pandas warns about the truncation
+        pdf = read_price_csv(str(p), "L", kind="daily", engine="pandas")
+    assert len(nat) == 2 and nat.loc[0, "close"] == 1.5
+    pd.testing.assert_frame_equal(nat, pdf, rtol=1e-15, atol=0)
+
+    p2 = tmp_path / "L2_daily.csv"
+    p2.write_text(
+        "Date,Close,Volume\n"
+        "2020-01-02,1.5,100\n"
+        "2020-01-03,1.6,200,7,8\n"       # long row later in the file
+    )
+    nat2 = read_price_csv(str(p2), "L2", kind="daily", engine="native")
+    assert len(nat2) == 2
+    with pytest.raises(Exception, match="fields"):
+        read_price_csv(str(p2), "L2", kind="daily", engine="pandas")
 
 
 def test_auto_engine_always_works(tmp_path):

@@ -80,6 +80,44 @@ def test_broadcast_per_cell_lags(rng):
             np.testing.assert_allclose(got[i, j], want, rtol=1e-10)
 
 
+def test_hand_computed_fixtures():
+    """Closed-form NW t-stats worked out by hand in exact arithmetic —
+    an oracle that shares no code (or author conventions) with either
+    implementation, so the kernel and the numpy oracle cannot both hide
+    one bug (VERDICT r2 weak #6).
+
+    x=[1,2,3,4], L=1: mean 5/2, u=[-3/2,-1/2,1/2,3/2],
+      g0 = 5/4, g1 = 5/16, w1 = 1/2 -> lrv = 25/16,
+      se = sqrt(25/64) = 5/8, t = (5/2)/(5/8) = 4 exactly.
+    x=[1,-1,1,-1,1], L=1: mean 1/5, u=[4/5,-6/5,...],
+      g0 = 24/25, g1 = -96/125 -> lrv = 24/125,
+      se = 2*sqrt(6)/25, t = 5/(2*sqrt(6)) = 5*sqrt(6)/12.
+    x=[2,1,3,1,2,4,1,2] with the automatic bandwidth (n=8 ->
+      L = floor(4*(8/100)^(2/9)) = 2): mean 2, u=[0,-1,1,-1,0,2,-1,0],
+      g0 = 1, g1 = -1/2, g2 = -1/8, w = (2/3, 1/3)
+      -> lrv = 1 - 2/3 - 1/12 = 1/4, se = 1/(4*sqrt(2)), t = 8*sqrt(2).
+    """
+    cases = [
+        (np.array([1.0, 2.0, 3.0, 4.0]), 1, 4.0),
+        (np.array([1.0, -1.0, 1.0, -1.0, 1.0]), 1, 5.0 * np.sqrt(6.0) / 12.0),
+        (np.array([2.0, 1.0, 3.0, 1.0, 2.0, 4.0, 1.0, 2.0]), None,
+         8.0 * np.sqrt(2.0)),
+    ]
+    for x, lags, want in cases:
+        v = np.ones(len(x), bool)
+        np.testing.assert_allclose(float(nw_t_stat(x, v, lags=lags)), want,
+                                   rtol=1e-12)
+        # the numpy oracle must reproduce the same closed forms
+        np.testing.assert_allclose(oracle(x, lags), want, rtol=1e-12)
+
+
+def test_hand_fixture_with_mask_prefix():
+    """Fixture 1 behind an invalid warmup prefix: masked == compacted."""
+    x = np.array([9.0, 9.0, 1.0, 2.0, 3.0, 4.0])
+    v = np.array([False, False, True, True, True, True])
+    np.testing.assert_allclose(float(nw_t_stat(x, v, lags=1)), 4.0, rtol=1e-12)
+
+
 def test_degenerate_cases():
     assert np.isnan(float(nw_t_stat(np.zeros(10), np.zeros(10, bool))))
     assert np.isnan(float(nw_t_stat(np.zeros(10), np.ones(10, bool))))

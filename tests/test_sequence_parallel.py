@@ -108,9 +108,13 @@ def test_unsupported_modes_raise(rng):
         time_sharded_event_backtest(
             price, valid, score, adv, vol, mesh, latency_bars=11
         )
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError, match="fill_key"):
         time_sharded_event_backtest(
             price, valid, score, adv, vol, mesh, order_type="limit"
+        )
+    with pytest.raises(ValueError, match="order_type"):
+        time_sharded_event_backtest(
+            price, valid, score, adv, vol, mesh, order_type="iceberg"
         )
     with pytest.raises(ValueError):
         time_sharded_event_backtest(
@@ -162,4 +166,63 @@ def test_latency_2d_mesh(rng):
     )
     ref = event_backtest(price, valid, np.nan_to_num(score), adv, vol,
                          latency_bars=4)
+    _assert_equal(got, ref)
+
+
+def test_limit_mode_time_sharded(rng):
+    """Counter-keyed limit draws reproduce the single-device fills when the
+    *time* axis is split (each block draws its global-bar counters)."""
+    import jax
+
+    price, valid, score, adv, vol = _scenario(rng, A=6, T=80)
+    key = jax.random.PRNGKey(11)
+    mesh = make_mesh(grid_axis=1, axis_names=("assets", "time"))  # 1 x 8
+    got = time_sharded_event_backtest(
+        price, valid, np.nan_to_num(score), adv, vol, mesh,
+        order_type="limit", aggressiveness=0.4, fill_key=key,
+    )
+    ref = event_backtest(price, valid, np.nan_to_num(score), adv, vol,
+                         order_type="limit", aggressiveness=0.4, fill_key=key)
+    _assert_equal(got, ref)
+    assert int(ref.n_trades) > 0
+
+
+def test_limit_mode_padding_invariant(rng):
+    """pad_time must not change limit fills on the original columns: draws
+    are keyed by nested (asset, bar) folds, not an a*T+t counter whose
+    stride would bake in the padded length."""
+    import jax
+
+    price, valid, score, adv, vol = _scenario(rng, A=5, T=75)
+    key = jax.random.PRNGKey(11)
+    ref = event_backtest(price, valid, np.nan_to_num(score), adv, vol,
+                         order_type="limit", fill_key=key)
+    pp, vp, sp, T0 = pad_time(price, valid, np.nan_to_num(score), 8)
+    mesh = make_mesh(grid_axis=1, axis_names=("assets", "time"))
+    got = time_sharded_event_backtest(pp, vp, sp, adv, vol, mesh,
+                                      order_type="limit", fill_key=key)
+    assert T0 == 75
+    np.testing.assert_array_equal(
+        np.asarray(got.trade_side)[:, :T0], np.asarray(ref.trade_side)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.positions)[:, :T0], np.asarray(ref.positions)
+    )
+    assert int(got.n_trades) == int(ref.n_trades) > 0
+
+
+def test_limit_mode_2d_mesh_with_latency(rng):
+    """Limit filter + halo-exchange latency fills on the 2D (assets x time)
+    layout == the single-device composition."""
+    import jax
+
+    price, valid, score, adv, vol = _scenario(rng, A=6, T=64)
+    key = jax.random.PRNGKey(13)
+    mesh = make_mesh(grid_axis=2, axis_names=("assets", "time"))  # 2 x 4
+    got = time_sharded_event_backtest(
+        price, valid, np.nan_to_num(score), adv, vol, mesh,
+        asset_axis="assets", order_type="limit", fill_key=key, latency_bars=3,
+    )
+    ref = event_backtest(price, valid, np.nan_to_num(score), adv, vol,
+                         order_type="limit", fill_key=key, latency_bars=3)
     _assert_equal(got, ref)

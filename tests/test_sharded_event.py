@@ -61,13 +61,42 @@ def test_matches_with_latency(rng, mesh):
     _compare(dist, local, 16)
 
 
-def test_limit_mode_raises(rng, mesh):
-    price, valid, score, adv, vol = _workload(rng, a=8, t=20)
-    with pytest.raises(NotImplementedError, match="limit"):
-        sharded_event_backtest(
-            jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
-            jnp.asarray(adv), jnp.asarray(vol), mesh, order_type="limit",
-        )
+@pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
+def test_limit_mode_matches_single_device(rng, n_shards):
+    """Limit fills are counter-keyed by global (asset, bar): any asset-shard
+    count reproduces the single-device draws exactly (VERDICT r2 missing #4)."""
+    price, valid, score, adv, vol = _workload(rng, a=16, t=40)
+    key = jax.random.PRNGKey(7)
+    local = event_backtest(jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+                           jnp.asarray(adv), jnp.asarray(vol),
+                           order_type="limit", aggressiveness=0.6, fill_key=key)
+    shard_mesh = make_mesh(jax.devices()[:n_shards], grid_axis=1)
+    dist = sharded_event_backtest(
+        jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+        jnp.asarray(adv), jnp.asarray(vol), shard_mesh,
+        order_type="limit", aggressiveness=0.6, fill_key=key,
+    )
+    _compare(dist, local, 16)
+    np.testing.assert_array_equal(np.asarray(dist.trade_side),
+                                  np.asarray(local.trade_side))
+    assert int(local.n_trades) > 0
+
+
+def test_limit_with_latency_sharded(rng, mesh):
+    """Limit filter composes with delayed fills under asset sharding."""
+    price, valid, score, adv, vol = _workload(rng, a=16, t=40)
+    key = jax.random.PRNGKey(3)
+    local = event_backtest(jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+                           jnp.asarray(adv), jnp.asarray(vol),
+                           order_type="limit", fill_key=key, latency_bars=2)
+    dist = sharded_event_backtest(
+        jnp.asarray(price), jnp.asarray(valid), jnp.asarray(score),
+        jnp.asarray(adv), jnp.asarray(vol), mesh,
+        order_type="limit", fill_key=key, latency_bars=2,
+    )
+    _compare(dist, local, 16)
+    np.testing.assert_array_equal(np.asarray(dist.trade_side),
+                                  np.asarray(local.trade_side))
 
 
 def test_indivisible_assets_raise(rng, mesh):
