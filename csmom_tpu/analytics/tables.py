@@ -147,6 +147,41 @@ def jk_grid_ci_table(spreads, live, Js, Ks, key=None, n_samples: int = 200,
     )
 
 
+def horizon_table(hp, group: int = 6) -> pd.DataFrame:
+    """Event-time profile table (Lee–Swaminathan Tables VI–VIII shape:
+    momentum by months-since-formation, persistence then reversal).
+
+    Args:
+      hp: :class:`csmom_tpu.backtest.horizon.HorizonProfile`.
+      group: horizons per printed bucket (6 -> half-year rows); per-month
+        rows when 1.
+
+    Returns a DataFrame indexed by horizon bucket with the bucket's mean
+    monthly spread, its NW t-stat range, cohort counts, and the cumulative
+    event-time spread at the bucket end.
+    """
+    mean_h = np.asarray(hp.mean_spread, dtype=float)
+    t_h = np.asarray(hp.tstat_nw, dtype=float)
+    n_h = np.asarray(hp.n_cohorts)
+    cum = np.asarray(hp.cum_spread, dtype=float)
+    H = len(mean_h)
+    rows = {}
+    for lo in range(0, H, group):
+        hi = min(lo + group, H)
+        label = f"m{lo + 1}" if hi == lo + 1 else f"m{lo + 1}-{hi}"
+        seg = mean_h[lo:hi]
+        ok = np.isfinite(seg)
+        t_ok = np.isfinite(t_h[lo:hi]).any()  # t can be NaN where n<=1
+        rows[label] = {
+            "mean_spread": float(np.mean(seg[ok])) if ok.any() else np.nan,
+            "t_nw_min": float(np.nanmin(t_h[lo:hi])) if t_ok else np.nan,
+            "t_nw_max": float(np.nanmax(t_h[lo:hi])) if t_ok else np.nan,
+            "cohorts": int(n_h[lo:hi].max()),
+            "cum_spread": float(cum[hi - 1]),
+        }
+    return pd.DataFrame(rows).T
+
+
 def double_sort_table(ds, freq: int = 12) -> pd.DataFrame:
     """Momentum spread by volume tercile (paper Table II shape).
 

@@ -6,6 +6,7 @@ from csmom_tpu.backtest.monthly import (
     MonthlyResult,
 )
 from csmom_tpu.backtest.grid import jk_grid_backtest, GridResult
+from csmom_tpu.backtest.horizon import horizon_profile, HorizonProfile
 from csmom_tpu.backtest.double_sort import volume_double_sort, DoubleSortResult
 from csmom_tpu.backtest.walkforward import (
     walk_forward_select,
@@ -19,6 +20,8 @@ __all__ = [
     "MonthlyResult",
     "jk_grid_backtest",
     "GridResult",
+    "horizon_profile",
+    "HorizonProfile",
     "volume_double_sort",
     "DoubleSortResult",
     "walk_forward_select",

@@ -380,6 +380,30 @@ def cmd_run(args) -> int:
     return cmd_intraday(args)
 
 
+def cmd_horizons(args) -> int:
+    """Event-time momentum profile by months since formation.
+
+    The paper's long-horizon persistence-then-reversal view (LeSw00
+    Tables VI-VIII); the reference computes only the 1-month holding
+    return."""
+    cfg = _load_cfg(args)
+    prices, _ = _price_panel(cfg)
+
+    from csmom_tpu.analytics.tables import horizon_table
+    from csmom_tpu.backtest import horizon_profile
+
+    v, m = prices.device()
+    max_h = getattr(args, "max_h", None) or 36
+    hp = horizon_profile(
+        v, m, lookback=cfg.momentum.lookback, skip=cfg.momentum.skip,
+        n_bins=cfg.momentum.n_bins, mode=cfg.momentum.mode, max_h=max_h,
+    )
+    print(f"J={cfg.momentum.lookback} event-time profile, horizons 1..{max_h}:")
+    print(horizon_table(hp, group=getattr(args, "group", None) or 6)
+          .round(4).to_string())
+    return 0
+
+
 def cmd_bench(args) -> int:
     """Run the headline benchmark (same as ``python bench.py``)."""
     import subprocess
@@ -421,6 +445,7 @@ def build_parser() -> argparse.ArgumentParser:
         ("doublesort", cmd_doublesort, ("doublesort",)),
         ("sweep", cmd_sweep, ("js", "ks", "min_months")),
         ("intraday", cmd_intraday, ("model",)),
+        ("horizons", cmd_horizons, ("horizons",)),
         ("bench", cmd_bench, ()),
     ):
         sp = sub.add_parser(name, help=(fn.__doc__ or "").splitlines()[0])
@@ -451,6 +476,12 @@ def build_parser() -> argparse.ArgumentParser:
                             help="months averaged into the volume sort "
                                  "(default: config's 3; use J for the "
                                  "paper's formation-period turnover)")
+        if "horizons" in extra:
+            sp.add_argument("--max-h", dest="max_h", type=int,
+                            help="longest horizon in months (default 36; "
+                                 "the paper's five-year view is 60)")
+            sp.add_argument("--group", type=int,
+                            help="horizons per table row (default 6)")
         if "model" in extra:
             sp.add_argument("--model", choices=["ridge", "elastic_net", "lasso"],
                             help="score model (default: ridge, the reference's)")
