@@ -165,6 +165,8 @@ def child_main():
 
     grid_rank_s = timed("rank")
     grid_qcut_s = timed("qcut")
+    # MXU-form cohort aggregation (membership^T @ returns cross table)
+    grid_matmul_s = timed("rank", "matmul")
     # the fused Pallas cohort kernel only makes sense compiled on the TPU;
     # off-TPU it runs in interpreter mode (correctness tests), far too slow
     # to time at this scale
@@ -173,7 +175,7 @@ def child_main():
     # CPU fallback: additionally time ONE rep of the full north-star-size
     # grid when the child's budget allows — proves full-size compile+memory
     # and bounds the TPU expectation (VERDICT r2 item 3)
-    full_rank_s = None
+    full_rank_s = full_matmul_s = None
     child_budget = float(os.environ.get("CSMOM_BENCH_CHILD_BUDGET", "0") or 0)
     child_left = (child_budget - (time.monotonic() - _CHILD_T0)) if child_budget else 0
     if on_cpu and child_left > 360:  # observed: ~23x the reduced data; compile ~1 min
@@ -182,15 +184,32 @@ def child_main():
             fseg, fends = month_end_segments(fp.times)
             fv, fm = fp.device(dtype)
             fpm, fmm = month_end_aggregate(fv, fm, fseg, len(fends))
-            gf = lambda: jax.block_until_ready(
-                jk_grid_backtest(fpm, fmm, Js, Ks, skip=1, mode="rank").mean_spread
-            )
+
+            def gf(impl="xla"):
+                jax.block_until_ready(
+                    jk_grid_backtest(
+                        fpm, fmm, Js, Ks, skip=1, mode="rank", impl=impl
+                    ).mean_spread
+                )
+
             gf()  # compile
             t0 = time.perf_counter()
             gf()
             full_rank_s = time.perf_counter() - t0
         except Exception as e:  # record, never lose the JSON line
             full_rank_s = f"failed: {type(e).__name__}: {e}"[:200]
+        # the matmul leg doubles the full-size work: re-check the budget and
+        # fail independently so a matmul problem can't discard the measured
+        # xla number
+        child_left = child_budget - (time.monotonic() - _CHILD_T0)
+        if isinstance(full_rank_s, float) and child_left > 3 * full_rank_s + 90:
+            try:
+                gf("matmul")  # compile
+                t0 = time.perf_counter()
+                gf("matmul")
+                full_matmul_s = time.perf_counter() - t0
+            except Exception as e:
+                full_matmul_s = f"failed: {type(e).__name__}: {e}"[:200]
 
     # simple cost model of the grid's dominant stage (cohort partial sums:
     # nJ x H horizon-shifted masked reductions over the [A, M] panel) so the
@@ -225,6 +244,7 @@ def child_main():
         "grid_is_north_star_size": (A, T) == (3000, 15120),
         "grid16_rank_s": round(grid_rank_s, 4),
         "grid16_qcut_s": round(grid_qcut_s, 4),
+        "grid16_rank_matmul_s": round(grid_matmul_s, 4),
         "grid16_rank_pallas_s": (None if grid_pallas_s is None
                                  else round(grid_pallas_s, 4)),
         "north_star_target_s": 10.0,
@@ -242,6 +262,10 @@ def child_main():
         ),
         "grid16_rank_full_s": (
             round(full_rank_s, 4) if isinstance(full_rank_s, float) else full_rank_s
+        ),
+        "grid16_rank_matmul_full_s": (
+            round(full_matmul_s, 4) if isinstance(full_matmul_s, float)
+            else full_matmul_s
         ),
         "grid_full_workload": "16 cells, 3000 stocks x 15120 days"
                               if full_rank_s is not None else None,

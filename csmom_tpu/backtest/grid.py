@@ -64,7 +64,32 @@ def _cohort_partial_sums(labels, ret, ret_valid, n_bins: int, max_hold: int,
     O(A*M) HBM traffic independent of H, vs the H rolled panel copies the
     XLA form materializes between fusion boundaries.  Interpreter mode off
     TPU keeps tests portable.
+
+    ``impl='matmul'`` recasts the whole aggregation as two batched
+    [2, M, A] @ [A, M] matmuls (membership^T @ returns and membership^T @
+    validity, both sides in the stacked leading axis — the full formation
+    x measurement-month cross table) followed by a diagonal-band gather of
+    columns s+1..s+H.  2*A*M^2 FLOPs per matmul
+    instead of H masked panel passes; on TPU this is MXU work, and the
+    band gather reads 2*M*H elements.  Summation order differs from the
+    elementwise forms, so float results agree to tolerance, not bitwise.
     """
+    if impl == "matmul":
+        A, M = ret.shape
+        rf = jnp.where(ret_valid, jnp.nan_to_num(ret), 0.0)
+        count_dtype = jnp.promote_types(rf.dtype, jnp.float32)
+        mem = jnp.stack([labels == 0, labels == (n_bins - 1)])  # [2, A, M]
+        mem = mem.astype(rf.dtype)
+        vf = ret_valid.astype(count_dtype)
+        full_sums = jnp.einsum("kas,am->ksm", mem, rf)          # [2, M, M]
+        full_cnts = jnp.einsum("kas,am->ksm", mem.astype(count_dtype), vf)
+        col = jnp.arange(M)[:, None] + jnp.arange(1, max_hold + 1)[None, :]
+        in_range = col < M                                       # [M, H]
+        colc = jnp.clip(col, 0, M - 1)[None]
+        sums = jnp.take_along_axis(full_sums, colc, axis=2)      # [2, M, H]
+        counts = jnp.take_along_axis(full_cnts, colc, axis=2)
+        keep = in_range[None]
+        return jnp.where(keep, sums, 0.0), jnp.where(keep, counts, 0.0)
     if impl == "pallas":
         import jax as _jax
 
@@ -74,6 +99,8 @@ def _cohort_partial_sums(labels, ret, ret_valid, n_bins: int, max_hold: int,
             ret, ret_valid, labels, n_bins=n_bins, max_hold=max_hold,
             interpret=_jax.default_backend() != "tpu",
         )
+    if impl != "xla":
+        raise ValueError(f"unknown impl {impl!r}: use 'xla', 'matmul' or 'pallas'")
     A, M = ret.shape
     top = labels == (n_bins - 1)
     bot = labels == 0
@@ -204,7 +231,9 @@ def jk_grid_backtest(
       n_bins: quantile bins.
       mode: ranking mode ('qcut' parity / 'rank' fast).
       max_hold: static horizon bound (defaults to max(Ks) when Ks is concrete).
-      impl: cohort-aggregation implementation ('xla' / 'pallas' fused kernel).
+      impl: cohort-aggregation kernel — 'xla' (rolled-panel reference form),
+        'matmul' (MXU cross-table form, fastest at scale), or 'pallas'
+        (fused VMEM kernel, TPU).
     """
     max_hold = validate_grid_args(Ks, max_hold)
     return _jk_grid_backtest(
