@@ -182,6 +182,46 @@ def horizon_table(hp, group: int = 6) -> pd.DataFrame:
     return pd.DataFrame(rows).T
 
 
+def volume_horizon_table(vhp, group: int = 6) -> pd.DataFrame:
+    """Momentum life-cycle table (LeSw00 Table VIII shape): event-time mean
+    spread per volume tercile, bucketed by horizon, with the high-minus-low
+    volume contrast — the late-stage-reversal signature is V_high falling
+    below V_low at long horizons.
+
+    Args:
+      vhp: :class:`csmom_tpu.backtest.horizon.VolumeHorizonProfile`.
+      group: horizons per bucket.
+    """
+    mean_vh = np.asarray(vhp.mean_spread, dtype=float)   # [V, H]
+    diff = np.asarray(vhp.diff_mean, dtype=float)        # [H]
+    dt = np.asarray(vhp.diff_tstat_nw, dtype=float)
+    V, H = mean_vh.shape
+    rows = {}
+    for lo in range(0, H, group):
+        hi = min(lo + group, H)
+        label = f"m{lo + 1}" if hi == lo + 1 else f"m{lo + 1}-{hi}"
+        row = {}
+        for v in range(V):
+            name = "V1 (low)" if v == 0 else (
+                f"V{v + 1} (high)" if v == V - 1 else f"V{v + 1}"
+            )
+            seg = mean_vh[v, lo:hi]
+            ok = np.isfinite(seg)
+            row[name] = float(np.mean(seg[ok])) if ok.any() else np.nan
+        seg_d = diff[lo:hi]
+        ok_d = np.isfinite(seg_d)
+        row["Vhigh-Vlow"] = float(np.mean(seg_d[ok_d])) if ok_d.any() else np.nan
+        t_seg = dt[lo:hi]
+        if np.isfinite(t_seg).any():
+            # signed t at max |t|: the reversal signature is this turning
+            # significantly NEGATIVE at long horizons, so the sign matters
+            row["diff_t_nw"] = float(t_seg[np.nanargmax(np.abs(t_seg))])
+        else:
+            row["diff_t_nw"] = np.nan
+        rows[label] = row
+    return pd.DataFrame(rows).T
+
+
 def double_sort_table(ds, freq: int = 12) -> pd.DataFrame:
     """Momentum spread by volume tercile (paper Table II shape).
 

@@ -6,7 +6,12 @@ from csmom_tpu.backtest.monthly import (
     MonthlyResult,
 )
 from csmom_tpu.backtest.grid import jk_grid_backtest, GridResult
-from csmom_tpu.backtest.horizon import horizon_profile, HorizonProfile
+from csmom_tpu.backtest.horizon import (
+    horizon_profile,
+    HorizonProfile,
+    volume_horizon_profile,
+    VolumeHorizonProfile,
+)
 from csmom_tpu.backtest.double_sort import volume_double_sort, DoubleSortResult
 from csmom_tpu.backtest.walkforward import (
     walk_forward_select,
@@ -22,6 +27,8 @@ __all__ = [
     "GridResult",
     "horizon_profile",
     "HorizonProfile",
+    "volume_horizon_profile",
+    "VolumeHorizonProfile",
     "volume_double_sort",
     "DoubleSortResult",
     "walk_forward_select",

@@ -224,24 +224,23 @@ def cmd_grid(args) -> int:
     return 0
 
 
-def cmd_doublesort(args) -> int:
-    """Momentum spread within volume terciles (Lee-Swaminathan Table II;
-    the turnover leg the reference computes but never ranks on,
-    ``features.py:60-107`` / SURVEY item 6)."""
+def _build_turnover(args, cfg, prices, volume):
+    """Shared turnover-panel construction for the volume-conditioned
+    commands (doublesort, horizons --by-volume): shares outstanding when
+    fetched, trailing-average-volume proxy otherwise.
+
+    Returns ``(turn, turn_valid, turn_lb)``.
+    """
     import numpy as np
 
-    cfg = _load_cfg(args)
-    prices, volume = _price_panel(cfg)
-
-    from csmom_tpu.analytics.tables import double_sort_table
-    from csmom_tpu.backtest import volume_double_sort
     from csmom_tpu.panel.fetch import get_shares_info
     from csmom_tpu.signals.turnover import (
         shares_outstanding_vector,
         turnover_features,
     )
 
-    shares_info = get_shares_info(list(prices.tickers)) if args.fetch_shares else {}
+    fetch = getattr(args, "fetch_shares", False)
+    shares_info = get_shares_info(list(prices.tickers)) if fetch else {}
     pv = np.asarray(prices.values)
     # each asset's last *finite* price (not the final column, which is NaN
     # for names that stopped trading) keeps the market_cap/price fallback
@@ -267,11 +266,29 @@ def cmd_doublesort(args) -> int:
         print(f"note: no shares metadata for {len(missing)} ticker(s) "
               f"({', '.join(missing[:5])}{'...' if len(missing) > 5 else ''}) — "
               "they are excluded from the volume terciles")
-    turn_lb = args.turnover_lookback or cfg.momentum.turnover_lookback
+    turn_lb = (getattr(args, "turnover_lookback", None)
+               or cfg.momentum.turnover_lookback)
     turn, turn_valid = turnover_features(
         np.asarray(volume.values), np.asarray(volume.mask), shares,
         lookback=turn_lb,
     )["turn_avg"]
+    return turn, turn_valid, turn_lb
+
+
+def cmd_doublesort(args) -> int:
+    """Momentum spread within volume terciles (Lee-Swaminathan Table II;
+    the turnover leg the reference computes but never ranks on,
+    ``features.py:60-107`` / SURVEY item 6)."""
+    import numpy as np
+
+    cfg = _load_cfg(args)
+    prices, volume = _price_panel(cfg)
+
+    from csmom_tpu.analytics.tables import double_sort_table
+    from csmom_tpu.backtest import volume_double_sort
+
+    turn, turn_valid, turn_lb = _build_turnover(args, cfg, prices, volume)
+    pv = np.asarray(prices.values)
     res = volume_double_sort(
         pv, np.asarray(prices.mask),
         np.asarray(turn), np.asarray(turn_valid),
@@ -388,21 +405,39 @@ def cmd_horizons(args) -> int:
     The paper's long-horizon persistence-then-reversal view (LeSw00
     Tables VI-VIII); the reference computes only the 1-month holding
     return."""
+    import numpy as np
+
     cfg = _load_cfg(args)
-    prices, _ = _price_panel(cfg)
+    prices, volume = _price_panel(cfg)
+
+    v, m = prices.device()
+    max_h = getattr(args, "max_h", None) or 36
+    group = getattr(args, "group", None) or 6
+
+    if getattr(args, "by_volume", False):
+        from csmom_tpu.analytics.tables import volume_horizon_table
+        from csmom_tpu.backtest import volume_horizon_profile
+
+        turn, turn_valid, turn_lb = _build_turnover(args, cfg, prices, volume)
+        vhp = volume_horizon_profile(
+            v, m, np.asarray(turn), np.asarray(turn_valid),
+            lookback=cfg.momentum.lookback, skip=cfg.momentum.skip,
+            n_bins=cfg.momentum.n_bins, mode=cfg.momentum.mode, max_h=max_h,
+        )
+        print(f"J={cfg.momentum.lookback} momentum life cycle by volume "
+              f"tercile (turnover avg {turn_lb}m), horizons 1..{max_h}:")
+        print(volume_horizon_table(vhp, group=group).round(4).to_string())
+        return 0
 
     from csmom_tpu.analytics.tables import horizon_table
     from csmom_tpu.backtest import horizon_profile
 
-    v, m = prices.device()
-    max_h = getattr(args, "max_h", None) or 36
     hp = horizon_profile(
         v, m, lookback=cfg.momentum.lookback, skip=cfg.momentum.skip,
         n_bins=cfg.momentum.n_bins, mode=cfg.momentum.mode, max_h=max_h,
     )
     print(f"J={cfg.momentum.lookback} event-time profile, horizons 1..{max_h}:")
-    print(horizon_table(hp, group=getattr(args, "group", None) or 6)
-          .round(4).to_string())
+    print(horizon_table(hp, group=group).round(4).to_string())
     return 0
 
 
@@ -488,6 +523,19 @@ def build_parser() -> argparse.ArgumentParser:
                                  "the paper's five-year view is 60)")
             sp.add_argument("--group", type=int,
                             help="horizons per table row (default 6)")
+            sp.add_argument("--by-volume", dest="by_volume",
+                            action="store_true",
+                            help="condition the profile on volume terciles "
+                                 "(the paper's momentum life cycle, Table "
+                                 "VIII: high-volume momentum reverses "
+                                 "sooner)")
+            sp.add_argument("--fetch-shares", dest="fetch_shares",
+                            action="store_true",
+                            help="fetch shares outstanding for true turnover "
+                                 "(network); default uses a volume proxy")
+            sp.add_argument("--turnover-lookback", dest="turnover_lookback",
+                            type=int,
+                            help="months averaged into the volume sort")
         if "model" in extra:
             sp.add_argument("--model", choices=["ridge", "elastic_net", "lasso"],
                             help="score model (default: ridge, the reference's)")

@@ -110,3 +110,28 @@ def test_golden_fingerprint():
     np.testing.assert_allclose(ours["price"].values, golden["price"].values, rtol=1e-9)
     np.testing.assert_allclose(ours["impact"].values, golden["impact"].values, rtol=1e-9, atol=1e-12)
     np.testing.assert_allclose(ours["score"].values, golden["score"].values, rtol=1e-6, atol=1e-12)
+
+
+@requires_reference
+def test_golden_fingerprint_f32():
+    """The same golden workload in float32 — the dtype the TPU path actually
+    runs (tests run on CPU but the numerics are the panel program's, not the
+    platform's).  The documented f32 tolerance (bench.py GOLDEN_TRADE_TOL):
+    a handful of threshold crossings sit within one f32 ulp of the 1e-5
+    score threshold, so the trade count may drift by up to ±4; the dollar
+    aggregates stay within float32 relative error of the f64 answers."""
+    from csmom_tpu.api import intraday_pipeline
+    from csmom_tpu.panel.ingest import load_daily, load_intraday
+
+    minute_df = load_intraday(REFERENCE_DATA, DEMO_TICKERS)
+    daily_df = load_daily(REFERENCE_DATA, MEASURED_TICKERS)
+    res, fit, compact, dense_score, dense_price, dense_valid = intraday_pipeline(
+        minute_df, daily_df, dtype=np.float32
+    )
+    assert np.asarray(dense_price).dtype == np.float32
+    assert abs(int(res.n_trades) - 28_020) <= 4
+    assert abs(int(res.n_buys) - 17_433) <= 4
+    assert abs(int(res.n_sells) - 10_587) <= 4
+    # ~$90M notional at f32 precision (2^-24 relative): dollars, not cents
+    assert abs(float(res.net_notional) - 90_084_558.39) / 90_084_558.39 < 1e-4
+    assert abs(float(res.total_pnl) - 765_431.87) / 765_431.87 < 5e-3

@@ -89,6 +89,86 @@ def test_horizon_table_buckets(rng):
     assert list(per_month.index)[0] == "m1" and len(per_month) == 12
 
 
+def _oracle_by_volume(prices, mask, turn, turn_valid, J, skip, n_bins, V, max_h):
+    """Pandas loop oracle for the volume-conditioned profile (independent
+    double sort at formation; qcut semantics for both sorts)."""
+    A, M = prices.shape
+    px = pd.DataFrame(prices.T)
+    ret = px.pct_change()
+    mom = px.shift(skip) / px.shift(skip + J) - 1.0
+
+    out = np.full((V, M, max_h), np.nan)
+    for s in range(M):
+        sig = mom.iloc[s]
+        live_m = sig.notna() & mask[:, s]
+        if live_m.sum() < 2:
+            continue
+        q = pd.qcut(sig[live_m], n_bins, labels=False, duplicates="drop")
+        if q.max() == 0:
+            continue
+        tv = pd.Series(turn[:, s])
+        live_v = live_m & tv.notna() & turn_valid[:, s]
+        if live_v.sum() < 2:
+            continue
+        vq = pd.qcut(tv[live_v], V, labels=False, duplicates="drop")
+        for v in range(V):
+            in_v = vq.index[vq == v]
+            top = [a for a in q.index[q == q.max()] if a in set(in_v)]
+            bot = [a for a in q.index[q == 0] if a in set(in_v)]
+            for h in range(1, max_h + 1):
+                if s + h >= M:
+                    break
+                r = ret.iloc[s + h]
+                rt, rb = r[top].dropna(), r[bot].dropna()
+                if len(rt) and len(rb):
+                    out[v, s, h - 1] = rt.mean() - rb.mean()
+    return out
+
+
+def test_volume_profile_matches_pandas_oracle(rng):
+    from csmom_tpu.backtest import volume_horizon_profile
+
+    A, M, V, max_h = 36, 60, 3, 5
+    prices, mask = _panel(rng, A=A, M=M)
+    turn = np.abs(rng.normal(2, 1, size=(A, M)))
+    turn_valid = rng.random((A, M)) > 0.1
+    turn = np.where(turn_valid, turn, np.nan)
+
+    vhp = volume_horizon_profile(prices, mask, turn, turn_valid, lookback=6,
+                                 skip=1, n_bins=4, n_vol_bins=V,
+                                 mode="qcut", max_h=max_h)
+    oracle = _oracle_by_volume(prices, mask, turn, turn_valid, 6, 1, 4, V, max_h)
+    want_mean = np.nanmean(oracle, axis=1)            # [V, H]
+    np.testing.assert_allclose(np.asarray(vhp.mean_spread), want_mean,
+                               rtol=1e-9, equal_nan=True)
+    want_n = np.sum(~np.isnan(oracle), axis=1)
+    np.testing.assert_array_equal(np.asarray(vhp.n_cohorts), want_n)
+    # the high-minus-low contrast uses only jointly-live (s, h) cells
+    both = ~np.isnan(oracle[-1]) & ~np.isnan(oracle[0])
+    want_diff = np.array([
+        np.mean((oracle[-1] - oracle[0])[both[:, h], h]) if both[:, h].any()
+        else np.nan
+        for h in range(max_h)
+    ])
+    np.testing.assert_allclose(np.asarray(vhp.diff_mean), want_diff,
+                               rtol=1e-9, equal_nan=True)
+
+
+def test_volume_horizon_table_shape(rng):
+    from csmom_tpu.backtest import volume_horizon_profile
+    from csmom_tpu.analytics.tables import volume_horizon_table
+
+    prices, mask = _panel(rng, A=30, M=60)
+    turn = np.abs(rng.normal(2, 1, size=prices.shape))
+    tv = np.ones(prices.shape, bool)
+    vhp = volume_horizon_profile(prices, mask, turn, tv, lookback=6,
+                                 n_bins=4, max_h=12)
+    df = volume_horizon_table(vhp, group=6)
+    assert list(df.index) == ["m1-6", "m7-12"]
+    assert list(df.columns) == ["V1 (low)", "V2", "V3 (high)", "Vhigh-Vlow",
+                                "diff_t_nw"]
+
+
 def test_persistence_signal_on_trending_panel(rng):
     """A panel with persistent per-asset drifts must show positive spreads
     at every horizon (winners keep winning when drifts are permanent)."""

@@ -96,3 +96,21 @@ def test_golden_parity_measured_baseline():
     assert abs(float(res.ann_sharpe) - 0.1002) < 5e-5
     cum = float(np.prod(1 + np.asarray(res.spread)[sv]))
     assert abs(cum - 0.7509) < 5e-5
+
+
+@requires_reference
+def test_golden_parity_f32():
+    """The same measured-baseline workload in float32 — the TPU production
+    dtype.  Deciles come from rank order (robust to f32), so validity is
+    identical; the spread statistics agree to f32 relative error."""
+    from csmom_tpu.api import monthly_price_panel
+
+    prices, _ = monthly_price_panel(REFERENCE_DATA, MEASURED_TICKERS)
+    v, m = prices.device()
+    res = monthly_spread_backtest(
+        np.asarray(v, dtype=np.float32), m, lookback=12, skip=1
+    )
+    sv = np.asarray(res.spread_valid)
+    assert int(sv.sum()) == 70
+    assert abs(float(res.mean_spread) - 0.003674) < 2e-6
+    assert abs(float(res.ann_sharpe) - 0.1002) < 2e-3
