@@ -74,6 +74,47 @@ def save_intraday_pnl_plot(times, pnl, results_dir: str,
     )
 
 
+def save_horizon_plot(profile, results_dir: str,
+                      fname: str = "horizon_profile.png") -> str:
+    """Event-time cumulative spread curve (the JT/LeSw hump: persistence
+    then reversal).  ``profile`` is a
+    :class:`csmom_tpu.backtest.horizon.HorizonProfile` or a
+    :class:`~csmom_tpu.backtest.horizon.VolumeHorizonProfile` (one line
+    per volume tercile)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    ensure_dir(results_dir)
+    cum = np.asarray(profile.cum_spread, dtype=float)
+    fig, ax = plt.subplots(figsize=(9, 4.5))
+    if cum.ndim == 1:
+        ax.plot(np.arange(1, len(cum) + 1), cum, color=_LINE, linewidth=2)
+    else:
+        from csmom_tpu.analytics.tables import tercile_labels
+
+        V = cum.shape[0]
+        labels = tercile_labels(V)
+        for v in range(V):
+            ax.plot(np.arange(1, cum.shape[1] + 1), cum[v], linewidth=2,
+                    label=labels[v])
+        ax.legend(frameon=False, labelcolor=_INK)
+    ax.axhline(0.0, color=_GRID, linewidth=1)
+    ax.set_title("Event-time cumulative momentum spread", color=_INK)
+    ax.set_xlabel("months since formation", color=_INK)
+    ax.set_ylabel("cumulative spread", color=_INK)
+    ax.grid(True, color=_GRID, linewidth=0.6)
+    for spine in ("top", "right"):
+        ax.spines[spine].set_visible(False)
+    ax.tick_params(colors=_INK)
+    fig.tight_layout()
+    out_path = os.path.join(results_dir, fname)
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path
+
+
 def save_trades_csv(trades_df, results_dir: str, fname: str = "trades.csv") -> str:
     """Write the trade log with the reference's exact header
     (``results/trades.csv:1``: datetime,ticker,size,price,impact,score)."""

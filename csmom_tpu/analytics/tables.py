@@ -182,6 +182,15 @@ def horizon_table(hp, group: int = 6) -> pd.DataFrame:
     return pd.DataFrame(rows).T
 
 
+def tercile_labels(V: int) -> list[str]:
+    """Display names for volume groups, shared by tables and plots so the
+    legend and columns can't drift: V1 (low) .. V{V} (high)."""
+    if V == 1:
+        return ["V1"]
+    return (["V1 (low)"] + [f"V{v + 1}" for v in range(1, V - 1)]
+            + [f"V{V} (high)"])
+
+
 def volume_horizon_table(vhp, group: int = 6) -> pd.DataFrame:
     """Momentum life-cycle table (LeSw00 Table VIII shape): event-time mean
     spread per volume tercile, bucketed by horizon, with the high-minus-low
@@ -201,13 +210,11 @@ def volume_horizon_table(vhp, group: int = 6) -> pd.DataFrame:
         hi = min(lo + group, H)
         label = f"m{lo + 1}" if hi == lo + 1 else f"m{lo + 1}-{hi}"
         row = {}
+        names = tercile_labels(V)
         for v in range(V):
-            name = "V1 (low)" if v == 0 else (
-                f"V{v + 1} (high)" if v == V - 1 else f"V{v + 1}"
-            )
             seg = mean_vh[v, lo:hi]
             ok = np.isfinite(seg)
-            row[name] = float(np.mean(seg[ok])) if ok.any() else np.nan
+            row[names[v]] = float(np.mean(seg[ok])) if ok.any() else np.nan
         seg_d = diff[lo:hi]
         ok_d = np.isfinite(seg_d)
         row["Vhigh-Vlow"] = float(np.mean(seg_d[ok_d])) if ok_d.any() else np.nan

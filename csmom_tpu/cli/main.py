@@ -427,6 +427,12 @@ def cmd_horizons(args) -> int:
         print(f"J={cfg.momentum.lookback} momentum life cycle by volume "
               f"tercile (turnover avg {turn_lb}m), horizons 1..{max_h}:")
         print(volume_horizon_table(vhp, group=group).round(4).to_string())
+        if getattr(args, "out", None):
+            from csmom_tpu.analytics.plots import save_horizon_plot
+
+            log.info("wrote %s", save_horizon_plot(
+                vhp, cfg.results_dir, fname="horizon_profile_by_volume.png"
+            ))
         return 0
 
     from csmom_tpu.analytics.tables import horizon_table
@@ -438,6 +444,10 @@ def cmd_horizons(args) -> int:
     )
     print(f"J={cfg.momentum.lookback} event-time profile, horizons 1..{max_h}:")
     print(horizon_table(hp, group=group).round(4).to_string())
+    if getattr(args, "out", None):
+        from csmom_tpu.analytics.plots import save_horizon_plot
+
+        log.info("wrote %s", save_horizon_plot(hp, cfg.results_dir))
     return 0
 
 
@@ -466,6 +476,21 @@ def _add_common(p):
                         "(fast ordinal), rank_hist (distributed radix-"
                         "histogram rank — grid command only, implies a "
                         "sharded mesh)")
+
+
+def _add_turnover_flags(sp):
+    """Volume-sort flags shared by every turnover-conditioned subcommand
+    (doublesort, horizons --by-volume) — one definition so help text and
+    defaults cannot drift."""
+    sp.add_argument("--fetch-shares", dest="fetch_shares",
+                    action="store_true",
+                    help="fetch shares outstanding for true turnover "
+                         "(network); default uses a volume proxy")
+    sp.add_argument("--turnover-lookback", dest="turnover_lookback",
+                    type=int,
+                    help="months averaged into the volume sort (default: "
+                         "config's 3; use J for the paper's "
+                         "formation-period turnover)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -508,15 +533,7 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--tables", action="store_true",
                             help="print the paper-style per-decile table")
         if "doublesort" in extra:
-            sp.add_argument("--fetch-shares", dest="fetch_shares",
-                            action="store_true",
-                            help="fetch shares outstanding for true turnover "
-                                 "(network); default uses a volume proxy")
-            sp.add_argument("--turnover-lookback", dest="turnover_lookback",
-                            type=int,
-                            help="months averaged into the volume sort "
-                                 "(default: config's 3; use J for the "
-                                 "paper's formation-period turnover)")
+            _add_turnover_flags(sp)
         if "horizons" in extra:
             sp.add_argument("--max-h", dest="max_h", type=int,
                             help="longest horizon in months (default 36; "
@@ -529,13 +546,7 @@ def build_parser() -> argparse.ArgumentParser:
                                  "(the paper's momentum life cycle, Table "
                                  "VIII: high-volume momentum reverses "
                                  "sooner)")
-            sp.add_argument("--fetch-shares", dest="fetch_shares",
-                            action="store_true",
-                            help="fetch shares outstanding for true turnover "
-                                 "(network); default uses a volume proxy")
-            sp.add_argument("--turnover-lookback", dest="turnover_lookback",
-                            type=int,
-                            help="months averaged into the volume sort")
+            _add_turnover_flags(sp)
         if "model" in extra:
             sp.add_argument("--model", choices=["ridge", "elastic_net", "lasso"],
                             help="score model (default: ridge, the reference's)")

@@ -92,6 +92,36 @@ def test_cli_replicate_pandas(tmp_path, capsys):
 
 
 @requires_reference
+def test_cli_horizons_writes_plot(tmp_path, capsys):
+    rc = main([
+        "horizons", "--data-dir", REFERENCE_DATA, "--out", str(tmp_path),
+        "--platform", "cpu", "--max-h", "12",
+    ])
+    assert rc == 0
+    assert "event-time profile" in capsys.readouterr().out
+    assert os.path.exists(tmp_path / "horizon_profile.png")
+
+
+def test_horizon_plot_both_profile_shapes(tmp_path, rng):
+    """save_horizon_plot accepts the plain [H] profile and the [V, H]
+    volume-conditioned one (one line per tercile)."""
+    from csmom_tpu.analytics.plots import save_horizon_plot
+    from csmom_tpu.backtest import horizon_profile, volume_horizon_profile
+    import numpy as np
+
+    A, M = 24, 50
+    prices = 50 * np.exp(np.cumsum(rng.normal(0.004, 0.06, size=(A, M)), axis=1))
+    mask = np.ones((A, M), bool)
+    hp = horizon_profile(prices, mask, lookback=6, max_h=8, n_bins=4)
+    p1 = save_horizon_plot(hp, str(tmp_path), fname="h1.png")
+    turn = np.abs(rng.normal(2, 1, size=(A, M)))
+    vhp = volume_horizon_profile(prices, mask, turn, np.ones((A, M), bool),
+                                 lookback=6, max_h=8, n_bins=4)
+    p2 = save_horizon_plot(vhp, str(tmp_path), fname="h2.png")
+    assert os.path.getsize(p1) > 0 and os.path.getsize(p2) > 0
+
+
+@requires_reference
 def test_cli_replicate_flag_overrides(tmp_path, capsys):
     main([
         "replicate", "--data-dir", REFERENCE_DATA, "--out", str(tmp_path),
