@@ -243,11 +243,10 @@ def double_sort_table(ds, freq: int = 12) -> pd.DataFrame:
     valid = np.asarray(ds.spread_valid, dtype=bool)
     V = spreads.shape[0]
     rows = {}
+    names = tercile_labels(V)
     for v in range(V):
         x, m = _masked_rows(spreads[v], valid[v])
-        rows["V1 (low)" if v == 0 else f"V{v + 1}" + (" (high)" if v == V - 1 else "")] = (
-            _row_stats(x, m, freq)
-        )
+        rows[names[v]] = _row_stats(x, m, freq)
     both = valid[V - 1] & valid[0]
     diff = np.where(both, spreads[V - 1] - spreads[0], np.nan)
     rows[f"V{V}-V1"] = _row_stats(*_masked_rows(diff, both), freq)

@@ -451,6 +451,53 @@ def cmd_horizons(args) -> int:
     return 0
 
 
+def cmd_fetch(args) -> int:
+    """Populate or refresh the CSV cache for a universe.
+
+    Cache-first like the reference's fetch layer (``data_io.py:131-228``):
+    tickers with a readable cache are left alone unless --force-refresh;
+    missing ones go to the network (requires yfinance, absent in offline
+    images — the error names the fix).  Writes versioned caches that
+    always roundtrip (the reference's dialect-B files silently dropped a
+    ticker on re-read, SURVEY §2.1.1)."""
+    cfg = _load_cfg(args)
+
+    from csmom_tpu.panel.fetch import fetch_daily, fetch_intraday
+
+    tickers = (
+        [t.strip().upper() for t in args.tickers.split(",") if t.strip()]
+        if getattr(args, "tickers", None) else list(cfg.universe.tickers)
+    )
+    data_dir = cfg.universe.data_dir
+    kind = getattr(args, "kind", None) or "both"
+    force = bool(getattr(args, "force_refresh", False))
+    rc = 0
+    if kind in ("daily", "both"):
+        df = fetch_daily(
+            tickers,
+            start=getattr(args, "start", None) or cfg.universe.start,
+            end=getattr(args, "end", None) or cfg.universe.end,
+            data_dir=data_dir, force_refresh=force,
+        )
+        got = df.groupby("ticker").size() if len(df) else {}
+        print(f"daily: {len(got)}/{len(tickers)} tickers cached in {data_dir}")
+        if len(got) < len(tickers):  # partial failure is failure: a scripted
+            rc = 1                   # fetch && replicate must stop, not run
+                                     # on a silently smaller universe
+    if kind in ("intraday", "both"):
+        df = fetch_intraday(
+            tickers,
+            period=getattr(args, "period", None) or "7d",
+            interval=getattr(args, "interval", None) or "1m",
+            data_dir=data_dir, force_refresh=force,
+        )
+        got = df.groupby("ticker").size() if len(df) else {}
+        print(f"intraday: {len(got)}/{len(tickers)} tickers cached in {data_dir}")
+        if len(got) < len(tickers):
+            rc = 1
+    return rc
+
+
 def cmd_bench(args) -> int:
     """Run the headline benchmark (same as ``python bench.py``)."""
     import subprocess
@@ -508,6 +555,7 @@ def build_parser() -> argparse.ArgumentParser:
         ("sweep", cmd_sweep, ("js", "ks", "min_months")),
         ("intraday", cmd_intraday, ("model",)),
         ("horizons", cmd_horizons, ("horizons",)),
+        ("fetch", cmd_fetch, ("fetch",)),
         ("bench", cmd_bench, ()),
     ):
         sp = sub.add_parser(name, help=(fn.__doc__ or "").splitlines()[0])
@@ -547,6 +595,18 @@ def build_parser() -> argparse.ArgumentParser:
                                  "VIII: high-volume momentum reverses "
                                  "sooner)")
             _add_turnover_flags(sp)
+        if "fetch" in extra:
+            sp.add_argument("--tickers", help="comma-separated symbols "
+                                              "(default: config universe)")
+            sp.add_argument("--kind", choices=["daily", "intraday", "both"],
+                            help="which bars to fetch (default both)")
+            sp.add_argument("--start", help="daily range start (YYYY-MM-DD)")
+            sp.add_argument("--end", help="daily range end")
+            sp.add_argument("--period", help="intraday lookback (default 7d)")
+            sp.add_argument("--interval", help="intraday bar size (default 1m)")
+            sp.add_argument("--force-refresh", dest="force_refresh",
+                            action="store_true",
+                            help="re-download even when a cache file exists")
         if "model" in extra:
             sp.add_argument("--model", choices=["ridge", "elastic_net", "lasso"],
                             help="score model (default: ridge, the reference's)")

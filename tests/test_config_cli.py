@@ -122,6 +122,22 @@ def test_horizon_plot_both_profile_shapes(tmp_path, rng):
 
 
 @requires_reference
+def test_cli_fetch_cache_hit_and_miss(tmp_path, capsys):
+    """fetch is cache-first: reference caches count as hits without any
+    network; a missing ticker in an empty dir is skipped loudly and the
+    command reports failure."""
+    rc = main(["fetch", "--data-dir", REFERENCE_DATA,
+               "--tickers", "AMD,NVDA", "--kind", "daily"])
+    assert rc == 0
+    assert "daily: 2/2" in capsys.readouterr().out
+
+    rc = main(["fetch", "--data-dir", str(tmp_path), "--tickers", "ZZZZ",
+               "--kind", "daily"])
+    assert rc == 1
+    assert "daily: 0/1" in capsys.readouterr().out
+
+
+@requires_reference
 def test_cli_replicate_flag_overrides(tmp_path, capsys):
     main([
         "replicate", "--data-dir", REFERENCE_DATA, "--out", str(tmp_path),
