@@ -123,13 +123,19 @@ def child_main():
         jax.config.update("jax_enable_x64", True)
     dtype = np.float64 if on_cpu else np.float32
 
+    # Timing discipline: every timed rep fetches a scalar result to host
+    # (see csmom_tpu.utils.profiling.fetch — block_until_ready does not
+    # reliably sync on the tunneled backend).  The tiny-op RTT is the floor
+    # such walls cannot go under, and is itself reported in extra.
+    from csmom_tpu.utils.profiling import fetch, measure_rtt
+
+    rtt_s = measure_rtt(dtype)
+
     # -- golden event workload (the headline metric) ------------------------
     price, valid, score, adv, vol, n_trades = _golden_inputs(dtype)
     n_bars = int(np.asarray(valid).any(axis=0).sum())
 
-    run = lambda: jax.block_until_ready(
-        event_backtest(price, valid, score, adv, vol).total_pnl
-    )
+    run = lambda: fetch(event_backtest(price, valid, score, adv, vol).total_pnl)
     run()  # compile
     reps = 20
     t0 = time.perf_counter()
@@ -152,8 +158,9 @@ def child_main():
     M = len(ends)
     Js = np.array([3, 6, 9, 12])
     Ks = np.array([3, 6, 9, 12])
-    g = lambda mode, impl="xla": jax.block_until_ready(
-        jk_grid_backtest(pm, mm, Js, Ks, skip=1, mode=mode, impl=impl).mean_spread
+    g = lambda mode, impl="xla": fetch(
+        jk_grid_backtest(pm, mm, Js, Ks, skip=1, mode=mode, impl=impl)
+        .mean_spread.sum()
     )
 
     def timed(mode, impl="xla"):
@@ -186,10 +193,10 @@ def child_main():
             fpm, fmm = month_end_aggregate(fv, fm, fseg, len(fends))
 
             def gf(impl="xla"):
-                jax.block_until_ready(
+                fetch(
                     jk_grid_backtest(
                         fpm, fmm, Js, Ks, skip=1, mode="rank", impl=impl
-                    ).mean_spread
+                    ).mean_spread.sum()
                 )
 
             gf()  # compile
@@ -233,6 +240,9 @@ def child_main():
         "platform": platform,
         "workload": f"golden 20x{n_bars} minute panel, "
                     f"{n_trades} trades ({np.dtype(dtype).name})",
+        "timing": "per-rep device_get of a scalar (block_until_ready does "
+                  "not reliably sync on tunneled backends)",
+        "tiny_op_rtt_s": round(rtt_s, 6),
         "event_backtest_wall_s": round(dt, 6),
         "reference_wall_s": 18.4,
         # on-platform golden gate: native-dtype trade count vs the reference

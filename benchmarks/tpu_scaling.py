@@ -1,0 +1,140 @@
+"""Universe-size scaling of the 16-cell grid on whatever platform is up.
+
+The north-star workload (3,000 stocks x 60 years, 16 J x K cells —
+``BASELINE.json``) measures ~0.09 s on one TPU v5e chip, which is
+dispatch-bound, not bandwidth-bound.  This benchmark quantifies the
+headroom: the same compiled grid at 4x / 16x / 32x the north-star
+universe, for each cohort-aggregation kernel (``impl='xla' | 'matmul' |
+'pallas'``), plus the decile-ranking kernel alone, emitting one JSON line
+per point and a trailing summary line.
+
+Monthly panels are synthesized directly (random-walk prices with
+staggered listing starts) instead of going through the daily pipeline:
+the grid consumes month-end panels ``pm f[A, M]``, and at A = 96k the
+daily intermediate would only add host-side generation time without
+touching the compiled path being measured.
+
+Timing discipline: on the image's tunneled 'axon' TPU backend,
+``jax.block_until_ready`` has been observed to return in ~60 us without a
+device round trip, flat across a 32x spread of problem sizes — so every
+timed rep here fetches an in-jit scalar reduction to host
+(``jax.device_get``), which provably includes execution, and the tiny-op
+RTT baseline is reported alongside.
+
+Run:  ``python benchmarks/tpu_scaling.py``  (honors JAX_PLATFORMS; use
+``JAX_PLATFORMS=cpu`` for the fallback).  Valid TPU results are committed
+as ``SCALING_TPU_r03.json`` once a tunnel window allows a device_get-timed
+run.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# runnable as `python benchmarks/tpu_scaling.py` from anywhere: the package
+# lives at the repo root, one level up from this script
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def monthly_panel(A: int, M: int, seed: int = 7):
+    """Month-end price panel with staggered listings: ``(prices, valid)``."""
+    rng = np.random.default_rng(seed)
+    rets = rng.normal(0.008, 0.06, size=(A, M)).astype(np.float32)
+    prices = 100.0 * np.exp(np.cumsum(rets, axis=1, dtype=np.float64))
+    start = rng.integers(0, M // 3, size=A)
+    valid = np.arange(M)[None, :] >= start[:, None]
+    prices = np.where(valid, prices, np.nan).astype(np.float32)
+    return prices, valid
+
+
+def main():
+    import jax
+
+    from csmom_tpu.backtest.grid import jk_grid_backtest
+    from csmom_tpu.ops.ranking import decile_assign_panel
+    from csmom_tpu.signals.momentum import momentum_dynamic
+
+    import jax.numpy as jnp
+
+    from csmom_tpu.utils.profiling import fetch, measure_rtt
+
+    platform = jax.devices()[0].platform
+    kind = str(jax.devices()[0].device_kind)
+
+    # Timed reps fetch an in-jit scalar to host (profiling.fetch) —
+    # block_until_ready does not reliably sync on the tunneled backend;
+    # the tiny-op RTT is the floor such walls cannot go under.
+    rtt_s = measure_rtt()
+    print(json.dumps({"tiny_op_rtt_s": round(rtt_s, 6)}), flush=True)
+    M = 720  # 60 years of months
+    Js = np.array([3, 6, 9, 12])
+    Ks = np.array([3, 6, 9, 12])
+    sizes = [3_000, 12_000, 48_000, 96_000]
+    impls = ["xla", "matmul", "pallas"] if platform == "tpu" else ["xla", "matmul"]
+    rows = []
+
+    for A in sizes:
+        pm, mm = monthly_panel(A, M)
+        pm_d, mm_d = jax.device_put(pm), jax.device_put(mm)
+
+        # ranking kernel alone: momentum signal -> per-date decile labels.
+        # Reduce to a scalar INSIDE the jit so the per-rep host fetch is 4
+        # bytes — the fetch forces execution without measuring transfer.
+        mom, mom_valid = jax.block_until_ready(
+            jax.jit(lambda p, v: momentum_dynamic(p, v, jnp.asarray(12), skip=1))(
+                pm_d, mm_d
+            )
+        )
+        rank_fn = jax.jit(
+            lambda x, v: decile_assign_panel(x, v, 10, mode="rank")[0].sum()
+        )
+        fetch(rank_fn(mom, mom_valid))
+        reps = 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fetch(rank_fn(mom, mom_valid))
+        rank_s = (time.perf_counter() - t0) / reps
+
+        row = {"A": A, "M": M, "decile_rank_s": round(rank_s, 5)}
+        for impl in impls:
+            g = jax.jit(
+                lambda p, v, impl=impl: jk_grid_backtest(
+                    p, v, Js, Ks, skip=1, mode="rank", impl=impl
+                ).mean_spread.sum()
+            )
+            try:
+                fetch(g(pm_d, mm_d))  # compile
+                reps = 5 if A <= 48_000 else 3
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    fetch(g(pm_d, mm_d))
+                row[f"grid16_{impl}_s"] = round((time.perf_counter() - t0) / reps, 5)
+            except Exception as e:  # record OOM/compile failures, keep going
+                row[f"grid16_{impl}_s"] = f"failed: {type(e).__name__}: {e}"[:160]
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    print(
+        json.dumps(
+            {
+                "metric": "grid16_scaling",
+                "platform": platform,
+                "device_kind": kind,
+                "grid": "16 cells (J,K in {3,6,9,12}), 60yr monthly, mode=rank",
+                "north_star": "A=3000 row",
+                "tiny_op_rtt_s": round(rtt_s, 6),
+                "timing": "per-rep device_get of an in-jit scalar reduction "
+                          "(block_until_ready does not reliably sync on "
+                          "tunneled backends)",
+                "rows": rows,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

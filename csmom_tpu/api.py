@@ -151,21 +151,26 @@ def intraday_pipeline(
 
     The panel-world equivalent of ``intraday_pipeline`` + ``backtest_run``
     (``run_demo.py:81-191``).  ``model`` selects the score model:
-    ``'ridge'`` (the reference's, ``models.py:8-22``) or ``'elastic_net'``
-    / ``'lasso'`` (sparse extensions; ``alpha``/``l1_ratio`` apply).
+    ``'ridge'`` (the reference's, ``models.py:8-22``), ``'elastic_net'``
+    / ``'lasso'`` (sparse extensions; ``alpha``/``l1_ratio`` apply), or
+    ``'mlp'`` (nonlinear extension; ``alpha`` is its weight decay).
     Note the scales differ: ridge's ``alpha`` is the reference's 1.0, but
     the elastic-net objective is per-row and minute returns are ~1e-4, so
     useful l1 penalties live around 1e-9..1e-7 (larger zeroes every
     coefficient and the strategy goes flat).  ``alpha=None`` therefore
     resolves per model — 1.0 for ridge (``run_demo.py:140``), 1e-8 for
-    elastic_net/lasso — so API and CLI callers get the same sane defaults.
-    Returns (EventResult, RidgeFit, compact, dense_score, dense_price,
-    dense_valid).
+    elastic_net/lasso, 1e-4 (weight decay) for mlp — so API and CLI
+    callers get the same sane defaults.
+    Returns (EventResult, fit, compact, dense_score, dense_price,
+    dense_valid) — ``fit`` is the selected model's fit object (RidgeFit
+    for the linear family, MLPFit for ``'mlp'``; all carry
+    ``scores`` / ``cv_mse`` / ``n_train``).
     """
     from csmom_tpu.signals.intraday import compact_minutes, minute_features, next_row_return
     from csmom_tpu.models import (
         as_ridge_fit,
         elastic_net_time_series_cv,
+        mlp_time_series_cv,
         ridge_time_series_cv,
     )
     from csmom_tpu.backtest.event import event_backtest
@@ -180,7 +185,10 @@ def intraday_pipeline(
                 "synthesize a fallback from"
             )
     if alpha is None:
-        alpha = 1.0 if model == "ridge" else 1e-8
+        # per-model scales: ridge's 1.0 is the reference's (run_demo.py:140);
+        # elastic-net penalties are per-row on ~1e-4 labels; for the MLP,
+        # alpha is AdamW weight decay
+        alpha = {"ridge": 1.0, "mlp": 1e-4}.get(model, 1e-8)
     compact = compact_minutes(minute_df)
     price = jnp.asarray(compact.price, dtype)
     volume = jnp.asarray(compact.volume, dtype)
@@ -205,9 +213,13 @@ def intraday_pipeline(
                 "~1e-9..1e-7", model, alpha,
             )
         fit = as_ridge_fit(enet)
+    elif model == "mlp":
+        fit = mlp_time_series_cv(feats, y, y_valid, n_splits=n_splits,
+                                 weight_decay=alpha)
     else:
         raise ValueError(
-            f"unknown model {model!r} (expected 'ridge', 'elastic_net', or 'lasso')"
+            f"unknown model {model!r} (expected 'ridge', 'elastic_net', "
+            f"'lasso', or 'mlp')"
         )
 
     # scatter compacted rows onto the global minute axis; padded/non-model

@@ -335,7 +335,7 @@ def cmd_sweep(args) -> int:
 
 def cmd_intraday(args) -> int:
     """Intraday pipeline + event backtest (``run_demo.py:81-191``): features,
-    linear-model CV (--model ridge|elastic_net|lasso), per-minute fills;
+    score-model CV (--model ridge|elastic_net|lasso|mlp), per-minute fills;
     writes trades.csv + intraday_cum_pnl.png."""
     import numpy as np
 
@@ -352,10 +352,10 @@ def cmd_intraday(args) -> int:
     elif model == "ridge":
         alpha = cfg.intraday.alpha
     else:
-        # l1 penalties live on the per-row objective scale (~1e-4 minute
-        # returns), not the ridge scale — a ridge-sized default would zero
-        # every coefficient (see intraday_pipeline's docstring)
-        alpha = 1e-8
+        # non-ridge scales differ (l1 penalties live on the per-row
+        # objective scale of ~1e-4 minute returns; the MLP's alpha is
+        # weight decay) — let the API resolve its per-model defaults
+        alpha = None
     extra = {}
     if getattr(args, "l1_ratio", None) is not None:
         extra["l1_ratio"] = args.l1_ratio
@@ -608,9 +608,11 @@ def build_parser() -> argparse.ArgumentParser:
                             action="store_true",
                             help="re-download even when a cache file exists")
         if "model" in extra:
-            sp.add_argument("--model", choices=["ridge", "elastic_net", "lasso"],
+            sp.add_argument("--model",
+                            choices=["ridge", "elastic_net", "lasso", "mlp"],
                             help="score model (default: ridge, the reference's)")
-            sp.add_argument("--alpha", type=float, help="regularization strength")
+            sp.add_argument("--alpha", type=float,
+                            help="regularization strength (mlp: weight decay)")
             sp.add_argument("--l1-ratio", dest="l1_ratio", type=float,
                             help="elastic-net l1 ratio (default 0.5)")
         if "strategy" in extra:

@@ -1,5 +1,6 @@
 """Predictive models: linear family (ridge closed-form, elastic-net/lasso
-via FISTA) with expanding-window time-series CV."""
+via FISTA) and a small MLP (full-batch AdamW), all on one shared
+expanding-window time-series-CV harness."""
 
 from csmom_tpu.models.ridge import ridge_time_series_cv, RidgeFit
 from csmom_tpu.models.elastic_net import (
@@ -7,6 +8,7 @@ from csmom_tpu.models.elastic_net import (
     as_ridge_fit,
     elastic_net_time_series_cv,
 )
+from csmom_tpu.models.mlp import MLPFit, mlp_time_series_cv
 
 __all__ = [
     "ridge_time_series_cv",
@@ -14,4 +16,6 @@ __all__ = [
     "elastic_net_time_series_cv",
     "ElasticNetFit",
     "as_ridge_fit",
+    "MLPFit",
+    "mlp_time_series_cv",
 ]

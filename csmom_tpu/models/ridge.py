@@ -68,22 +68,28 @@ def time_series_cv_harness(
     train_frac: float,
     train_frac_small: float,
     small_threshold: int,
+    predict=None,
 ):
     """Shared prepare -> scale -> expanding-CV -> final-fit -> score harness.
 
     The one implementation of the reference pipeline's modeling scaffold
-    (``run_demo.py:139-147`` + ``models.py:8-22``) used by every linear
+    (``run_demo.py:139-147`` + ``models.py:8-22``) used by every score
     model: flatten to the global (ticker, datetime) row order, train on the
     leading ``train_frac`` of valid rows, fit the scaler on that training
     block, run ``TimeSeriesSplit``-layout expanding folds, refit on the
     full training block, score the entire history.
 
     ``solver(Xs, yf, w)`` fits one model on rows weighted by w (0/1) and
-    returns ``(coef f[F], intercept f[])``; it is called per fold and for
-    the final fit, so any model that can fit a weighted row set plugs in.
+    returns its parameters — any pytree; it is called per fold and for the
+    final fit, so any model that can fit a weighted row set plugs in.
+    ``predict(params, Xs)`` maps those parameters to per-row predictions;
+    the default treats ``params`` as ``(coef f[F], intercept f[])``, the
+    linear-model case.
 
-    Returns ``(coef, intercept, mean, std, cv_mse, scores, n_train)``.
+    Returns ``(params, mean, std, cv_mse, scores, n_train)``.
     """
+    if predict is None:
+        predict = lambda params, Xs: Xs @ params[0] + params[1]
     A, R, F = features.shape
     Xf = jnp.nan_to_num(features.reshape(A * R, F))
     yf = jnp.nan_to_num(y.reshape(A * R))
@@ -116,18 +122,18 @@ def time_series_cv_harness(
         test_start = n_train - (n_splits - i) * test_size
         tr = train & (ordinal < test_start)
         te = train & (ordinal >= test_start) & (ordinal < test_start + test_size)
-        coef, icept = solver(Xs, yf, tr.astype(Xf.dtype))
-        pred = Xs @ coef + icept
+        params = solver(Xs, yf, tr.astype(Xf.dtype))
+        pred = predict(params, Xs)
         wte = te.astype(Xf.dtype)
         mse = jnp.sum(wte * (pred - yf) ** 2) / jnp.maximum(jnp.sum(wte), 1.0)
         return mse
 
     cv_mse = jnp.stack([fold(i) for i in range(n_splits)])
 
-    coef, icept = solver(Xs, yf, w_tr)
-    scores = (Xs @ coef + icept).reshape(A, R)
+    params = solver(Xs, yf, w_tr)
+    scores = predict(params, Xs).reshape(A, R)
     scores = jnp.where(valid, scores, jnp.nan)
-    return coef, icept, mean, std, cv_mse, scores, n_train
+    return params, mean, std, cv_mse, scores, n_train
 
 
 @partial(jax.jit, static_argnames=("n_splits", "train_frac_small"))
@@ -156,7 +162,7 @@ def ridge_time_series_cv(
     Returns RidgeFit; ``scores`` covers every valid row (the by-design
     "score the training span too" behaviour of the demo).
     """
-    coef, icept, mean, std, cv_mse, scores, n_train = time_series_cv_harness(
+    (coef, icept), mean, std, cv_mse, scores, n_train = time_series_cv_harness(
         features, y, valid,
         solver=lambda Xs, yf, w: _masked_ridge(Xs, yf, w, alpha),
         n_splits=n_splits, train_frac=train_frac,

@@ -1,7 +1,8 @@
 """Cross-cutting utilities: logging, profiling, numerical guards."""
 
 from csmom_tpu.utils.logging import get_logger
-from csmom_tpu.utils.profiling import wall, trace
+from csmom_tpu.utils.profiling import fetch, measure_rtt, wall, trace
 from csmom_tpu.utils.guards import validate_panel, checked
 
-__all__ = ["get_logger", "wall", "trace", "validate_panel", "checked"]
+__all__ = ["get_logger", "fetch", "measure_rtt", "wall", "trace",
+           "validate_panel", "checked"]

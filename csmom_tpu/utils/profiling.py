@@ -4,8 +4,15 @@ Two layers, both zero-cost when unused:
 
 - ``wall(fn, *args)`` — wall-clock a compiled call correctly: JAX dispatch
   is async, so a naive ``time.time()`` pair measures only the enqueue;
-  every timing here closes over ``block_until_ready``.  This is the timing
-  discipline behind every number in BASELINE.md / bench.py.
+  every timing here closes over ``block_until_ready``.
+- ``fetch(y)`` / ``measure_rtt()`` — the stricter discipline for
+  remote/tunneled backends (this image's 'axon' TPU), where
+  ``block_until_ready`` has been observed to return in ~60 us without a
+  device round trip, flat across a 32x spread of problem sizes: a timed
+  rep must ``device_get`` a (small) result to host to provably include
+  execution, and the tiny-op RTT is the floor such walls cannot go under.
+  This is the timing discipline behind every number in bench.py and
+  benchmarks/.
 - ``trace(label, out_dir=...)`` — a context manager that wraps
   ``jax.profiler.trace`` (Perfetto/XPlane dump viewable in Perfetto or
   TensorBoard) when given a directory, and always logs the wall time of the
@@ -34,6 +41,32 @@ def wall(fn, *args, warmup: int = 0, **kwargs):
     out = fn(*args, **kwargs)
     jax.block_until_ready(out)
     return out, time.perf_counter() - t0
+
+
+def fetch(y):
+    """Materialize ``y`` on the host and return it as a numpy array.
+
+    Use inside timed loops instead of ``block_until_ready``: the host
+    transfer forces real execution even on tunneled backends whose ready
+    signal is unreliable.  Reduce to a scalar inside the jit first so the
+    transfer itself is negligible."""
+    import numpy as np
+
+    return np.asarray(jax.device_get(y))
+
+
+def measure_rtt(dtype=None, reps: int = 10) -> float:
+    """Per-call floor of ``fetch``-timed walls: dispatch + device round
+    trip for a trivial op, in seconds (mean over ``reps``)."""
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda a: a + 1.0)
+    s = jax.device_put(jnp.asarray(0, dtype) if dtype else jnp.float32(0))
+    fetch(tiny(s))  # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fetch(tiny(s))
+    return (time.perf_counter() - t0) / reps
 
 
 @contextlib.contextmanager

@@ -7,7 +7,7 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
-from csmom_tpu.utils import wall, trace, validate_panel, checked
+from csmom_tpu.utils import fetch, measure_rtt, wall, trace, validate_panel, checked
 
 
 def test_wall_blocks_and_times():
@@ -95,3 +95,15 @@ def test_checked_catches_nan():
     err2, out2 = g(jnp.float32(1.0), jnp.float32(2.0))
     err2.throw()  # no error
     assert float(out2) == 0.5
+
+
+def test_fetch_materializes_and_rtt_positive():
+    """fetch returns host numpy (real values, not a future); measure_rtt is a
+    plausible per-call floor."""
+    import jax
+
+    y = fetch(jax.jit(lambda a: a * 2.0)(jnp.asarray([1.0, 2.0])))
+    assert isinstance(y, np.ndarray)
+    np.testing.assert_array_equal(y, [2.0, 4.0])
+    rtt = measure_rtt(reps=3)
+    assert 0.0 < rtt < 5.0

@@ -1,0 +1,96 @@
+"""MLP score model: linear anchor vs ridge, nonlinear lift, determinism,
+padding invariance."""
+
+import numpy as np
+
+from csmom_tpu.models import mlp_time_series_cv, ridge_time_series_cv
+
+from tests.test_ridge import _padded
+
+
+def test_linear_anchor_matches_ridge(rng):
+    """``hidden=()`` is a linear model trained by gradient descent — on a
+    well-conditioned linear problem it must land near the closed-form ridge
+    solution (same harness, so identical folds/scaler by construction)."""
+    A, R, F = 2, 400, 5
+    valid = np.ones((A, R), bool)
+    X = rng.normal(size=(A, R, F))
+    w_true = np.array([0.8, -0.5, 0.3, 0.0, 0.2])
+    y = X @ w_true + 0.01 * rng.normal(size=(A, R))
+
+    mlp = mlp_time_series_cv(
+        X, y, valid, hidden=(), n_steps=3000, learning_rate=3e-2,
+        weight_decay=0.0,
+    )
+    ridge = ridge_time_series_cv(X, y, valid, alpha=1e-8)
+
+    assert int(mlp.n_train) == int(ridge.n_train)
+    np.testing.assert_allclose(
+        np.asarray(mlp.scale_mean), np.asarray(ridge.scale_mean), rtol=1e-12
+    )
+    # gradient-descent convergence tolerance, not solver equality
+    v = valid.reshape(-1)
+    np.testing.assert_allclose(
+        np.asarray(mlp.scores).reshape(-1)[v],
+        np.asarray(ridge.scores).reshape(-1)[v],
+        atol=5e-3,
+    )
+
+
+def test_nonlinear_lift_over_ridge(rng):
+    """On a target no linear model can express, the MLP's held-out fold MSE
+    must beat ridge's."""
+    A, R, F = 2, 600, 5
+    valid = np.ones((A, R), bool)
+    X = rng.normal(size=(A, R, F))
+    y = np.sin(2.0 * X[..., 0]) * X[..., 1] + 0.05 * rng.normal(size=(A, R))
+
+    mlp = mlp_time_series_cv(
+        X, y, valid, hidden=(32, 16), n_steps=1500, learning_rate=1e-2
+    )
+    ridge = ridge_time_series_cv(X, y, valid, alpha=1.0)
+
+    assert float(mlp.cv_mse[-1]) < float(ridge.cv_mse[-1])
+    assert float(mlp.train_mse) < float(ridge.cv_mse[-1])
+
+
+def test_deterministic_given_seed(rng):
+    X, y, valid, _, _ = _padded(rng)
+    a = mlp_time_series_cv(X, y, valid, n_steps=50, seed=7)
+    b = mlp_time_series_cv(X, y, valid, n_steps=50, seed=7)
+    np.testing.assert_array_equal(np.asarray(a.scores), np.asarray(b.scores))
+    c = mlp_time_series_cv(X, y, valid, n_steps=50, seed=8)
+    assert not np.array_equal(
+        np.asarray(c.scores)[np.asarray(valid)],
+        np.asarray(a.scores)[np.asarray(valid)],
+    )
+
+
+def test_padding_layout_invariance(rng):
+    """The fit depends on the ordered set of valid rows, not where padding
+    sits: appending extra all-invalid rows must not change anything."""
+    X, y, valid, _, _ = _padded(rng)
+    A, R, F = X.shape
+    Xp = np.concatenate([X, np.full((A, 37, F), np.nan)], axis=1)
+    yp = np.concatenate([y, np.full((A, 37), np.nan)], axis=1)
+    vp = np.concatenate([valid, np.zeros((A, 37), bool)], axis=1)
+
+    a = mlp_time_series_cv(X, y, valid, n_steps=100)
+    b = mlp_time_series_cv(Xp, yp, vp, n_steps=100)
+    np.testing.assert_allclose(np.asarray(a.cv_mse), np.asarray(b.cv_mse),
+                               rtol=1e-9)
+    np.testing.assert_allclose(
+        np.asarray(a.scores)[np.asarray(valid)],
+        np.asarray(b.scores)[np.asarray(vp)],
+        rtol=1e-9,
+    )
+
+
+def test_scores_masked_and_shaped(rng):
+    X, y, valid, _, _ = _padded(rng)
+    fit = mlp_time_series_cv(X, y, valid, n_steps=50)
+    s = np.asarray(fit.scores)
+    assert s.shape == y.shape
+    assert np.isnan(s[~np.asarray(valid)]).all()
+    assert np.isfinite(s[np.asarray(valid)]).all()
+    assert np.asarray(fit.cv_mse).shape == (3,)
