@@ -13,6 +13,13 @@ from csmom_tpu.analytics.bootstrap import (
     circular_block_indices,
     BootstrapResult,
 )
+from csmom_tpu.analytics.tearsheet import (
+    Tearsheet,
+    annual_returns,
+    format_tearsheet,
+    max_drawdown,
+    tearsheet,
+)
 
 __all__ = [
     "sharpe",
@@ -24,4 +31,9 @@ __all__ = [
     "block_bootstrap_grid",
     "circular_block_indices",
     "BootstrapResult",
+    "Tearsheet",
+    "annual_returns",
+    "format_tearsheet",
+    "max_drawdown",
+    "tearsheet",
 ]

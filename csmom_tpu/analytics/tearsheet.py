@@ -1,0 +1,206 @@
+"""Portfolio tearsheet: the risk/return summary the reference stops short of.
+
+The reference's analytics layer is a single annualized Sharpe plus a
+cumulative-return plot (``/root/reference/src/utils.py:8-21``,
+``run_demo.py:72-79``); a user taking its strategies seriously immediately
+needs the rest of the standard tearsheet — drawdown, Calmar, Sortino, hit
+rate, tail risk, higher moments, per-year returns.  This module provides
+them in the framework's house style: every statistic is a mask-aware
+reduction over the LAST axis, so the same code summarizes one spread
+series ``f[T]``, a J x K grid ``f[nJ, nK, T]``, or a bootstrap batch
+``f[B, T]`` in one fused jit call with no Python branching on shape.
+
+Masked periods are simply absent: compounding treats them as flat
+(log-growth 0), counts use the valid-lane total, and order statistics
+sort masked lanes to +inf and index by valid count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from csmom_tpu.analytics.stats import (
+    cumulative_growth,
+    masked_mean,
+    masked_std,
+    sharpe,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Tearsheet:
+    """All fields reduce the time axis; leading axes broadcast through."""
+
+    ann_return: jnp.ndarray      # geometric, (prod(1+r))**(f/n) - 1
+    ann_vol: jnp.ndarray         # std(ddof=1) * sqrt(f)
+    ann_sharpe: jnp.ndarray      # reference semantics (utils.py:8-16)
+    sortino: jnp.ndarray         # mean*f / (downside std * sqrt(f))
+    max_drawdown: jnp.ndarray    # positive fraction (0.25 = -25% peak-to-trough)
+    calmar: jnp.ndarray          # ann_return / max_drawdown
+    hit_rate: jnp.ndarray        # P(r > 0) over valid periods
+    skewness: jnp.ndarray        # biased (moment) estimator
+    excess_kurtosis: jnp.ndarray # biased, Fisher (normal -> 0)
+    var_95: jnp.ndarray          # 5th-percentile period return (a loss, < 0)
+    cvar_95: jnp.ndarray         # mean return at or below var_95
+    best: jnp.ndarray            # best single-period return
+    worst: jnp.ndarray           # worst single-period return
+    n_periods: jnp.ndarray       # i32 valid count
+
+
+def max_drawdown(returns, valid):
+    """Largest peak-to-trough loss of the compounded curve, as a positive
+    fraction; masked periods compound as flat.  NaN when nothing is valid."""
+    growth = cumulative_growth(returns, valid)
+    peak = jax.lax.associative_scan(jnp.maximum, growth, axis=-1)
+    dd = 1.0 - growth / peak
+    mdd = jnp.max(jnp.where(valid, dd, 0.0), axis=-1)
+    return jnp.where(jnp.any(valid, axis=-1), mdd, jnp.nan)
+
+
+def _moment_stats(returns, valid):
+    """Biased skewness and excess kurtosis (scipy.stats.skew/kurtosis with
+    bias=True), masked."""
+    n = jnp.sum(valid, axis=-1)
+    mean = masked_mean(returns, valid)
+    dev = jnp.where(valid, jnp.nan_to_num(returns) - mean[..., None], 0.0)
+    nf = jnp.maximum(n, 1).astype(returns.dtype)
+    m2 = jnp.sum(dev**2, axis=-1) / nf
+    m3 = jnp.sum(dev**3, axis=-1) / nf
+    m4 = jnp.sum(dev**4, axis=-1) / nf
+    ok = (n > 2) & (m2 > 0)
+    skew = jnp.where(ok, m3 / jnp.where(m2 > 0, m2, 1.0) ** 1.5, jnp.nan)
+    kurt = jnp.where(ok, m4 / jnp.where(m2 > 0, m2, 1.0) ** 2 - 3.0, jnp.nan)
+    return skew, kurt
+
+
+def _tail_stats(returns, valid, q: float):
+    """Historical VaR (the ceil(q*n)-th worst return) and CVaR (mean of
+    returns at or below it).  Lower-tail convention: both are returns, so a
+    5% VaR of -0.02 reads 'the worst 5% of periods lose at least 2%'."""
+    big = jnp.asarray(jnp.finfo(returns.dtype).max, returns.dtype)
+    x = jnp.where(valid, jnp.nan_to_num(returns), big)
+    xs = jnp.sort(x, axis=-1)
+    n = jnp.sum(valid, axis=-1)
+    k = jnp.maximum(jnp.ceil(q * n).astype(jnp.int32), 1)  # tail count
+    idx = jnp.minimum(k - 1, x.shape[-1] - 1)
+    var = jnp.take_along_axis(xs, idx[..., None], axis=-1)[..., 0]
+    in_tail = jnp.arange(x.shape[-1]) < k[..., None]
+    cvar = jnp.sum(jnp.where(in_tail, xs, 0.0), axis=-1) / k.astype(returns.dtype)
+    ok = n > 0
+    return jnp.where(ok, var, jnp.nan), jnp.where(ok, cvar, jnp.nan)
+
+
+@partial(jax.jit, static_argnames=("freq_per_year",))
+def tearsheet(returns, valid, freq_per_year: int = 12) -> Tearsheet:
+    """Full tearsheet of a masked return series (last axis = time)."""
+    dt = returns.dtype
+    n = jnp.sum(valid, axis=-1)
+    nf = jnp.maximum(n, 1).astype(dt)
+    f = jnp.asarray(freq_per_year, dt)
+
+    log_total = jnp.sum(jnp.where(valid, jnp.log1p(returns), 0.0), axis=-1)
+    ann_ret = jnp.where(n > 0, jnp.expm1(log_total * f / nf), jnp.nan)
+    sd = masked_std(returns, valid, ddof=1)
+    ann_vol = sd * jnp.sqrt(f)
+
+    mean = masked_mean(returns, valid)
+    down = jnp.where(valid & (returns < 0), jnp.nan_to_num(returns), 0.0)
+    dstd = jnp.sqrt(jnp.sum(down**2, axis=-1) / nf)
+    sortino = jnp.where(dstd > 0, mean * f / (dstd * jnp.sqrt(f)), jnp.nan)
+
+    mdd = max_drawdown(returns, valid)
+    calmar = jnp.where(mdd > 0, ann_ret / mdd, jnp.nan)
+    hit = jnp.where(
+        n > 0, jnp.sum(valid & (returns > 0), axis=-1) / nf, jnp.nan
+    )
+    skew, kurt = _moment_stats(returns, valid)
+    var95, cvar95 = _tail_stats(returns, valid, 0.05)
+    neg_big = jnp.asarray(jnp.finfo(dt).min, dt)
+    big = jnp.asarray(jnp.finfo(dt).max, dt)
+    best = jnp.where(
+        n > 0, jnp.max(jnp.where(valid, jnp.nan_to_num(returns), neg_big), axis=-1),
+        jnp.nan,
+    )
+    worst = jnp.where(
+        n > 0, jnp.min(jnp.where(valid, jnp.nan_to_num(returns), big), axis=-1),
+        jnp.nan,
+    )
+
+    return Tearsheet(
+        ann_return=ann_ret,
+        ann_vol=ann_vol,
+        ann_sharpe=sharpe(returns, valid, freq_per_year=freq_per_year),
+        sortino=sortino,
+        max_drawdown=mdd,
+        calmar=calmar,
+        hit_rate=hit,
+        skewness=skew,
+        excess_kurtosis=kurt,
+        var_95=var95,
+        cvar_95=cvar95,
+        best=best,
+        worst=worst,
+        n_periods=n.astype(jnp.int32),
+    )
+
+
+def annual_returns(returns, valid, years):
+    """Compound per-calendar-year returns.
+
+    Args:
+      returns: f[..., T] period returns.
+      valid: bool[..., T].
+      years: i32[T] calendar-year label per period (need not be contiguous).
+
+    Returns ``(uniq_years i32[Y], ann f[..., Y], any_valid bool[..., Y])``
+    with Y = number of distinct labels, sorted ascending; years with no
+    valid periods report NaN.  Uses a one-hot matmul over the (small) year
+    axis, so it fuses like everything else.
+    """
+    years = jnp.asarray(years)
+    uniq = jnp.unique(years)  # host-side: year labels are concrete
+    onehot = (years[None, :] == uniq[:, None]).astype(returns.dtype)  # [Y, T]
+    lr = jnp.where(valid, jnp.log1p(returns), 0.0)
+    ann = jnp.expm1(jnp.einsum("...t,yt->...y", lr, onehot))
+    any_valid = jnp.einsum(
+        "...t,yt->...y", valid.astype(returns.dtype), onehot
+    ) > 0
+    return uniq, jnp.where(any_valid, ann, jnp.nan), any_valid
+
+
+def format_tearsheet(ts: Tearsheet, label: str = "portfolio") -> str:
+    """Plain-text rendering of a scalar tearsheet (CLI surface)."""
+    import numpy as np
+
+    def pct(v):
+        v = float(np.asarray(v))
+        return "n/a" if not np.isfinite(v) else f"{v * 100:+.2f}%"
+
+    def num(v):
+        v = float(np.asarray(v))
+        return "n/a" if not np.isfinite(v) else f"{v:.2f}"
+
+    rows = [
+        ("Ann. return", pct(ts.ann_return)),
+        ("Ann. vol", pct(ts.ann_vol)),
+        ("Sharpe", num(ts.ann_sharpe)),
+        ("Sortino", num(ts.sortino)),
+        ("Max drawdown", pct(-np.asarray(ts.max_drawdown))),
+        ("Calmar", num(ts.calmar)),
+        ("Hit rate", pct(ts.hit_rate)),
+        ("Skew", num(ts.skewness)),
+        ("Excess kurtosis", num(ts.excess_kurtosis)),
+        ("VaR 95 (period)", pct(ts.var_95)),
+        ("CVaR 95 (period)", pct(ts.cvar_95)),
+        ("Best period", pct(ts.best)),
+        ("Worst period", pct(ts.worst)),
+        ("Periods", str(int(np.asarray(ts.n_periods)))),
+    ]
+    w = max(len(k) for k, _ in rows)
+    head = f"-- tearsheet: {label} --"
+    return "\n".join([head] + [f"{k:<{w}}  {v}" for k, v in rows])

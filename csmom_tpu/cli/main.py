@@ -131,6 +131,28 @@ def cmd_replicate(args) -> int:
         print(decile_table(rep.decile_means, rep.decile_counts,
                            rep.spread).round(4).to_string())
 
+    if getattr(args, "tearsheet", False):
+        import numpy as np
+        import pandas as pd
+
+        from csmom_tpu.analytics import annual_returns, format_tearsheet, tearsheet
+
+        spread = np.asarray(rep.spread)
+        valid = np.isfinite(spread)
+        print()
+        print(format_tearsheet(
+            tearsheet(np.nan_to_num(spread), valid, freq_per_year=12),
+            label=f"monthly spread ({cfg.backend})",
+        ))
+        years = pd.DatetimeIndex(rep.times).year.values.astype(np.int32)
+        uniq, ann, any_valid = annual_returns(
+            np.nan_to_num(spread), valid, years
+        )
+        live = np.asarray(any_valid)
+        print("\nPer-year compounded spread:")
+        for yy, aa in zip(np.asarray(uniq)[live], np.asarray(ann)[live]):
+            print(f"  {int(yy)}  {aa * 100:+.2f}%")
+
     if getattr(args, "bootstrap", None):
         import jax
         import numpy as np
@@ -580,6 +602,9 @@ def build_parser() -> argparse.ArgumentParser:
         if "tables" in extra:
             sp.add_argument("--tables", action="store_true",
                             help="print the paper-style per-decile table")
+            sp.add_argument("--tearsheet", action="store_true",
+                            help="print the full risk tearsheet (drawdown, "
+                                 "Calmar, Sortino, tails, per-year returns)")
         if "doublesort" in extra:
             _add_turnover_flags(sp)
         if "horizons" in extra:

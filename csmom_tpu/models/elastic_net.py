@@ -105,7 +105,7 @@ def elastic_net_time_series_cv(
     """
     from csmom_tpu.models.ridge import time_series_cv_harness
 
-    (coef, icept), mean, std, cv_mse, scores, n_train = time_series_cv_harness(
+    (coef, icept), mean, std, cv_mse, scores, n_train, _ = time_series_cv_harness(
         features, y, valid,
         solver=lambda Xs, yf, w: _masked_enet(Xs, yf, w, alpha, l1_ratio, n_iter),
         n_splits=n_splits, train_frac=train_frac,

@@ -141,7 +141,7 @@ def mlp_time_series_cv(
     solver = lambda Xs, yf, w: _fit_mlp(
         Xs, yf, w, key, hidden, n_steps, learning_rate, weight_decay
     )
-    params, mean, std, cv_mse, scores, n_train = time_series_cv_harness(
+    params, mean, std, cv_mse, scores, n_train, w_tr = time_series_cv_harness(
         features, y, valid,
         solver=solver,
         n_splits=n_splits, train_frac=train_frac,
@@ -150,14 +150,12 @@ def mlp_time_series_cv(
     )
 
     # final-model training error, for the fit-quality diagnostic the linear
-    # models get from their closed forms — derived from the scores the
-    # harness already computed (they cover every valid row, training span
-    # included), so it cannot drift from the model that produced them
+    # models get from their closed forms — derived from the scores and the
+    # train mask the harness itself produced, so it cannot drift from the
+    # model or the fold layout
     A, R = y.shape
     sf = jnp.nan_to_num(scores.reshape(A * R))
     yf = jnp.nan_to_num(y.reshape(A * R))
-    vf = valid.reshape(A * R)
-    w_tr = (vf & (jnp.cumsum(vf) - 1 < n_train)).astype(sf.dtype)
     train_mse = jnp.sum(w_tr * (sf - yf) ** 2) / jnp.maximum(jnp.sum(w_tr), 1.0)
 
     return MLPFit(

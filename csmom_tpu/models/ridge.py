@@ -86,7 +86,10 @@ def time_series_cv_harness(
     the default treats ``params`` as ``(coef f[F], intercept f[])``, the
     linear-model case.
 
-    Returns ``(params, mean, std, cv_mse, scores, n_train)``.
+    Returns ``(params, mean, std, cv_mse, scores, n_train, train_w)``;
+    ``train_w f[A*R]`` is the final fit's 0/1 row weights, so callers that
+    need training-block diagnostics use the harness's own mask rather than
+    re-deriving the ordinal arithmetic.
     """
     if predict is None:
         predict = lambda params, Xs: Xs @ params[0] + params[1]
@@ -133,7 +136,7 @@ def time_series_cv_harness(
     params = solver(Xs, yf, w_tr)
     scores = predict(params, Xs).reshape(A, R)
     scores = jnp.where(valid, scores, jnp.nan)
-    return params, mean, std, cv_mse, scores, n_train
+    return params, mean, std, cv_mse, scores, n_train, w_tr
 
 
 @partial(jax.jit, static_argnames=("n_splits", "train_frac_small"))
@@ -162,7 +165,7 @@ def ridge_time_series_cv(
     Returns RidgeFit; ``scores`` covers every valid row (the by-design
     "score the training span too" behaviour of the demo).
     """
-    (coef, icept), mean, std, cv_mse, scores, n_train = time_series_cv_harness(
+    (coef, icept), mean, std, cv_mse, scores, n_train, _ = time_series_cv_harness(
         features, y, valid,
         solver=lambda Xs, yf, w: _masked_ridge(Xs, yf, w, alpha),
         n_splits=n_splits, train_frac=train_frac,

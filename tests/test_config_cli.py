@@ -150,3 +150,18 @@ def test_cli_replicate_flag_overrides(tmp_path, capsys):
     ])
     out12 = capsys.readouterr().out
     assert out6 != out12
+
+
+@requires_reference
+def test_cli_replicate_tearsheet(tmp_path, capsys):
+    rc = main([
+        "replicate", "--data-dir", REFERENCE_DATA, "--out", str(tmp_path),
+        "--backend", "pandas", "--tearsheet",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Max drawdown" in out
+    assert "Per-year compounded spread" in out
+    # every year of the reference's post-warmup span (2019-2024) appears
+    for yy in range(2019, 2025):
+        assert str(yy) in out

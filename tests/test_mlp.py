@@ -2,6 +2,9 @@
 padding invariance."""
 
 import numpy as np
+import pytest
+
+pytest.importorskip("optax")
 
 from csmom_tpu.models import mlp_time_series_cv, ridge_time_series_cv
 

@@ -1,0 +1,126 @@
+"""Tearsheet statistics vs independent numpy/scipy/pandas oracles."""
+
+import numpy as np
+import pytest
+
+from csmom_tpu.analytics import (
+    annual_returns,
+    format_tearsheet,
+    max_drawdown,
+    tearsheet,
+)
+
+
+def _series(rng, T=240, hole_frac=0.1):
+    r = rng.normal(0.005, 0.04, size=T)
+    valid = rng.random(T) > hole_frac
+    r[~valid] = np.nan
+    return r, valid
+
+
+def _mdd_loop(r, valid):
+    """Straight-line oracle: compound, track peak, max loss from peak."""
+    growth, peak, mdd = 1.0, 1.0, 0.0
+    for x, v in zip(r, valid):
+        if v:
+            growth *= 1.0 + x
+            peak = max(peak, growth)
+            mdd = max(mdd, 1.0 - growth / peak)
+    return mdd
+
+
+def test_max_drawdown_vs_loop(rng):
+    r, valid = _series(rng)
+    got = float(max_drawdown(r, valid))
+    assert got == pytest.approx(_mdd_loop(r, valid), rel=1e-12)
+
+
+def test_moments_vs_scipy(rng):
+    from scipy import stats as sps
+
+    r, valid = _series(rng)
+    ts = tearsheet(r, valid)
+    rv = r[valid]
+    assert float(ts.skewness) == pytest.approx(sps.skew(rv), rel=1e-10)
+    assert float(ts.excess_kurtosis) == pytest.approx(
+        sps.kurtosis(rv), rel=1e-10
+    )
+    assert float(ts.hit_rate) == pytest.approx((rv > 0).mean(), rel=1e-12)
+    assert float(ts.best) == pytest.approx(rv.max(), rel=1e-12)
+    assert float(ts.worst) == pytest.approx(rv.min(), rel=1e-12)
+    assert int(ts.n_periods) == valid.sum()
+
+
+def test_annualization_identities(rng):
+    r, valid = _series(rng)
+    ts = tearsheet(r, valid, freq_per_year=12)
+    rv = r[valid]
+    n = len(rv)
+    want_ann = np.prod(1 + rv) ** (12.0 / n) - 1
+    assert float(ts.ann_return) == pytest.approx(want_ann, rel=1e-10)
+    assert float(ts.ann_vol) == pytest.approx(rv.std(ddof=1) * np.sqrt(12), rel=1e-10)
+    if ts.max_drawdown > 0:
+        assert float(ts.calmar) == pytest.approx(
+            float(ts.ann_return) / float(ts.max_drawdown), rel=1e-10
+        )
+
+
+def test_tail_stats_vs_sorted_tail(rng):
+    r, valid = _series(rng, T=400)
+    ts = tearsheet(r, valid)
+    rv = np.sort(r[valid])
+    k = max(int(np.ceil(0.05 * len(rv))), 1)
+    assert float(ts.var_95) == pytest.approx(rv[k - 1], rel=1e-12)
+    assert float(ts.cvar_95) == pytest.approx(rv[:k].mean(), rel=1e-12)
+    assert float(ts.cvar_95) <= float(ts.var_95)
+
+
+def test_batched_matches_per_series(rng):
+    """[G, T] reduces exactly as G independent [T] calls (the grid use)."""
+    G, T = 5, 180
+    r = rng.normal(0.003, 0.05, size=(G, T))
+    valid = rng.random((G, T)) > 0.15
+    batch = tearsheet(r, valid)
+    for g in range(G):
+        one = tearsheet(r[g], valid[g])
+        for f in ("ann_return", "max_drawdown", "cvar_95", "skewness"):
+            a, b = np.asarray(getattr(batch, f))[g], np.asarray(getattr(one, f))
+            np.testing.assert_allclose(a, b, rtol=1e-12)
+
+
+def test_degenerate_inputs():
+    T = 24
+    empty = tearsheet(np.zeros(T), np.zeros(T, bool))
+    assert np.isnan(float(empty.ann_return))
+    assert np.isnan(float(empty.max_drawdown))
+    assert int(empty.n_periods) == 0
+
+    allpos = tearsheet(np.full(T, 0.01), np.ones(T, bool))
+    assert float(allpos.max_drawdown) == 0.0
+    assert np.isnan(float(allpos.calmar))  # no drawdown -> undefined
+    assert float(allpos.hit_rate) == 1.0
+    assert np.isnan(float(allpos.sortino))  # no down periods
+
+    txt = format_tearsheet(allpos, "x")
+    assert "Max drawdown" in txt and "n/a" in txt
+
+
+def test_annual_returns_vs_pandas(rng):
+    import pandas as pd
+
+    T = 60
+    dates = pd.date_range("2018-01-31", periods=T, freq="ME")
+    r, valid = _series(rng, T=T)
+    years = dates.year.values.astype(np.int32)
+
+    uniq, ann, any_valid = annual_returns(r, valid, years)
+    s = pd.Series(np.where(valid, r, 0.0), index=dates)
+    want = (1 + s).groupby(s.index.year).prod() - 1
+    np.testing.assert_array_equal(np.asarray(uniq), want.index.values)
+    has = pd.Series(valid, index=dates).groupby(dates.year).any()
+    np.testing.assert_allclose(
+        np.asarray(ann)[np.asarray(any_valid)],
+        want.values[has.values],
+        rtol=1e-10,
+    )
+    assert np.isnan(np.asarray(ann)[~np.asarray(any_valid)]).all()
