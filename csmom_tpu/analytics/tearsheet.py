@@ -86,7 +86,10 @@ def _tail_stats(returns, valid, q: float):
     x = jnp.where(valid, jnp.nan_to_num(returns), big)
     xs = jnp.sort(x, axis=-1)
     n = jnp.sum(valid, axis=-1)
-    k = jnp.maximum(jnp.ceil(q * n).astype(jnp.int32), 1)  # tail count
+    # snap q*n before the ceil: float representation error (0.05*240 =
+    # 12.000000000000002 in f64, exactly 12.0 in f32) would otherwise make
+    # the tail count dtype-dependent exactly when q*n is an integer
+    k = jnp.maximum(jnp.ceil(q * n - 1e-6).astype(jnp.int32), 1)
     idx = jnp.minimum(k - 1, x.shape[-1] - 1)
     var = jnp.take_along_axis(xs, idx[..., None], axis=-1)[..., 0]
     in_tail = jnp.arange(x.shape[-1]) < k[..., None]

@@ -11,6 +11,7 @@ from csmom_tpu.strategy.base import (
 )
 from csmom_tpu.strategy.builtin import (
     Momentum,
+    ResidualMomentum,
     Reversal,
     VolumeZMomentum,
     ZScoreCombo,
@@ -25,6 +26,7 @@ __all__ = [
     "register_strategy",
     "xs_zscore",
     "Momentum",
+    "ResidualMomentum",
     "Reversal",
     "VolumeZMomentum",
     "ZScoreCombo",

@@ -14,11 +14,13 @@ import dataclasses
 import jax.numpy as jnp
 
 from csmom_tpu.signals.momentum import momentum
+from csmom_tpu.signals.residual import residual_momentum
 from csmom_tpu.strategy.base import Strategy, register_strategy, xs_zscore
 
 __all__ = [
     "Momentum",
     "Reversal",
+    "ResidualMomentum",
     "VolumeZMomentum",
     "ZScoreCombo",
 ]
@@ -49,6 +51,29 @@ class Reversal(Strategy):
     def signal(self, prices, mask, **panels):
         mom, valid = momentum(prices, mask, lookback=self.lookback, skip=self.skip)
         return jnp.where(valid, -mom, jnp.nan), valid
+
+
+@register_strategy("residual_momentum")
+@dataclasses.dataclass(frozen=True)
+class ResidualMomentum(Strategy):
+    """Blitz–Huij–Martens (2011) idiosyncratic momentum: rank on the
+    volatility-scaled mean of trailing market-model residuals instead of
+    raw returns (see :mod:`csmom_tpu.signals.residual` for the closed-form
+    rolling-OLS kernel).  Hedges the market-beta loading that raw momentum
+    carries; the first valid score lands at month ``est_window + skip + 1``.
+    """
+
+    lookback: int = 12
+    skip: int = 1
+    est_window: int = 36
+    scale_by_vol: bool = True
+
+    def signal(self, prices, mask, **panels):
+        return residual_momentum(
+            prices, mask,
+            lookback=self.lookback, skip=self.skip,
+            est_window=self.est_window, scale_by_vol=self.scale_by_vol,
+        )
 
 
 @register_strategy("volume_z_momentum")

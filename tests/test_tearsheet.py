@@ -69,10 +69,21 @@ def test_tail_stats_vs_sorted_tail(rng):
     r, valid = _series(rng, T=400)
     ts = tearsheet(r, valid)
     rv = np.sort(r[valid])
-    k = max(int(np.ceil(0.05 * len(rv))), 1)
+    k = max(int(np.ceil(0.05 * len(rv) - 1e-6)), 1)
     assert float(ts.var_95) == pytest.approx(rv[k - 1], rel=1e-12)
     assert float(ts.cvar_95) == pytest.approx(rv[:k].mean(), rel=1e-12)
     assert float(ts.cvar_95) <= float(ts.var_95)
+
+
+def test_tail_count_integer_boundary():
+    """q*n landing on an integer must give exactly that tail count in every
+    dtype: n=240, q=0.05 -> k=12, so VaR is the 12th-worst return."""
+    n = 240
+    r = np.linspace(-0.12, 0.119, n)  # distinct, sorted, 12th worst known
+    ts = tearsheet(r, np.ones(n, bool))
+    want_var = np.sort(r)[11]
+    assert float(ts.var_95) == pytest.approx(want_var, rel=1e-12)
+    assert float(ts.cvar_95) == pytest.approx(np.sort(r)[:12].mean(), rel=1e-12)
 
 
 def test_batched_matches_per_series(rng):
