@@ -184,6 +184,9 @@ def child_main():
     # off-TPU it runs in interpreter mode (correctness tests), far too slow
     # to time at this scale
     grid_pallas_s = None if on_cpu else timed("rank", "pallas")
+    # bf16-operand MXU form: reduced-precision throughput mode, only
+    # meaningful on the accelerator
+    grid_bf16_s = None if on_cpu else timed("rank", "matmul_bf16")
 
     # CPU fallback: additionally time ONE rep of the full north-star-size
     # grid when the child's budget allows — proves full-size compile+memory
@@ -267,6 +270,8 @@ def child_main():
         "grid16_rank_matmul_s": round(grid_matmul_s, 4),
         "grid16_rank_pallas_s": (None if grid_pallas_s is None
                                  else round(grid_pallas_s, 4)),
+        "grid16_rank_matmul_bf16_s": (None if grid_bf16_s is None
+                                      else round(grid_bf16_s, 4)),
         "north_star_target_s": 10.0,
         "north_star_met": bool(
             (A, T) == (3000, 15120) and grid_rank_s < 10.0

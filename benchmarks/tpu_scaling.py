@@ -73,7 +73,11 @@ def main():
     Js = np.array([3, 6, 9, 12])
     Ks = np.array([3, 6, 9, 12])
     sizes = [3_000, 12_000, 48_000, 96_000]
-    impls = ["xla", "matmul", "pallas"] if platform == "tpu" else ["xla", "matmul"]
+    impls = (
+        ["xla", "matmul", "matmul_bf16", "pallas"]
+        if platform == "tpu"
+        else ["xla", "matmul"]
+    )
     rows = []
 
     for A in sizes:

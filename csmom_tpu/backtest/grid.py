@@ -73,16 +73,38 @@ def _cohort_partial_sums(labels, ret, ret_valid, n_bins: int, max_hold: int,
     instead of H masked panel passes; on TPU this is MXU work, and the
     band gather reads 2*M*H elements.  Summation order differs from the
     elementwise forms, so float results agree to tolerance, not bitwise.
+
+    ``impl='matmul_bf16'`` is the same cross table with bf16 operands and
+    f32 accumulation — the TPU MXU's native fast path.  Counts stay exact
+    (0/1 operands are representable; accumulation is f32); return sums
+    carry bf16's ~3-decimal-digit input rounding, so this is the opt-in
+    throughput mode, not parity mode.
     """
-    if impl == "matmul":
+    if impl in ("matmul", "matmul_bf16"):
         A, M = ret.shape
         rf = jnp.where(ret_valid, jnp.nan_to_num(ret), 0.0)
         count_dtype = jnp.promote_types(rf.dtype, jnp.float32)
         mem = jnp.stack([labels == 0, labels == (n_bins - 1)])  # [2, A, M]
-        mem = mem.astype(rf.dtype)
-        vf = ret_valid.astype(count_dtype)
-        full_sums = jnp.einsum("kas,am->ksm", mem, rf)          # [2, M, M]
-        full_cnts = jnp.einsum("kas,am->ksm", mem.astype(count_dtype), vf)
+        if impl == "matmul_bf16":
+            # MXU-native operands, f32 accumulation: membership and validity
+            # are 0/1 (exact in bf16), so the COUNT cross table is exact to
+            # 2^24; only the return sums carry bf16's ~3-decimal-digit input
+            # rounding.  Opt-in reduced precision — the bf16 MXU path is the
+            # chip's fast path for exactly this shape of work.
+            mem = mem.astype(jnp.bfloat16)
+            full_sums = jnp.einsum(
+                "kas,am->ksm", mem, rf.astype(jnp.bfloat16),
+                preferred_element_type=count_dtype,
+            )
+            full_cnts = jnp.einsum(
+                "kas,am->ksm", mem, ret_valid.astype(jnp.bfloat16),
+                preferred_element_type=count_dtype,
+            )
+        else:
+            mem = mem.astype(rf.dtype)
+            vf = ret_valid.astype(count_dtype)
+            full_sums = jnp.einsum("kas,am->ksm", mem, rf)      # [2, M, M]
+            full_cnts = jnp.einsum("kas,am->ksm", mem.astype(count_dtype), vf)
         col = jnp.arange(M)[:, None] + jnp.arange(1, max_hold + 1)[None, :]
         in_range = col < M                                       # [M, H]
         colc = jnp.clip(col, 0, M - 1)[None]
@@ -100,7 +122,10 @@ def _cohort_partial_sums(labels, ret, ret_valid, n_bins: int, max_hold: int,
             interpret=_jax.default_backend() != "tpu",
         )
     if impl != "xla":
-        raise ValueError(f"unknown impl {impl!r}: use 'xla', 'matmul' or 'pallas'")
+        raise ValueError(
+            f"unknown impl {impl!r}: use 'xla', 'matmul', 'matmul_bf16' or "
+            f"'pallas'"
+        )
     A, M = ret.shape
     top = labels == (n_bins - 1)
     bot = labels == 0
@@ -232,8 +257,10 @@ def jk_grid_backtest(
       mode: ranking mode ('qcut' parity / 'rank' fast).
       max_hold: static horizon bound (defaults to max(Ks) when Ks is concrete).
       impl: cohort-aggregation kernel — 'xla' (rolled-panel reference form),
-        'matmul' (MXU cross-table form, fastest at scale), or 'pallas'
-        (fused VMEM kernel, TPU).
+        'matmul' (MXU cross-table form, fastest at scale), 'matmul_bf16'
+        (same with bf16 operands / f32 accumulation — opt-in reduced
+        precision for the MXU fast path), or 'pallas' (fused VMEM kernel,
+        TPU).
     """
     max_hold = validate_grid_args(Ks, max_hold)
     return _jk_grid_backtest(

@@ -589,10 +589,13 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--shards", type=int, metavar="N",
                             help="run the grid asset-sharded over an N-device "
                                  "mesh (required form for --mode rank_hist)")
-            sp.add_argument("--impl", choices=["xla", "pallas", "matmul"],
+            sp.add_argument("--impl",
+                            choices=["xla", "pallas", "matmul", "matmul_bf16"],
                             help="cohort-aggregation kernel (default xla; "
                                  "matmul = MXU cross-table form, ~5x on big "
-                                 "panels; pallas = fused VMEM kernel, TPU)")
+                                 "panels; matmul_bf16 = bf16 operands/f32 "
+                                 "accumulation; pallas = fused VMEM kernel, "
+                                 "TPU)")
         if "min_months" in extra:
             sp.add_argument("--min-months", dest="min_months", type=int)
         if "bootstrap" in extra:

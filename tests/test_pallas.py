@@ -186,3 +186,53 @@ def test_custom_tiling(rng):
     # blocked accumulation reorders the sum vs XLA: tolerance, not equality
     np.testing.assert_allclose(np.asarray(sums), ws, rtol=1e-10)
     np.testing.assert_allclose(np.asarray(counts), wc)
+
+
+def test_cohort_matmul_bf16_counts_exact_sums_close(rng):
+    """bf16 operands with f32 accumulation: the count cross table must be
+    EXACT (0/1 operands are bf16-representable), and the return sums within
+    bf16 input-rounding tolerance of the f64 XLA form."""
+    from csmom_tpu.backtest.grid import _cohort_partial_sums
+
+    a, m, h, n_bins = 130, 60, 6, 5
+    labels = rng.integers(-1, n_bins, size=(a, m)).astype(np.int32)
+    valid = rng.random((a, m)) > 0.25
+    ret = np.where(valid, rng.normal(0, 0.02, size=(a, m)), np.nan)
+    sx, cx = _cohort_partial_sums(
+        jnp.asarray(labels), jnp.asarray(ret), jnp.asarray(valid), n_bins, h
+    )
+    sb, cb = _cohort_partial_sums(
+        jnp.asarray(labels), jnp.asarray(ret), jnp.asarray(valid), n_bins, h,
+        impl="matmul_bf16",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cb, dtype=np.float64), np.asarray(cx, dtype=np.float64)
+    )
+    # bf16 has ~8 mantissa bits: per-element relative error <= 2^-8; sums of
+    # ~a/n_bins same-sign-ish terms keep roughly that relative scale
+    np.testing.assert_allclose(np.asarray(sb), np.asarray(sx),
+                               rtol=2e-2, atol=5e-4)
+
+
+def test_grid_backtest_matmul_bf16_close(rng):
+    """End to end the bf16 grid tracks the exact grid: identical validity,
+    mean spreads within bf16 tolerance."""
+    from csmom_tpu.backtest.grid import jk_grid_backtest
+
+    prices = 50 * np.exp(np.cumsum(rng.normal(0.004, 0.06, size=(60, 90)), axis=1))
+    mask = np.ones((60, 90), bool)
+    mask[:8, :20] = False
+    Js = np.array([3, 6])
+    Ks = np.array([1, 6])
+    r1 = jk_grid_backtest(prices, mask, Js, Ks, skip=1, n_bins=5, mode="rank")
+    r2 = jk_grid_backtest(prices, mask, Js, Ks, skip=1, n_bins=5, mode="rank",
+                          impl="matmul_bf16")
+    np.testing.assert_array_equal(np.asarray(r1.spread_valid),
+                                  np.asarray(r2.spread_valid))
+    v = np.asarray(r1.spread_valid)
+    np.testing.assert_allclose(np.asarray(r2.spreads)[v],
+                               np.asarray(r1.spreads)[v],
+                               rtol=0, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(r2.mean_spread),
+                               np.asarray(r1.mean_spread),
+                               rtol=0, atol=5e-4)
