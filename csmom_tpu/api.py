@@ -19,14 +19,17 @@ from csmom_tpu.panel.calendar import (
 from csmom_tpu.panel.panel import Panel
 
 
-def monthly_price_panel(data_dir: str, tickers, field: str = "adj_close"):
+def monthly_price_panel(data_dir: str, tickers, field: str = "adj_close",
+                        daily_df=None):
     """Daily CSV caches -> month-end price & volume panels.
 
     Returns ``(prices Panel[A, M], volume Panel[A, M])`` with month-end
     timestamps, mirroring ``compute_monthly_momentum_from_daily``'s
-    aggregation (``features.py:34-39``).
+    aggregation (``features.py:34-39``).  Pass ``daily_df`` (a canonical
+    long frame from :func:`csmom_tpu.panel.ingest.load_daily`) to reuse an
+    already-loaded universe instead of re-reading the CSV cache.
     """
-    df = ingest.load_daily(data_dir, tickers)
+    df = daily_df if daily_df is not None else ingest.load_daily(data_dir, tickers)
     price_daily = ingest.long_to_panel(df, field, time_col="date")
     vol_daily = ingest.long_to_panel(
         df, "volume", time_col="date",

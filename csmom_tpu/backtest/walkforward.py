@@ -117,6 +117,7 @@ def walk_forward_grid_backtest(
     max_hold: int | None = None,
     min_months: int = 24,
     freq: int = 12,
+    impl: str = "xla",
 ):
     """End-to-end walk-forward sweep: one grid call + one selection pass.
 
@@ -126,7 +127,7 @@ def walk_forward_grid_backtest(
     max_hold = validate_grid_args(Ks, max_hold)
     grid = jk_grid_backtest(
         prices, mask, Js, Ks, skip=skip, n_bins=n_bins, mode=mode,
-        max_hold=max_hold, freq=freq,
+        max_hold=max_hold, freq=freq, impl=impl,
     )
     wf = walk_forward_select(
         grid.spreads, grid.spread_valid, min_months=min_months, freq=freq

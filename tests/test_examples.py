@@ -1,0 +1,45 @@
+"""The examples/ scripts must keep running (and keep their golden checks)."""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+from tests.conftest import REFERENCE_DATA, requires_reference
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(__file__)), "examples")
+
+
+def _run(script, argv):
+    path = os.path.join(EXAMPLES, script)
+    old = sys.argv
+    sys.argv = [path] + argv
+    try:
+        runpy.run_path(path, run_name="__main__")
+    finally:
+        sys.argv = old
+
+
+@requires_reference
+def test_replicate_reference_example(capsys):
+    """Runs end to end and its own golden asserts hold (the script raises
+    AssertionError on parity drift)."""
+    _run("replicate_reference.py", ["--data-dir", REFERENCE_DATA])
+    assert "parity OK" in capsys.readouterr().out
+
+
+@requires_reference
+def test_strategy_zoo_example(capsys):
+    _run("strategy_zoo.py", ["--data-dir", REFERENCE_DATA, "--n-bins", "5"])
+    out = capsys.readouterr().out
+    for label in ("momentum J=12", "reversal 1m", "residual mom",
+                  "volume-z mom"):
+        assert label in out
+
+
+def test_north_star_grid_example(capsys):
+    _run("north_star_grid.py", ["--assets", "64", "--years", "4"])
+    out = capsys.readouterr().out
+    assert "16-cell grid in" in out
+    assert "walk-forward" in out
