@@ -13,6 +13,13 @@ from csmom_tpu.backtest.horizon import (
     VolumeHorizonProfile,
 )
 from csmom_tpu.backtest.double_sort import volume_double_sort, DoubleSortResult
+from csmom_tpu.backtest.event import (
+    CostAttribution,
+    EventResult,
+    cost_attribution,
+    event_backtest,
+    trades_dataframe,
+)
 from csmom_tpu.backtest.walkforward import (
     walk_forward_select,
     walk_forward_grid_backtest,
@@ -34,4 +41,9 @@ __all__ = [
     "walk_forward_select",
     "walk_forward_grid_backtest",
     "WalkForwardResult",
+    "CostAttribution",
+    "EventResult",
+    "cost_attribution",
+    "event_backtest",
+    "trades_dataframe",
 ]

@@ -381,7 +381,7 @@ def cmd_intraday(args) -> int:
     extra = {}
     if getattr(args, "l1_ratio", None) is not None:
         extra["l1_ratio"] = args.l1_ratio
-    res, fit, compact, dense_score, _p, _v = intraday_pipeline(
+    res, fit, compact, dense_score, dense_price, _v = intraday_pipeline(
         minute_df, daily_df,
         window_minutes=cfg.intraday.window_minutes,
         n_splits=cfg.intraday.n_splits,
@@ -396,6 +396,16 @@ def cmd_intraday(args) -> int:
     print(f"Trades:      {int(res.n_trades)} "
           f"({int(res.n_buys)} buys / {int(res.n_sells)} sells)")
     print(f"Total PnL:   ${float(res.total_pnl):,.2f}")
+
+    from csmom_tpu.backtest.event import cost_attribution
+
+    tca = cost_attribution(res, dense_price,
+                           size_shares=cfg.intraday.size_shares)
+    print(f"Costs:       ${float(tca.total_cost):,.2f} "
+          f"({float(tca.cost_bps):.2f} bps of ${float(tca.gross_notional):,.0f}"
+          f" traded; spread ${float(tca.spread_cost):,.2f}, "
+          f"impact ${float(tca.impact_cost):,.2f}) — "
+          f"gross PnL ${float(tca.gross_pnl):,.2f}")
 
     from csmom_tpu.analytics.plots import save_intraday_pnl_plot, save_trades_csv
     from csmom_tpu.backtest.event import trades_dataframe

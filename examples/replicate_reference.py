@@ -34,12 +34,11 @@ def main(argv=None):
     from csmom_tpu.backtest.monthly import monthly_spread_backtest
     from csmom_tpu.panel.ingest import load_daily, load_intraday
 
+    from csmom_tpu.config import DEFAULT_TICKERS
+
     # the reference's 20-ticker universe; its own loader silently loses AAPL
     # to the dialect-B cache bug (SURVEY 2.1.1), so parity mode drops it too
-    tickers = [
-        "MSFT", "AMZN", "GOOGL", "NVDA", "TSLA", "META", "JPM", "BAC", "WMT",
-        "PG", "KO", "DIS", "CSCO", "ORCL", "INTC", "AMD", "NFLX", "C", "GS",
-    ]
+    tickers = [t for t in DEFAULT_TICKERS if t != "AAPL"]
 
     # -- monthly leg (run_demo.py:31-79) ------------------------------------
     daily = load_daily(args.data_dir, tickers)

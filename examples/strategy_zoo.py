@@ -33,10 +33,10 @@ def main(argv=None):
     from csmom_tpu.api import monthly_price_panel
     from csmom_tpu.strategy import make_strategy, strategy_backtest
 
-    tickers = [
-        "MSFT", "AMZN", "GOOGL", "NVDA", "TSLA", "META", "JPM", "BAC", "WMT",
-        "PG", "KO", "DIS", "CSCO", "ORCL", "INTC", "AMD", "NFLX", "C", "GS",
-    ]
+    from csmom_tpu.config import DEFAULT_TICKERS
+
+    # parity universe: the reference's 20 names minus AAPL (SURVEY 2.1.1)
+    tickers = [t for t in DEFAULT_TICKERS if t != "AAPL"]
     panel, volume = monthly_price_panel(args.data_dir, tickers)
     v, m = panel.device(np.float64)
 

@@ -135,3 +135,74 @@ def test_golden_fingerprint_f32():
     # ~$90M notional at f32 precision (2^-24 relative): dollars, not cents
     assert abs(float(res.net_notional) - 90_084_558.39) / 90_084_558.39 < 1e-4
     assert abs(float(res.total_pnl) - 765_431.87) / 765_431.87 < 5e-3
+
+
+class TestCostAttribution:
+    def _run(self, rng, order_type="market", **kw):
+        from csmom_tpu.backtest.event import cost_attribution, event_backtest
+
+        A, T = 6, 120
+        price = np.abs(rng.normal(100, 5, size=(A, T)))
+        valid = rng.random((A, T)) > 0.1
+        score = rng.normal(0, 3e-5, size=(A, T))
+        adv = np.full(A, 1e5)
+        vol = np.full(A, 0.02)
+        price = np.where(valid, price, np.nan)
+        res = event_backtest(price, valid, np.nan_to_num(score), adv, vol,
+                             order_type=order_type, **kw)
+        return res, cost_attribution(res, price)
+
+    def test_identities_market(self, rng):
+        """gross = net + cost; the formula split is exact for market fills
+        (residual ~ 0); every leg is non-negative."""
+        res, tca = self._run(rng)
+        assert int(res.n_trades) > 0
+        assert float(tca.gross_pnl) == pytest.approx(
+            float(tca.net_pnl) + float(tca.total_cost), abs=1e-9
+        )
+        assert abs(float(tca.residual)) < 1e-9 * max(1.0, float(tca.total_cost))
+        assert float(tca.spread_cost) > 0
+        assert float(tca.impact_cost) > 0
+        assert float(tca.total_cost) > 0
+        assert 0 < float(tca.cost_bps) < 100
+
+    def test_matches_trade_log(self, rng):
+        """total_cost equals per-trade slippage reconstructed independently:
+        mid = fill / (1 + side*(spread/2 + impact)) inverts the market-fill
+        formula, so |fill - mid| * size summed over fills is the cost."""
+        res, tca = self._run(rng)
+        side = np.asarray(res.trade_side, dtype=np.float64)
+        fill = np.asarray(res.exec_price)
+        traded = side != 0
+        frac = 0.001 / 2 + np.asarray(res.impact)[:, None]
+        mid = fill / (1 + side * np.where(traded, frac, 0))
+        want = (np.abs(fill - mid)[traded] * 50).sum()
+        assert float(tca.total_cost) == pytest.approx(want, rel=1e-9)
+
+    def test_limit_mode_cost_identity(self, rng):
+        """Limit fills execute at mid*(1 - 0.5*agg*spread) regardless of
+        side, so total cost reduces exactly to
+        0.5*agg*spread*size*(sell mid notional - buy mid notional) —
+        buys earn the improvement, sells pay it."""
+        import jax
+
+        agg, spread = 0.5, 0.001
+        res, tca = self._run(rng, order_type="limit",
+                             fill_key=jax.random.PRNGKey(3),
+                             aggressiveness=agg)
+        if int(res.n_trades) == 0:
+            pytest.skip("no limit fills under this seed")
+        side = np.asarray(res.trade_side, dtype=np.float64)
+        fill = np.asarray(res.exec_price)
+        mid = fill / (1 - 0.5 * agg * spread)
+        want = 0.5 * agg * spread * 50 * (
+            mid[side < 0].sum() - mid[side > 0].sum()
+        )
+        assert float(tca.total_cost) == pytest.approx(want, rel=1e-9)
+
+    def test_latency_guard(self, rng):
+        from csmom_tpu.backtest.event import cost_attribution
+
+        res, _ = self._run(rng)
+        with pytest.raises(NotImplementedError, match="latency"):
+            cost_attribution(res, np.ones((6, 120)), latency_bars=2)
