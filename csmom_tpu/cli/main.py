@@ -537,6 +537,29 @@ def cmd_bench(args) -> int:
     return subprocess.call([sys.executable, "bench.py"])
 
 
+def cmd_strategies(args) -> int:
+    """List registered strategy plugins (name, parameters, description)."""
+    import dataclasses
+
+    from csmom_tpu.strategy import available_strategies
+
+    for name, cls in sorted(available_strategies().items()):
+        # user plugins may lack docstrings or plain defaults — never let
+        # one undocumented registration break the whole listing
+        params = ", ".join(
+            f"{f.name}={f.default!r}"
+            if f.default is not dataclasses.MISSING else f.name
+            for f in dataclasses.fields(cls)
+        )
+        lines = (cls.__doc__ or "").strip().splitlines()
+        print(f"{name}({params})")
+        if lines:
+            print(f"    {lines[0]}")
+    print("\nuse: csmom replicate --strategy NAME "
+          "[--strategy-arg key=value ...]")
+    return 0
+
+
 def _add_common(p):
     p.add_argument("--config", help="TOML RunConfig file")
     p.add_argument("--data-dir", help="CSV cache directory")
@@ -588,6 +611,7 @@ def build_parser() -> argparse.ArgumentParser:
         ("intraday", cmd_intraday, ("model",)),
         ("horizons", cmd_horizons, ("horizons",)),
         ("fetch", cmd_fetch, ("fetch",)),
+        ("strategies", cmd_strategies, ()),
         ("bench", cmd_bench, ()),
     ):
         sp = sub.add_parser(name, help=(fn.__doc__ or "").splitlines()[0])

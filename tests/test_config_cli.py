@@ -165,3 +165,38 @@ def test_cli_replicate_tearsheet(tmp_path, capsys):
     # every year of the reference's post-warmup span (2019-2024) appears
     for yy in range(2019, 2025):
         assert str(yy) in out
+
+
+def test_cli_strategies_lists_registry(capsys):
+    assert main(["strategies"]) == 0
+    out = capsys.readouterr().out
+    for name in ("momentum", "reversal", "residual_momentum",
+                 "volume_z_momentum", "zscore_combo"):
+        assert name in out
+    assert "est_window=36" in out
+
+
+def test_cli_strategies_robust_to_bare_plugins(capsys):
+    """A user plugin with no docstring and a required field must not break
+    the listing."""
+    import dataclasses as dc
+
+    from csmom_tpu.strategy import register_strategy
+    from csmom_tpu.strategy.base import _REGISTRY, Strategy
+
+    @register_strategy("_bare_test_plugin")
+    @dc.dataclass(frozen=True)
+    class Bare(Strategy):
+        required_knob: float = dc.field()  # no default
+
+        def signal(self, prices, mask, **panels):  # pragma: no cover
+            return prices, mask
+
+    Bare.__doc__ = None
+    try:
+        assert main(["strategies"]) == 0
+        out = capsys.readouterr().out
+        assert "_bare_test_plugin(required_knob)" in out
+        assert "_MISSING_TYPE" not in out
+    finally:
+        _REGISTRY.pop("_bare_test_plugin", None)
