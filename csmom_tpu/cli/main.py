@@ -546,11 +546,14 @@ def cmd_strategies(args) -> int:
     for name, cls in sorted(available_strategies().items()):
         # user plugins may lack docstrings or plain defaults — never let
         # one undocumented registration break the whole listing
-        params = ", ".join(
-            f"{f.name}={f.default!r}"
-            if f.default is not dataclasses.MISSING else f.name
-            for f in dataclasses.fields(cls)
-        )
+        def _param(f):
+            if f.default is not dataclasses.MISSING:
+                return f"{f.name}={f.default!r}"
+            if f.default_factory is not dataclasses.MISSING:
+                return f"{f.name}={f.default_factory()!r}"
+            return f.name
+
+        params = ", ".join(_param(f) for f in dataclasses.fields(cls))
         lines = (cls.__doc__ or "").strip().splitlines()
         print(f"{name}({params})")
         if lines:

@@ -117,3 +117,23 @@ def test_auto_mesh_and_padding(rng):
     pv, mv, A = pad_assets(prices, mask, 4)
     assert pv.shape[0] == 12 and A == 10
     assert not mv[10:].any()
+
+
+def test_sharded_grid_bf16_impl_close_counts_exact(rng, eight_devices):
+    """impl='matmul_bf16' through the sharded path: validity (from the
+    exact f32-accumulated counts) is bit-identical to xla; spreads are
+    within bf16 input-rounding tolerance."""
+    prices, mask = _panel(rng, A=29, M=72)
+    mesh = make_mesh(eight_devices, grid_axis=2)
+    pv, mv, _ = pad_assets(prices, mask, mesh.shape["assets"])
+
+    Js = np.array([6, 12])
+    Ks = np.array([1, 3])
+    res_b = sharded_jk_grid_backtest(pv, mv, Js, Ks, mesh, impl="matmul_bf16")
+    res_x = sharded_jk_grid_backtest(pv, mv, Js, Ks, mesh, impl="xla")
+    np.testing.assert_array_equal(np.asarray(res_b.spread_valid),
+                                  np.asarray(res_x.spread_valid))
+    v = np.asarray(res_x.spread_valid)
+    np.testing.assert_allclose(np.asarray(res_b.spreads)[v],
+                               np.asarray(res_x.spreads)[v],
+                               rtol=0, atol=2e-3)
