@@ -53,6 +53,8 @@ def run_monthly(
     freq: int = 12,
     backend: str = "tpu",
     strategy=None,
+    sector_ids=None,
+    n_sectors: int = 0,
     **panels,
 ) -> MonthlyReport:
     """Run the monthly decile backtest on the requested engine.
@@ -67,6 +69,11 @@ def run_monthly(
         Extra ``**panels`` (e.g. ``volumes=``) are forwarded to its
         ``signal``.  Either engine ranks the plugged-in scores through the
         same tail, so callers never branch on signal choice.
+      sector_ids: optional i32[A] sector id per asset (negative =
+        unclassified, excluded from ranking) with ``n_sectors`` the id
+        count — switches the TPU engine to sector-neutral ranking
+        (BASELINE config 3).  Not supported with ``strategy`` or the
+        pandas backend.
     """
     if strategy is None and panels:
         raise TypeError(
@@ -86,6 +93,11 @@ def run_monthly(
                 "— misspelled? A strategy's **panels catch-all exists to ignore "
                 "panels other strategies need, not to swallow typos."
             )
+    if sector_ids is not None and (strategy is not None or backend != "tpu"):
+        raise NotImplementedError(
+            "sector-neutral ranking runs on the TPU engine's built-in "
+            "momentum path only (no strategy=, backend='tpu')"
+        )
     if backend == "tpu":
         from csmom_tpu.backtest import monthly_spread_backtest
 
@@ -95,6 +107,14 @@ def run_monthly(
 
             res = strategy_backtest(
                 v, m, strategy, n_bins=n_bins, mode=mode, freq=freq, **panels
+            )
+        elif sector_ids is not None:
+            from csmom_tpu.backtest import sector_neutral_backtest
+
+            res = sector_neutral_backtest(
+                v, m, np.asarray(sector_ids, np.int32), int(n_sectors),
+                lookback=lookback, skip=skip, n_bins=n_bins, mode=mode,
+                freq=freq,
             )
         else:
             res = monthly_spread_backtest(

@@ -193,6 +193,29 @@ def sector_neutral_backtest(
 
 
 @partial(jax.jit, static_argnames=("n_bins", "freq"))
+def net_of_costs_arrays(
+    labels,
+    decile_counts,
+    spread,
+    spread_valid,
+    half_spread: float = 0.0005,
+    n_bins: int = 10,
+    freq: int = 12,
+):
+    """Array-level core of :func:`net_of_costs` — takes exactly the four
+    panel outputs the cost adjustment needs, so callers holding a host-side
+    report (e.g. the CLI's ``MonthlyReport``) don't have to fabricate an
+    engine-internal :class:`MonthlyResult`."""
+    w = long_short_weights(labels, decile_counts, n_bins)
+    cost = turnover_cost(w, half_spread)
+    net = jnp.where(spread_valid, spread - cost, jnp.nan)
+    return (
+        net,
+        masked_mean(net, spread_valid),
+        sharpe(net, spread_valid, freq_per_year=freq),
+    )
+
+
 def net_of_costs(
     result: MonthlyResult,
     half_spread: float = 0.0005,
@@ -206,11 +229,8 @@ def net_of_costs(
     ``(net_spread f[M], net_mean, net_sharpe)``; validity is unchanged (costs
     only shift live months).
     """
-    w = long_short_weights(result.labels, result.decile_counts, n_bins)
-    cost = turnover_cost(w, half_spread)
-    net = jnp.where(result.spread_valid, result.spread - cost, jnp.nan)
-    return (
-        net,
-        masked_mean(net, result.spread_valid),
-        sharpe(net, result.spread_valid, freq_per_year=freq),
+    return net_of_costs_arrays(
+        result.labels, result.decile_counts, result.spread,
+        result.spread_valid, half_spread=half_spread, n_bins=n_bins,
+        freq=freq,
     )

@@ -50,6 +50,41 @@ def _price_panel(cfg: RunConfig):
     return monthly_price_panel(cfg.universe.data_dir, list(cfg.universe.tickers))
 
 
+def _load_sector_map(path: str, tickers):
+    """``ticker,sector`` CSV -> (ids i32[A], n_sectors) aligned to the panel.
+
+    Sector names factorize in sorted order; panel tickers absent from the
+    file get id -1 (excluded from sector-neutral ranking, like masked
+    lanes) with a warning naming them.
+    """
+    import numpy as np
+    import pandas as pd
+
+    df = pd.read_csv(path)
+    df.columns = [c.strip().lower() for c in df.columns]
+    if not {"ticker", "sector"} <= set(df.columns):
+        raise SystemExit(
+            f"--sector-map {path}: need columns ticker,sector "
+            f"(got {list(df.columns)})"
+        )
+    mapping = dict(zip(df["ticker"].astype(str).str.upper(),
+                       df["sector"].astype(str)))
+    names = sorted(set(mapping.values()))
+    code = {s: i for i, s in enumerate(names)}
+    ids = np.full(len(tickers), -1, np.int32)
+    missing = []
+    for i, t in enumerate(tickers):
+        s = mapping.get(str(t).upper())
+        if s is None:
+            missing.append(str(t))
+        else:
+            ids[i] = code[s]
+    if missing:
+        log.warning("sector map has no entry for %s — excluded from ranking",
+                    ",".join(missing))
+    return ids, len(names)
+
+
 def _parse_strategy(args, cfg):
     """``--strategy name [--strategy-arg k=v ...]`` -> Strategy | None.
 
@@ -109,6 +144,16 @@ def cmd_replicate(args) -> int:
         offered = {"volumes": volume.values, "volumes_mask": volume.mask}
         allowed = consumed_panels(strategy)
         panels = {k: v for k, v in offered.items() if k in allowed}
+    sector_kw = {}
+    if getattr(args, "sector_map", None):
+        if strategy is not None or cfg.backend != "tpu":
+            print("--sector-map needs the TPU engine's built-in momentum "
+                  "path (drop --strategy / --backend pandas)",
+                  file=sys.stderr)
+            return 2
+        ids, n_sectors = _load_sector_map(args.sector_map, prices.tickers)
+        sector_kw = {"sector_ids": ids, "n_sectors": n_sectors}
+        print(f"sector-neutral ranking: {n_sectors} sectors")
     rep = run_monthly(
         prices,
         lookback=cfg.momentum.lookback,
@@ -117,12 +162,31 @@ def cmd_replicate(args) -> int:
         mode=cfg.momentum.mode,
         backend=cfg.backend,
         strategy=strategy,
+        **sector_kw,
         **panels,
     )
     print(f"Mean monthly spread: {rep.mean_spread:.6f}")
     print(f"Annualized Sharpe:   {rep.ann_sharpe:.4f}")
     print(f"t-stat (NW):         {rep.tstat_nw:.3f}")
     print(f"t-stat (iid):        {rep.tstat:.3f}")
+
+    if getattr(args, "tc_bps", None):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from csmom_tpu.backtest.monthly import net_of_costs_arrays
+        from csmom_tpu.analytics.stats import nw_t_stat
+
+        valid = np.isfinite(rep.spread)
+        net, net_mean, net_sharpe = net_of_costs_arrays(
+            rep.labels, rep.decile_counts,
+            jnp.nan_to_num(jnp.asarray(rep.spread)), jnp.asarray(valid),
+            half_spread=args.tc_bps / 1e4, n_bins=cfg.momentum.n_bins,
+        )
+        net_t = nw_t_stat(jnp.nan_to_num(net), jnp.asarray(valid))
+        print(f"net of {args.tc_bps:g} bps half-spread turnover costs: "
+              f"mean {float(net_mean):+.6f}, Sharpe {float(net_sharpe):.4f}, "
+              f"NW t {float(net_t):+.3f}")
 
     if getattr(args, "tables", False):
         from csmom_tpu.analytics.tables import decile_table
@@ -550,7 +614,10 @@ def cmd_strategies(args) -> int:
             if f.default is not dataclasses.MISSING:
                 return f"{f.name}={f.default!r}"
             if f.default_factory is not dataclasses.MISSING:
-                return f"{f.name}={f.default_factory()!r}"
+                try:
+                    return f"{f.name}={f.default_factory()!r}"
+                except Exception:
+                    return f.name  # a raising factory must not kill the listing
             return f.name
 
         params = ", ".join(_param(f) for f in dataclasses.fields(cls))
@@ -606,8 +673,9 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command")
 
     for name, fn, extra in (
-        ("run", cmd_run, ("bootstrap", "strategy", "tables")),
-        ("replicate", cmd_replicate, ("bootstrap", "strategy", "tables")),
+        ("run", cmd_run, ("bootstrap", "strategy", "tables", "monthly_extras")),
+        ("replicate", cmd_replicate,
+         ("bootstrap", "strategy", "tables", "monthly_extras")),
         ("grid", cmd_grid, ("js", "ks", "bootstrap")),
         ("doublesort", cmd_doublesort, ("doublesort",)),
         ("sweep", cmd_sweep, ("js", "ks", "min_months")),
@@ -645,6 +713,14 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--tearsheet", action="store_true",
                             help="print the full risk tearsheet (drawdown, "
                                  "Calmar, Sortino, tails, per-year returns)")
+        if "monthly_extras" in extra:
+            sp.add_argument("--tc-bps", dest="tc_bps", type=float,
+                            help="also report the spread net of linear "
+                                 "transaction costs at this half-spread "
+                                 "(bps per unit weight turnover)")
+            sp.add_argument("--sector-map", dest="sector_map",
+                            help="ticker,sector CSV: rank within sectors "
+                                 "(sector-neutral momentum; TPU engine)")
         if "doublesort" in extra:
             _add_turnover_flags(sp)
         if "horizons" in extra:

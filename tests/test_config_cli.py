@@ -200,3 +200,63 @@ def test_cli_strategies_robust_to_bare_plugins(capsys):
         assert "_MISSING_TYPE" not in out
     finally:
         _REGISTRY.pop("_bare_test_plugin", None)
+
+
+@requires_reference
+def test_cli_replicate_sector_neutral_and_costs(tmp_path, capsys):
+    sm = tmp_path / "sectors.csv"
+    sm.write_text(
+        "ticker,sector\n" + "\n".join(
+            f"{t},{'tech' if i % 2 else 'other'}"
+            for i, t in enumerate(
+                ["MSFT", "AMZN", "GOOGL", "NVDA", "TSLA", "META", "JPM",
+                 "BAC", "WMT", "PG", "KO", "DIS", "CSCO", "ORCL", "INTC",
+                 "AMD", "NFLX", "C", "GS", "AAPL"])
+        ) + "\n"
+    )
+    rc = main([
+        "replicate", "--data-dir", REFERENCE_DATA, "--out", str(tmp_path),
+        "--sector-map", str(sm), "--tc-bps", "5",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "sector-neutral ranking: 2 sectors" in out
+    assert "net of 5 bps" in out
+    # costs can only reduce the mean spread
+    import re
+
+    gross = float(re.search(r"Mean monthly spread: (\S+)", out).group(1))
+    net = float(re.search(r"net of 5 bps.*mean ([+-][0-9.]+)", out).group(1))
+    assert net < gross
+
+
+def test_run_monthly_sector_guards(rng):
+    import numpy as np
+
+    from csmom_tpu.backends import run_monthly
+    from csmom_tpu.panel.panel import Panel
+    from csmom_tpu.strategy import make_strategy
+
+    A, M = 12, 40
+    prices = 50 * np.exp(np.cumsum(rng.normal(0, 0.05, size=(A, M)), axis=1))
+    panel = Panel(values=prices, mask=np.ones((A, M), bool),
+                  tickers=np.array([f"T{i}" for i in range(A)]),
+                  times=np.arange(M))
+    ids = np.zeros(A, np.int32)
+    with pytest.raises(NotImplementedError, match="sector"):
+        run_monthly(panel, backend="pandas", sector_ids=ids, n_sectors=1)
+    with pytest.raises(NotImplementedError, match="sector"):
+        run_monthly(panel, strategy=make_strategy("momentum"),
+                    sector_ids=ids, n_sectors=1)
+
+
+@requires_reference
+def test_cli_sector_map_combo_rejected_cleanly(tmp_path, capsys):
+    sm = tmp_path / "s.csv"
+    sm.write_text("ticker,sector\nMSFT,t\n")
+    rc = main([
+        "replicate", "--data-dir", REFERENCE_DATA, "--backend", "pandas",
+        "--sector-map", str(sm),
+    ])
+    assert rc == 2
+    assert "TPU engine" in capsys.readouterr().err
