@@ -67,8 +67,8 @@ def _load_sector_map(path: str, tickers):
             f"--sector-map {path}: need columns ticker,sector "
             f"(got {list(df.columns)})"
         )
-    mapping = dict(zip(df["ticker"].astype(str).str.upper(),
-                       df["sector"].astype(str)))
+    mapping = dict(zip(df["ticker"].astype(str).str.strip().str.upper(),
+                       df["sector"].astype(str).str.strip()))
     names = sorted(set(mapping.values()))
     code = {s: i for i, s in enumerate(names)}
     ids = np.full(len(tickers), -1, np.int32)
@@ -82,6 +82,11 @@ def _load_sector_map(path: str, tickers):
     if missing:
         log.warning("sector map has no entry for %s — excluded from ranking",
                     ",".join(missing))
+    if (ids >= 0).sum() == 0:
+        raise SystemExit(
+            f"--sector-map {path}: no entry matches any panel ticker — "
+            "check the ticker naming convention"
+        )
     return ids, len(names)
 
 
@@ -170,7 +175,7 @@ def cmd_replicate(args) -> int:
     print(f"t-stat (NW):         {rep.tstat_nw:.3f}")
     print(f"t-stat (iid):        {rep.tstat:.3f}")
 
-    if getattr(args, "tc_bps", None):
+    if getattr(args, "tc_bps", None) is not None:
         import jax.numpy as jnp
         import numpy as np
 
@@ -294,6 +299,22 @@ def cmd_grid(args) -> int:
                      ("annualized Sharpe", sharpe_df)):
         print(f"\n{name}:")
         print(df.round(4).to_string())
+
+    if getattr(args, "tearsheet", False):
+        import pandas as pd
+
+        from csmom_tpu.analytics import tearsheet
+
+        # one batched call: every cell's risk stats reduce together
+        ts = tearsheet(np.nan_to_num(np.asarray(res.spreads)),
+                       np.asarray(res.spread_valid), freq_per_year=12)
+        for name, field in (("max drawdown", ts.max_drawdown),
+                            ("Calmar", ts.calmar),
+                            ("hit rate", ts.hit_rate)):
+            df = pd.DataFrame(np.asarray(field), index=pd.Index(Js, name="J"),
+                              columns=pd.Index(Ks, name="K"))
+            print(f"\n{name}:")
+            print(df.round(4).to_string())
 
     n_boot = args.bootstrap if getattr(args, "bootstrap", None) is not None else 200
     if n_boot > 0:  # default inference: per-cell block-bootstrap mean CIs
@@ -673,10 +694,11 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command")
 
     for name, fn, extra in (
-        ("run", cmd_run, ("bootstrap", "strategy", "tables", "monthly_extras")),
+        ("run", cmd_run,
+         ("bootstrap", "strategy", "tables", "tearsheet", "monthly_extras")),
         ("replicate", cmd_replicate,
-         ("bootstrap", "strategy", "tables", "monthly_extras")),
-        ("grid", cmd_grid, ("js", "ks", "bootstrap")),
+         ("bootstrap", "strategy", "tables", "tearsheet", "monthly_extras")),
+        ("grid", cmd_grid, ("js", "ks", "bootstrap", "tearsheet")),
         ("doublesort", cmd_doublesort, ("doublesort",)),
         ("sweep", cmd_sweep, ("js", "ks", "min_months")),
         ("intraday", cmd_intraday, ("model",)),
@@ -710,9 +732,11 @@ def build_parser() -> argparse.ArgumentParser:
         if "tables" in extra:
             sp.add_argument("--tables", action="store_true",
                             help="print the paper-style per-decile table")
+        if "tearsheet" in extra:
             sp.add_argument("--tearsheet", action="store_true",
                             help="print the full risk tearsheet (drawdown, "
-                                 "Calmar, Sortino, tails, per-year returns)")
+                                 "Calmar, Sortino, tails; per-cell tables "
+                                 "for grid)")
         if "monthly_extras" in extra:
             sp.add_argument("--tc-bps", dest="tc_bps", type=float,
                             help="also report the spread net of linear "

@@ -260,3 +260,39 @@ def test_cli_sector_map_combo_rejected_cleanly(tmp_path, capsys):
     ])
     assert rc == 2
     assert "TPU engine" in capsys.readouterr().err
+
+
+@requires_reference
+def test_cli_grid_tearsheet_tables(tmp_path, capsys):
+    rc = main([
+        "grid", "--data-dir", REFERENCE_DATA, "--js", "6,12", "--ks", "3",
+        "--tearsheet", "--bootstrap", "0",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for name in ("max drawdown", "Calmar", "hit rate"):
+        assert name in out
+
+
+@requires_reference
+def test_cli_sector_map_no_match_errors(tmp_path, capsys):
+    sm = tmp_path / "s.csv"
+    sm.write_text("ticker,sector\nZZZQ,none\n")
+    with pytest.raises(SystemExit, match="no entry matches"):
+        main(["replicate", "--data-dir", REFERENCE_DATA,
+              "--sector-map", str(sm)])
+
+
+@requires_reference
+def test_cli_tc_bps_zero_reports_net_equals_gross(tmp_path, capsys):
+    rc = main([
+        "replicate", "--data-dir", REFERENCE_DATA, "--out", str(tmp_path),
+        "--backend", "pandas", "--tc-bps", "0",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import re
+
+    gross = float(re.search(r"Mean monthly spread: (\S+)", out).group(1))
+    net = float(re.search(r"net of 0 bps.*mean ([+-][0-9.]+)", out).group(1))
+    assert net == pytest.approx(gross, abs=1e-6)
