@@ -303,18 +303,10 @@ def cmd_grid(args) -> int:
     if getattr(args, "tearsheet", False):
         import pandas as pd
 
-        from csmom_tpu.analytics import tearsheet
-
-        # one batched call: every cell's risk stats reduce together
-        ts = tearsheet(np.nan_to_num(np.asarray(res.spreads)),
-                       np.asarray(res.spread_valid), freq_per_year=12)
-        for name, field in (("max drawdown", ts.max_drawdown),
-                            ("Calmar", ts.calmar),
-                            ("hit rate", ts.hit_rate)):
-            df = pd.DataFrame(np.asarray(field), index=pd.Index(Js, name="J"),
-                              columns=pd.Index(Ks, name="K"))
-            print(f"\n{name}:")
-            print(df.round(4).to_string())
+        _print_cell_tearsheets(
+            res.spreads, res.spread_valid,
+            pd.Index(Js, name="J"), pd.Index(Ks, name="K"),
+        )
 
     n_boot = args.bootstrap if getattr(args, "bootstrap", None) is not None else 200
     if n_boot > 0:  # default inference: per-cell block-bootstrap mean CIs
@@ -622,6 +614,68 @@ def cmd_bench(args) -> int:
     return subprocess.call([sys.executable, "bench.py"])
 
 
+def _print_cell_tearsheets(spreads, spread_valid, index, columns):
+    """Shared per-cell risk tables for grid-shaped results (grid/residual):
+    one batched tearsheet call, one table per field."""
+    import numpy as np
+    import pandas as pd
+
+    from csmom_tpu.analytics import tearsheet
+
+    ts = tearsheet(np.nan_to_num(np.asarray(spreads)),
+                   np.asarray(spread_valid), freq_per_year=12)
+    for name, field in (("max drawdown", ts.max_drawdown),
+                        ("Calmar", ts.calmar),
+                        ("hit rate", ts.hit_rate)):
+        df = pd.DataFrame(np.asarray(field), index=index, columns=columns)
+        print(f"\n{name}:")
+        print(df.round(4).to_string())
+
+
+def cmd_residual(args) -> int:
+    """Residual-momentum (lookback x est_window) hyperparameter grid in one
+    compiled call; prints mean / NW-t / Sharpe tables per cell."""
+    import numpy as np
+    import pandas as pd
+
+    cfg = _load_cfg(args)
+    Js = ([int(j) for j in args.js.split(",")] if getattr(args, "js", None)
+          else [3, 6, 12])
+    Ws = ([int(w) for w in args.est_windows.split(",")]
+          if getattr(args, "est_windows", None) else [12, 24, 36])
+    bad = [(j, w) for j in Js for w in Ws if w < max(j, 3)]
+    if bad:
+        print("structurally invalid cells (est_window < max(lookback, 3)) "
+              "will be all-NaN: "
+              + ", ".join(f"J={j}/W={w}" for j, w in bad), file=sys.stderr)
+    prices, _ = _price_panel(cfg)
+    v, m = prices.device()
+
+    from csmom_tpu.signals.residual import residual_sweep_backtest
+
+    res = residual_sweep_backtest(
+        v, m, np.asarray(Js), np.asarray(Ws), skip=cfg.momentum.skip,
+        n_bins=cfg.momentum.n_bins, mode=cfg.momentum.mode,
+    )
+
+    def table(field):
+        return pd.DataFrame(np.asarray(field), index=pd.Index(Js, name="J"),
+                            columns=pd.Index(Ws, name="est_window"))
+
+    for name, field in (("mean monthly spread", res.mean_spread),
+                        ("Newey-West t-stat", res.tstat_nw),
+                        ("annualized Sharpe", res.ann_sharpe)):
+        print(f"\n{name}:")
+        print(table(field).round(4).to_string())
+
+    if getattr(args, "tearsheet", False):
+        _print_cell_tearsheets(
+            res.spreads, res.spread_valid,
+            pd.Index(Js, name="J"), pd.Index(Ws, name="est_window"),
+        )
+    return 0
+
+
 def cmd_strategies(args) -> int:
     """List registered strategy plugins (name, parameters, description)."""
     import dataclasses
@@ -704,6 +758,7 @@ def build_parser() -> argparse.ArgumentParser:
         ("intraday", cmd_intraday, ("model",)),
         ("horizons", cmd_horizons, ("horizons",)),
         ("fetch", cmd_fetch, ("fetch",)),
+        ("residual", cmd_residual, ("js", "est_windows", "tearsheet")),
         ("strategies", cmd_strategies, ()),
         ("bench", cmd_bench, ()),
     ):
@@ -711,7 +766,12 @@ def build_parser() -> argparse.ArgumentParser:
         _add_common(sp)
         if "js" in extra:
             sp.add_argument("--js", help="comma-separated J values")
+        if "ks" in extra:
             sp.add_argument("--ks", help="comma-separated K values")
+        if "est_windows" in extra:
+            sp.add_argument("--est-windows", dest="est_windows",
+                            help="comma-separated OLS estimation windows "
+                                 "(months; default 12,24,36)")
         if name == "grid":
             sp.add_argument("--shards", type=int, metavar="N",
                             help="run the grid asset-sharded over an N-device "

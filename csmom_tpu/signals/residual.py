@@ -49,39 +49,14 @@ from csmom_tpu.ops.rolling import _windowed_prefix_diff
 from csmom_tpu.signals.momentum import monthly_returns
 
 
-@partial(jax.jit, static_argnames=("lookback", "skip", "est_window",
-                                   "scale_by_vol"))
-def residual_momentum(
-    prices,
-    mask,
-    lookback: int = 12,
-    skip: int = 1,
-    est_window: int = 36,
-    scale_by_vol: bool = True,
-):
-    """Market-model residual momentum score per (asset, month).
-
-    Args:
-      prices: f[A, M] month-end price panel (NaN at masked slots).
-      mask: bool[A, M].
-      lookback: formation months J whose residuals are averaged.
-      skip: most-recent months excluded (both windows end at t - skip).
-      est_window: trailing months for the per-asset market-model OLS;
-        must be >= lookback (the formation window is its tail) and >= 3.
-      scale_by_vol: divide the mean residual by the formation-window
-        residual std (the paper's volatility-scaled "iMom" variant);
-        ``False`` ranks on the raw residual mean.
-
-    Returns:
-      ``(score f[A, M], valid bool[A, M])`` — valid requires every month
-      of the estimation window observed for that asset and a
-      well-conditioned regression (non-degenerate market variance).
-    """
-    if est_window < max(lookback, 3):
-        raise ValueError(
-            f"est_window={est_window} must be >= max(lookback, 3)="
-            f"{max(lookback, 3)}"
-        )
+def _residual_score(prices, mask, lookback, skip: int, est_window,
+                    scale_by_vol: bool):
+    """Body of :func:`residual_momentum` with possibly-*traced* window
+    scalars.  ``lookback`` / ``est_window`` enter only through prefix-sum
+    gather indices and count comparisons, so a whole (J, W) parameter grid
+    can run as nested ``vmap``s over one trace — the same trick as
+    ``momentum_dynamic``.  A misconfigured traced cell (est_window <
+    lookback or < 3) comes back all-invalid rather than raising."""
     dt = prices.dtype
     A, M = prices.shape
     r, r_valid = monthly_returns(prices, mask)
@@ -109,9 +84,11 @@ def residual_momentum(
     E = moments(est_window)   # estimation window (OLS)
     F = moments(lookback)     # formation window (residual mean/std)
 
-    # OLS on the estimation window
+    # OLS on the estimation window; a traced cell with est_window <
+    # max(lookback, 3) is structurally invalid rather than an error
     denom = E["n"] * E["mm"] - E["m"] ** 2
-    ok_reg = (E["n"] >= est_window) & (denom > 0)
+    ok_cfg = jnp.asarray(est_window) >= jnp.maximum(jnp.asarray(lookback), 3)
+    ok_reg = (E["n"] >= est_window) & (denom > 0) & ok_cfg
     safe_denom = jnp.where(ok_reg, denom, 1.0)
     beta = (E["n"] * E["rm"] - E["r"] * E["m"]) / safe_denom
     alpha = (E["r"] - beta * E["m"]) / jnp.maximum(E["n"], 1.0)
@@ -146,3 +123,118 @@ def residual_momentum(
     else:
         score = mean_e
     return jnp.where(ok, score, jnp.nan), ok
+
+
+@partial(jax.jit, static_argnames=("lookback", "skip", "est_window",
+                                   "scale_by_vol"))
+def residual_momentum(
+    prices,
+    mask,
+    lookback: int = 12,
+    skip: int = 1,
+    est_window: int = 36,
+    scale_by_vol: bool = True,
+):
+    """Market-model residual momentum score per (asset, month).
+
+    Args:
+      prices: f[A, M] month-end price panel (NaN at masked slots).
+      mask: bool[A, M].
+      lookback: formation months J whose residuals are averaged.
+      skip: most-recent months excluded (both windows end at t - skip).
+      est_window: trailing months for the per-asset market-model OLS;
+        must be >= lookback (the formation window is its tail) and >= 3.
+      scale_by_vol: divide the mean residual by the formation-window
+        residual std (the paper's volatility-scaled "iMom" variant);
+        ``False`` ranks on the raw residual mean.
+
+    Returns:
+      ``(score f[A, M], valid bool[A, M])`` — valid requires every month
+      of the estimation window observed for that asset and a
+      well-conditioned regression (non-degenerate market variance).
+    """
+    if est_window < max(lookback, 3):
+        raise ValueError(
+            f"est_window={est_window} must be >= max(lookback, 3)="
+            f"{max(lookback, 3)}"
+        )
+    return _residual_score(prices, mask, lookback, skip, est_window,
+                           scale_by_vol)
+
+
+@partial(jax.jit, static_argnames=("skip", "scale_by_vol"))
+def residual_momentum_sweep(
+    prices,
+    mask,
+    lookbacks,
+    est_windows,
+    skip: int = 1,
+    scale_by_vol: bool = True,
+):
+    """Every (lookback, est_window) residual-momentum score in one call.
+
+    The window lengths enter :func:`_residual_score` only as traced
+    scalars, so the whole hyperparameter grid is two nested ``vmap``s over
+    one trace — no per-cell compilation, the direct analogue of the J x K
+    momentum grid.
+
+    Returns ``(scores f[nJ, nW, A, M], valid bool[nJ, nW, A, M])``; cells
+    with ``est_window < max(lookback, 3)`` are all-invalid.
+    """
+    lookbacks = jnp.asarray(lookbacks)
+    est_windows = jnp.asarray(est_windows)
+
+    def cell(J, W):
+        return _residual_score(prices, mask, J, skip, W, scale_by_vol)
+
+    return jax.vmap(lambda J: jax.vmap(lambda W: cell(J, W))(est_windows))(
+        lookbacks
+    )
+
+
+@partial(jax.jit, static_argnames=("skip", "scale_by_vol", "n_bins", "mode",
+                                   "freq"))
+def residual_sweep_backtest(
+    prices,
+    mask,
+    lookbacks,
+    est_windows,
+    skip: int = 1,
+    scale_by_vol: bool = True,
+    n_bins: int = 10,
+    mode: str = "rank",
+    freq: int = 12,
+):
+    """Decile backtest of the full (lookback, est_window) residual grid.
+
+    One compiled call: sweep scores (nested vmap), per-cell decile labels,
+    and the shared monthly-engine tail per cell.  Returns a
+    :class:`csmom_tpu.backtest.grid.GridResult` with the ``nK`` axis
+    reinterpreted as the ``est_window`` axis (1-month holding throughout,
+    so ``tstat_nw`` uses the auto bandwidth, not a holding-period lag) —
+    every GridResult consumer (tables, batched tearsheets) works on it
+    unchanged.
+    """
+    from csmom_tpu.backtest.grid import GridResult
+    from csmom_tpu.backtest.monthly import _assemble_result
+    from csmom_tpu.ops.ranking import decile_assign_panel
+
+    scores, valid = residual_momentum_sweep(
+        prices, mask, lookbacks, est_windows, skip=skip,
+        scale_by_vol=scale_by_vol,
+    )
+    r, r_valid = monthly_returns(prices, mask)
+
+    def cell(score, ok):
+        labels, _ = decile_assign_panel(score, ok, n_bins, mode=mode)
+        return _assemble_result(r, r_valid, labels, n_bins, freq)
+
+    res = jax.vmap(jax.vmap(cell))(scores, valid)
+    return GridResult(
+        spreads=res.spread,
+        spread_valid=res.spread_valid,
+        mean_spread=res.mean_spread,
+        ann_sharpe=res.ann_sharpe,
+        tstat=res.tstat,
+        tstat_nw=res.tstat_nw,
+    )

@@ -296,3 +296,17 @@ def test_cli_tc_bps_zero_reports_net_equals_gross(tmp_path, capsys):
     gross = float(re.search(r"Mean monthly spread: (\S+)", out).group(1))
     net = float(re.search(r"net of 0 bps.*mean ([+-][0-9.]+)", out).group(1))
     assert net == pytest.approx(gross, abs=1e-6)
+
+
+@requires_reference
+def test_cli_residual_sweep_tables(capsys):
+    rc = main([
+        "residual", "--data-dir", REFERENCE_DATA, "--js", "3,6",
+        "--est-windows", "12,24", "--tearsheet",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "est_window" in out
+    for name in ("mean monthly spread", "Newey-West t-stat",
+                 "annualized Sharpe", "max drawdown", "Calmar"):
+        assert name in out

@@ -119,3 +119,67 @@ def test_plugin_runs_through_engine(rng):
     assert not np.allclose(
         np.asarray(res.spread)[both], np.asarray(raw.spread)[both]
     )
+
+
+def test_sweep_matches_per_cell_calls(rng):
+    """Each (J, W) sweep cell is bit-identical to the static single call."""
+    from csmom_tpu.signals.residual import residual_momentum_sweep
+
+    prices, mask = _panel(rng, A=10, M=70)
+    Js = np.array([3, 6])
+    Ws = np.array([12, 18])
+    scores, valid = residual_momentum_sweep(prices, mask, Js, Ws, skip=1)
+    assert scores.shape == (2, 2, 10, 70)
+    for i, J in enumerate(Js):
+        for j, W in enumerate(Ws):
+            s1, v1 = residual_momentum(prices, mask, lookback=int(J),
+                                       skip=1, est_window=int(W))
+            np.testing.assert_array_equal(np.asarray(valid)[i, j],
+                                          np.asarray(v1))
+            np.testing.assert_allclose(
+                np.asarray(scores)[i, j][np.asarray(v1)],
+                np.asarray(s1)[np.asarray(v1)], rtol=1e-12,
+            )
+
+
+def test_sweep_misconfigured_cell_is_invalid_not_fatal(rng):
+    """A cell with est_window < lookback comes back all-invalid while the
+    well-formed cells are untouched."""
+    from csmom_tpu.signals.residual import residual_momentum_sweep
+
+    prices, mask = _panel(rng, A=8, M=60)
+    scores, valid = residual_momentum_sweep(
+        prices, mask, np.array([6, 12]), np.array([9, 18]), skip=1
+    )
+    v = np.asarray(valid)
+    assert not v[1, 0].any()   # J=12, W=9 < J: structurally invalid
+    assert v[0, 0].any() and v[0, 1].any() and v[1, 1].any()
+
+
+def test_sweep_backtest_matches_strategy_engine(rng):
+    """residual_sweep_backtest's per-cell spreads equal the strategy engine
+    run at the same parameters."""
+    from csmom_tpu.signals.residual import residual_sweep_backtest
+    from csmom_tpu.strategy import ResidualMomentum
+
+    prices, mask = _panel(rng, A=12, M=80, hole_frac=0.0)
+    Js = np.array([3, 6])
+    Ws = np.array([12, 18])
+    grid = residual_sweep_backtest(prices, mask, Js, Ws, n_bins=3,
+                                   mode="rank")
+    for i, J in enumerate(Js):
+        for j, W in enumerate(Ws):
+            one = strategy_backtest(
+                prices, mask,
+                ResidualMomentum(lookback=int(J), skip=1, est_window=int(W)),
+                n_bins=3, mode="rank",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(grid.spread_valid)[i, j],
+                np.asarray(one.spread_valid),
+            )
+            v = np.asarray(one.spread_valid)
+            np.testing.assert_allclose(
+                np.asarray(grid.spreads)[i, j][v],
+                np.asarray(one.spread)[v], rtol=1e-11,
+            )
