@@ -418,16 +418,11 @@ def cmd_sweep(args) -> int:
         skip=cfg.momentum.skip, n_bins=cfg.momentum.n_bins, mode=cfg.momentum.mode,
         min_months=args.min_months or cfg.grid.walk_forward_min_months,
     )
-    choice = np.asarray(wf.choice)
-    live = choice >= 0
-    picked = [(Js[c // len(Ks)], Ks[c % len(Ks)]) for c in choice[live]]
+    top, _n_live = _most_picked(wf.choice, Js, Ks, "J", "K")
     print(f"OOS months:        {int(np.asarray(wf.oos_valid).sum())}")
     print(f"OOS mean spread:   {float(wf.mean_spread):.6f}")
     print(f"OOS ann. Sharpe:   {float(wf.ann_sharpe):.4f}")
-    if picked:
-        from collections import Counter
-
-        top = Counter(picked).most_common(3)
+    if top:
         print("Most-selected cells:", ", ".join(f"J={j}/K={k} x{n}" for (j, k), n in top))
     return 0
 
@@ -476,6 +471,7 @@ def cmd_intraday(args) -> int:
 
     from csmom_tpu.backtest.event import cost_attribution
 
+    bar = np.asarray(res.bar_mask)
     tca = cost_attribution(res, dense_price,
                            size_shares=cfg.intraday.size_shares)
     print(f"Costs:       ${float(tca.total_cost):,.2f} "
@@ -483,6 +479,22 @@ def cmd_intraday(args) -> int:
           f" traded; spread ${float(tca.spread_cost):,.2f}, "
           f"impact ${float(tca.impact_cost):,.2f}) — "
           f"gross PnL ${float(tca.gross_pnl):,.2f}")
+
+    if getattr(args, "tearsheet", False):
+        import pandas as pd
+
+        from csmom_tpu.analytics import format_tearsheet, tearsheet
+
+        # minute PnL -> calendar-day returns on starting capital: the
+        # standard daily tearsheet for an intraday strategy
+        days = pd.DatetimeIndex(np.asarray(compact.times)[bar]).normalize()
+        daily = pd.Series(np.asarray(res.pnl)[bar], index=days).groupby(level=0).sum()
+        rets = (daily / cfg.intraday.cash0).to_numpy()
+        print()
+        print(format_tearsheet(
+            tearsheet(rets, np.isfinite(rets), freq_per_year=252),
+            label=f"daily PnL / ${cfg.intraday.cash0:,.0f} start",
+        ))
 
     from csmom_tpu.analytics.plots import save_intraday_pnl_plot, save_trades_csv
     from csmom_tpu.backtest.event import trades_dataframe
@@ -492,7 +504,6 @@ def cmd_intraday(args) -> int:
         size_shares=cfg.intraday.size_shares,
     )
     out_csv = save_trades_csv(trades, cfg.results_dir)
-    bar = np.asarray(res.bar_mask)
     out_png = save_intraday_pnl_plot(
         np.asarray(compact.times)[bar], np.asarray(res.pnl)[bar], cfg.results_dir
     )
@@ -614,6 +625,24 @@ def cmd_bench(args) -> int:
     return subprocess.call([sys.executable, "bench.py"])
 
 
+def _most_picked(choice, row_labels, col_labels, row_name, col_name, top_n=3):
+    """Decode a walk-forward flat cell index path into the top-N
+    most-selected (row, col) cells: ``[((row, col), count), ...]``.
+    Shared by the sweep and residual subcommands so the -1-sentinel /
+    flat-index semantics live in one place."""
+    from collections import Counter
+
+    import numpy as np
+
+    choice = np.asarray(choice)
+    live = choice >= 0
+    picked = [
+        (row_labels[c // len(col_labels)], col_labels[c % len(col_labels)])
+        for c in choice[live]
+    ]
+    return Counter(picked).most_common(top_n), int(live.sum())
+
+
 def _print_cell_tearsheets(spreads, spread_valid, index, columns):
     """Shared per-cell risk tables for grid-shaped results (grid/residual):
     one batched tearsheet call, one table per field."""
@@ -673,6 +702,24 @@ def cmd_residual(args) -> int:
             res.spreads, res.spread_valid,
             pd.Index(Js, name="J"), pd.Index(Ws, name="est_window"),
         )
+
+    if getattr(args, "sweep", False):
+        from csmom_tpu.backtest.walkforward import walk_forward_select
+
+        wf = walk_forward_select(
+            res.spreads, res.spread_valid,
+            min_months=getattr(args, "min_months", None)
+            or cfg.grid.walk_forward_min_months,
+        )
+        print(f"\nwalk-forward (expanding in-sample Sharpe selection): "
+              f"OOS mean {float(wf.mean_spread):+.6f}, "
+              f"Sharpe {float(wf.ann_sharpe):.4f}, "
+              f"NW t {float(wf.tstat_nw):+.3f}")
+        top, n_live = _most_picked(wf.choice, Js, Ws, "J", "est_window")
+        if top:
+            (j, w), n = top[0]
+            print(f"most-picked cell: J={j}, est_window={w} "
+                  f"({n}/{n_live} months)")
     return 0
 
 
@@ -755,10 +802,11 @@ def build_parser() -> argparse.ArgumentParser:
         ("grid", cmd_grid, ("js", "ks", "bootstrap", "tearsheet")),
         ("doublesort", cmd_doublesort, ("doublesort",)),
         ("sweep", cmd_sweep, ("js", "ks", "min_months")),
-        ("intraday", cmd_intraday, ("model",)),
+        ("intraday", cmd_intraday, ("model", "tearsheet")),
         ("horizons", cmd_horizons, ("horizons",)),
         ("fetch", cmd_fetch, ("fetch",)),
-        ("residual", cmd_residual, ("js", "est_windows", "tearsheet")),
+        ("residual", cmd_residual,
+         ("js", "est_windows", "tearsheet", "wf", "min_months")),
         ("strategies", cmd_strategies, ()),
         ("bench", cmd_bench, ()),
     ):
@@ -772,6 +820,10 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--est-windows", dest="est_windows",
                             help="comma-separated OLS estimation windows "
                                  "(months; default 12,24,36)")
+        if "wf" in extra:
+            sp.add_argument("--sweep", action="store_true",
+                            help="also walk-forward the grid (out-of-sample "
+                                 "expanding-window cell selection)")
         if name == "grid":
             sp.add_argument("--shards", type=int, metavar="N",
                             help="run the grid asset-sharded over an N-device "

@@ -310,3 +310,27 @@ def test_cli_residual_sweep_tables(capsys):
     for name in ("mean monthly spread", "Newey-West t-stat",
                  "annualized Sharpe", "max drawdown", "Calmar"):
         assert name in out
+
+
+@requires_reference
+def test_cli_residual_walkforward(capsys):
+    rc = main([
+        "residual", "--data-dir", REFERENCE_DATA, "--js", "3,6",
+        "--est-windows", "12,24", "--sweep",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "walk-forward" in out
+    assert "most-picked cell" in out
+
+
+@requires_reference
+def test_cli_intraday_daily_tearsheet(tmp_path, capsys):
+    rc = main([
+        "intraday", "--data-dir", REFERENCE_DATA, "--out", str(tmp_path),
+        "--tearsheet",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "daily PnL" in out
+    assert "Max drawdown" in out
