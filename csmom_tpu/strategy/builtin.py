@@ -127,18 +127,54 @@ class VolumeZMomentum(Strategy):
         return jnp.where(valid, score, jnp.nan), valid
 
 
+def parse_combo_spec(spec: str) -> tuple:
+    """``"momentum:0.6,reversal:0.4"`` -> ((Momentum(), 0.6), (Reversal(), 0.4)).
+
+    The CLI-facing constructor for :class:`ZScoreCombo` components: each
+    comma-separated term is ``name[:weight]`` (weight defaults to 1.0),
+    where ``name`` is any registered strategy instantiated with its
+    defaults.  For parametrized components use the Python API.
+    """
+    from csmom_tpu.strategy.base import make_strategy
+
+    out = []
+    for term in spec.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        name, _, w = term.partition(":")
+        try:
+            weight = float(w) if w else 1.0
+        except ValueError:
+            raise ValueError(
+                f"combo term {term!r}: weight {w!r} is not a number"
+            ) from None
+        out.append((make_strategy(name.strip()), weight))
+    if not out:
+        raise ValueError(f"empty combo spec {spec!r}")
+    return tuple(out)
+
+
 @register_strategy("zscore_combo")
 @dataclasses.dataclass(frozen=True)
 class ZScoreCombo(Strategy):
     """Weighted sum of cross-sectionally z-scored component strategies.
 
     ``components`` is a tuple of ``(Strategy, weight)`` pairs (tuple so the
-    combo stays hashable/jit-static).  A slot is valid only where every
-    component is valid — matching how the reference's dropna would treat a
-    multi-column signal frame.
+    combo stays hashable/jit-static), or a CLI-friendly string spec like
+    ``"momentum:0.6,reversal:0.4"`` (parsed by :func:`parse_combo_spec` at
+    construction).  A slot is valid only where every component is valid —
+    matching how the reference's dropna would treat a multi-column signal
+    frame.
     """
 
     components: tuple = ()
+
+    def __post_init__(self):
+        if isinstance(self.components, str):
+            object.__setattr__(
+                self, "components", parse_combo_spec(self.components)
+            )
 
     @property
     def panel_names(self):

@@ -206,3 +206,45 @@ def test_cross_backend_parity_residual_momentum(rng):
     pdr = run_monthly(panel, n_bins=5, backend="pandas", strategy=strat)
     np.testing.assert_array_equal(tpu.labels, pdr.labels)
     np.testing.assert_allclose(tpu.spread, pdr.spread, rtol=1e-9, equal_nan=True)
+
+
+def test_zscore_combo_string_spec(rng):
+    """The CLI-friendly "name:weight,..." spec builds the same combo as the
+    tuple API, and bad specs fail loudly."""
+    from csmom_tpu.strategy import Momentum, Reversal, ZScoreCombo
+    from csmom_tpu.strategy.builtin import parse_combo_spec
+
+    prices, mask = _toy(rng)
+    by_str = ZScoreCombo(components="momentum:0.6, reversal:0.4")
+    by_tup = ZScoreCombo(components=((Momentum(), 0.6), (Reversal(), 0.4)))
+    a = strategy_backtest(prices, mask, by_str, n_bins=5)
+    b = strategy_backtest(prices, mask, by_tup, n_bins=5)
+    np.testing.assert_array_equal(np.asarray(a.labels), np.asarray(b.labels))
+
+    assert parse_combo_spec("momentum")[0][1] == 1.0
+    with pytest.raises(ValueError, match="not a number"):
+        parse_combo_spec("momentum:abc")
+    with pytest.raises(KeyError, match="unknown strategy"):
+        parse_combo_spec("nope:1.0")
+    with pytest.raises(ValueError, match="empty"):
+        parse_combo_spec(" , ")
+
+
+def test_zscore_combo_string_spec_via_cli_parsing(rng):
+    """--strategy zscore_combo --strategy-arg components=momentum:1 works
+    through the REAL CLI channel: _parse_strategy's literal_eval fallback
+    must deliver the spec to __post_init__ as a string."""
+    import argparse
+
+    from csmom_tpu.cli.main import _load_cfg, _parse_strategy
+
+    ns = argparse.Namespace(
+        strategy="zscore_combo",
+        strategy_arg=["components=momentum:0.5,reversal:0.5"],
+        lookback=None, skip=None, config=None,
+    )
+    s = _parse_strategy(ns, _load_cfg(ns))
+    assert len(s.components) == 2
+    prices, mask = _toy(rng)
+    res = strategy_backtest(prices, mask, s, n_bins=5)
+    assert np.asarray(res.spread_valid).any()
