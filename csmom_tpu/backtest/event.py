@@ -369,3 +369,40 @@ def cost_attribution(result: EventResult, price, size_shares: int = 50,
             gross_notional > 0, total_cost / gross_notional * 1e4, jnp.nan
         ),
     )
+
+
+def threshold_sweep(price, valid, score, adv, vol, thresholds, **kwargs):
+    """Event backtest at every score threshold in one vmapped call.
+
+    The reference hardcodes ``threshold=1e-5`` (``run_demo.py:180``) with
+    no way to ask the obvious next question — how sensitive are PnL and
+    trade count to it.  ``threshold`` is a traced argument of
+    :func:`event_backtest`, so the whole sensitivity curve is one
+    ``vmap``: every other input is closed over, XLA batches the prefix
+    sums, and no per-threshold recompilation happens.
+
+    Args:
+      thresholds: f[N] thresholds (ascending recommended for readability).
+      **kwargs: forwarded to :func:`event_backtest` (sizes, costs, latency
+        — anything but ``threshold``).
+
+    Returns ``(total_pnl f[N], n_trades i32[N], cost_bps f[N])`` —
+    ``cost_bps`` is :func:`cost_attribution`'s total slippage over gross
+    mid notional per threshold (NaN where nothing traded).  Latency runs
+    raise, via the same guard: delayed fills cannot be attributed against
+    the decision-bar mid.
+    """
+    thresholds = jnp.asarray(thresholds)
+    size_shares = kwargs.get("size_shares", 50)
+    spread = kwargs.get("spread", 0.001)
+    latency_bars = kwargs.get("latency_bars", 0)
+    kwargs = {k: v for k, v in kwargs.items() if k != "threshold"}
+
+    def one(th):
+        r = event_backtest(price, valid, score, adv, vol, threshold=th,
+                           **kwargs)
+        tca = cost_attribution(r, price, size_shares=size_shares,
+                               spread=spread, latency_bars=latency_bars)
+        return r.total_pnl, r.n_trades, tca.cost_bps
+
+    return jax.vmap(one)(thresholds)

@@ -453,7 +453,7 @@ def cmd_intraday(args) -> int:
     extra = {}
     if getattr(args, "l1_ratio", None) is not None:
         extra["l1_ratio"] = args.l1_ratio
-    res, fit, compact, dense_score, dense_price, _v = intraday_pipeline(
+    res, fit, compact, dense_score, dense_price, dense_valid = intraday_pipeline(
         minute_df, daily_df,
         window_minutes=cfg.intraday.window_minutes,
         n_splits=cfg.intraday.n_splits,
@@ -479,6 +479,24 @@ def cmd_intraday(args) -> int:
           f" traded; spread ${float(tca.spread_cost):,.2f}, "
           f"impact ${float(tca.impact_cost):,.2f}) — "
           f"gross PnL ${float(tca.gross_pnl):,.2f}")
+
+    if getattr(args, "threshold_sweep", None):
+        from csmom_tpu.api import daily_risk_maps
+        from csmom_tpu.backtest.event import threshold_sweep
+
+        ths = [float(t) for t in args.threshold_sweep.split(",")]
+        adv, vol = daily_risk_maps(daily_df, compact.tickers)
+        pnl, ntr, bps = threshold_sweep(
+            dense_price, dense_valid, np.nan_to_num(np.asarray(dense_score)),
+            np.asarray(adv), np.asarray(vol),
+            np.asarray(ths), size_shares=cfg.intraday.size_shares,
+            cash0=cfg.intraday.cash0,
+        )
+        print("\nthreshold sensitivity (one vmapped call):")
+        print(f"{'threshold':>12} {'trades':>8} {'PnL':>16} {'cost bps':>9}")
+        for t, p, n, b in zip(ths, np.asarray(pnl), np.asarray(ntr),
+                              np.asarray(bps)):
+            print(f"{t:>12g} {int(n):>8d} {float(p):>16,.2f} {float(b):>9.2f}")
 
     if getattr(args, "tearsheet", False):
         import pandas as pd
@@ -892,6 +910,10 @@ def build_parser() -> argparse.ArgumentParser:
                             help="regularization strength (mlp: weight decay)")
             sp.add_argument("--l1-ratio", dest="l1_ratio", type=float,
                             help="elastic-net l1 ratio (default 0.5)")
+            sp.add_argument("--threshold-sweep", dest="threshold_sweep",
+                            help="comma-separated score thresholds: print "
+                                 "PnL/trades/cost sensitivity (one vmapped "
+                                 "call)")
         if "strategy" in extra:
             sp.add_argument("--strategy",
                             help="registered strategy plugin to rank instead of "

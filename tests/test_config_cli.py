@@ -334,3 +334,20 @@ def test_cli_intraday_daily_tearsheet(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "daily PnL" in out
     assert "Max drawdown" in out
+
+
+@requires_reference
+def test_cli_intraday_threshold_sweep(tmp_path, capsys):
+    rc = main([
+        "intraday", "--data-dir", REFERENCE_DATA, "--out", str(tmp_path),
+        "--threshold-sweep", "1e-6,1e-5,1e-3",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "threshold sensitivity" in out
+    # the reference threshold lane reproduces the golden trade count
+    # (all-20-ticker panel: 28,020 + the AAPL trades the reference loses)
+    import re
+
+    row = re.search(r"1e-05\s+(\d+)", out)
+    assert row and int(row.group(1)) > 28_000

@@ -206,3 +206,37 @@ class TestCostAttribution:
         res, _ = self._run(rng)
         with pytest.raises(NotImplementedError, match="latency"):
             cost_attribution(res, np.ones((6, 120)), latency_bars=2)
+
+
+def test_threshold_sweep_matches_single_runs(rng):
+    """Each sweep lane equals a standalone run at that threshold; the trade
+    count is non-increasing in the threshold."""
+    from csmom_tpu.backtest.event import event_backtest, threshold_sweep
+
+    A, T = 5, 150
+    price = np.abs(rng.normal(100, 5, size=(A, T)))
+    valid = rng.random((A, T)) > 0.1
+    score = rng.normal(0, 3e-5, size=(A, T))
+    price = np.where(valid, price, np.nan)
+    adv = np.full(A, 1e5)
+    vol = np.full(A, 0.02)
+    ths = np.array([1e-6, 1e-5, 5e-5])
+
+    pnl, ntr, bps = threshold_sweep(price, valid, np.nan_to_num(score),
+                                    adv, vol, ths)
+    assert (np.diff(np.asarray(ntr)) <= 0).all()
+    for k, th in enumerate(ths):
+        one = event_backtest(price, valid, np.nan_to_num(score), adv, vol,
+                             threshold=float(th))
+        assert int(ntr[k]) == int(one.n_trades)
+        np.testing.assert_allclose(float(pnl[k]), float(one.total_pnl),
+                                   rtol=1e-12)
+
+
+def test_threshold_sweep_latency_guard(rng):
+    from csmom_tpu.backtest.event import threshold_sweep
+
+    price, valid, score, adv, vol = _scenario(rng)
+    with pytest.raises(NotImplementedError, match="latency"):
+        threshold_sweep(price, valid, np.nan_to_num(score), adv, vol,
+                        np.array([1e-5]), latency_bars=2)
