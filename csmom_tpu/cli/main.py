@@ -293,6 +293,26 @@ def cmd_grid(args) -> int:
 
     from csmom_tpu.analytics.tables import jk_grid_table
 
+    if getattr(args, "tc_bps", None) is not None and mode == "rank_hist":
+        print("--tc-bps: cost netting recomputes labels single-device and "
+              "has no rank_hist form; rerun with --mode rank", file=sys.stderr)
+    elif getattr(args, "tc_bps", None) is not None:
+        import pandas as pd
+
+        from csmom_tpu.backtest.grid import grid_net_of_costs
+
+        net = grid_net_of_costs(
+            np.asarray(v), np.asarray(m), np.asarray(Js), np.asarray(Ks),
+            res, half_spread=args.tc_bps / 1e4, skip=cfg.momentum.skip,
+            n_bins=cfg.momentum.n_bins, mode=mode,
+        )
+        print(f"\nmean monthly spread NET of {args.tc_bps:g} bps half-spread "
+              "turnover costs (exact overlapping-book turnover):")
+        print(pd.DataFrame(np.asarray(net.mean_spread),
+                           index=pd.Index(Js, name="J"),
+                           columns=pd.Index(Ks, name="K"))
+              .round(4).to_string())
+
     mean_df, tstat_df, sharpe_df = jk_grid_table(res.spreads, res.spread_valid, Js, Ks)
     for name, df in (("mean monthly spread", mean_df),
                      ("Newey-West t-stat (lag=K)", tstat_df),
@@ -817,7 +837,7 @@ def build_parser() -> argparse.ArgumentParser:
          ("bootstrap", "strategy", "tables", "tearsheet", "monthly_extras")),
         ("replicate", cmd_replicate,
          ("bootstrap", "strategy", "tables", "tearsheet", "monthly_extras")),
-        ("grid", cmd_grid, ("js", "ks", "bootstrap", "tearsheet")),
+        ("grid", cmd_grid, ("js", "ks", "bootstrap", "tearsheet", "tc")),
         ("doublesort", cmd_doublesort, ("doublesort",)),
         ("sweep", cmd_sweep, ("js", "ks", "min_months")),
         ("intraday", cmd_intraday, ("model", "tearsheet")),
@@ -867,11 +887,12 @@ def build_parser() -> argparse.ArgumentParser:
                             help="print the full risk tearsheet (drawdown, "
                                  "Calmar, Sortino, tails; per-cell tables "
                                  "for grid)")
-        if "monthly_extras" in extra:
+        if "monthly_extras" in extra or "tc" in extra:
             sp.add_argument("--tc-bps", dest="tc_bps", type=float,
                             help="also report the spread net of linear "
                                  "transaction costs at this half-spread "
                                  "(bps per unit weight turnover)")
+        if "monthly_extras" in extra:
             sp.add_argument("--sector-map", dest="sector_map",
                             help="ticker,sector CSV: rank within sectors "
                                  "(sector-neutral momentum; TPU engine)")

@@ -351,3 +351,14 @@ def test_cli_intraday_threshold_sweep(tmp_path, capsys):
 
     row = re.search(r"1e-05\s+(\d+)", out)
     assert row and int(row.group(1)) > 28_000
+
+
+@requires_reference
+def test_cli_grid_tc_bps(capsys):
+    rc = main([
+        "grid", "--data-dir", REFERENCE_DATA, "--js", "6", "--ks", "1,6",
+        "--tc-bps", "5", "--bootstrap", "0",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "NET of 5 bps" in out

@@ -116,3 +116,62 @@ def test_gappy_panel_grid(rng):
     np.testing.assert_array_equal(np.where(got_valid)[0], sorted(want))
     for m in want:
         assert abs(got[m] - want[m]) < 1e-9
+
+
+class TestGridNetOfCosts:
+    def _setup(self, rng, A=40, M=90):
+        prices = 50 * np.exp(np.cumsum(rng.normal(0.004, 0.06, size=(A, M)), axis=1))
+        mask = np.ones((A, M), bool)
+        mask[: A // 8, : M // 4] = False
+        return prices, mask
+
+    def test_k1_matches_monthly_net_of_costs(self, rng):
+        """A K=1 grid cell's netted spread equals the monthly engine's
+        net_of_costs, shifted from formation-month to holding-month
+        indexing (grid month m = formation month m-1)."""
+        from csmom_tpu.backtest.grid import grid_net_of_costs, jk_grid_backtest
+        from csmom_tpu.backtest.monthly import monthly_spread_backtest, net_of_costs
+
+        prices, mask = self._setup(rng)
+        Js, Ks = np.array([6]), np.array([1])
+        hs = 7e-4
+        grid = jk_grid_backtest(prices, mask, Js, Ks, skip=1, n_bins=5,
+                                mode="rank")
+        net_grid = grid_net_of_costs(prices, mask, Js, Ks, grid,
+                                     half_spread=hs, skip=1, n_bins=5,
+                                     mode="rank")
+
+        mon = monthly_spread_backtest(prices, mask, lookback=6, skip=1,
+                                      n_bins=5, mode="rank")
+        net_m, _, _ = net_of_costs(mon, half_spread=hs, n_bins=5)
+
+        g = np.asarray(net_grid.spreads)[0, 0]
+        gv = np.asarray(net_grid.spread_valid)[0, 0]
+        m_ = np.asarray(net_m)
+        # holding month m <-> formation month m-1
+        both = gv[1:] & np.isfinite(m_[:-1])
+        assert both.any()
+        np.testing.assert_allclose(g[1:][both], m_[:-1][both], rtol=1e-9)
+
+    def test_costs_fall_with_k_and_validity_preserved(self, rng):
+        """Longer holding replaces ~1/K of the book per month, so the mean
+        per-month cost drag must decrease with K; validity is untouched."""
+        from csmom_tpu.backtest.grid import grid_net_of_costs, jk_grid_backtest
+
+        prices, mask = self._setup(rng, A=60, M=120)
+        Js, Ks = np.array([6]), np.array([1, 3, 6])
+        grid = jk_grid_backtest(prices, mask, Js, Ks, skip=1, n_bins=5,
+                                mode="rank")
+        net = grid_net_of_costs(prices, mask, Js, Ks, grid,
+                                half_spread=1e-3, skip=1, n_bins=5,
+                                mode="rank")
+        np.testing.assert_array_equal(np.asarray(net.spread_valid),
+                                      np.asarray(grid.spread_valid))
+        drag = []
+        for k in range(3):
+            v = np.asarray(grid.spread_valid)[0, k]
+            d = (np.asarray(grid.spreads)[0, k][v]
+                 - np.asarray(net.spreads)[0, k][v])
+            assert (d >= -1e-12).all()  # costs only subtract
+            drag.append(d.mean())
+        assert drag[0] > drag[1] > drag[2]
