@@ -315,24 +315,33 @@ def grid_net_of_costs(prices, mask, Js, Ks, grid: GridResult,
 
     Formation labels are recomputed with the grid's own kernels
     (``momentum_dynamic`` + ``decile_assign_panel``), so they are
-    bit-identical to the labels behind ``grid.spreads``.  Weights are the
-    formation-date books (a later missing return is a data hole, not a
-    trade).  ``Ks`` must be concrete here (each K is a static rolling
-    window).
+    bit-identical to the labels behind ``grid.spreads`` — PROVIDED
+    ``Js/skip/n_bins/mode`` are the exact values the grid was built with
+    (GridResult does not carry its parameters; a mismatch nets a
+    differently-binned book against the given spreads with no error —
+    pass the same config object to both calls, as the CLI does).
+    Weights are the formation-date books (a later missing return is a
+    data hole, not a trade).  ``Ks`` must be concrete here (each K is a
+    static rolling window).
 
     Returns a :class:`GridResult` of the netted spreads (same validity).
     """
     import numpy as np
 
+    Ks_c = tuple(int(k) for k in np.asarray(Ks))
+    return _grid_net_core(
+        jnp.asarray(prices), jnp.asarray(mask), jnp.asarray(Js),
+        grid.spreads, grid.spread_valid, half_spread,
+        Ks_c=Ks_c, skip=skip, n_bins=n_bins, mode=mode, freq=freq,
+    )
+
+
+@partial(jax.jit, static_argnames=("Ks_c", "skip", "n_bins", "mode", "freq"))
+def _grid_net_core(prices, mask, Js, spreads, spread_valid, half_spread,
+                   Ks_c: tuple, skip: int, n_bins: int, mode: str, freq: int):
     from csmom_tpu.costs.impact import long_short_weights, turnover_cost
-    from csmom_tpu.ops.rolling import _windowed_prefix_diff
 
-    Js = jnp.asarray(Js)
-    Ks_c = [int(k) for k in np.asarray(Ks)]
-    prices = jnp.asarray(prices)
-    mask = jnp.asarray(mask)
     A, M = prices.shape
-
     moms, mvalids = jax.vmap(
         lambda J: momentum_dynamic(prices, mask, J, skip)
     )(Js)
@@ -350,24 +359,28 @@ def grid_net_of_costs(prices, mask, Js, Ks, grid: GridResult,
         lambda l, c: long_short_weights(l, c, n_bins)
     )(labels, counts)                                  # f[nJ, A, M]
 
+    # one padded cumsum serves every K's trailing-window difference
+    c = jnp.cumsum(w_f, axis=-1)
+    cpad = jnp.concatenate([jnp.zeros_like(c[..., :1]), c], axis=-1)
     costs = []
     for K in Ks_c:
         # book at holding month m = mean of cohorts formed at m-K .. m-1
-        S = _windowed_prefix_diff(w_f, K)
+        lo = cpad[..., jnp.maximum(jnp.arange(M) + 1 - K, 0)]
+        S = cpad[..., 1:] - lo
         w_pf = jnp.pad(S, ((0, 0), (0, 0), (1, 0)))[..., :M] / K
         costs.append(turnover_cost(w_pf, half_spread))  # f[nJ, M]
     cost = jnp.stack(costs, axis=1)                    # f[nJ, nK, M]
 
-    net = jnp.where(grid.spread_valid, grid.spreads - cost, jnp.nan)
+    net = jnp.where(spread_valid, spreads - cost, jnp.nan)
     Ks_arr = jnp.asarray(Ks_c)
     return GridResult(
         spreads=net,
-        spread_valid=grid.spread_valid,
-        mean_spread=masked_mean(net, grid.spread_valid),
-        ann_sharpe=sharpe(net, grid.spread_valid, freq_per_year=freq),
-        tstat=t_stat(net, grid.spread_valid),
+        spread_valid=spread_valid,
+        mean_spread=masked_mean(net, spread_valid),
+        ann_sharpe=sharpe(net, spread_valid, freq_per_year=freq),
+        tstat=t_stat(net, spread_valid),
         # same HAC bandwidth as the gross grid (lag = K), so gross-vs-net
         # significance is an apples-to-apples comparison
-        tstat_nw=nw_t_stat(net, grid.spread_valid, lags=Ks_arr[None, :],
+        tstat_nw=nw_t_stat(net, spread_valid, lags=Ks_arr[None, :],
                            max_lag=max(Ks_c)),
     )

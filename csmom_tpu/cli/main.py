@@ -306,12 +306,19 @@ def cmd_grid(args) -> int:
             res, half_spread=args.tc_bps / 1e4, skip=cfg.momentum.skip,
             n_bins=cfg.momentum.n_bins, mode=mode,
         )
-        print(f"\nmean monthly spread NET of {args.tc_bps:g} bps half-spread "
-              "turnover costs (exact overlapping-book turnover):")
-        print(pd.DataFrame(np.asarray(net.mean_spread),
-                           index=pd.Index(Js, name="J"),
-                           columns=pd.Index(Ks, name="K"))
-              .round(4).to_string())
+
+        def _net_table(field):
+            return pd.DataFrame(np.asarray(field),
+                                index=pd.Index(Js, name="J"),
+                                columns=pd.Index(Ks, name="K"))
+
+        print(f"\nNET of {args.tc_bps:g} bps half-spread turnover costs "
+              "(exact overlapping-book turnover):")
+        for name, field in (("mean monthly spread", net.mean_spread),
+                            ("Newey-West t-stat (lag=K)", net.tstat_nw),
+                            ("annualized Sharpe", net.ann_sharpe)):
+            print(f"\n{name}, net:")
+            print(_net_table(field).round(4).to_string())
 
     mean_df, tstat_df, sharpe_df = jk_grid_table(res.spreads, res.spread_valid, Js, Ks)
     for name, df in (("mean monthly spread", mean_df),

@@ -175,3 +175,38 @@ class TestGridNetOfCosts:
             assert (d >= -1e-12).all()  # costs only subtract
             drag.append(d.mean())
         assert drag[0] > drag[1] > drag[2]
+
+    def test_overlapping_book_turnover_vs_loop_oracle(self, rng):
+        """K=3 netted costs equal an explicit cohort-loop reconstruction:
+        book at month m = mean of the 3 most recent formation books,
+        turnover = L1 weight change, cost = half_spread * turnover."""
+        from csmom_tpu.backtest.grid import grid_net_of_costs, jk_grid_backtest
+        from csmom_tpu.backtest.monthly import monthly_spread_backtest
+        from csmom_tpu.costs.impact import long_short_weights
+
+        prices, mask = self._setup(rng, A=30, M=70)
+        Js, Ks, K, hs, nb = np.array([6]), np.array([3]), 3, 1e-3, 5
+        grid = jk_grid_backtest(prices, mask, Js, Ks, skip=1, n_bins=nb,
+                                mode="rank")
+        net = grid_net_of_costs(prices, mask, Js, Ks, grid, half_spread=hs,
+                                skip=1, n_bins=nb, mode="rank")
+
+        # formation books from the monthly engine's labels (same kernels)
+        mon = monthly_spread_backtest(prices, mask, lookback=6, skip=1,
+                                      n_bins=nb, mode="rank")
+        w_f = np.asarray(long_short_weights(mon.labels, mon.decile_counts, nb))
+        A, M = w_f.shape
+        prev_book = np.zeros(A)
+        want_cost = np.zeros(M)
+        for m in range(M):
+            cohorts = [w_f[:, s] for s in range(max(m - K, 0), m)]
+            # the engine divides by K even during warm-up months (< K
+            # cohorts live), matching _holding_month_spreads' 1/K scale
+            book = (np.sum(cohorts, axis=0) / K if cohorts else np.zeros(A))
+            want_cost[m] = hs * np.abs(book - prev_book).sum()
+            prev_book = book
+
+        v = np.asarray(grid.spread_valid)[0, 0]
+        got_cost = (np.asarray(grid.spreads)[0, 0] -
+                    np.asarray(net.spreads)[0, 0])
+        np.testing.assert_allclose(got_cost[v], want_cost[v], rtol=1e-9)
