@@ -2,10 +2,12 @@
 
 from csmom_tpu.backtest.monthly import (
     monthly_spread_backtest,
+    net_of_costs,
+    net_of_costs_arrays,
     sector_neutral_backtest,
     MonthlyResult,
 )
-from csmom_tpu.backtest.grid import jk_grid_backtest, GridResult
+from csmom_tpu.backtest.grid import grid_net_of_costs, jk_grid_backtest, GridResult
 from csmom_tpu.backtest.horizon import (
     horizon_profile,
     HorizonProfile,
@@ -18,6 +20,7 @@ from csmom_tpu.backtest.event import (
     EventResult,
     cost_attribution,
     event_backtest,
+    threshold_sweep,
     trades_dataframe,
 )
 from csmom_tpu.backtest.walkforward import (
@@ -28,9 +31,12 @@ from csmom_tpu.backtest.walkforward import (
 
 __all__ = [
     "monthly_spread_backtest",
+    "net_of_costs",
+    "net_of_costs_arrays",
     "sector_neutral_backtest",
     "MonthlyResult",
     "jk_grid_backtest",
+    "grid_net_of_costs",
     "GridResult",
     "horizon_profile",
     "HorizonProfile",
@@ -45,5 +51,6 @@ __all__ = [
     "EventResult",
     "cost_attribution",
     "event_backtest",
+    "threshold_sweep",
     "trades_dataframe",
 ]

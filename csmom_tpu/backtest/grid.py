@@ -340,6 +340,7 @@ def grid_net_of_costs(prices, mask, Js, Ks, grid: GridResult,
 def _grid_net_core(prices, mask, Js, spreads, spread_valid, half_spread,
                    Ks_c: tuple, skip: int, n_bins: int, mode: str, freq: int):
     from csmom_tpu.costs.impact import long_short_weights, turnover_cost
+    from csmom_tpu.ops.rolling import _windowed_prefix_diff
 
     A, M = prices.shape
     moms, mvalids = jax.vmap(
@@ -359,14 +360,12 @@ def _grid_net_core(prices, mask, Js, spreads, spread_valid, half_spread,
         lambda l, c: long_short_weights(l, c, n_bins)
     )(labels, counts)                                  # f[nJ, A, M]
 
-    # one padded cumsum serves every K's trailing-window difference
-    c = jnp.cumsum(w_f, axis=-1)
-    cpad = jnp.concatenate([jnp.zeros_like(c[..., :1]), c], axis=-1)
+    # the per-K helper calls share one cumsum: the whole body is under one
+    # jit, so XLA CSE dedupes _windowed_prefix_diff's identical prefix sum
     costs = []
     for K in Ks_c:
         # book at holding month m = mean of cohorts formed at m-K .. m-1
-        lo = cpad[..., jnp.maximum(jnp.arange(M) + 1 - K, 0)]
-        S = cpad[..., 1:] - lo
+        S = _windowed_prefix_diff(w_f, K)
         w_pf = jnp.pad(S, ((0, 0), (0, 0), (1, 0)))[..., :M] / K
         costs.append(turnover_cost(w_pf, half_spread))  # f[nJ, M]
     cost = jnp.stack(costs, axis=1)                    # f[nJ, nK, M]

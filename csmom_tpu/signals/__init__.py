@@ -1,6 +1,11 @@
 """Signal engineering over masked panels: momentum, turnover, intraday."""
 
 from csmom_tpu.signals.momentum import monthly_returns, momentum, momentum_dynamic
+from csmom_tpu.signals.residual import (
+    residual_momentum,
+    residual_momentum_sweep,
+    residual_sweep_backtest,
+)
 from csmom_tpu.signals.turnover import (
     turnover_features,
     shares_outstanding_vector,
@@ -11,6 +16,9 @@ __all__ = [
     "monthly_returns",
     "momentum",
     "momentum_dynamic",
+    "residual_momentum",
+    "residual_momentum_sweep",
+    "residual_sweep_backtest",
     "turnover_features",
     "shares_outstanding_vector",
     "volume_tercile_labels",
