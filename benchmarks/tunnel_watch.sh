@@ -1,0 +1,28 @@
+#!/bin/bash
+# Watch for a live TPU tunnel window and capture the scaling benchmark.
+#
+# The image's axon backend flaps (up in ~25-minute windows, otherwise jax
+# backend init hangs), so a foreground "run it now" approach misses windows.
+# This loop probes with a hard timeout; on the first successful probe it runs
+# benchmarks/tpu_scaling.py and saves raw output to benchmarks/scaling_raw.log,
+# then exits. All probe attempts are logged with timestamps.
+LOG=/root/repo/benchmarks/tunnel_watch.log
+OUT=/root/repo/benchmarks/scaling_raw.log
+cd /root/repo
+while true; do
+  ts=$(date -u +%FT%TZ)
+  if timeout 120 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>>"$LOG"; then
+    echo "$ts probe OK — tunnel up, starting scaling capture" >> "$LOG"
+    timeout 1500 python benchmarks/tpu_scaling.py > "$OUT" 2>&1
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+      echo "$(date -u +%FT%TZ) scaling capture DONE" >> "$LOG"
+      exit 0
+    else
+      echo "$(date -u +%FT%TZ) scaling capture FAILED/timed out (rc=$rc), will retry" >> "$LOG"
+    fi
+  else
+    echo "$ts probe failed (init hang or no tpu)" >> "$LOG"
+  fi
+  sleep 150
+done

@@ -56,7 +56,9 @@ def max_drawdown(returns, valid):
     """Largest peak-to-trough loss of the compounded curve, as a positive
     fraction; masked periods compound as flat.  NaN when nothing is valid."""
     growth = cumulative_growth(returns, valid)
-    peak = jax.lax.associative_scan(jnp.maximum, growth, axis=-1)
+    # the running peak starts at the initial capital of 1.0: a curve that
+    # declines from inception draws down against 1.0, not its own first point
+    peak = jnp.maximum(jax.lax.associative_scan(jnp.maximum, growth, axis=-1), 1.0)
     dd = 1.0 - growth / peak
     mdd = jnp.max(jnp.where(valid, dd, 0.0), axis=-1)
     return jnp.where(jnp.any(valid, axis=-1), mdd, jnp.nan)

@@ -39,7 +39,17 @@ from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat, nw_t_stat
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class GridResult:
-    """Full J x K grid outputs; axes [nJ, nK, ...] / time axis = holding month."""
+    """Full J x K grid outputs; axes [nJ, nK, ...] / time axis = holding month.
+
+    The build parameters ride along (``Js/Ks/skip`` as arrays, ``n_bins`` /
+    ``mode`` as static metadata) so downstream transforms that must
+    recompute formation books — :func:`grid_net_of_costs` — read them from
+    the result instead of trusting the caller to re-specify them
+    consistently.  Results whose axes are *not* a (formation, holding) grid
+    (e.g. the residual sweep's est_window axis) leave them ``None``, which
+    makes parameter-dependent transforms fail loudly instead of netting a
+    differently-binned book.
+    """
 
     spreads: jnp.ndarray       # f[nJ, nK, M] portfolio spread return in month m
     spread_valid: jnp.ndarray  # bool[nJ, nK, M] (all K cohorts live)
@@ -49,6 +59,15 @@ class GridResult:
     tstat_nw: jnp.ndarray      # f[nJ, nK] Newey–West t-stat, lag = K (the
                                # reported inference: K-overlap spreads are
                                # serially correlated by construction)
+    Js: jnp.ndarray | None = None    # i32[nJ] formation lookbacks built with
+    Ks: jnp.ndarray | None = None    # i32[nK] holding periods built with
+    skip: jnp.ndarray | None = None  # i32[] formation-to-holding skip months
+    n_bins: int | None = dataclasses.field(
+        default=None, metadata=dict(static=True)
+    )
+    mode: str | None = dataclasses.field(
+        default=None, metadata=dict(static=True)
+    )
 
 
 def _cohort_partial_sums(labels, ret, ret_valid, n_bins: int, max_hold: int,
@@ -293,12 +312,16 @@ def _jk_grid_backtest(
         tstat=t_stat(spreads, spread_valid),
         tstat_nw=nw_t_stat(spreads, spread_valid, lags=Ks[None, :],
                            max_lag=max_hold),
+        Js=Js,
+        Ks=Ks,
+        skip=jnp.asarray(skip),
+        n_bins=n_bins,
+        mode=mode,
     )
 
 
-def grid_net_of_costs(prices, mask, Js, Ks, grid: GridResult,
-                      half_spread: float = 0.0005, skip: int = 1,
-                      n_bins: int = 10, mode: str = "qcut", freq: int = 12):
+def grid_net_of_costs(prices, mask, grid: GridResult,
+                      half_spread: float = 0.0005, freq: int = 12):
     """Cost-netted J x K grid: exact overlapping-portfolio turnover.
 
     The month-m (J, K) portfolio is the 1/K average of the K most recent
@@ -314,25 +337,48 @@ def grid_net_of_costs(prices, mask, Js, Ks, grid: GridResult,
     longer holding periods survive costs better.
 
     Formation labels are recomputed with the grid's own kernels
-    (``momentum_dynamic`` + ``decile_assign_panel``), so they are
-    bit-identical to the labels behind ``grid.spreads`` — PROVIDED
-    ``Js/skip/n_bins/mode`` are the exact values the grid was built with
-    (GridResult does not carry its parameters; a mismatch nets a
-    differently-binned book against the given spreads with no error —
-    pass the same config object to both calls, as the CLI does).
-    Weights are the formation-date books (a later missing return is a
-    data hole, not a trade).  ``Ks`` must be concrete here (each K is a
-    static rolling window).
+    (``momentum_dynamic`` + ``decile_assign_panel``) from the parameters
+    the :class:`GridResult` itself carries (``Js/Ks/skip/n_bins/mode``),
+    so no grid parameter can be re-specified inconsistently.  The one
+    input still owed by the caller is the PANEL: ``prices``/``mask`` must
+    be the arrays the grid was built from (the result does not retain
+    them — at north-star scale that would double its footprint), or the
+    recomputed books will not be the books behind ``grid.spreads``.
+    Raises on a result that carries no parameters (e.g. the residual
+    sweep, whose nK axis is not a holding axis).  Weights are the
+    formation-date books (a later missing return is a data hole, not a
+    trade).
 
-    Returns a :class:`GridResult` of the netted spreads (same validity).
+    Host-side only: ``Ks`` and ``skip`` become static rolling windows, so
+    the carried values are read back concretely — call this on a
+    materialized result, not under an outer ``jit`` trace.
+
+    Returns a :class:`GridResult` of the netted spreads (same validity
+    and parameters).
     """
     import numpy as np
 
-    Ks_c = tuple(int(k) for k in np.asarray(Ks))
+    if grid.Js is None or grid.Ks is None or grid.skip is None \
+            or grid.n_bins is None or grid.mode is None:
+        raise ValueError(
+            "grid_net_of_costs needs the GridResult's build parameters "
+            "(Js/Ks/skip/n_bins/mode), but this result carries none — it "
+            "was not produced by jk_grid_backtest (the residual sweep's "
+            "est_window axis, for one, is not a holding axis, so spread "
+            "netting is undefined for it)"
+        )
+    if isinstance(grid.Ks, jax.core.Tracer) or isinstance(grid.skip, jax.core.Tracer):
+        raise ValueError(
+            "grid_net_of_costs is host-side: the carried Ks/skip define "
+            "static rolling windows, so it cannot run under an outer jit "
+            "trace — materialize the GridResult first, then net costs"
+        )
+    Ks_c = tuple(int(k) for k in np.asarray(grid.Ks))
     return _grid_net_core(
-        jnp.asarray(prices), jnp.asarray(mask), jnp.asarray(Js),
+        jnp.asarray(prices), jnp.asarray(mask), jnp.asarray(grid.Js),
         grid.spreads, grid.spread_valid, half_spread,
-        Ks_c=Ks_c, skip=skip, n_bins=n_bins, mode=mode, freq=freq,
+        Ks_c=Ks_c, skip=int(np.asarray(grid.skip)), n_bins=grid.n_bins,
+        mode=grid.mode, freq=freq,
     )
 
 
@@ -382,4 +428,9 @@ def _grid_net_core(prices, mask, Js, spreads, spread_valid, half_spread,
         # significance is an apples-to-apples comparison
         tstat_nw=nw_t_stat(net, spread_valid, lags=Ks_arr[None, :],
                            max_lag=max(Ks_c)),
+        Js=Js,
+        Ks=Ks_arr,
+        skip=jnp.asarray(skip),
+        n_bins=n_bins,
+        mode=mode,
     )

@@ -302,9 +302,7 @@ def cmd_grid(args) -> int:
         from csmom_tpu.backtest.grid import grid_net_of_costs
 
         net = grid_net_of_costs(
-            np.asarray(v), np.asarray(m), np.asarray(Js), np.asarray(Ks),
-            res, half_spread=args.tc_bps / 1e4, skip=cfg.momentum.skip,
-            n_bins=cfg.momentum.n_bins, mode=mode,
+            np.asarray(v), np.asarray(m), res, half_spread=args.tc_bps / 1e4,
         )
 
         def _net_table(field):

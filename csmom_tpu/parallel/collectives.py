@@ -180,4 +180,9 @@ def sharded_jk_grid_backtest(
         ann_sharpe=sharpe(spreads, live, freq_per_year=freq),
         tstat=t_stat(spreads, live),
         tstat_nw=nw_t_stat(spreads, live, lags=Ks[None, :], max_lag=max_hold),
+        Js=Js,
+        Ks=Ks,
+        skip=jnp.asarray(skip),
+        n_bins=n_bins,
+        mode=mode,
     )

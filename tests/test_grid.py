@@ -137,9 +137,7 @@ class TestGridNetOfCosts:
         hs = 7e-4
         grid = jk_grid_backtest(prices, mask, Js, Ks, skip=1, n_bins=5,
                                 mode="rank")
-        net_grid = grid_net_of_costs(prices, mask, Js, Ks, grid,
-                                     half_spread=hs, skip=1, n_bins=5,
-                                     mode="rank")
+        net_grid = grid_net_of_costs(prices, mask, grid, half_spread=hs)
 
         mon = monthly_spread_backtest(prices, mask, lookback=6, skip=1,
                                       n_bins=5, mode="rank")
@@ -162,9 +160,7 @@ class TestGridNetOfCosts:
         Js, Ks = np.array([6]), np.array([1, 3, 6])
         grid = jk_grid_backtest(prices, mask, Js, Ks, skip=1, n_bins=5,
                                 mode="rank")
-        net = grid_net_of_costs(prices, mask, Js, Ks, grid,
-                                half_spread=1e-3, skip=1, n_bins=5,
-                                mode="rank")
+        net = grid_net_of_costs(prices, mask, grid, half_spread=1e-3)
         np.testing.assert_array_equal(np.asarray(net.spread_valid),
                                       np.asarray(grid.spread_valid))
         drag = []
@@ -175,6 +171,28 @@ class TestGridNetOfCosts:
             assert (d >= -1e-12).all()  # costs only subtract
             drag.append(d.mean())
         assert drag[0] > drag[1] > drag[2]
+
+    def test_result_carries_build_params(self, rng):
+        """The GridResult rides its own build parameters, and netting a
+        result that has none (residual sweep) fails loudly."""
+        from csmom_tpu.backtest.grid import grid_net_of_costs, jk_grid_backtest
+        from csmom_tpu.signals.residual import residual_sweep_backtest
+
+        prices, mask = self._setup(rng)
+        Js, Ks = np.array([3, 6]), np.array([1, 3])
+        grid = jk_grid_backtest(prices, mask, Js, Ks, skip=2, n_bins=5,
+                                mode="rank")
+        np.testing.assert_array_equal(np.asarray(grid.Js), Js)
+        np.testing.assert_array_equal(np.asarray(grid.Ks), Ks)
+        assert int(grid.skip) == 2
+        assert grid.n_bins == 5 and grid.mode == "rank"
+        net = grid_net_of_costs(prices, mask, grid, half_spread=1e-3)
+        assert net.n_bins == 5 and int(net.skip) == 2
+
+        res = residual_sweep_backtest(prices, mask, np.array([6]),
+                                      np.array([24]), n_bins=5)
+        with pytest.raises(ValueError, match="carries none"):
+            grid_net_of_costs(prices, mask, res)
 
     def test_overlapping_book_turnover_vs_loop_oracle(self, rng):
         """K=3 netted costs equal an explicit cohort-loop reconstruction:
@@ -188,8 +206,7 @@ class TestGridNetOfCosts:
         Js, Ks, K, hs, nb = np.array([6]), np.array([3]), 3, 1e-3, 5
         grid = jk_grid_backtest(prices, mask, Js, Ks, skip=1, n_bins=nb,
                                 mode="rank")
-        net = grid_net_of_costs(prices, mask, Js, Ks, grid, half_spread=hs,
-                                skip=1, n_bins=nb, mode="rank")
+        net = grid_net_of_costs(prices, mask, grid, half_spread=hs)
 
         # formation books from the monthly engine's labels (same kernels)
         mon = monthly_spread_backtest(prices, mask, lookback=6, skip=1,

@@ -35,6 +35,17 @@ def test_max_drawdown_vs_loop(rng):
     assert got == pytest.approx(_mdd_loop(r, valid), rel=1e-12)
 
 
+def test_max_drawdown_declining_from_start():
+    """A curve that never exceeds 1.0 draws down against the initial
+    capital: r=[-0.10,-0.05,0.02,0.01] troughs at 0.855, mdd=0.145 — not
+    the 0.050 a peak that starts at the first point would give."""
+    r = np.array([-0.10, -0.05, 0.02, 0.01])
+    valid = np.ones(4, bool)
+    got = float(max_drawdown(r, valid))
+    assert got == pytest.approx(_mdd_loop(r, valid), rel=1e-12)
+    assert got == pytest.approx(1.0 - 0.90 * 0.95, rel=1e-12)
+
+
 def test_moments_vs_scipy(rng):
     from scipy import stats as sps
 
