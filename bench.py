@@ -214,9 +214,53 @@ def child_main():
         A, T, grid_reps = 512, 3780, 2  # 512 stocks x 15 yr
     else:
         A, T, grid_reps = 3000, 15120, 5  # the north-star workload
-    panel = synthetic_daily_panel(A, T, seed=7, listing_gaps=True)
+    # At-scale data path: the panel is fed from the packed binary cache
+    # (memmapped [A, T] .npy — csmom_tpu.panel.pack), not re-synthesized or
+    # re-parsed per run; the synthesis happens once per (A, T, generator
+    # version) per machine.  pack_ingest_s is the measured disk -> host wall
+    # for the full panel — the number that replaces a CSV parse at 150x the
+    # reference's scale.
+    from csmom_tpu.panel.pack import load_packed, save_packed
+    from csmom_tpu.panel.synthetic import SYNTH_VERSION
+
+    def _ensure_pack(A_, T_) -> str:
+        """Create-if-missing the synthetic pack, atomically; returns its dir.
+
+        Keyed by SYNTH_VERSION so a generator edit can never serve stale
+        panels; built in a pid-suffixed temp dir and os.rename'd into
+        place so concurrent bench runs cannot read a half-written pack
+        (rename is atomic; the loser just removes its own temp copy).
+        """
+        import shutil
+        import tempfile
+
+        d = os.path.join(
+            tempfile.gettempdir(),
+            f"csmom_pack_s{SYNTH_VERSION}_{A_}x{T_}_seed7",
+        )
+        if not os.path.exists(os.path.join(d, "meta.json")):
+            tmp = f"{d}.build{os.getpid()}"
+            save_packed(
+                synthetic_daily_panel(A_, T_, seed=7, listing_gaps=True), tmp
+            )
+            try:
+                os.rename(tmp, d)
+            except OSError:  # lost the race: someone else's pack is in place
+                shutil.rmtree(tmp, ignore_errors=True)
+        return d
+
+    # build (if cold) OUTSIDE the timed region: pack_ingest_s measures the
+    # disk -> host read, not one-time synthesis
+    pack_dir = _ensure_pack(A, T)
+    t0 = time.perf_counter()
+    panel = load_packed(pack_dir)  # memmap: pages fault in on first touch
+    host_values = np.ascontiguousarray(panel.values, dtype=dtype)
+    host_mask = np.ascontiguousarray(panel.mask)
+    pack_ingest_s = time.perf_counter() - t0
     seg, ends = month_end_segments(panel.times)
-    v, m = panel.device(dtype)
+    import jax.numpy as _jnp
+
+    v, m = _jnp.asarray(host_values), _jnp.asarray(host_mask)
     pm, mm = month_end_aggregate(v, m, seg, len(ends))
     M = len(ends)
     Js = np.array([3, 6, 9, 12])
@@ -279,7 +323,7 @@ def child_main():
     child_left = _child_left()  # inf when unbudgeted (standalone child runs)
     if on_cpu and child_left > 360:  # observed: ~23x the reduced data; compile ~1 min
         try:
-            fp = synthetic_daily_panel(3000, 15120, seed=7, listing_gaps=True)
+            fp = load_packed(_ensure_pack(3000, 15120))
             fseg, fends = month_end_segments(fp.times)
             fv, fm = fp.device(dtype)
             fpm, fmm = month_end_aggregate(fv, fm, fseg, len(fends))
@@ -374,6 +418,11 @@ def child_main():
         "golden_ok": abs(n_trades - GOLDEN_TRADES) <= GOLDEN_TRADE_TOL,
         "grid_workload": f"16 cells, {A} stocks x {T} days ({M} months)",
         "grid_is_north_star_size": (A, T) == (3000, 15120),
+        "pack_ingest_s": round(pack_ingest_s, 4),
+        "pack_ingest_note": f"memmapped binary panel ({A}x{T} f32 values + "
+                            "mask) read disk->host from the packed cache "
+                            "(csmom_tpu.panel.pack); replaces per-run CSV "
+                            "parsing at scale",
         "grid16_rank_s": round(grid_rank_s, 4),
         "grid16_qcut_s": (round(grid_qcut_s, 4)
                           if isinstance(grid_qcut_s, float) else grid_qcut_s),

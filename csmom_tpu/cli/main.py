@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import sys
 
 from csmom_tpu.config import RunConfig, load_config
@@ -170,6 +171,14 @@ def cmd_replicate(args) -> int:
         **sector_kw,
         **panels,
     )
+    # name the universe the numbers were computed on: this ingest reads the
+    # dialect-B caches the reference's own loader drops (SURVEY §2.1.1), so
+    # on the reference data a fresh run is 20 tickers (mean ~0.001935) while
+    # BASELINE.md's measured 0.003674 is the reference's effective
+    # 19-ticker panel — a universe difference, not drift
+    print(f"Universe: {prices.n_assets} tickers x {prices.n_times} dates "
+          f"({prices.tickers[0]}..{prices.tickers[-1]}; all readable caches "
+          "included — the reference's own loader drops dialect-B files)")
     print(f"Mean monthly spread: {rep.mean_spread:.6f}")
     print(f"Annualized Sharpe:   {rep.ann_sharpe:.4f}")
     print(f"t-stat (NW):         {rep.tstat_nw:.3f}")
@@ -658,6 +667,35 @@ def cmd_fetch(args) -> int:
         print(f"intraday: {len(got)}/{len(tickers)} tickers cached in {data_dir}")
         if len(got) < len(tickers):
             rc = 1
+    pack_to = getattr(args, "pack", None)
+    if pack_to:
+        # cache -> dense [A, T] pack: the at-scale binary path the grid and
+        # bench feed from (memmapped load; CSV parse happens exactly once).
+        # A partial fetch must NOT pack: a pack quietly missing tickers is
+        # exactly the §2.1.1 universe-shrink failure the format exists to
+        # prevent.
+        if rc != 0:
+            print("not packing: fetch was incomplete (see above) — fix the "
+                  "universe or drop the failing tickers, then re-run",
+                  file=sys.stderr)
+            return rc
+        import json as _json
+
+        from csmom_tpu.panel.pack import pack_csv_cache
+
+        try:
+            out = pack_csv_cache(data_dir, tickers, pack_to)
+        except ValueError as e:
+            print(f"pack failed: {e}", file=sys.stderr)
+            return 1
+        meta = _json.load(open(os.path.join(out, "meta.json")))
+        n_packed = len(meta["tickers"])
+        print(f"packed {n_packed} tickers -> {out}")
+        if n_packed < len(tickers):
+            print(f"pack is INCOMPLETE: {len(tickers) - n_packed} of "
+                  f"{len(tickers)} requested tickers had no readable daily "
+                  "cache", file=sys.stderr)
+            return 1
     return rc
 
 
@@ -928,6 +966,11 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--force-refresh", dest="force_refresh",
                             action="store_true",
                             help="re-download even when a cache file exists")
+            sp.add_argument("--pack", metavar="DIR",
+                            help="after fetch, convert the daily CSV cache "
+                                 "to a packed binary panel directory "
+                                 "(dense [A,T] .npy + manifest; loads "
+                                 "memmapped via panel.load_packed)")
         if "model" in extra:
             sp.add_argument("--model",
                             choices=["ridge", "elastic_net", "lasso", "mlp"],
@@ -951,19 +994,67 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _apply_platform(args) -> None:
-    """Pin the jax platform before any device use.
+# commands that never touch a device (pure pandas/numpy, or — bench — a
+# supervisor that does its own subprocess probing): no init probe for these
+_DEVICE_FREE_COMMANDS = {"fetch", "strategies", "bench"}
+
+
+def _apply_platform(args) -> int:
+    """Pin the jax platform before any device use; fail fast on dead tunnels.
 
     The env-var route is not enough in images that pin ``JAX_PLATFORMS``
     and import jax at interpreter start (sitecustomize);
     ``jax.config.update`` post-import is the override that works.
+
+    When no ``--platform`` is given and the environment pins a non-cpu
+    platform, backend init can HANG (observed: a tunneled TPU plugin
+    blocking ``jax.devices()`` for >900 s when the tunnel is down), so the
+    default platform is probed in a subprocess with a hard timeout
+    (``CSMOM_PLATFORM_PROBE_S``, default 6 s) before any in-process device
+    use; on timeout the CLI prints the workaround and exits 3 instead of
+    hanging.  An explicit ``--platform tpu`` skips the probe — that is the
+    "I know, wait for it" escape hatch.
     """
     choice = getattr(args, "platform", None)
     if choice in (None, "default"):
-        return
+        envp = os.environ.get("JAX_PLATFORMS", "")
+        if "jax" in sys.modules:
+            import jax
+
+            if (jax.config.jax_platforms or "") == "cpu":
+                # an embedder (the test suite, a notebook) already pinned
+                # the in-process backend to cpu via config.update — that
+                # override beats the env var, so there is nothing to probe
+                return 0
+        if (envp and envp != "cpu"
+                and getattr(args, "command", None) not in _DEVICE_FREE_COMMANDS):
+            import subprocess
+
+            probe_s = float(os.environ.get("CSMOM_PLATFORM_PROBE_S", "6"))
+            try:
+                subprocess.run(
+                    [sys.executable, "-c",
+                     "import jax; jax.devices()"],
+                    capture_output=True, timeout=probe_s, check=True,
+                )
+            except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+                print(
+                    f"error: the environment pins JAX_PLATFORMS={envp!r} and "
+                    f"that backend did not initialize within {probe_s:.0f}s "
+                    "(remote tunnel down?).\n"
+                    "  - re-run with `--platform cpu` (every subcommand "
+                    "supports it), or\n"
+                    "  - `--platform tpu` to skip this probe and wait for "
+                    "the backend, or\n"
+                    "  - raise the probe timeout via CSMOM_PLATFORM_PROBE_S",
+                    file=sys.stderr,
+                )
+                return 3
+        return 0
     import jax
 
     jax.config.update("jax_platforms", choice)
+    return 0
 
 
 def main(argv=None) -> int:
@@ -975,7 +1066,9 @@ def main(argv=None) -> int:
         print("--mode rank_hist is distributed-only: use "
               "`csmom grid --shards N --mode rank_hist`", file=sys.stderr)
         return 2
-    _apply_platform(args)
+    rc = _apply_platform(args)
+    if rc:
+        return rc
     return args.fn(args)
 
 

@@ -8,6 +8,7 @@ from csmom_tpu.panel.ingest import (
     long_to_panel,
 )
 from csmom_tpu.panel.calendar import month_end_segments, month_end_aggregate
+from csmom_tpu.panel.pack import save_packed, load_packed, pack_csv_cache
 from csmom_tpu.panel.fetch import (
     fetch_daily,
     fetch_intraday,
@@ -23,6 +24,9 @@ __all__ = [
     "long_to_panel",
     "month_end_segments",
     "month_end_aggregate",
+    "save_packed",
+    "load_packed",
+    "pack_csv_cache",
     "fetch_daily",
     "fetch_intraday",
     "get_shares_info",

@@ -1,0 +1,125 @@
+"""Packed binary panel cache: the at-scale data path.
+
+The reference's only persistence is its per-ticker CSV cache
+(``/root/reference/src/data_io.py:131-159``), which is re-parsed from text
+on every run — fine at 20 tickers x 1,760 bars, hopeless at the north-star
+3,000 x 15,120 (the CSV text alone would be ~1 GB and minutes of pandas
+parsing).  This module is the scale analogue: dense ``[A, T]`` arrays
+written once as raw ``.npy`` (one file per field) next to a tiny JSON
+manifest, re-read with ``numpy`` memory mapping so a load touches pages
+only as kernels pull them.
+
+Why a directory of flat ``.npy`` and not the compressed ``.npz`` snapshot
+(:meth:`csmom_tpu.panel.panel.Panel.save`): ``np.load`` cannot memory-map
+members of a zip archive — it would decompress the whole panel into RAM at
+open.  The snapshot stays the right answer for small panels that travel as
+one file; the pack is the bulk format the bench and grid feed from.
+
+Layout (version 1)::
+
+    <dir>/
+      meta.json          {"version": 1, "tickers": [...], "fields": [...],
+                          "times_dtype": "datetime64[ns]"}
+      times.npy          i64[T] (datetime64 ticks, dtype in meta)
+      <field>.values.npy f32/f64[A, T] per field, NaN at masked slots
+      <field>.mask.npy   bool[A, T]
+
+Masks are stored explicitly (not re-derived from NaN) so a pack of a
+non-float field or an all-finite panel with deliberate invalid lanes
+roundtrips exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from csmom_tpu.panel.panel import Panel, PanelBundle
+
+_PACK_VERSION = 1
+
+
+def save_packed(obj, path: str) -> str:
+    """Write a :class:`Panel` or :class:`PanelBundle` as a packed directory.
+
+    Overwrites field files already present; returns ``path``.
+    """
+    panels = obj.panels if isinstance(obj, PanelBundle) else {obj.name: obj}
+    if not panels:
+        raise ValueError("nothing to pack: empty bundle")
+    first = next(iter(panels.values()))
+    os.makedirs(path, exist_ok=True)
+    times = np.asarray(first.times)
+    np.save(os.path.join(path, "times.npy"), times.view("i8"))
+    for field, p in panels.items():
+        if not np.array_equal(np.asarray(p.times), times):
+            raise ValueError(f"field {field!r} is not on the shared calendar")
+        if tuple(p.tickers) != tuple(first.tickers):
+            raise ValueError(f"field {field!r} is not on the shared tickers")
+        np.save(os.path.join(path, f"{field}.values.npy"), p.values)
+        np.save(os.path.join(path, f"{field}.mask.npy"), p.mask)
+    meta = {
+        "version": _PACK_VERSION,
+        "tickers": list(first.tickers),
+        "fields": sorted(panels),
+        "times_dtype": str(times.dtype),
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    return path
+
+
+def load_packed(path: str, mmap: bool = True):
+    """Re-open a packed directory.
+
+    Returns a :class:`Panel` when the pack holds one field, else a
+    :class:`PanelBundle`.  With ``mmap=True`` (default) the arrays are
+    ``np.memmap`` views — pages fault in as they are read, so opening a
+    north-star-sized pack is O(metadata); ``Panel.device()`` streams them
+    straight to HBM.  Unknown future versions fail loudly (the §2.1.1
+    lesson: an unreadable cache must never quietly shrink the universe).
+    """
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    ver = int(meta.get("version", -1))
+    if ver > _PACK_VERSION or ver < 1:
+        raise ValueError(
+            f"{path}: pack version {ver} is not understood by this library "
+            f"(supports 1..{_PACK_VERSION}) — refusing to guess at the layout"
+        )
+    mode = "r" if mmap else None
+    times = np.load(os.path.join(path, "times.npy"), mmap_mode=None)
+    times = times.view(meta["times_dtype"])
+    tickers = tuple(meta["tickers"])
+    panels = {}
+    for field in meta["fields"]:
+        values = np.load(os.path.join(path, f"{field}.values.npy"), mmap_mode=mode)
+        mask = np.load(os.path.join(path, f"{field}.mask.npy"), mmap_mode=mode)
+        panels[field] = Panel(
+            values=values, mask=mask, tickers=tickers, times=times, name=field
+        )
+    if len(panels) == 1:
+        return next(iter(panels.values()))
+    return PanelBundle(panels=panels, tickers=tickers, times=times)
+
+
+def pack_csv_cache(data_dir: str, tickers, out: str,
+                   fields=("adj_close", "volume")) -> str:
+    """One-shot CSV cache -> packed directory conversion (``csmom fetch
+    --pack``): load the per-ticker daily CSVs through the normal ingest
+    path, pivot each requested field to a dense panel, write the pack."""
+    from csmom_tpu.panel.ingest import load_daily, long_to_panel
+
+    df = load_daily(data_dir, list(tickers))
+    if df.empty:
+        raise ValueError(f"no readable daily caches for {len(tickers)} "
+                         f"tickers under {data_dir}")
+    panels = {f: long_to_panel(df, f) for f in fields}
+    first = next(iter(panels.values()))
+    return save_packed(
+        PanelBundle(panels=panels, tickers=tuple(first.tickers),
+                    times=np.asarray(first.times)),
+        out,
+    )

@@ -22,6 +22,12 @@ import numpy as np
 from csmom_tpu.panel.panel import Panel
 
 
+# bump when any generator's output changes for the same (shape, seed): disk
+# caches of synthesized panels (bench.py) key on this so they can never
+# silently serve stale data after a generator edit
+SYNTH_VERSION = 1
+
+
 def synthetic_daily_panel(
     n_assets: int,
     n_days: int,

@@ -39,6 +39,9 @@ def test_backends_agree_gap_free(rng):
     np.testing.assert_allclose(tpu.tstat_nw, pdr.tstat_nw, rtol=1e-9)
 
 
+@pytest.mark.slow
+
+
 def test_backends_agree_with_leading_gaps(rng):
     """Late listings (leading NaN runs) — warmup must match month for month."""
     panel = _toy_panel(rng, a=25, m=40)

@@ -103,6 +103,9 @@ def test_cv_mses_match_sklearn_folds(rng):
     np.testing.assert_allclose(np.asarray(fit.cv_mse), mses, rtol=1e-6)
 
 
+@pytest.mark.slow
+
+
 def test_intraday_pipeline_model_selection(rng):
     """--model wiring: elastic_net/lasso run end-to-end through the intraday
     pipeline; unknown model raises."""
@@ -124,6 +127,9 @@ def test_intraday_pipeline_model_selection(rng):
     assert not np.allclose(np.nan_to_num(a), np.nan_to_num(b))
     with pytest.raises(ValueError, match="unknown model"):
         intraday_pipeline(minutes, None, model="svm")
+
+
+@pytest.mark.slow
 
 
 def test_intraday_pipeline_warns_on_zeroed_model(rng):

@@ -113,6 +113,27 @@ def test_golden_fingerprint():
 
 
 @requires_reference
+def test_cv_mse_golden():
+    """BASELINE.md's measured intraday ridge CV fold MSEs, pinned as their
+    own golden: the reference's ``train_ridge_time_series``
+    (``/root/reference/src/models.py:8-22``) on the shipped caches produces
+    per-fold MSEs [2.97e-07, 1.80e-06, 3.35e-07] (3 expanding folds,
+    alpha=1.0, scaler leak replicated by design — SURVEY §2.1.4); our
+    one-jit harness must land on all three."""
+    from csmom_tpu.api import intraday_pipeline
+    from csmom_tpu.panel.ingest import load_daily, load_intraday
+
+    minute_df = load_intraday(REFERENCE_DATA, DEMO_TICKERS)
+    daily_df = load_daily(REFERENCE_DATA, MEASURED_TICKERS)
+    _, fit, *_ = intraday_pipeline(minute_df, daily_df)
+    got = np.asarray(fit.cv_mse)
+    want = np.array([2.97e-07, 1.80e-06, 3.35e-07])  # BASELINE.md:22
+    assert got.shape == (3,)
+    np.testing.assert_allclose(got, want, rtol=5e-3)
+
+
+@requires_reference
+@pytest.mark.slow
 def test_golden_fingerprint_f32():
     """The same golden workload in float32 — the dtype the TPU path actually
     runs (tests run on CPU but the numerics are the panel program's, not the

@@ -5,6 +5,8 @@ SURVEY §2.1.7); this extension makes the delay real: decision at row t,
 execution at the first event row >= t+L at that row's price.
 """
 
+import pytest
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -74,6 +76,9 @@ def test_latency_zero_unchanged(rng):
     np.testing.assert_array_equal(np.asarray(base.positions), np.asarray(lat0.positions))
     np.testing.assert_array_equal(np.asarray(base.cash), np.asarray(lat0.cash))
     np.testing.assert_array_equal(np.asarray(base.pnl), np.asarray(lat0.pnl))
+
+
+@pytest.mark.slow
 
 
 def test_latency_matches_oracle(rng):

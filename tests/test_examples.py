@@ -30,12 +30,16 @@ def test_replicate_reference_example(capsys):
 
 
 @requires_reference
+@pytest.mark.slow
 def test_strategy_zoo_example(capsys):
     _run("strategy_zoo.py", ["--data-dir", REFERENCE_DATA, "--n-bins", "5"])
     out = capsys.readouterr().out
     for label in ("momentum J=12", "reversal 1m", "residual mom",
                   "volume-z mom"):
         assert label in out
+
+
+@pytest.mark.slow
 
 
 def test_north_star_grid_example(capsys):

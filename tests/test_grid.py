@@ -71,6 +71,9 @@ def test_grid_cell_matches_oracle(rng, J, K):
         assert abs(got[m] - want[m]) < 1e-9, (m, got[m], want[m])
 
 
+@pytest.mark.slow
+
+
 def test_full_16_cell_grid_shapes(rng):
     prices = _make_prices(rng, M=90, A=30)
     vals = prices.values.T
@@ -125,6 +128,8 @@ class TestGridNetOfCosts:
         mask[: A // 8, : M // 4] = False
         return prices, mask
 
+    @pytest.mark.slow
+
     def test_k1_matches_monthly_net_of_costs(self, rng):
         """A K=1 grid cell's netted spread equals the monthly engine's
         net_of_costs, shifted from formation-month to holding-month
@@ -150,6 +155,8 @@ class TestGridNetOfCosts:
         both = gv[1:] & np.isfinite(m_[:-1])
         assert both.any()
         np.testing.assert_allclose(g[1:][both], m_[:-1][both], rtol=1e-9)
+
+    @pytest.mark.slow
 
     def test_costs_fall_with_k_and_validity_preserved(self, rng):
         """Longer holding replaces ~1/K of the book per month, so the mean
@@ -193,6 +200,8 @@ class TestGridNetOfCosts:
                                       np.array([24]), n_bins=5)
         with pytest.raises(ValueError, match="carries none"):
             grid_net_of_costs(prices, mask, res)
+
+    @pytest.mark.slow
 
     def test_overlapping_book_turnover_vs_loop_oracle(self, rng):
         """K=3 netted costs equal an explicit cohort-loop reconstruction:

@@ -17,6 +17,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from csmom_tpu.ops.ranking import decile_assign_panel
 from csmom_tpu.parallel.histrank import histogram_rank_labels
 
+# 8-device-mesh / compile-heavy: excluded from the default fast tier
+pytestmark = pytest.mark.slow
+
 
 def _sharded_labels(x, valid, n_bins, n_shards):
     mesh = Mesh(np.array(jax.devices()[:n_shards]), ("assets",))

@@ -125,6 +125,9 @@ def _oracle_by_volume(prices, mask, turn, turn_valid, J, skip, n_bins, V, max_h)
     return out
 
 
+@pytest.mark.slow
+
+
 def test_volume_profile_matches_pandas_oracle(rng):
     from csmom_tpu.backtest import volume_horizon_profile
 
@@ -152,6 +155,9 @@ def test_volume_profile_matches_pandas_oracle(rng):
     ])
     np.testing.assert_allclose(np.asarray(vhp.diff_mean), want_diff,
                                rtol=1e-9, equal_nan=True)
+
+
+@pytest.mark.slow
 
 
 def test_volume_horizon_table_shape(rng):

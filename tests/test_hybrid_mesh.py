@@ -21,6 +21,9 @@ from csmom_tpu.parallel import (
 )
 from csmom_tpu.parallel.mesh import _group_by_host, pad_assets
 
+# 8-device-mesh / compile-heavy: excluded from the default fast tier
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def eight_devices():

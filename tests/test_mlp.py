@@ -11,6 +11,9 @@ from csmom_tpu.models import mlp_time_series_cv, ridge_time_series_cv
 from tests.test_ridge import _padded
 
 
+@pytest.mark.slow
+
+
 def test_linear_anchor_matches_ridge(rng):
     """``hidden=()`` is a linear model trained by gradient descent — on a
     well-conditioned linear problem it must land near the closed-form ridge
@@ -40,6 +43,9 @@ def test_linear_anchor_matches_ridge(rng):
     )
 
 
+@pytest.mark.slow
+
+
 def test_nonlinear_lift_over_ridge(rng):
     """On a target no linear model can express, the MLP's held-out fold MSE
     must beat ridge's."""
@@ -57,6 +63,9 @@ def test_nonlinear_lift_over_ridge(rng):
     assert float(mlp.train_mse) < float(ridge.cv_mse[-1])
 
 
+@pytest.mark.slow
+
+
 def test_deterministic_given_seed(rng):
     X, y, valid, _, _ = _padded(rng)
     a = mlp_time_series_cv(X, y, valid, n_steps=50, seed=7)
@@ -67,6 +76,9 @@ def test_deterministic_given_seed(rng):
         np.asarray(c.scores)[np.asarray(valid)],
         np.asarray(a.scores)[np.asarray(valid)],
     )
+
+
+@pytest.mark.slow
 
 
 def test_padding_layout_invariance(rng):

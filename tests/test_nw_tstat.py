@@ -56,6 +56,9 @@ def test_lag_zero_vs_iid():
     np.testing.assert_allclose(t0, ti * np.sqrt(50 / 49), rtol=1e-10)
 
 
+@pytest.mark.slow
+
+
 def test_positive_autocorrelation_shrinks_t(rng):
     """Overlapping K-month holding induces positive serial correlation; NW
     must report smaller |t| than iid there (the whole point of the fix)."""
@@ -64,6 +67,9 @@ def test_positive_autocorrelation_shrinks_t(rng):
     x = 0.003 + np.convolve(e, np.ones(6) / 6.0, mode="same")
     v = np.ones_like(x, bool)
     assert abs(float(nw_t_stat(x, v, lags=6))) < abs(float(t_stat(x, v)))
+
+
+@pytest.mark.slow
 
 
 def test_broadcast_per_cell_lags(rng):

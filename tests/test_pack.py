@@ -1,0 +1,134 @@
+"""Packed binary panel cache: roundtrip exactness, memmapped loads, version
+loudness, CSV-cache conversion (the at-scale analogue of the reference's
+per-ticker CSV persistence, /root/reference/src/data_io.py:131-159)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from csmom_tpu.panel import Panel, load_packed, save_packed
+from csmom_tpu.panel.panel import PanelBundle
+from csmom_tpu.panel.synthetic import synthetic_daily_panel
+
+
+def _panel(rng, A=7, T=40):
+    vals = rng.normal(100, 10, size=(A, T))
+    vals[rng.random((A, T)) < 0.2] = np.nan
+    return Panel.from_dense(
+        vals,
+        tickers=[f"T{i}" for i in range(A)],
+        times=np.arange("2020-01-01", 40, dtype="datetime64[D]")[:T].astype(
+            "datetime64[ns]"
+        ),
+        name="adj_close",
+    )
+
+
+def test_roundtrip_exact(tmp_path, rng):
+    p = _panel(rng)
+    out = save_packed(p, str(tmp_path / "pack"))
+    q = load_packed(out)
+    assert isinstance(q, Panel)
+    np.testing.assert_array_equal(np.asarray(q.values), p.values)
+    np.testing.assert_array_equal(np.asarray(q.mask), p.mask)
+    assert q.tickers == p.tickers
+    np.testing.assert_array_equal(q.times, p.times)
+    assert q.name == "adj_close"
+
+
+def test_load_is_memmapped(tmp_path, rng):
+    """mmap=True must return lazily-paged views, not RAM copies — the whole
+    point of the flat-.npy layout over the .npz snapshot."""
+    p = _panel(rng)
+    save_packed(p, str(tmp_path / "pack"))
+    q = load_packed(str(tmp_path / "pack"))
+    assert isinstance(q.values, np.memmap)
+    assert isinstance(q.mask, np.memmap)
+    eager = load_packed(str(tmp_path / "pack"), mmap=False)
+    assert not isinstance(eager.values, np.memmap)
+
+
+def test_bundle_roundtrip_and_calendar_guard(tmp_path, rng):
+    px = _panel(rng)
+    vol = Panel.from_dense(
+        np.abs(rng.normal(1e6, 1e5, size=px.shape)),
+        tickers=px.tickers, times=px.times, name="volume",
+    )
+    b = PanelBundle(panels={"adj_close": px, "volume": vol},
+                    tickers=px.tickers, times=px.times)
+    out = save_packed(b, str(tmp_path / "bundle"))
+    q = load_packed(out)
+    assert isinstance(q, PanelBundle)
+    assert set(q.fields) == {"adj_close", "volume"}
+    np.testing.assert_array_equal(
+        np.asarray(q["volume"].values), vol.values
+    )
+
+    # mismatched calendars must refuse to pack
+    other = Panel.from_dense(
+        px.values[:, :-1], tickers=px.tickers, times=px.times[:-1],
+        name="close",
+    )
+    bad = PanelBundle(panels={"adj_close": px, "close": other},
+                      tickers=px.tickers, times=px.times)
+    with pytest.raises(ValueError, match="shared calendar"):
+        save_packed(bad, str(tmp_path / "bad"))
+
+
+def test_unknown_version_is_loud(tmp_path, rng):
+    p = _panel(rng)
+    out = save_packed(p, str(tmp_path / "pack"))
+    meta = json.load(open(os.path.join(out, "meta.json")))
+    meta["version"] = 99
+    json.dump(meta, open(os.path.join(out, "meta.json"), "w"))
+    with pytest.raises(ValueError, match="version 99"):
+        load_packed(out)
+
+
+def test_packed_feeds_kernels(tmp_path):
+    """A packed synthetic panel drives the compiled path end-to-end and
+    matches the in-memory panel bit-for-bit (the bench's data path)."""
+    from csmom_tpu.backtest.grid import jk_grid_backtest
+    from csmom_tpu.panel.calendar import month_end_aggregate, month_end_segments
+
+    p = synthetic_daily_panel(24, 500, seed=3, listing_gaps=True)
+    save_packed(p, str(tmp_path / "ns"))
+    q = load_packed(str(tmp_path / "ns"))
+
+    def run(panel):
+        seg, ends = month_end_segments(panel.times)
+        v, m = panel.device()
+        pm, mm = month_end_aggregate(v, m, seg, len(ends))
+        Js, Ks = np.array([3, 6]), np.array([1, 3])
+        return jk_grid_backtest(pm, mm, Js, Ks, skip=1, n_bins=5, mode="rank")
+
+    a, b = run(p), run(q)
+    np.testing.assert_array_equal(np.asarray(a.mean_spread),
+                                  np.asarray(b.mean_spread))
+
+
+@pytest.mark.reference_data
+def test_pack_csv_cache_cli(tmp_path):
+    """csmom fetch --pack converts the CSV caches; the pack re-opens with
+    the full universe and the dense values match the ingest pivot."""
+    from tests.conftest import DEMO_TICKERS, REFERENCE_DATA
+
+    from csmom_tpu.cli.main import main
+    from csmom_tpu.panel.ingest import load_daily, long_to_panel
+
+    out = tmp_path / "packed"
+    rc = main(["fetch", "--data-dir", REFERENCE_DATA,
+               "--tickers", "AAPL,AMD,NVDA", "--kind", "daily",
+               "--pack", str(out)])
+    assert rc == 0
+    b = load_packed(str(out))
+    assert set(b.fields) == {"adj_close", "volume"}
+    assert len(b.tickers) == 3  # AAPL included: the dialect-B file reads
+
+    df = load_daily(REFERENCE_DATA, ["AAPL", "AMD", "NVDA"])
+    want = long_to_panel(df, "adj_close")
+    np.testing.assert_array_equal(
+        np.asarray(b["adj_close"].values), want.values
+    )

@@ -8,6 +8,9 @@ import pytest
 from csmom_tpu.backtest.monthly import decile_partial_sums
 from csmom_tpu.ops.pallas_kernels import decile_partial_sums_pallas
 
+# 8-device-mesh / compile-heavy: excluded from the default fast tier
+pytestmark = pytest.mark.slow
+
 
 def _case(rng, a, m, n_bins):
     labels = rng.integers(-1, n_bins, size=(a, m)).astype(np.int32)

@@ -92,6 +92,9 @@ def test_rank_mode_matches_reference_fallback(rng):
     np.testing.assert_array_equal(np.asarray(got), bins.astype(int).values)
 
 
+@pytest.mark.slow
+
+
 def test_rank_mode_fuzz_ties_masks_small_n(rng):
     """Rank mode vs the pandas fallback formula under heavy ties, masked
     lanes, and tiny/degenerate cross-sections (exercises the boundary-pair
@@ -126,6 +129,9 @@ def test_panel_vmap(rng):
         np.testing.assert_array_equal(
             np.asarray(labels[:, t]), oracle_deciles(x[:, t])
         )
+
+
+@pytest.mark.slow
 
 
 def test_random_fuzz_vs_oracle(rng):

@@ -103,6 +103,9 @@ def test_est_window_guard():
                           lookback=12, est_window=6)
 
 
+@pytest.mark.slow
+
+
 def test_plugin_runs_through_engine(rng):
     """The registered strategy runs the shared engine end-to-end and its
     spread differs from raw momentum's (it is a genuinely different sort)."""
@@ -154,6 +157,9 @@ def test_sweep_misconfigured_cell_is_invalid_not_fatal(rng):
     v = np.asarray(valid)
     assert not v[1, 0].any()   # J=12, W=9 < J: structurally invalid
     assert v[0, 0].any() and v[0, 1].any() and v[1, 1].any()
+
+
+@pytest.mark.slow
 
 
 def test_sweep_backtest_matches_strategy_engine(rng):

@@ -5,6 +5,8 @@ The oracle is what a pandas user would write for BASELINE config 3:
 reference's qcut semantics (duplicates='drop'), then pooled decile means.
 """
 
+import pytest
+
 import numpy as np
 import pandas as pd
 
@@ -23,6 +25,9 @@ def oracle_sector_deciles(values, sector_ids, n_sectors, n=10):
         sub = np.where(sel, values, np.nan)
         out[sel] = oracle_deciles(sub, n)[sel]
     return out
+
+
+@pytest.mark.slow
 
 
 def test_single_date_vs_oracle(rng):

@@ -10,6 +10,9 @@ from csmom_tpu.backtest.event import event_backtest
 from csmom_tpu.parallel.event_time import pad_time, time_sharded_event_backtest
 from csmom_tpu.parallel.mesh import make_mesh
 
+# 8-device-mesh / compile-heavy: excluded from the default fast tier
+pytestmark = pytest.mark.slow
+
 
 def _scenario(rng, A=6, T=120):
     price = 100 * np.exp(np.cumsum(rng.normal(0, 1e-3, size=(A, T)), axis=1))

@@ -7,6 +7,9 @@ import pytest
 from csmom_tpu.analytics import block_bootstrap
 from csmom_tpu.parallel import make_mesh, sharded_block_bootstrap
 
+# 8-device-mesh / compile-heavy: excluded from the default fast tier
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def mesh():

@@ -11,6 +11,9 @@ from csmom_tpu.parallel.mesh import pad_assets
 
 from tests.test_event_latency import _workload
 
+# 8-device-mesh / compile-heavy: excluded from the default fast tier
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def mesh():

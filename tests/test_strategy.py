@@ -76,6 +76,9 @@ def test_zscore_combo_single_component_same_deciles(rng):
     np.testing.assert_array_equal(np.asarray(via.labels), np.asarray(ded.labels))
 
 
+@pytest.mark.slow
+
+
 def test_volume_z_momentum_gamma_zero_matches_momentum(rng):
     prices, mask = _toy(rng)
     volumes = rng.lognormal(10, 1, size=prices.shape)

@@ -87,14 +87,14 @@ def test_double_sort_table(rng):
     np.testing.assert_allclose(df.loc["V3-V1"].mean_ret, md)
 
 
+@pytest.mark.reference_data
+@pytest.mark.slow
 def test_cli_doublesort_and_tables_run():
     """End-to-end CLI smoke on the shipped caches (CPU/pandas-safe paths)."""
-    import os
+    from tests.conftest import REFERENCE_DATA
 
-    if not os.path.isdir("/root/reference/data"):
-        pytest.skip("reference data not mounted")
     from csmom_tpu.cli.main import main
 
-    assert main(["doublesort", "--data-dir", "/root/reference/data"]) == 0
-    assert main(["replicate", "--data-dir", "/root/reference/data",
+    assert main(["doublesort", "--data-dir", REFERENCE_DATA]) == 0
+    assert main(["replicate", "--data-dir", REFERENCE_DATA,
                  "--backend", "pandas", "--tables", "--out", "/tmp/cli_tables"]) == 0

@@ -1,5 +1,7 @@
 """Walk-forward selection vs a per-month numpy loop oracle."""
 
+import pytest
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -57,6 +59,9 @@ def test_selection_prefers_dominant_cell(rng):
     live = np.ones_like(x, dtype=bool)
     res = walk_forward_select(jnp.asarray(x), jnp.asarray(live), min_months=12)
     assert (np.asarray(res.choice)[13:] == 4).all()
+
+
+@pytest.mark.slow
 
 
 def test_end_to_end_grid_sweep(rng):
