@@ -250,12 +250,15 @@ def child_main():
         return d
 
     # build (if cold) OUTSIDE the timed region: pack_ingest_s measures the
-    # disk -> host read, not one-time synthesis
+    # disk -> host read, not one-time synthesis.  copy=True forces the full
+    # read inside the timed window — with a matching dtype,
+    # ascontiguousarray on a memmap is a zero-copy view and the pages
+    # would otherwise fault in later, under someone else's timer
     pack_dir = _ensure_pack(A, T)
     t0 = time.perf_counter()
     panel = load_packed(pack_dir)  # memmap: pages fault in on first touch
-    host_values = np.ascontiguousarray(panel.values, dtype=dtype)
-    host_mask = np.ascontiguousarray(panel.mask)
+    host_values = np.array(panel.values, dtype=dtype, copy=True)
+    host_mask = np.array(panel.mask, copy=True)
     pack_ingest_s = time.perf_counter() - t0
     seg, ends = month_end_segments(panel.times)
     import jax.numpy as _jnp

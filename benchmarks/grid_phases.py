@@ -1,0 +1,217 @@
+"""Phase breakdown of the north-star J x K grid (any platform).
+
+Times each stage of ``jk_grid_backtest`` separately — formation signal,
+decile ranking, cohort aggregation (each impl), holding/stats tail, and
+the full fused call — with the same device_get timing discipline as
+``bench.py`` (``block_until_ready`` does not reliably sync on the
+tunneled TPU backend), and pairs every wall with a first-principles
+bytes/FLOPs model so each phase reads as a fraction of the chip's
+roofline rather than a bare number.
+
+The point (VERDICT r3 next-step 3): the 16-cell grid at the north-star
+size (3,000 x 720 months) measures ~0.09 s on one v5e chip at ~1.6% of
+HBM peak — this tool shows WHICH phase owns the time and at what size
+each phase leaves the latency-bound regime.  Run it at several ``--ax``
+multipliers to trace the transition.
+
+Usage::
+
+    python benchmarks/grid_phases.py            # north-star size
+    python benchmarks/grid_phases.py --ax 32    # 96k assets
+
+Emits one JSON line per phase and a trailing summary line (committed as
+``PHASES_TPU_r{N}.json`` when captured on-chip).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root: csmom_tpu package
+sys.path.insert(0, _HERE)                   # sibling benchmark modules
+
+from tpu_scaling import monthly_panel  # noqa: E402  (sibling module)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ax", type=int, default=1,
+                    help="asset-count multiplier on the 3,000 north star")
+    ap.add_argument("--assets", type=int, default=None,
+                    help="explicit asset count (overrides --ax; for quick "
+                         "correctness runs on slow hosts)")
+    ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--platform", choices=["default", "cpu"], default="default",
+                    help="pin the jax platform ('cpu' for hosts whose "
+                         "default platform hangs at init; the env-var route "
+                         "is defeated by images whose sitecustomize imports "
+                         "jax at interpreter start)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from csmom_tpu.backtest.grid import (
+        _cohort_partial_sums, _finalize_cohorts, _holding_month_spreads,
+        jk_grid_backtest,
+    )
+    from csmom_tpu.analytics.stats import masked_mean, sharpe
+    from csmom_tpu.ops.ranking import decile_assign_panel
+    from csmom_tpu.signals.momentum import momentum_dynamic, monthly_returns
+    from csmom_tpu.utils.profiling import fetch, measure_rtt
+
+    platform = jax.devices()[0].platform
+    kind = str(jax.devices()[0].device_kind)
+    A, M, H, B = args.assets or 3000 * args.ax, 720, 12, 10
+    # numpy (not jnp): these are closed over inside an extra jit wrapper,
+    # where any jnp op — even on a constant — stages to a tracer and would
+    # break the host-side max(Ks) validation in jk_grid_backtest
+    Js = np.array([3, 6, 9, 12])
+    Ks = np.array([3, 6, 9, 12])
+    itemsize = 4 if platform == "tpu" else 8
+    dtype = np.float32 if platform == "tpu" else np.float64
+
+    rtt_s = measure_rtt()
+    print(json.dumps({"tiny_op_rtt_s": round(rtt_s, 6)}), flush=True)
+
+    pm, mm = monthly_panel(A, M)
+    pm = jax.device_put(pm.astype(dtype))
+    mm = jax.device_put(mm)
+
+    def timed(fn, *xs, reps=args.reps):
+        """Per-rep device_get of an in-jit scalar reduction."""
+        f = jax.jit(lambda *a: jnp.asarray(fn(*a), dtype).sum())
+        fetch(f(*xs))  # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fetch(f(*xs))
+        return (time.perf_counter() - t0) / reps
+
+    rows = []
+
+    def report(phase, wall, gbytes, gflops, note):
+        row = {
+            "phase": phase,
+            "wall_s": round(wall, 5),
+            "model_gbytes": round(gbytes, 3),
+            "model_gflops": round(gflops, 3),
+            "achieved_gbps": round(gbytes / wall, 1),
+            "achieved_gflops_s": round(gflops / wall, 1),
+            "note": note,
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # -- phase 1: formation momentum, all four J in one vmap ----------------
+    mom_fn = lambda p, v: jax.vmap(
+        lambda J: momentum_dynamic(p, v, J, 1)[0]
+    )(Js)
+    nJ = len(Js)
+    report(
+        "momentum(vmap J)", timed(mom_fn, pm, mm),
+        # log1p + 2 prefix gathers over [A, M] per J, ~4 passes
+        nJ * 4 * A * M * itemsize / 1e9, nJ * 3 * A * M / 1e9,
+        "telescoped-ratio formation signal for all J",
+    )
+
+    # -- phase 2: decile ranking (the batched per-date sort), rank & qcut ---
+    mom, momv = jax.jit(
+        lambda p, v: jax.vmap(lambda J: momentum_dynamic(p, v, J, 1))(Js)
+    )(pm, mm)
+    mom = jax.block_until_ready(mom)
+
+    for mode in ("rank", "qcut"):
+        rank_fn = lambda x, v, mode=mode: jax.vmap(
+            lambda xj, vj: decile_assign_panel(xj, vj, B, mode=mode)[0]
+        )(x, v)
+        report(
+            f"ranking[{mode}](vmap JxM sort)", timed(rank_fn, mom, momv),
+            # sort reads+writes [A, M] keys ~log(A) times per J (bitonic on
+            # TPU); count one logical pass as the *lower bound* model
+            nJ * 3 * A * M * itemsize / 1e9,
+            nJ * A * np.log2(max(A, 2)) * M / 1e9,
+            "one batched argsort over (J, M); flops column = comparison model",
+        )
+
+    labels = jax.jit(
+        lambda x, v: jax.vmap(
+            lambda xj, vj: decile_assign_panel(xj, vj, B, mode="rank")[0]
+        )(x, v)
+    )(mom, momv)
+    labels = jax.block_until_ready(labels)
+    ret, retv = jax.jit(monthly_returns)(pm, mm)
+    ret = jax.block_until_ready(ret)
+
+    # -- phase 3: cohort aggregation, each impl -----------------------------
+    impls = ["xla", "matmul"] + (["matmul_bf16", "pallas"]
+                                 if platform == "tpu" else [])
+    for impl in impls:
+        coh_fn = lambda l, r, rv, impl=impl: jax.vmap(
+            lambda lj: _cohort_partial_sums(lj, r, rv, B, H, impl=impl)[0]
+        )(l)
+        if impl.startswith("matmul"):
+            gb = nJ * (3 * A * M + 2 * M * M) * itemsize / 1e9
+            gf = nJ * 2 * 2 * 2 * A * M * M / 1e9  # 2 sides x 2 tables x 2 flop
+            note = "2 batched [2,M,A]@[A,M] cross tables + band gather (MXU)"
+        else:
+            gb = nJ * H * 3 * A * M * itemsize / 1e9
+            gf = nJ * H * 6 * A * M / 1e9
+            note = "H rolled masked reductions over [A, M] per J (HBM-bound form)"
+        report(f"cohort_sums[{impl}]", timed(coh_fn, labels, ret, retv), gb,
+               gf, note)
+
+    # -- phase 4: holding/stats tail ----------------------------------------
+    sums, counts = jax.jit(
+        lambda l, r, rv: jax.vmap(
+            lambda lj: _cohort_partial_sums(lj, r, rv, B, H, impl="xla")
+        )(l)
+    )(labels, ret, retv)
+    sums = jax.block_until_ready(sums)
+
+    def tail_fn(s, c):
+        R, Rv = jax.vmap(_finalize_cohorts)(s, c)
+        spreads, live = _holding_month_spreads(R, Rv, Ks)
+        return masked_mean(spreads, live) + sharpe(spreads, live)
+
+    report(
+        "holding+stats tail", timed(tail_fn, sums, counts),
+        nJ * H * M * 4 * itemsize / 1e9, nJ * H * M * 8 / 1e9,
+        "K-overlap gather + masked stats over [nJ, M, H] — asset-free",
+    )
+
+    # -- full fused grid ------------------------------------------------------
+    full_fn = lambda p, v: jk_grid_backtest(
+        p, v, Js, Ks, skip=1, mode="rank", impl="xla", max_hold=H
+    ).mean_spread
+    report(
+        "full grid (fused, rank/xla)", timed(full_fn, pm, mm),
+        (nJ * (4 + 3) * A * M + nJ * H * 3 * A * M) * itemsize / 1e9,
+        nJ * H * 6 * A * M / 1e9,
+        "everything under one jit: XLA fuses phases 1-4",
+    )
+
+    peak = {"TPU v5 lite": 819.0, "TPU v5e": 819.0, "TPU v4": 1228.0,
+            "TPU v5p": 2765.0, "TPU v6 lite": 1640.0,
+            "TPU v6e": 1640.0}.get(kind)
+    print(json.dumps({
+        "metric": "grid_phase_breakdown",
+        "platform": platform,
+        "device_kind": kind,
+        "A": A, "M": M, "H": H,
+        "tiny_op_rtt_s": round(rtt_s, 6),
+        "chip_peak_hbm_gbps": peak or "unknown device kind",
+        "timing": "per-rep device_get of an in-jit scalar reduction",
+        "phases": rows,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -644,8 +644,9 @@ def cmd_fetch(args) -> int:
     kind = getattr(args, "kind", None) or "both"
     force = bool(getattr(args, "force_refresh", False))
     rc = 0
+    daily_df = None
     if kind in ("daily", "both"):
-        df = fetch_daily(
+        df = daily_df = fetch_daily(
             tickers,
             start=getattr(args, "start", None) or cfg.universe.start,
             end=getattr(args, "end", None) or cfg.universe.end,
@@ -684,7 +685,10 @@ def cmd_fetch(args) -> int:
         from csmom_tpu.panel.pack import pack_csv_cache
 
         try:
-            out = pack_csv_cache(data_dir, tickers, pack_to)
+            # reuse the frame fetch_daily already parsed (double-parsing the
+            # CSVs is the cost the pack exists to eliminate); intraday-only
+            # invocations still read the daily caches themselves
+            out = pack_csv_cache(data_dir, tickers, pack_to, df=daily_df)
         except ValueError as e:
             print(f"pack failed: {e}", file=sys.stderr)
             return 1

@@ -106,13 +106,19 @@ def load_packed(path: str, mmap: bool = True):
 
 
 def pack_csv_cache(data_dir: str, tickers, out: str,
-                   fields=("adj_close", "volume")) -> str:
+                   fields=("adj_close", "volume"), df=None) -> str:
     """One-shot CSV cache -> packed directory conversion (``csmom fetch
     --pack``): load the per-ticker daily CSVs through the normal ingest
-    path, pivot each requested field to a dense panel, write the pack."""
+    path, pivot each requested field to a dense panel, write the pack.
+
+    Pass ``df`` (the canonical long daily frame) when the caller already
+    holds it — ``csmom fetch`` does — so the CSVs are not re-parsed; that
+    double parse is the exact cost this format exists to eliminate.
+    """
     from csmom_tpu.panel.ingest import load_daily, long_to_panel
 
-    df = load_daily(data_dir, list(tickers))
+    if df is None:
+        df = load_daily(data_dir, list(tickers))
     if df.empty:
         raise ValueError(f"no readable daily caches for {len(tickers)} "
                          f"tickers under {data_dir}")

@@ -109,7 +109,6 @@ def test_config_file_momentum_keys_flow_through(tmp_path):
 # --- ADVICE #4: model-dependent alpha default in the API layer ------------
 
 @pytest.mark.slow
-
 def test_intraday_alpha_default_resolves_per_model(rng, monkeypatch):
     import pandas as pd
 

@@ -40,8 +40,6 @@ def test_backends_agree_gap_free(rng):
 
 
 @pytest.mark.slow
-
-
 def test_backends_agree_with_leading_gaps(rng):
     """Late listings (leading NaN runs) — warmup must match month for month."""
     panel = _toy_panel(rng, a=25, m=40)

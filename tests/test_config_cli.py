@@ -104,8 +104,6 @@ def test_cli_horizons_writes_plot(tmp_path, capsys):
 
 
 @pytest.mark.slow
-
-
 def test_horizon_plot_both_profile_shapes(tmp_path, rng):
     """save_horizon_plot accepts the plain [H] profile and the [V, H]
     volume-conditioned one (one line per tercile)."""

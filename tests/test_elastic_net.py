@@ -104,8 +104,6 @@ def test_cv_mses_match_sklearn_folds(rng):
 
 
 @pytest.mark.slow
-
-
 def test_intraday_pipeline_model_selection(rng):
     """--model wiring: elastic_net/lasso run end-to-end through the intraday
     pipeline; unknown model raises."""
@@ -130,8 +128,6 @@ def test_intraday_pipeline_model_selection(rng):
 
 
 @pytest.mark.slow
-
-
 def test_intraday_pipeline_warns_on_zeroed_model(rng):
     """A ridge-scale alpha on the l1 objective zeroes everything; the API
     must say so instead of silently going flat.  (The package logger has

@@ -79,8 +79,6 @@ def test_latency_zero_unchanged(rng):
 
 
 @pytest.mark.slow
-
-
 def test_latency_matches_oracle(rng):
     for L in (1, 3, 7):
         price, valid, score, adv, vol = _workload(rng)

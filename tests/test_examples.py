@@ -40,8 +40,6 @@ def test_strategy_zoo_example(capsys):
 
 
 @pytest.mark.slow
-
-
 def test_north_star_grid_example(capsys):
     _run("north_star_grid.py", ["--assets", "64", "--years", "4"])
     out = capsys.readouterr().out

@@ -72,8 +72,6 @@ def test_grid_cell_matches_oracle(rng, J, K):
 
 
 @pytest.mark.slow
-
-
 def test_full_16_cell_grid_shapes(rng):
     prices = _make_prices(rng, M=90, A=30)
     vals = prices.values.T
@@ -129,7 +127,6 @@ class TestGridNetOfCosts:
         return prices, mask
 
     @pytest.mark.slow
-
     def test_k1_matches_monthly_net_of_costs(self, rng):
         """A K=1 grid cell's netted spread equals the monthly engine's
         net_of_costs, shifted from formation-month to holding-month
@@ -157,7 +154,6 @@ class TestGridNetOfCosts:
         np.testing.assert_allclose(g[1:][both], m_[:-1][both], rtol=1e-9)
 
     @pytest.mark.slow
-
     def test_costs_fall_with_k_and_validity_preserved(self, rng):
         """Longer holding replaces ~1/K of the book per month, so the mean
         per-month cost drag must decrease with K; validity is untouched."""
@@ -202,7 +198,6 @@ class TestGridNetOfCosts:
             grid_net_of_costs(prices, mask, res)
 
     @pytest.mark.slow
-
     def test_overlapping_book_turnover_vs_loop_oracle(self, rng):
         """K=3 netted costs equal an explicit cohort-loop reconstruction:
         book at month m = mean of the 3 most recent formation books,

@@ -126,8 +126,6 @@ def _oracle_by_volume(prices, mask, turn, turn_valid, J, skip, n_bins, V, max_h)
 
 
 @pytest.mark.slow
-
-
 def test_volume_profile_matches_pandas_oracle(rng):
     from csmom_tpu.backtest import volume_horizon_profile
 
@@ -158,8 +156,6 @@ def test_volume_profile_matches_pandas_oracle(rng):
 
 
 @pytest.mark.slow
-
-
 def test_volume_horizon_table_shape(rng):
     from csmom_tpu.backtest import volume_horizon_profile
     from csmom_tpu.analytics.tables import volume_horizon_table

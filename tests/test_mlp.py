@@ -12,8 +12,6 @@ from tests.test_ridge import _padded
 
 
 @pytest.mark.slow
-
-
 def test_linear_anchor_matches_ridge(rng):
     """``hidden=()`` is a linear model trained by gradient descent — on a
     well-conditioned linear problem it must land near the closed-form ridge
@@ -44,8 +42,6 @@ def test_linear_anchor_matches_ridge(rng):
 
 
 @pytest.mark.slow
-
-
 def test_nonlinear_lift_over_ridge(rng):
     """On a target no linear model can express, the MLP's held-out fold MSE
     must beat ridge's."""
@@ -64,8 +60,6 @@ def test_nonlinear_lift_over_ridge(rng):
 
 
 @pytest.mark.slow
-
-
 def test_deterministic_given_seed(rng):
     X, y, valid, _, _ = _padded(rng)
     a = mlp_time_series_cv(X, y, valid, n_steps=50, seed=7)
@@ -79,8 +73,6 @@ def test_deterministic_given_seed(rng):
 
 
 @pytest.mark.slow
-
-
 def test_padding_layout_invariance(rng):
     """The fit depends on the ordered set of valid rows, not where padding
     sits: appending extra all-invalid rows must not change anything."""

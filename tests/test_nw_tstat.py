@@ -57,8 +57,6 @@ def test_lag_zero_vs_iid():
 
 
 @pytest.mark.slow
-
-
 def test_positive_autocorrelation_shrinks_t(rng):
     """Overlapping K-month holding induces positive serial correlation; NW
     must report smaller |t| than iid there (the whole point of the fix)."""
@@ -70,8 +68,6 @@ def test_positive_autocorrelation_shrinks_t(rng):
 
 
 @pytest.mark.slow
-
-
 def test_broadcast_per_cell_lags(rng):
     """A [nJ, nK, M] grid with per-K lags equals per-cell scalar calls."""
     nJ, nK, M = 2, 3, 150

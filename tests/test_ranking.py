@@ -93,8 +93,6 @@ def test_rank_mode_matches_reference_fallback(rng):
 
 
 @pytest.mark.slow
-
-
 def test_rank_mode_fuzz_ties_masks_small_n(rng):
     """Rank mode vs the pandas fallback formula under heavy ties, masked
     lanes, and tiny/degenerate cross-sections (exercises the boundary-pair
@@ -132,8 +130,6 @@ def test_panel_vmap(rng):
 
 
 @pytest.mark.slow
-
-
 def test_random_fuzz_vs_oracle(rng):
     """Fuzz: many random cross-sections incl. ties, NaNs, tiny N."""
     for trial in range(200):

@@ -104,8 +104,6 @@ def test_est_window_guard():
 
 
 @pytest.mark.slow
-
-
 def test_plugin_runs_through_engine(rng):
     """The registered strategy runs the shared engine end-to-end and its
     spread differs from raw momentum's (it is a genuinely different sort)."""
@@ -160,8 +158,6 @@ def test_sweep_misconfigured_cell_is_invalid_not_fatal(rng):
 
 
 @pytest.mark.slow
-
-
 def test_sweep_backtest_matches_strategy_engine(rng):
     """residual_sweep_backtest's per-cell spreads equal the strategy engine
     run at the same parameters."""

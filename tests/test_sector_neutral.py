@@ -28,8 +28,6 @@ def oracle_sector_deciles(values, sector_ids, n_sectors, n=10):
 
 
 @pytest.mark.slow
-
-
 def test_single_date_vs_oracle(rng):
     for trial in range(50):
         a = int(rng.integers(6, 60))

@@ -77,8 +77,6 @@ def test_zscore_combo_single_component_same_deciles(rng):
 
 
 @pytest.mark.slow
-
-
 def test_volume_z_momentum_gamma_zero_matches_momentum(rng):
     prices, mask = _toy(rng)
     volumes = rng.lognormal(10, 1, size=prices.shape)

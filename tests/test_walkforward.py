@@ -62,8 +62,6 @@ def test_selection_prefers_dominant_cell(rng):
 
 
 @pytest.mark.slow
-
-
 def test_end_to_end_grid_sweep(rng):
     A, M = 24, 70
     prices = 50 * np.exp(np.cumsum(rng.normal(0.004, 0.06, size=(A, M)), axis=1))
