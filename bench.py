@@ -388,11 +388,9 @@ def child_main():
 
     # peak HBM bandwidth by device kind, so achieved GB/s reads as a
     # fraction of the roofline rather than a bare number (VERDICT r2 item 2)
-    _PEAK_HBM_GBPS = {
-        "TPU v4": 1228.0, "TPU v5 lite": 819.0, "TPU v5e": 819.0,
-        "TPU v5p": 2765.0, "TPU v6 lite": 1640.0, "TPU v6e": 1640.0,
-    }
-    peak_gbps = None if on_cpu else _PEAK_HBM_GBPS.get(
+    from csmom_tpu.utils.profiling import PEAK_HBM_GBPS
+
+    peak_gbps = None if on_cpu else PEAK_HBM_GBPS.get(
         jax.devices()[0].device_kind
     )
 
@@ -679,6 +677,25 @@ def _load_last_tpu():
         with open(LAST_TPU_PATH) as f:
             return json.load(f)
     except (OSError, json.JSONDecodeError):
+        pass
+    # fall back to the committed round-3 session capture so a full-outage
+    # run still surfaces the most recent on-chip evidence — labeled with
+    # its weaker timing discipline rather than silently dropped
+    r3 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "BENCH_TPU_r03_session.json")
+    try:
+        with open(r3) as f:
+            rec = json.load(f)
+        probes = rec.get("extra", {}).get("tpu_probes") or [{}]
+        return {
+            # read the capture time from the record itself so a replaced
+            # file can never be misdated by a stale hardcoded string
+            "captured_utc": f"{probes[0].get('utc', 'unknown')} (r3 session)",
+            "provenance": "live (r3; block_until_ready-timed — treat walls "
+                          "as dispatch-inclusive upper bounds)",
+            "record": rec,
+        }
+    except (OSError, json.JSONDecodeError):
         return None
 
 
@@ -767,7 +784,9 @@ def main():
         if cached is not None and _is_tpu(cached.get("record")):
             rec = cached["record"]
             result.setdefault("extra", {})["tpu_last_verified"] = {
-                "provenance": "session-cached",
+                # compose: how it was captured then + that it is a cache now
+                "provenance": "session-cached (originally: "
+                              f"{cached.get('provenance', 'unknown')})",
                 "captured_utc": cached.get("captured_utc"),
                 "note": "most recent verified on-chip capture (this run's "
                         "probes never found the tunnel up — see tpu_probes); "

@@ -70,6 +70,11 @@ def main():
 
     platform = jax.devices()[0].platform
     kind = str(jax.devices()[0].device_kind)
+    if platform != "tpu":
+        # same dtype discipline as bench.py: f64 math off-TPU needs x64
+        # enabled, otherwise everything silently truncates to f32 while the
+        # itemsize-8 traffic model overstates bandwidth 2x
+        jax.config.update("jax_enable_x64", True)
     A, M, H, B = args.assets or 3000 * args.ax, 720, 12, 10
     # numpy (not jnp): these are closed over inside an extra jit wrapper,
     # where any jnp op — even on a constant — stages to a tracer and would
@@ -198,9 +203,9 @@ def main():
         "everything under one jit: XLA fuses phases 1-4",
     )
 
-    peak = {"TPU v5 lite": 819.0, "TPU v5e": 819.0, "TPU v4": 1228.0,
-            "TPU v5p": 2765.0, "TPU v6 lite": 1640.0,
-            "TPU v6e": 1640.0}.get(kind)
+    from csmom_tpu.utils.profiling import PEAK_HBM_GBPS
+
+    peak = PEAK_HBM_GBPS.get(kind)
     print(json.dumps({
         "metric": "grid_phase_breakdown",
         "platform": platform,

@@ -55,6 +55,15 @@ def fetch(y):
     return np.asarray(jax.device_get(y))
 
 
+# peak HBM bandwidth by jax device_kind, GB/s — the roofline denominator.
+# Single source of truth for bench.py / benchmarks/grid_phases.py: achieved
+# GB/s only means something as a fraction of the chip it ran on.
+PEAK_HBM_GBPS = {
+    "TPU v4": 1228.0, "TPU v5 lite": 819.0, "TPU v5e": 819.0,
+    "TPU v5p": 2765.0, "TPU v6 lite": 1640.0, "TPU v6e": 1640.0,
+}
+
+
 def measure_rtt(dtype=None, reps: int = 10) -> float:
     """Per-call floor of ``fetch``-timed walls: dispatch + device round
     trip for a trivial op, in seconds (mean over ``reps``)."""
