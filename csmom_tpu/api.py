@@ -21,20 +21,64 @@ from csmom_tpu.panel.panel import Panel
 
 def monthly_price_panel(data_dir: str, tickers, field: str = "adj_close",
                         daily_df=None):
-    """Daily CSV caches -> month-end price & volume panels.
+    """Daily CSV caches OR a packed panel directory -> month-end panels.
 
     Returns ``(prices Panel[A, M], volume Panel[A, M])`` with month-end
     timestamps, mirroring ``compute_monthly_momentum_from_daily``'s
     aggregation (``features.py:34-39``).  Pass ``daily_df`` (a canonical
     long frame from :func:`csmom_tpu.panel.ingest.load_daily`) to reuse an
     already-loaded universe instead of re-reading the CSV cache.
+
+    When ``data_dir`` is a packed directory (see
+    :func:`csmom_tpu.panel.pack.is_packed`), the dense panels are memmapped
+    straight from it: no CSV parsing at all, which is the at-scale path
+    (``csmom fetch --pack`` writes it; every monthly-panel CLI subcommand
+    — replicate/grid/doublesort/sweep/horizons/residual — then accepts the
+    pack as its ``--data-dir``; the intraday pipeline still needs minute
+    CSV caches, which packs do not hold).  ``tickers`` selects a subset of
+    the pack; pass an empty/None universe to take every packed ticker.
     """
-    df = daily_df if daily_df is not None else ingest.load_daily(data_dir, tickers)
-    price_daily = ingest.long_to_panel(df, field, time_col="date")
-    vol_daily = ingest.long_to_panel(
-        df, "volume", time_col="date",
-        tickers=price_daily.tickers, times=price_daily.times,
-    )
+    from csmom_tpu.panel.pack import is_packed
+
+    if daily_df is None and is_packed(data_dir):
+        from csmom_tpu.panel.pack import load_packed
+
+        bundle = load_packed(data_dir)
+        if isinstance(bundle, Panel):  # single-field pack: no volume leg
+            raise ValueError(
+                f"packed panel {data_dir} holds only {bundle.name!r}; the "
+                f"monthly pipeline needs {field!r} and 'volume' — repack "
+                "with both fields (csmom fetch --pack does)"
+            )
+        for need in (field, "volume"):
+            if need not in bundle:
+                raise ValueError(
+                    f"packed panel {data_dir} lacks field {need!r} "
+                    f"(has {', '.join(bundle.fields)}) — repack with it"
+                )
+        price_daily = bundle[field]
+        vol_daily = bundle["volume"]
+        if tickers:
+            want = set(tickers)
+            missing = sorted(want - set(price_daily.tickers))
+            if missing:
+                raise ValueError(
+                    f"packed panel {data_dir} lacks {len(missing)} requested "
+                    f"tickers: {','.join(missing[:8])}"
+                )
+            # sorted, like the CSV path's ingest pivot: the two sources must
+            # return identical row order for the same request
+            keep = sorted(t for t in price_daily.tickers if t in want)
+            price_daily = price_daily.select_assets(keep)
+            vol_daily = vol_daily.select_assets(keep)
+    else:
+        df = (daily_df if daily_df is not None
+              else ingest.load_daily(data_dir, tickers))
+        price_daily = ingest.long_to_panel(df, field, time_col="date")
+        vol_daily = ingest.long_to_panel(
+            df, "volume", time_col="date",
+            tickers=price_daily.tickers, times=price_daily.times,
+        )
     seg, month_ends = month_end_segments(price_daily.times)
     m = len(month_ends)
 

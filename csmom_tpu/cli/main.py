@@ -24,6 +24,11 @@ from csmom_tpu.utils.logging import get_logger
 log = get_logger("cli")
 
 
+def _parse_tickers(s: str) -> tuple:
+    """One comma-list parser for every --tickers flag (fetch included)."""
+    return tuple(t.strip().upper() for t in s.split(",") if t.strip())
+
+
 def _load_cfg(args) -> RunConfig:
     cfg = load_config(args.config) if args.config else RunConfig()
     if getattr(args, "backend", None):
@@ -33,6 +38,13 @@ def _load_cfg(args) -> RunConfig:
     if getattr(args, "data_dir", None):
         cfg = dataclasses.replace(
             cfg, universe=dataclasses.replace(cfg.universe, data_dir=args.data_dir)
+        )
+    if getattr(args, "tickers", None) and args.command != "fetch":
+        cfg = dataclasses.replace(
+            cfg,
+            universe=dataclasses.replace(cfg.universe,
+                                         tickers=_parse_tickers(args.tickers)),
+            explicit_universe=True,
         )
     mom = cfg.momentum
     explicit = set(cfg.explicit_momentum)  # config-file keys (load_config)
@@ -47,8 +59,14 @@ def _load_cfg(args) -> RunConfig:
 
 def _price_panel(cfg: RunConfig):
     from csmom_tpu.api import monthly_price_panel
+    from csmom_tpu.panel.pack import is_packed
 
-    return monthly_price_panel(cfg.universe.data_dir, list(cfg.universe.tickers))
+    tickers = list(cfg.universe.tickers)
+    if not cfg.explicit_universe and is_packed(cfg.universe.data_dir):
+        # a packed --data-dir with no user-chosen universe means "run the
+        # whole pack" — the built-in 20-name demo list is a CSV-era default
+        tickers = None
+    return monthly_price_panel(cfg.universe.data_dir, tickers)
 
 
 def _load_sector_map(path: str, tickers):
@@ -176,9 +194,13 @@ def cmd_replicate(args) -> int:
     # on the reference data a fresh run is 20 tickers (mean ~0.001935) while
     # BASELINE.md's measured 0.003674 is the reference's effective
     # 19-ticker panel — a universe difference, not drift
+    from csmom_tpu.panel.pack import is_packed
+
+    src = ("packed panel" if is_packed(cfg.universe.data_dir)
+           else "all readable caches included — the reference's own loader "
+                "drops dialect-B files")
     print(f"Universe: {prices.n_assets} tickers x {prices.n_times} dates "
-          f"({prices.tickers[0]}..{prices.tickers[-1]}; all readable caches "
-          "included — the reference's own loader drops dialect-B files)")
+          f"({prices.tickers[0]}..{prices.tickers[-1]}; {src})")
     print(f"Mean monthly spread: {rep.mean_spread:.6f}")
     print(f"Annualized Sharpe:   {rep.ann_sharpe:.4f}")
     print(f"t-stat (NW):         {rep.tstat_nw:.3f}")
@@ -470,7 +492,14 @@ def cmd_intraday(args) -> int:
     cfg = _load_cfg(args)
     from csmom_tpu.api import intraday_pipeline
     from csmom_tpu.panel.ingest import load_daily, load_intraday
+    from csmom_tpu.panel.pack import is_packed
 
+    if is_packed(cfg.universe.data_dir):
+        print("error: --data-dir is a packed panel, which holds daily "
+              "panels only; the intraday pipeline needs the minute CSV "
+              "caches — point --data-dir at the CSV cache directory",
+              file=sys.stderr)
+        return 2
     tickers = list(cfg.universe.tickers)
     minute_df = load_intraday(cfg.universe.data_dir, tickers)
     daily_df = load_daily(cfg.universe.data_dir, tickers)
@@ -637,7 +666,7 @@ def cmd_fetch(args) -> int:
     from csmom_tpu.panel.fetch import fetch_daily, fetch_intraday
 
     tickers = (
-        [t.strip().upper() for t in args.tickers.split(",") if t.strip()]
+        list(_parse_tickers(args.tickers))
         if getattr(args, "tickers", None) else list(cfg.universe.tickers)
     )
     data_dir = cfg.universe.data_dir
@@ -837,9 +866,15 @@ def cmd_strategies(args) -> int:
     return 0
 
 
-def _add_common(p):
+def _add_common(p, tickers: bool = True):
     p.add_argument("--config", help="TOML RunConfig file")
-    p.add_argument("--data-dir", help="CSV cache directory")
+    p.add_argument("--data-dir", help="CSV cache directory, or a packed "
+                                      "panel directory (csmom fetch --pack)")
+    if tickers:
+        p.add_argument("--tickers",
+                       help="comma-separated symbols (default: config "
+                            "universe; with a packed --data-dir, default = "
+                            "every packed ticker)")
     p.add_argument("--out", help="results directory")
     p.add_argument("--backend", choices=["tpu", "pandas"])
     p.add_argument("--platform", choices=["cpu", "tpu", "default"],
@@ -896,7 +931,7 @@ def build_parser() -> argparse.ArgumentParser:
         ("bench", cmd_bench, ()),
     ):
         sp = sub.add_parser(name, help=(fn.__doc__ or "").splitlines()[0])
-        _add_common(sp)
+        _add_common(sp, tickers=(name != "fetch"))  # fetch has its own
         if "js" in extra:
             sp.add_argument("--js", help="comma-separated J values")
         if "ks" in extra:
@@ -1016,8 +1051,10 @@ def _apply_platform(args) -> int:
     default platform is probed in a subprocess with a hard timeout
     (``CSMOM_PLATFORM_PROBE_S``, default 6 s) before any in-process device
     use; on timeout the CLI prints the workaround and exits 3 instead of
-    hanging.  An explicit ``--platform tpu`` skips the probe — that is the
-    "I know, wait for it" escape hatch.
+    hanging.  ``CSMOM_PLATFORM_PROBE_S=0`` disables the probe (the "I
+    know, wait for it" escape hatch — an explicit ``--platform tpu``
+    is NOT that: it selects the local tpu plugin, a different backend
+    than a tunneled platform like this image's 'axon').
     """
     choice = getattr(args, "platform", None)
     if choice in (None, "default"):
@@ -1035,6 +1072,8 @@ def _apply_platform(args) -> int:
             import subprocess
 
             probe_s = float(os.environ.get("CSMOM_PLATFORM_PROBE_S", "6"))
+            if probe_s <= 0:
+                return 0  # probe disabled: proceed on the env's platform
             try:
                 subprocess.run(
                     [sys.executable, "-c",
@@ -1048,9 +1087,11 @@ def _apply_platform(args) -> int:
                     "(remote tunnel down?).\n"
                     "  - re-run with `--platform cpu` (every subcommand "
                     "supports it), or\n"
-                    "  - `--platform tpu` to skip this probe and wait for "
-                    "the backend, or\n"
-                    "  - raise the probe timeout via CSMOM_PLATFORM_PROBE_S",
+                    "  - set CSMOM_PLATFORM_PROBE_S=0 to skip this probe "
+                    "and wait the backend out, or raise it for a longer "
+                    "probe (note: `--platform tpu` selects a LOCAL tpu "
+                    "plugin, which is a different backend than a tunneled "
+                    "one like 'axon')",
                     file=sys.stderr,
                 )
                 return 3

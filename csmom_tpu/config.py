@@ -94,6 +94,11 @@ class RunConfig:
     # e.g. strategy parametrization — distinguish "user chose lookback=12"
     # from "built-in default is 12", without re-parsing the file.
     explicit_momentum: Sequence[str] = ()
+    # True when the user chose the universe (config-file [universe].tickers
+    # or a --tickers flag) rather than inheriting the built-in demo list;
+    # lets pack-aware consumers default to "every packed ticker" without
+    # overriding an explicit choice
+    explicit_universe: bool = False
 
 
 _SECTIONS = {
@@ -132,4 +137,5 @@ def load_config(path: str) -> RunConfig:
         else:
             kwargs[key] = val
     kwargs["explicit_momentum"] = tuple(sorted(raw.get("momentum", {})))
+    kwargs["explicit_universe"] = "tickers" in raw.get("universe", {})
     return RunConfig(**kwargs)

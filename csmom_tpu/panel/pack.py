@@ -41,6 +41,16 @@ from csmom_tpu.panel.panel import Panel, PanelBundle
 _PACK_VERSION = 1
 
 
+def is_packed(path: str) -> bool:
+    """True iff ``path`` is a packed panel directory (manifest present).
+
+    The one place pack detection lives: the API and every CLI surface that
+    accepts a pack as ``--data-dir`` route through this, so a future layout
+    change cannot diverge between them.
+    """
+    return os.path.isfile(os.path.join(path, "meta.json"))
+
+
 def save_packed(obj, path: str) -> str:
     """Write a :class:`Panel` or :class:`PanelBundle` as a packed directory.
 

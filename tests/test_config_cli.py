@@ -419,3 +419,17 @@ class TestPlatformFailFast:
         rc = main(["strategies"])
         assert rc == 0
         assert "momentum" in capsys.readouterr().out
+
+    def test_probe_disabled_via_env_zero(self, monkeypatch):
+        # CSMOM_PLATFORM_PROBE_S=0 skips the probe entirely: the command
+        # proceeds on the env default (here: in-process cpu via conftest)
+        import jax
+
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("CSMOM_PLATFORM_PROBE_S", "0")
+        jax.config.update("jax_platforms", "")
+        try:
+            with pytest.raises((Exception, SystemExit)):
+                main(["replicate", "--data-dir", "/nonexistent"])
+        finally:
+            jax.config.update("jax_platforms", "cpu")

@@ -132,3 +132,55 @@ def test_pack_csv_cache_cli(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(b["adj_close"].values), want.values
     )
+
+
+@pytest.mark.reference_data
+def test_monthly_pipeline_reads_pack_directly(tmp_path):
+    """monthly_price_panel on a packed dir must equal the CSV path exactly
+    (same tickers, same month-ends, bit-equal panels) — the pack is a
+    drop-in --data-dir for every CLI subcommand."""
+    from tests.conftest import REFERENCE_DATA
+
+    from csmom_tpu.api import monthly_price_panel
+    from csmom_tpu.panel.pack import pack_csv_cache
+
+    tk = ["AAPL", "AMD", "NVDA", "MSFT"]
+    out = str(tmp_path / "pack")
+    pack_csv_cache(REFERENCE_DATA, tk, out)
+
+    p_csv, v_csv = monthly_price_panel(REFERENCE_DATA, tk)
+    p_pack, v_pack = monthly_price_panel(out, tk)
+    assert p_pack.tickers == p_csv.tickers
+    np.testing.assert_array_equal(p_pack.times, p_csv.times)
+    np.testing.assert_array_equal(p_pack.values, p_csv.values)
+    np.testing.assert_array_equal(v_pack.values, v_csv.values)
+    np.testing.assert_array_equal(v_pack.mask, v_csv.mask)
+
+    # subset selection + loud failure on missing tickers
+    p_sub, _ = monthly_price_panel(out, ["AMD", "NVDA"])
+    assert p_sub.tickers == ("AMD", "NVDA")
+    with pytest.raises(ValueError, match="lacks 1 requested"):
+        monthly_price_panel(out, ["AMD", "ZZZNOPE"])
+
+
+@pytest.mark.reference_data
+def test_cli_replicate_on_pack(tmp_path, capsys):
+    """A packed --data-dir drives replicate end-to-end: default = whole
+    pack, --tickers = explicit subset, and the universe line names the
+    packed source."""
+    from tests.conftest import REFERENCE_DATA
+
+    from csmom_tpu.cli.main import main
+    from csmom_tpu.panel.pack import pack_csv_cache
+
+    out = str(tmp_path / "pack")
+    pack_csv_cache(REFERENCE_DATA, ["AAPL", "AMD", "NVDA", "MSFT"], out)
+
+    assert main(["replicate", "--data-dir", out, "--out",
+                 str(tmp_path / "r1")]) == 0
+    text = capsys.readouterr().out
+    assert "Universe: 4 tickers" in text and "packed panel" in text
+
+    assert main(["replicate", "--data-dir", out, "--tickers", "AMD,NVDA",
+                 "--out", str(tmp_path / "r2")]) == 0
+    assert "Universe: 2 tickers" in capsys.readouterr().out
