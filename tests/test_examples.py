@@ -45,3 +45,12 @@ def test_north_star_grid_example(capsys):
     out = capsys.readouterr().out
     assert "16-cell grid in" in out
     assert "walk-forward" in out
+
+
+def test_pack_at_scale_example(capsys, tmp_path):
+    """The at-scale pack workflow demo: its own bit-identity assert holds
+    (the script raises on any packed-vs-memory divergence)."""
+    _run("pack_at_scale.py", ["--assets", "48", "--years", "4",
+                              "--keep", str(tmp_path / "pack")])
+    out = capsys.readouterr().out
+    assert "bit-identical" in out and "pack kept" in out
