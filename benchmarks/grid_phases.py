@@ -133,18 +133,29 @@ def main():
     )(pm, mm)
     mom = jax.block_until_ready(mom)
 
-    for mode in ("rank", "qcut"):
+    for mode in ("rank", "qcut", "hist"):
         rank_fn = lambda x, v, mode=mode: jax.vmap(
             lambda xj, vj: decile_assign_panel(xj, vj, B, mode=mode)[0]
         )(x, v)
-        report(
-            f"ranking[{mode}](vmap JxM sort)", timed(rank_fn, mom, momv),
+        if mode == "hist":
+            # sort-free radix binning: nbits/4 rounds of bucket scans over
+            # the [A, M] keys + the (B-1)-boundary compare pass
+            rounds = (8 if itemsize == 4 else 16)
+            gb = nJ * (rounds + 3) * A * M * itemsize / 1e9
+            gf = nJ * rounds * 2 * A * M / 1e9
+            note = ("radix-histogram binning (no sort): label-identical to "
+                    "rank; CANDIDATE for sort-dominated sizes — measured "
+                    "slower on CPU f64 (16 rounds, no fusion win), the "
+                    "tpu f32 form (8 rounds, fused scans vs bitonic sort) "
+                    "is what this phase row decides")
+        else:
             # sort reads+writes [A, M] keys ~log(A) times per J (bitonic on
             # TPU); count one logical pass as the *lower bound* model
-            nJ * 3 * A * M * itemsize / 1e9,
-            nJ * A * np.log2(max(A, 2)) * M / 1e9,
-            "one batched argsort over (J, M); flops column = comparison model",
-        )
+            gb = nJ * 3 * A * M * itemsize / 1e9
+            gf = nJ * A * np.log2(max(A, 2)) * M / 1e9
+            note = ("one batched argsort over (J, M); flops column = "
+                    "comparison model")
+        report(f"ranking[{mode}]", timed(rank_fn, mom, momv), gb, gf, note)
 
     labels = jax.jit(
         lambda x, v: jax.vmap(

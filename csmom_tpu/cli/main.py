@@ -288,6 +288,15 @@ def cmd_grid(args) -> int:
     v, m = prices.device()
     n_shards = getattr(args, "shards", None) or 0
     mode = getattr(args, "mode", None) or cfg.momentum.mode
+    if (n_shards > 1 or mode == "rank_hist") and mode == "hist":
+        # sharded 'hist' would all_gather and then re-run the full-panel
+        # histogram kernel redundantly on every shard — strictly worse than
+        # the gather+sort baseline at exactly the sizes hist targets.  The
+        # labels are identical to rank by construction, so substitute it.
+        print("--mode hist under --shards: labels are identical to rank; "
+              "using the distributed rank path (rank_hist is the "
+              "comm-efficient large-A form)", file=sys.stderr)
+        mode = "rank"
     if n_shards > 1 or mode == "rank_hist":
         # distributed grid over an asset-sharded mesh; the only mode that
         # REQUIRES it is rank_hist (the O(A)-free radix-histogram rank has
@@ -885,11 +894,13 @@ def _add_common(p, tickers: bool = True):
     p.add_argument("--lookback", type=int, help="formation months J")
     p.add_argument("--skip", type=int, help="skip months")
     p.add_argument("--n-bins", dest="n_bins", type=int)
-    p.add_argument("--mode", choices=["qcut", "rank", "rank_hist"],
+    p.add_argument("--mode", choices=["qcut", "rank", "hist", "rank_hist"],
                    help="decile assignment: qcut (pandas parity), rank "
-                        "(fast ordinal), rank_hist (distributed radix-"
-                        "histogram rank — grid command only, implies a "
-                        "sharded mesh)")
+                        "(fast ordinal, one batched sort), hist (sort-free "
+                        "radix-histogram form of rank — same labels; the "
+                        "candidate for >=50k-asset universes), rank_hist "
+                        "(distributed radix-histogram rank — grid command "
+                        "only, implies a sharded mesh)")
 
 
 def _add_turnover_flags(sp):

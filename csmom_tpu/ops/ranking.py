@@ -33,6 +33,38 @@ import jax.numpy as jnp
 _BIG = jnp.inf
 
 
+def sortable_bits(x, valid):
+    """Monotone float -> unsigned-int key map; invalid lanes get the max
+    key, STRICTLY above every valid value including ``+inf`` — an ``inf``
+    sentinel would tie with valid ``+inf`` lanes and let stable-sort
+    position decide whether an invalid lane steals a boundary slot
+    (mislabeling real data whose momentum hits ``inf``, e.g. a zero
+    formation price).  Signed zeros are canonicalized first:
+    ``jnp.argsort``'s comparator treats -0.0 and +0.0 as equal (stable tie
+    by position), so they must map to one bit key.  ``x + 0.0`` would do
+    it in IEEE arithmetic but XLA's algebraic simplifier folds
+    ``a + 0.0 -> a`` under jit (verified: the sign bit survives jit but
+    not eager), so use a compare-select, which the simplifier cannot
+    legally fold (-0.0 == +0.0 is true yet their bits differ).
+
+    Shared by single-device ranking (here) and the distributed radix rank
+    (:mod:`csmom_tpu.parallel.histrank`) — one key map, one total order.
+    """
+    from jax import lax
+
+    x = jnp.where(x == 0.0, jnp.zeros_like(x), x)
+    if x.dtype == jnp.float64:
+        ib, ub, nbits = jnp.int64, jnp.uint64, 64
+    else:
+        x = x.astype(jnp.float32)
+        ib, ub, nbits = jnp.int32, jnp.uint32, 32
+    b = lax.bitcast_convert_type(x, ib)
+    u = lax.bitcast_convert_type(b, ub)
+    top = jnp.array(1, ub) << (nbits - 1)
+    flipped = jnp.where(b < 0, ~u, u | top)
+    return jnp.where(valid, flipped, ~jnp.array(0, ub)), nbits
+
+
 def _rank_labels(x, valid, n_bins: int):
     """The reference's fallback binning: ``floor(pct_rank * n_bins)`` capped
     at ``n_bins-1`` (``run_demo.py:26-29``), ties by position like
@@ -53,10 +85,16 @@ def _rank_labels(x, valid, n_bins: int):
     only bin count, B=10, the two agree for every n up to at least 20,000
     assets (checked exhaustively); larger B may differ on ~1 boundary lane
     per affected date, and the exact-arithmetic answer is the intended
-    binning."""
+    binning.
+
+    Ranks on :func:`sortable_bits` keys, not a float-``inf`` sentinel:
+    invalid lanes sort STRICTLY after every valid value (including a
+    valid ``+inf``), so a boundary slot can never land on an invalid
+    lane — and the total order is the same one the histogram form uses,
+    which is what makes ``mode='hist'`` label-identical by construction."""
     A = x.shape[0]
-    key = jnp.where(valid, x, _BIG)
-    order = jnp.argsort(key, stable=True)  # invalid lanes sort last
+    key, _ = sortable_bits(x, valid)
+    order = jnp.argsort(key, stable=True)  # invalid lanes sort last, strictly
     n = jnp.sum(valid).astype(jnp.int32)
     k = jnp.arange(1, n_bins, dtype=jnp.int32)
     r_k = (k * n + n_bins - 1) // n_bins   # ceil(k*n/B): label >= k iff rank >= r_k
@@ -139,11 +177,17 @@ def decile_assign(x, valid, n_bins: int = 10, mode: str = "qcut"):
       x: f[A] signal values (NaN allowed at masked lanes).
       valid: bool[A].
       n_bins: number of quantile bins (10 = deciles).
-      mode: "qcut" (pandas parity) or "rank" (fast ordinal binning).
+      mode: "qcut" (pandas parity), "rank" (fast ordinal binning) or
+        "hist" (sort-free radix-histogram form of rank — same labels).
 
     Returns:
       (labels i32[A] with -1 at masked lanes, n_bins_effective i32 scalar)
     """
+    if mode == "hist":
+        labels, n_eff = decile_assign_panel(
+            x[:, None], valid[:, None], n_bins=n_bins, mode="hist"
+        )
+        return labels[:, 0], n_eff[0]
     if mode == "qcut":
         return _qcut_labels(x, valid, n_bins)
     if mode == "rank":
@@ -157,8 +201,24 @@ def decile_assign(x, valid, n_bins: int = 10, mode: str = "qcut"):
 def decile_assign_panel(x, valid, n_bins: int = 10, mode: str = "qcut"):
     """Vectorize ``decile_assign`` over the time axis of an ``[A, T]`` panel.
 
+    ``mode='hist'`` bins without sorting: the radix-histogram boundary
+    selection (``parallel.histrank.histogram_rank_labels`` with its
+    collectives degenerated to identities) replaces the O(A log A) batched
+    sort with O(A * rounds) bucket scans — label-identical to ``'rank'``
+    by construction (same order statistics, same stable tie rule), it is
+    the candidate kernel for the >=50k-asset regime where the sort owns
+    the phase profile (ROOFLINE.md; measured by benchmarks/grid_phases.py).
+
     Returns ``(labels i32[A, T], n_bins_effective i32[T])``.
     """
+    if mode == "hist":
+        from csmom_tpu.parallel.histrank import histogram_rank_labels
+
+        labels_t = histogram_rank_labels(x, valid, n_bins, axis_name=None)
+        n_eff = jnp.minimum(
+            jnp.sum(valid, axis=0), n_bins
+        ).astype(jnp.int32)
+        return labels_t, n_eff
     labels_t, n_eff = jax.vmap(
         lambda xv, mv: decile_assign(xv, mv, n_bins=n_bins, mode=mode),
         in_axes=1,

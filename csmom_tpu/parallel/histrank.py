@@ -36,29 +36,14 @@ from jax import lax
 __all__ = ["histogram_rank_labels"]
 
 
-def _sortable_bits(x, valid):
-    """Monotone float -> unsigned-int key map; invalid lanes get the max
-    key.  Signed zeros are canonicalized first: ``jnp.argsort``'s comparator
-    treats -0.0 and +0.0 as equal (stable tie by position), so they must map
-    to one bit key.  ``x + 0.0`` would do it in IEEE arithmetic but XLA's
-    algebraic simplifier folds ``a + 0.0 -> a`` under jit (verified: the
-    sign bit survives jit but not eager), so use a compare-select, which
-    the simplifier cannot legally fold (-0.0 == +0.0 is true yet their bits
-    differ)."""
-    x = jnp.where(x == 0.0, jnp.zeros_like(x), x)
-    if x.dtype == jnp.float64:
-        ib, ub, nbits = jnp.int64, jnp.uint64, 64
-    else:
-        x = x.astype(jnp.float32)
-        ib, ub, nbits = jnp.int32, jnp.uint32, 32
-    b = lax.bitcast_convert_type(x, ib)
-    u = lax.bitcast_convert_type(b, ub)
-    top = jnp.array(1, ub) << (nbits - 1)
-    flipped = jnp.where(b < 0, ~u, u | top)
-    return jnp.where(valid, flipped, ~jnp.array(0, ub)), nbits
+# the float->uint key map lives with the single-device ranking kernels
+# (ops.ranking.sortable_bits): one key map defines THE total order that
+# both the argsort rank and this histogram rank bin by — keeping them
+# label-identical by construction, including the invalid-above-+inf rule
+from csmom_tpu.ops.ranking import sortable_bits as _sortable_bits
 
 
-def histogram_rank_labels(x_l, valid_l, n_bins: int, axis_name: str,
+def histogram_rank_labels(x_l, valid_l, n_bins: int, axis_name: str | None,
                           bits_per_round: int = 4):
     """Shard-local rank-mode decile labels for an asset-sharded panel.
 
@@ -66,15 +51,31 @@ def histogram_rank_labels(x_l, valid_l, n_bins: int, axis_name: str,
     ``[A_local, M]`` rows (shard i holding global rows
     ``[i*A_local, (i+1)*A_local)``, as ``P('assets', None)`` lays out).
 
+    With ``axis_name=None`` the collectives degenerate to identities and
+    this IS the single-device histogram binning kernel: O(A·rounds)
+    bucket scans + O(A·(B-1)) boundary compares instead of the O(A log A)
+    sort — the sort-free form of ``decile_assign_panel(mode='rank')``
+    (exposed there as ``mode='hist'``), worth it exactly when the batched
+    per-date sort is the dominant phase (benchmarks/grid_phases.py).
+
     Returns ``labels i32[A_local, M]`` (-1 at invalid lanes), equal to the
     local slice of ``decile_assign_panel(gathered, mode='rank')``.
     """
+    if axis_name is None:
+        psum = lambda v, _=None: v
+        axis_index = lambda _=None: jnp.int32(0)
+        all_gather = lambda v, _=None: v[None]
+    else:
+        psum = lambda v, _=None: lax.psum(v, axis_name)
+        axis_index = lambda _=None: lax.axis_index(axis_name)
+        all_gather = lambda v, _=None: lax.all_gather(v, axis_name)
+
     A_l, M = x_l.shape
     key, nbits = _sortable_bits(x_l, valid_l)
     R = 1 << bits_per_round
-    shard = lax.axis_index(axis_name)
+    shard = axis_index()
     gpos = shard * A_l + jnp.arange(A_l, dtype=jnp.int32)          # [A_l]
-    n = lax.psum(jnp.sum(valid_l, axis=0, dtype=jnp.int32), axis_name)  # [M]
+    n = psum(jnp.sum(valid_l, axis=0, dtype=jnp.int32))            # [M]
     E = n_bins - 1
     ks = jnp.arange(1, n_bins, dtype=jnp.int32)
     r_k = (ks[:, None] * n[None, :] + n_bins - 1) // n_bins        # [E, M]
@@ -96,7 +97,7 @@ def histogram_rank_labels(x_l, valid_l, n_bins: int, axis_name: str,
             [jnp.sum(cand & (bucket == b)[:, :, None], axis=0,
                      dtype=jnp.int32) for b in range(R)], axis=0
         )                                                          # [R, M, E]
-        hist = lax.psum(hist, axis_name)
+        hist = psum(hist)
         cum = jnp.cumsum(hist, axis=0)
         rk = rank.T                                                # [M, E]
         bstar = jnp.sum(cum < rk[None, :, :], axis=0)              # [M, E]
@@ -111,10 +112,10 @@ def histogram_rank_labels(x_l, valid_l, n_bins: int, axis_name: str,
     # --- tie resolution: global position of each boundary lane, among
     #     *bit-identical* keys (the stable argsort's total order) ---------
     below_v = valid_l[:, :, None] & (key[:, :, None] < v[None, :, :])
-    c_lt = lax.psum(jnp.sum(below_v, axis=0, dtype=jnp.int32), axis_name)
+    c_lt = psum(jnp.sum(below_v, axis=0, dtype=jnp.int32))
     eq = valid_l[:, :, None] & (key[:, :, None] == v[None, :, :])  # [A_l, M, E]
     loc_eq = jnp.sum(eq, axis=0, dtype=jnp.int32)                  # [M, E]
-    g_eq = lax.all_gather(loc_eq, axis_name)                       # [nsh, M, E]
+    g_eq = all_gather(loc_eq)                                      # [nsh, M, E]
     sh_ids = jnp.arange(g_eq.shape[0])
     prev_eq = jnp.sum(
         jnp.where((sh_ids < shard)[:, None, None], g_eq, 0), axis=0
@@ -124,8 +125,8 @@ def histogram_rank_labels(x_l, valid_l, n_bins: int, axis_name: str,
     ceq = jnp.cumsum(eq, axis=0)
     match = eq & (ceq == local_j[None]) & (local_j > 0)[None] \
         & (local_j <= loc_eq)[None]
-    bpos = lax.psum(
-        jnp.sum(jnp.where(match, gpos[:, None, None], 0), axis=0), axis_name
+    bpos = psum(
+        jnp.sum(jnp.where(match, gpos[:, None, None], 0), axis=0)
     )                                                              # [M, E]
 
     # --- labels: dominated boundary pairs, exactly _rank_labels' rule

@@ -136,3 +136,88 @@ def test_random_fuzz_vs_oracle(rng):
         a = int(rng.integers(1, 40))
         vals = rng.choice([np.nan, 0.0, 1.0, 1.0 + 1e-9, *rng.normal(size=5)], size=a)
         _check(vals)
+
+
+class TestHistMode:
+    """mode='hist' (sort-free radix binning) must be label-identical to
+    mode='rank' — same order statistics, same stable tie rule — including
+    the adversarial cases that broke round 2's distributed version."""
+
+    def test_matches_rank_random_with_holes(self, rng):
+        x = rng.normal(size=(57, 9))
+        valid = rng.random((57, 9)) > 0.25
+        x = np.where(valid, x, np.nan)
+        lr, nr = decile_assign_panel(x, valid, 10, mode="rank")
+        lh, nh = decile_assign_panel(x, valid, 10, mode="hist")
+        np.testing.assert_array_equal(np.asarray(lr), np.asarray(lh))
+        np.testing.assert_array_equal(np.asarray(nr), np.asarray(nh))
+
+    def test_heavy_ties_and_signed_zero(self, rng):
+        x = rng.choice([0.0, -0.0, 1.5, -1.5, 2.0], size=(40, 6))
+        valid = rng.random((40, 6)) > 0.2
+        x = np.where(valid, x, np.nan)
+        for B in (3, 5, 10):
+            lr, _ = decile_assign_panel(x, valid, B, mode="rank")
+            lh, _ = decile_assign_panel(x, valid, B, mode="hist")
+            np.testing.assert_array_equal(np.asarray(lr), np.asarray(lh))
+
+    def test_fewer_valid_than_bins_and_empty_dates(self, rng):
+        x = rng.normal(size=(4, 5))
+        valid = np.zeros((4, 5), bool)
+        valid[:2, 0] = True   # 2 valid < 10 bins
+        valid[:, 2] = True    # full date
+        x = np.where(valid, x, np.nan)
+        lr, nr = decile_assign_panel(x, valid, 10, mode="rank")
+        lh, nh = decile_assign_panel(x, valid, 10, mode="hist")
+        np.testing.assert_array_equal(np.asarray(lr), np.asarray(lh))
+        np.testing.assert_array_equal(np.asarray(nr), np.asarray(nh))
+
+    def test_single_date_form(self, rng):
+        x = rng.normal(size=37)
+        valid = rng.random(37) > 0.3
+        x = np.where(valid, x, np.nan)
+        lr, nr = decile_assign(x, valid, 10, mode="rank")
+        lh, nh = decile_assign(x, valid, 10, mode="hist")
+        np.testing.assert_array_equal(np.asarray(lr), np.asarray(lh))
+        assert int(nr) == int(nh)
+
+    def test_f32_keys(self, rng):
+        x = rng.normal(size=(48, 4)).astype(np.float32)
+        valid = np.ones((48, 4), bool)
+        lr, _ = decile_assign_panel(x, valid, 10, mode="rank")
+        lh, _ = decile_assign_panel(x, valid, 10, mode="hist")
+        np.testing.assert_array_equal(np.asarray(lr), np.asarray(lh))
+
+    def test_grid_engine_hist_mode_matches_rank(self, rng):
+        from csmom_tpu.backtest.grid import jk_grid_backtest
+
+        prices = 50 * np.exp(np.cumsum(rng.normal(0.004, 0.06, size=(30, 60)), axis=1))
+        mask = np.ones((30, 60), bool)
+        mask[:4, :12] = False
+        Js, Ks = np.array([3, 6]), np.array([1, 3])
+        a = jk_grid_backtest(prices, mask, Js, Ks, n_bins=5, mode="rank")
+        b = jk_grid_backtest(prices, mask, Js, Ks, n_bins=5, mode="hist")
+        np.testing.assert_array_equal(np.asarray(a.spread_valid),
+                                      np.asarray(b.spread_valid))
+        np.testing.assert_allclose(np.asarray(a.mean_spread),
+                                   np.asarray(b.mean_spread), rtol=1e-12)
+
+    def test_valid_inf_with_invalid_lanes(self):
+        """A valid +inf must not tie with the invalid-lane sentinel: rank
+        and hist agree, and no boundary slot lands on an invalid lane
+        (regression: the float-inf sentinel let stable-sort position decide
+        and mislabeled real +inf momentum, e.g. a zero formation price)."""
+        x = np.array([[np.nan], [np.inf], [np.inf], [np.inf], [0.0], [1.0]])
+        valid = np.array([[False], [True], [True], [True], [True], [True]])
+        for B in (3, 5, 10):
+            lr, nr = decile_assign_panel(x, valid, B, mode="rank")
+            lh, nh = decile_assign_panel(x, valid, B, mode="hist")
+            np.testing.assert_array_equal(np.asarray(lr), np.asarray(lh))
+            np.testing.assert_array_equal(np.asarray(nr), np.asarray(nh))
+            assert np.asarray(lr)[0, 0] == -1
+        # B=3 over 5 valid, ordinal ranks [3,4,5,1,2] (ties by position,
+        # rank(method='first')): labels floor(pct*3) = [1,2,2,0,1] — the
+        # first +inf lands in bin 1, exactly as the pandas formula says
+        lr, _ = decile_assign_panel(x, valid, 3, mode="rank")
+        np.testing.assert_array_equal(np.asarray(lr)[:, 0],
+                                      [-1, 1, 2, 2, 0, 1])
