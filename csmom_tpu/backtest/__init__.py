@@ -7,7 +7,8 @@ from csmom_tpu.backtest.monthly import (
     sector_neutral_backtest,
     MonthlyResult,
 )
-from csmom_tpu.backtest.grid import grid_net_of_costs, jk_grid_backtest, GridResult
+from csmom_tpu.backtest.grid import (grid_break_even_bps, grid_net_of_costs,
+                                     jk_grid_backtest, GridResult)
 from csmom_tpu.backtest.horizon import (
     horizon_profile,
     HorizonProfile,
@@ -36,6 +37,7 @@ __all__ = [
     "sector_neutral_backtest",
     "MonthlyResult",
     "jk_grid_backtest",
+    "grid_break_even_bps",
     "grid_net_of_costs",
     "GridResult",
     "horizon_profile",

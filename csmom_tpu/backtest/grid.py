@@ -382,6 +382,72 @@ def grid_net_of_costs(prices, mask, grid: GridResult,
     )
 
 
+def grid_break_even_bps(prices, mask, grid: GridResult,
+                        unit: GridResult | None = None):
+    """Per-cell break-even transaction cost, in bps of half-spread.
+
+    Turnover cost is LINEAR in the half-spread (cost_m = hs * L1 weight
+    change), so one unit-cost netting run prices every cost level: the
+    break-even half-spread of cell (J, K) is the gross mean spread per
+    unit of mean turnover,
+
+        be_bps[J, K] = mean(gross_m) / mean(turnover_m) * 1e4,
+
+    the cost level at which the cell's mean monthly spread nets to zero.
+    The classic JT/LeSw finding falls out: longer K replaces ~1/K of the
+    book per month, so break-evens rise with K even as gross spreads fall.
+
+    Same host-side contract as :func:`grid_net_of_costs` (parameters ride
+    on the result; ``prices``/``mask`` must be the panel the grid was
+    built from).  Pass ``unit`` — a ``grid_net_of_costs(..., half_spread
+    =1.0)`` result — to reuse an existing netting run instead of
+    recomputing the books (the CLI does; see :func:`grid_net_from_unit`).
+    Returns ``(be_bps f[nJ, nK], mean_turnover f[nJ, nK])`` — cells with
+    zero mean turnover report +/-inf by sign of the spread.
+    """
+    if unit is None:
+        unit = grid_net_of_costs(prices, mask, grid, half_spread=1.0)
+    # mean cost at hs=1 == mean turnover per month (masked to live months;
+    # both spread tensors are already NaN outside spread_valid)
+    mean_turn = masked_mean(grid.spreads - unit.spreads, grid.spread_valid)
+    be = grid.mean_spread / mean_turn * 1e4
+    return be, mean_turn
+
+
+def grid_net_from_unit(grid: GridResult, unit: GridResult,
+                       half_spread: float, freq: int = 12) -> GridResult:
+    """Re-price a netted grid at any cost level from ONE unit-cost run.
+
+    The cost series is linear in the half-spread, so with ``unit`` =
+    ``grid_net_of_costs(..., half_spread=1.0)`` the per-month unit cost is
+    ``grid.spreads - unit.spreads`` and any level is an elementwise
+    re-price — no book recomputation.  Statistics (Sharpe, iid and
+    Newey–West t) are re-assembled from the re-priced series, matching
+    ``grid_net_of_costs(..., half_spread)`` exactly.
+    """
+    import numpy as np
+
+    cost_unit = grid.spreads - unit.spreads
+    net = jnp.where(grid.spread_valid, grid.spreads - half_spread * cost_unit,
+                    jnp.nan)
+    Ks_c = tuple(int(k) for k in np.asarray(grid.Ks))
+    return GridResult(
+        spreads=net,
+        spread_valid=grid.spread_valid,
+        mean_spread=masked_mean(net, grid.spread_valid),
+        ann_sharpe=sharpe(net, grid.spread_valid, freq_per_year=freq),
+        tstat=t_stat(net, grid.spread_valid),
+        tstat_nw=nw_t_stat(net, grid.spread_valid,
+                           lags=jnp.asarray(Ks_c)[None, :],
+                           max_lag=max(Ks_c)),
+        Js=grid.Js,
+        Ks=grid.Ks,
+        skip=grid.skip,
+        n_bins=grid.n_bins,
+        mode=grid.mode,
+    )
+
+
 @partial(jax.jit, static_argnames=("Ks_c", "skip", "n_bins", "mode", "freq"))
 def _grid_net_core(prices, mask, Js, spreads, spread_valid, half_spread,
                    Ks_c: tuple, skip: int, n_bins: int, mode: str, freq: int):

@@ -339,11 +339,13 @@ def cmd_grid(args) -> int:
     elif getattr(args, "tc_bps", None) is not None:
         import pandas as pd
 
-        from csmom_tpu.backtest.grid import grid_net_of_costs
+        from csmom_tpu.backtest.grid import grid_net_of_costs, grid_net_from_unit
 
-        net = grid_net_of_costs(
-            np.asarray(v), np.asarray(m), res, half_spread=args.tc_bps / 1e4,
-        )
+        # ONE book computation prices every cost level (linear model): the
+        # unit-cost run feeds both the requested net level and break-evens
+        unit = grid_net_of_costs(np.asarray(v), np.asarray(m), res,
+                                 half_spread=1.0)
+        net = grid_net_from_unit(res, unit, half_spread=args.tc_bps / 1e4)
 
         def _net_table(field):
             return pd.DataFrame(np.asarray(field),
@@ -357,6 +359,16 @@ def cmd_grid(args) -> int:
                             ("annualized Sharpe", net.ann_sharpe)):
             print(f"\n{name}, net:")
             print(_net_table(field).round(4).to_string())
+
+        from csmom_tpu.backtest.grid import grid_break_even_bps
+
+        be, mean_turn = grid_break_even_bps(np.asarray(v), np.asarray(m),
+                                            res, unit=unit)
+        print("\nbreak-even half-spread (bps) — cost level where the cell's "
+              "mean spread nets to zero:")
+        print(_net_table(be).round(1).to_string())
+        print("\nmean monthly turnover (L1 weight change):")
+        print(_net_table(mean_turn).round(3).to_string())
 
     mean_df, tstat_df, sharpe_df = jk_grid_table(res.spreads, res.spread_valid, Js, Ks)
     for name, df in (("mean monthly spread", mean_df),
@@ -741,6 +753,36 @@ def cmd_fetch(args) -> int:
     return rc
 
 
+def cmd_packinfo(args) -> int:
+    """Describe a packed panel directory: fields, universe, calendar,
+    coverage, on-disk size."""
+    import numpy as np
+
+    from csmom_tpu.panel.pack import is_packed, load_packed
+
+    path = args.pack_dir
+    if not is_packed(path):
+        print(f"{path}: not a packed panel (no meta.json)", file=sys.stderr)
+        return 2
+    b = load_packed(path)  # memmap: coverage scan pages through lazily
+    panels = b.panels if hasattr(b, "panels") else {b.name: b}
+    first = next(iter(panels.values()))
+    a, t = first.shape
+    size_mb = sum(
+        os.path.getsize(os.path.join(path, f)) for f in os.listdir(path)
+    ) / 1e6
+    t0 = np.datetime_as_string(first.times[0], unit="D")
+    t1 = np.datetime_as_string(first.times[-1], unit="D")
+    print(f"packed panel: {path} ({size_mb:.1f} MB on disk)")
+    print(f"universe: {a} tickers ({first.tickers[0]}..{first.tickers[-1]})")
+    print(f"calendar: {t} dates, {t0} .. {t1}")
+    for name, p in sorted(panels.items()):
+        cov = float(np.asarray(p.mask).mean())
+        print(f"field {name}: dtype {np.asarray(p.values).dtype}, "
+              f"coverage {cov:.1%}")
+    return 0
+
+
 def cmd_bench(args) -> int:
     """Run the headline benchmark (same as ``python bench.py``)."""
     import subprocess
@@ -939,9 +981,14 @@ def build_parser() -> argparse.ArgumentParser:
         ("residual", cmd_residual,
          ("js", "est_windows", "tearsheet", "wf", "min_months")),
         ("strategies", cmd_strategies, ()),
+        ("pack-info", cmd_packinfo, ()),
         ("bench", cmd_bench, ()),
     ):
         sp = sub.add_parser(name, help=(fn.__doc__ or "").splitlines()[0])
+        if name == "pack-info":
+            sp.add_argument("pack_dir", help="packed panel directory")
+            sp.set_defaults(fn=fn)
+            continue
         _add_common(sp, tickers=(name != "fetch"))  # fetch has its own
         if "js" in extra:
             sp.add_argument("--js", help="comma-separated J values")
@@ -1046,7 +1093,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 # commands that never touch a device (pure pandas/numpy, or — bench — a
 # supervisor that does its own subprocess probing): no init probe for these
-_DEVICE_FREE_COMMANDS = {"fetch", "strategies", "bench"}
+_DEVICE_FREE_COMMANDS = {"fetch", "strategies", "bench", "pack-info"}
 
 
 def _apply_platform(args) -> int:

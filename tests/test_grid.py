@@ -231,3 +231,49 @@ class TestGridNetOfCosts:
         got_cost = (np.asarray(grid.spreads)[0, 0] -
                     np.asarray(net.spreads)[0, 0])
         np.testing.assert_allclose(got_cost[v], want_cost[v], rtol=1e-9)
+
+    def test_break_even_bps(self, rng):
+        """Netting at the break-even level zeroes the mean spread (the
+        cost model is linear in half-spread), and break-evens rise with K
+        on a gross-positive planted-momentum panel (1/K book replacement)."""
+        from csmom_tpu.backtest.grid import (grid_break_even_bps,
+                                             grid_net_of_costs,
+                                             jk_grid_backtest)
+
+        prices, mask = self._setup(rng, A=60, M=140)
+        Js, Ks = np.array([6]), np.array([1, 3, 6])
+        grid = jk_grid_backtest(prices, mask, Js, Ks, skip=1, n_bins=5,
+                                mode="rank")
+        be, mean_turn = grid_break_even_bps(prices, mask, grid)
+        assert np.asarray(mean_turn).shape == (1, 3)
+        assert (np.asarray(mean_turn) > 0).all()
+        # turnover falls with K (the 1/K replacement rate)
+        mt = np.asarray(mean_turn)[0]
+        assert mt[0] > mt[1] > mt[2]
+        for k in range(3):
+            hs = float(np.asarray(be)[0, k]) / 1e4
+            net = grid_net_of_costs(prices, mask, grid, half_spread=hs)
+            assert abs(float(np.asarray(net.mean_spread)[0, k])) < 1e-10
+
+    def test_net_from_unit_matches_direct(self, rng):
+        """Re-pricing from the unit-cost run equals a direct netting run
+        at the same level, stats included (the CLI path)."""
+        from csmom_tpu.backtest.grid import (grid_net_from_unit,
+                                             grid_net_of_costs,
+                                             jk_grid_backtest)
+
+        prices, mask = self._setup(rng)
+        grid = jk_grid_backtest(prices, mask, np.array([6]),
+                                np.array([1, 3]), skip=1, n_bins=5,
+                                mode="rank")
+        unit = grid_net_of_costs(prices, mask, grid, half_spread=1.0)
+        hs = 13e-4
+        a = grid_net_of_costs(prices, mask, grid, half_spread=hs)
+        b = grid_net_from_unit(grid, unit, half_spread=hs)
+        for f in ("mean_spread", "ann_sharpe", "tstat", "tstat_nw"):
+            np.testing.assert_allclose(np.asarray(getattr(a, f)),
+                                       np.asarray(getattr(b, f)),
+                                       rtol=1e-9)
+        np.testing.assert_allclose(
+            np.asarray(a.spreads)[np.asarray(a.spread_valid)],
+            np.asarray(b.spreads)[np.asarray(b.spread_valid)], rtol=1e-9)

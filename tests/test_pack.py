@@ -184,3 +184,16 @@ def test_cli_replicate_on_pack(tmp_path, capsys):
     assert main(["replicate", "--data-dir", out, "--tickers", "AMD,NVDA",
                  "--out", str(tmp_path / "r2")]) == 0
     assert "Universe: 2 tickers" in capsys.readouterr().out
+
+
+def test_cli_pack_info(tmp_path, capsys, rng):
+    from csmom_tpu.cli.main import main
+
+    px = _panel(rng, A=5, T=30)
+    save_packed(px, str(tmp_path / "p"))
+    assert main(["pack-info", str(tmp_path / "p")]) == 0
+    out = capsys.readouterr().out
+    assert "5 tickers" in out and "30 dates" in out and "adj_close" in out
+
+    assert main(["pack-info", str(tmp_path / "nope")]) == 2
+    assert "not a packed panel" in capsys.readouterr().err
