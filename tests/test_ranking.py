@@ -221,3 +221,22 @@ class TestHistMode:
         lr, _ = decile_assign_panel(x, valid, 3, mode="rank")
         np.testing.assert_array_equal(np.asarray(lr)[:, 0],
                                       [-1, 1, 2, 2, 0, 1])
+
+    def test_fuzz_matches_rank_with_inf_injection(self, rng):
+        """Randomized panels with ties, holes, +/-inf and signed zeros:
+        hist and rank must agree bin-for-bin on every draw."""
+        for _ in range(12):
+            A = int(rng.integers(3, 80))
+            M = int(rng.integers(1, 8))
+            B = int(rng.choice([3, 4, 5, 10]))
+            x = rng.normal(size=(A, M))
+            x[rng.random((A, M)) < 0.25] = 0.0
+            x[rng.random((A, M)) < 0.1] = np.inf
+            x[rng.random((A, M)) < 0.1] = -np.inf
+            x[rng.random((A, M)) < 0.15] = -0.0
+            valid = rng.random((A, M)) > 0.3
+            x = np.where(valid, x, np.nan)
+            lr, nr = decile_assign_panel(x, valid, B, mode="rank")
+            lh, nh = decile_assign_panel(x, valid, B, mode="hist")
+            np.testing.assert_array_equal(np.asarray(lr), np.asarray(lh))
+            np.testing.assert_array_equal(np.asarray(nr), np.asarray(nh))
