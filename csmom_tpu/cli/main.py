@@ -283,6 +283,21 @@ def cmd_grid(args) -> int:
     cfg = _load_cfg(args)
     Js = [int(j) for j in args.js.split(",")] if args.js else list(cfg.grid.Js)
     Ks = [int(k) for k in args.ks.split(",")] if args.ks else list(cfg.grid.Ks)
+    # fail fast on flag problems BEFORE the compiled backtest runs: a
+    # silently-dropped sweep after minutes of compute is the worst outcome
+    tc_levels = None
+    if getattr(args, "tc_sweep", None):
+        if getattr(args, "tc_bps", None) is None:
+            print("--tc-sweep needs --tc-bps (it re-prices the unit-cost "
+                  "run that --tc-bps triggers); add e.g. --tc-bps 5",
+                  file=sys.stderr)
+            return 2
+        try:
+            tc_levels = [float(s) for s in args.tc_sweep.split(",") if s.strip()]
+        except ValueError:
+            print(f"--tc-sweep {args.tc_sweep!r}: levels must be plain "
+                  "numbers in bps, e.g. --tc-sweep 0,5,25", file=sys.stderr)
+            return 2
     prices, _ = _price_panel(cfg)
 
     v, m = prices.device()
@@ -334,8 +349,9 @@ def cmd_grid(args) -> int:
     from csmom_tpu.analytics.tables import jk_grid_table
 
     if getattr(args, "tc_bps", None) is not None and mode == "rank_hist":
-        print("--tc-bps: cost netting recomputes labels single-device and "
-              "has no rank_hist form; rerun with --mode rank", file=sys.stderr)
+        print("--tc-bps" + ("/--tc-sweep" if tc_levels else "") + ": cost "
+              "netting recomputes labels single-device and has no rank_hist "
+              "form; rerun with --mode rank", file=sys.stderr)
     elif getattr(args, "tc_bps", None) is not None:
         import pandas as pd
 
@@ -369,6 +385,16 @@ def cmd_grid(args) -> int:
         print(_net_table(be).round(1).to_string())
         print("\nmean monthly turnover (L1 weight change):")
         print(_net_table(mean_turn).round(3).to_string())
+
+        if tc_levels:
+            print("\ncost sweep — net mean monthly spread by half-spread "
+                  "level (all re-priced from the single unit-cost run):")
+            rows = {}
+            for bps in tc_levels:
+                n_l = grid_net_from_unit(res, unit, half_spread=bps / 1e4)
+                rows[f"{bps:g}bps"] = np.asarray(n_l.mean_spread).ravel()
+            idx = pd.MultiIndex.from_product([Js, Ks], names=["J", "K"])
+            print(pd.DataFrame(rows, index=idx).round(4).to_string())
 
     mean_df, tstat_df, sharpe_df = jk_grid_table(res.spreads, res.spread_valid, Js, Ks)
     for name, df in (("mean monthly spread", mean_df),
@@ -1032,6 +1058,12 @@ def build_parser() -> argparse.ArgumentParser:
                             help="also report the spread net of linear "
                                  "transaction costs at this half-spread "
                                  "(bps per unit weight turnover)")
+        if "tc" in extra:
+            sp.add_argument("--tc-sweep", dest="tc_sweep", metavar="BPS,...",
+                            help="with --tc-bps: also print net mean spreads "
+                                 "at these half-spread levels, re-priced "
+                                 "from the single unit-cost run (the cost "
+                                 "model is linear in the half-spread)")
         if "monthly_extras" in extra:
             sp.add_argument("--sector-map", dest="sector_map",
                             help="ticker,sector CSV: rank within sectors "

@@ -433,3 +433,30 @@ class TestPlatformFailFast:
                 main(["replicate", "--data-dir", "/nonexistent"])
         finally:
             jax.config.update("jax_platforms", "cpu")
+
+
+@requires_reference
+def test_cli_grid_tc_sweep(capsys):
+    rc = main(["grid", "--data-dir", REFERENCE_DATA, "--js", "6", "--ks",
+               "1,3", "--mode", "rank", "--n-bins", "5", "--tc-bps", "5",
+               "--tc-sweep", "0,5,25", "--bootstrap", "0"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "cost sweep" in out
+    for col in ("0bps", "5bps", "25bps"):
+        assert col in out
+    # the linear re-pricer itself is oracle-tested in
+    # tests/test_grid.py::test_net_from_unit_matches_direct; this is the
+    # CLI plumbing smoke
+
+
+def test_cli_grid_tc_sweep_fails_fast(capsys):
+    # without --tc-bps: rc=2 BEFORE any backtest compute
+    rc = main(["grid", "--data-dir", "/nonexistent", "--tc-sweep", "0,5"])
+    assert rc == 2
+    assert "--tc-bps" in capsys.readouterr().err
+    # malformed levels: rc=2 with a readable message
+    rc = main(["grid", "--data-dir", "/nonexistent", "--tc-bps", "5",
+               "--tc-sweep", "5bps,10"])
+    assert rc == 2
+    assert "plain numbers" in capsys.readouterr().err
