@@ -505,7 +505,13 @@ def cmd_doublesort(args) -> int:
 
 
 def cmd_sweep(args) -> int:
-    """Walk-forward (J, K) selection: out-of-sample series from the grid."""
+    """Walk-forward (J, K) selection: out-of-sample series from the grid.
+
+    ``--tc-bps`` makes the whole exercise net-of-costs: the expanding
+    window selects cells on NET past performance and the OOS series is
+    net too — the honest form of the sweep (a gross selector happily
+    picks high-turnover cells whose edge a realistic spread erases).
+    """
     import numpy as np
 
     cfg = _load_cfg(args)
@@ -513,15 +519,29 @@ def cmd_sweep(args) -> int:
     Ks = [int(k) for k in args.ks.split(",")] if args.ks else list(cfg.grid.Ks)
     prices, _ = _price_panel(cfg)
 
-    from csmom_tpu.backtest import walk_forward_grid_backtest
+    from csmom_tpu.backtest import jk_grid_backtest, walk_forward_select
 
-    wf, _grid = walk_forward_grid_backtest(
+    grid = jk_grid_backtest(
         np.asarray(prices.values), np.asarray(prices.mask),
         np.asarray(Js), np.asarray(Ks),
-        skip=cfg.momentum.skip, n_bins=cfg.momentum.n_bins, mode=cfg.momentum.mode,
+        skip=cfg.momentum.skip, n_bins=cfg.momentum.n_bins,
+        mode=cfg.momentum.mode,
+    )
+    label = "gross"
+    if getattr(args, "tc_bps", None) is not None:
+        from csmom_tpu.backtest.grid import grid_net_of_costs
+
+        grid = grid_net_of_costs(
+            np.asarray(prices.values), np.asarray(prices.mask), grid,
+            half_spread=args.tc_bps / 1e4,
+        )
+        label = f"net of {args.tc_bps:g} bps"
+    wf = walk_forward_select(
+        grid.spreads, grid.spread_valid,
         min_months=args.min_months or cfg.grid.walk_forward_min_months,
     )
     top, _n_live = _most_picked(wf.choice, Js, Ks, "J", "K")
+    print(f"Selection basis:   {label}")
     print(f"OOS months:        {int(np.asarray(wf.oos_valid).sum())}")
     print(f"OOS mean spread:   {float(wf.mean_spread):.6f}")
     print(f"OOS ann. Sharpe:   {float(wf.ann_sharpe):.4f}")
@@ -1000,7 +1020,7 @@ def build_parser() -> argparse.ArgumentParser:
          ("bootstrap", "strategy", "tables", "tearsheet", "monthly_extras")),
         ("grid", cmd_grid, ("js", "ks", "bootstrap", "tearsheet", "tc")),
         ("doublesort", cmd_doublesort, ("doublesort",)),
-        ("sweep", cmd_sweep, ("js", "ks", "min_months")),
+        ("sweep", cmd_sweep, ("js", "ks", "min_months", "tc_bps")),
         ("intraday", cmd_intraday, ("model", "tearsheet")),
         ("horizons", cmd_horizons, ("horizons",)),
         ("fetch", cmd_fetch, ("fetch",)),
@@ -1053,11 +1073,17 @@ def build_parser() -> argparse.ArgumentParser:
                             help="print the full risk tearsheet (drawdown, "
                                  "Calmar, Sortino, tails; per-cell tables "
                                  "for grid)")
-        if "monthly_extras" in extra or "tc" in extra:
+        if "monthly_extras" in extra or "tc" in extra or "tc_bps" in extra:
+            if "tc_bps" in extra:  # the sweep: costs change the SELECTION
+                tc_help = ("select cells and report OOS performance NET of "
+                           "linear transaction costs at this half-spread "
+                           "(bps per unit weight turnover)")
+            else:
+                tc_help = ("also report the spread net of linear "
+                           "transaction costs at this half-spread (bps per "
+                           "unit weight turnover)")
             sp.add_argument("--tc-bps", dest="tc_bps", type=float,
-                            help="also report the spread net of linear "
-                                 "transaction costs at this half-spread "
-                                 "(bps per unit weight turnover)")
+                            help=tc_help)
         if "tc" in extra:
             sp.add_argument("--tc-sweep", dest="tc_sweep", metavar="BPS,...",
                             help="with --tc-bps: also print net mean spreads "

@@ -460,3 +460,17 @@ def test_cli_grid_tc_sweep_fails_fast(capsys):
                "--tc-sweep", "5bps,10"])
     assert rc == 2
     assert "plain numbers" in capsys.readouterr().err
+
+
+@requires_reference
+def test_cli_sweep_net_of_costs(capsys):
+    rc = main(["sweep", "--data-dir", REFERENCE_DATA, "--js", "3,6",
+               "--ks", "1,3", "--mode", "rank", "--n-bins", "5",
+               "--tc-bps", "5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Selection basis:   net of 5 bps" in out
+    rc = main(["sweep", "--data-dir", REFERENCE_DATA, "--js", "3,6",
+               "--ks", "1,3", "--mode", "rank", "--n-bins", "5"])
+    assert rc == 0
+    assert "Selection basis:   gross" in capsys.readouterr().out
