@@ -18,6 +18,7 @@ from csmom_tpu.signals.residual import residual_momentum
 from csmom_tpu.strategy.base import Strategy, register_strategy, xs_zscore
 
 __all__ = [
+    "FiftyTwoWeekHigh",
     "Momentum",
     "Reversal",
     "ResidualMomentum",
@@ -124,6 +125,46 @@ class VolumeZMomentum(Strategy):
         score = xs_zscore(mom, valid) + self.gamma * xs_zscore(
             jnp.log1p(jnp.maximum(vol_avg, 0.0)), valid
         )
+        return jnp.where(valid, score, jnp.nan), valid
+
+
+@register_strategy("high_52w")
+@dataclasses.dataclass(frozen=True)
+class FiftyTwoWeekHigh(Strategy):
+    """George–Hwang (2004) 52-week-high momentum: rank on nearness of the
+    current price to its trailing high, ``P[t-skip] / max(P over the
+    lookback window ending t-skip)`` — a score in (0, 1] that GH showed
+    subsumes much of plain momentum's power.  On the monthly panel the
+    12-month window is the 52-week high; validity requires the full
+    window of PRICE observations, so the first valid score lands at
+    month ``lookback + skip`` — one month earlier than momentum's
+    ``lookback + skip + 1`` (momentum needs J *returns*, i.e. J+1
+    prices; this ratio needs only J prices)."""
+
+    lookback: int = 12
+    skip: int = 1
+
+    def signal(self, prices, mask, **panels):
+        from csmom_tpu.ops.rolling import rolling_count
+
+        _, M = prices.shape
+        neg_inf = jnp.asarray(-jnp.inf, prices.dtype)
+        p = jnp.where(mask, prices, neg_inf)
+
+        def shift(x, s, fill):
+            return jnp.pad(x, ((0, 0), (s, 0)), constant_values=fill)[:, :M]
+
+        # rolling max has no prefix-sum form, so the window is a static
+        # unroll of maxima; window VALIDITY reuses the shared prefix-sum
+        # counter (one place owns the min_periods semantics)
+        high = jnp.full_like(p, -jnp.inf)
+        for s in range(self.skip, self.skip + self.lookback):
+            high = jnp.maximum(high, shift(p, s, neg_inf))
+        cnt = rolling_count(mask, self.lookback)
+        allv = shift(cnt == self.lookback, self.skip, False)
+        ps = shift(jnp.where(mask, prices, jnp.nan), self.skip, jnp.nan)
+        valid = allv & (high > 0)
+        score = ps / jnp.where(valid, high, 1.0)
         return jnp.where(valid, score, jnp.nan), valid
 
 

@@ -249,3 +249,45 @@ def test_zscore_combo_string_spec_via_cli_parsing(rng):
     prices, mask = _toy(rng)
     res = strategy_backtest(prices, mask, s, n_bins=5)
     assert np.asarray(res.spread_valid).any()
+
+
+class TestFiftyTwoWeekHigh:
+    def test_matches_pandas_rolling_max_oracle(self, rng):
+        """score = P.shift(skip) / P.shift(skip).rolling(W).max(), full
+        window required (min_periods=W), exactly the GH nearness ratio."""
+        import pandas as pd
+
+        from csmom_tpu.strategy import make_strategy
+
+        A, M, W, skip = 12, 60, 12, 1
+        prices = 50 * np.exp(np.cumsum(rng.normal(0.003, 0.08, size=(A, M)), axis=1))
+        mask = rng.random((A, M)) > 0.15
+        pv = np.where(mask, prices, np.nan)
+
+        strat = make_strategy("high_52w", lookback=W, skip=skip)
+        score, valid = strat.signal(pv, mask)
+
+        df = pd.DataFrame(pv.T)  # time-major for pandas rolling
+        shifted = df.shift(skip)
+        want = shifted / shifted.rolling(W, min_periods=W).max()
+        want_v = want.notna().values.T
+        np.testing.assert_array_equal(np.asarray(valid), want_v)
+        np.testing.assert_allclose(
+            np.asarray(score)[want_v], want.values.T[want_v], rtol=1e-12
+        )
+
+    def test_runs_through_engine_and_cli_listing(self, rng):
+        from csmom_tpu.backends import run_monthly
+        from csmom_tpu.panel.panel import Panel
+        from csmom_tpu.strategy import available_strategies, make_strategy
+
+        assert "high_52w" in available_strategies()
+        A, M = 20, 70
+        prices = 50 * np.exp(np.cumsum(rng.normal(0.004, 0.07, size=(A, M)), axis=1))
+        panel = Panel.from_dense(
+            prices, tickers=[f"T{i}" for i in range(A)],
+            times=np.arange("2015-01", "2020-11", dtype="datetime64[M]")[:M],
+        )
+        rep = run_monthly(panel, n_bins=5, mode="rank",
+                          strategy=make_strategy("high_52w"))
+        assert np.isfinite(float(rep.mean_spread))
