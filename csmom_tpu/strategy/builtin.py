@@ -139,7 +139,13 @@ class FiftyTwoWeekHigh(Strategy):
     window of PRICE observations, so the first valid score lands at
     month ``lookback + skip`` — one month earlier than momentum's
     ``lookback + skip + 1`` (momentum needs J *returns*, i.e. J+1
-    prices; this ratio needs only J prices)."""
+    prices; this ratio needs only J prices).
+
+    Ranking-mode note: the score has an atom at exactly 1.0 (every name
+    sitting at its high), so ``qcut``'s duplicate-edge dropping can
+    empty the top decile on strong-market months and invalidate the
+    spread there — GH rank on ordinals, and ``mode='rank'`` (ties by
+    position) is the natural pairing for this signal."""
 
     lookback: int = 12
     skip: int = 1

@@ -45,6 +45,7 @@ def main(argv=None):
         ("momentum J=6",         make_strategy("momentum", lookback=6), {}),
         ("reversal 1m",          make_strategy("reversal"), {}),
         ("residual mom",         make_strategy("residual_momentum"), {}),
+        ("52w high",             make_strategy("high_52w"), {}),
         ("volume-z mom",         make_strategy("volume_z_momentum"),
          {"volumes": volume.values, "volumes_mask": volume.mask}),
     ]
