@@ -210,19 +210,35 @@ def cmd_replicate(args) -> int:
         import jax.numpy as jnp
         import numpy as np
 
+        from csmom_tpu.analytics.stats import nw_t_stat, sharpe
         from csmom_tpu.backtest.monthly import net_of_costs_arrays
-        from csmom_tpu.analytics.stats import nw_t_stat
 
+        # ONE unit-cost netting prices every level (the cost model is
+        # linear in the half-spread) — same pattern as cmd_grid: the unit
+        # run feeds the requested net level AND the break-even
         valid = np.isfinite(rep.spread)
-        net, net_mean, net_sharpe = net_of_costs_arrays(
-            rep.labels, rep.decile_counts,
-            jnp.nan_to_num(jnp.asarray(rep.spread)), jnp.asarray(valid),
-            half_spread=args.tc_bps / 1e4, n_bins=cfg.momentum.n_bins,
+        spread0 = jnp.nan_to_num(jnp.asarray(rep.spread))
+        net1, _, _ = net_of_costs_arrays(
+            rep.labels, rep.decile_counts, spread0, jnp.asarray(valid),
+            half_spread=1.0, n_bins=cfg.momentum.n_bins,
         )
-        net_t = nw_t_stat(jnp.nan_to_num(net), jnp.asarray(valid))
+        cost1 = spread0 - net1                 # per-month unit turnover cost
+        hs = args.tc_bps / 1e4
+        net = spread0 - hs * cost1
+        vj = jnp.asarray(valid)
+        net_mean = jnp.sum(jnp.where(vj, net, 0.0)) / jnp.maximum(
+            jnp.sum(vj), 1)
+        net_sharpe = sharpe(net, vj, freq_per_year=12)
+        net_t = nw_t_stat(net, vj)
         print(f"net of {args.tc_bps:g} bps half-spread turnover costs: "
               f"mean {float(net_mean):+.6f}, Sharpe {float(net_sharpe):.4f}, "
               f"NW t {float(net_t):+.3f}")
+        cost1 = np.asarray(cost1)
+        mean_turn = float(cost1[valid].mean()) if valid.any() else float("nan")
+        if mean_turn > 0:
+            be = float(rep.mean_spread) / mean_turn * 1e4
+            print(f"break-even half-spread: {be:+.1f} bps "
+                  f"(mean monthly turnover {mean_turn:.3f})")
 
     if getattr(args, "tables", False):
         from csmom_tpu.analytics.tables import decile_table

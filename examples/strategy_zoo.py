@@ -45,14 +45,21 @@ def main(argv=None):
         ("momentum J=6",         make_strategy("momentum", lookback=6), {}),
         ("reversal 1m",          make_strategy("reversal"), {}),
         ("residual mom",         make_strategy("residual_momentum"), {}),
-        ("52w high",             make_strategy("high_52w"), {}),
+        # rank mode: the 52w-high score has an atom at exactly 1.0, and
+        # qcut's duplicate-edge dropping would empty the top bin on
+        # strong-market months (see the strategy's docstring); GH rank on
+        # ordinals, so this row does too
+        ("52w high (rank)",      make_strategy("high_52w"),
+         {"mode": "rank"}),
         ("volume-z mom",         make_strategy("volume_z_momentum"),
          {"volumes": volume.values, "volumes_mask": volume.mask}),
     ]
 
     rows = []
     for label, strat, panels in zoo:
-        res = strategy_backtest(v, m, strat, n_bins=args.n_bins, **panels)
+        mode = panels.pop("mode", "qcut")
+        res = strategy_backtest(v, m, strat, n_bins=args.n_bins, mode=mode,
+                                **panels)
         spread = np.asarray(res.spread)
         valid = np.asarray(res.spread_valid)
         ts = tearsheet(np.nan_to_num(spread), valid, freq_per_year=12)

@@ -474,3 +474,24 @@ def test_cli_sweep_net_of_costs(capsys):
                "--ks", "1,3", "--mode", "rank", "--n-bins", "5"])
     assert rc == 0
     assert "Selection basis:   gross" in capsys.readouterr().out
+
+
+@requires_reference
+def test_cli_replicate_break_even_line(capsys, tmp_path):
+    rc = main(["replicate", "--data-dir", REFERENCE_DATA, "--tc-bps", "5",
+               "--out", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import re
+
+    m = re.search(r"break-even half-spread: ([+-][\d.]+) bps "
+                  r"\(mean monthly turnover ([\d.]+)\)", out)
+    assert m, out
+    be, turn = float(m.group(1)), float(m.group(2))
+    g = re.search(r"Mean monthly spread: ([-\d.]+)", out)
+    n = re.search(r"net of 5 bps.*mean ([+-][\d.]+)", out)
+    gross, net5 = float(g.group(1)), float(n.group(1))
+    # linearity: gross - 5e-4 * turn == net at 5 bps; be * turn == gross.
+    # tolerances reflect the printed precision (be at 0.1 bps, turn at 1e-3)
+    assert abs(gross - 5e-4 * turn - net5) < 2e-6
+    assert abs(be / 1e4 * turn - gross) < 0.06 / 1e4 * turn + 1e-6

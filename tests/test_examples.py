@@ -35,7 +35,7 @@ def test_strategy_zoo_example(capsys):
     _run("strategy_zoo.py", ["--data-dir", REFERENCE_DATA, "--n-bins", "5"])
     out = capsys.readouterr().out
     for label in ("momentum J=12", "reversal 1m", "residual mom",
-                  "52w high", "volume-z mom"):
+                  "52w high (rank)", "volume-z mom"):
         assert label in out
 
 
