@@ -210,7 +210,7 @@ def cmd_replicate(args) -> int:
         import jax.numpy as jnp
         import numpy as np
 
-        from csmom_tpu.analytics.stats import nw_t_stat, sharpe
+        from csmom_tpu.analytics.stats import masked_mean, nw_t_stat, sharpe
         from csmom_tpu.backtest.monthly import net_of_costs_arrays
 
         # ONE unit-cost netting prices every level (the cost model is
@@ -226,8 +226,7 @@ def cmd_replicate(args) -> int:
         hs = args.tc_bps / 1e4
         net = spread0 - hs * cost1
         vj = jnp.asarray(valid)
-        net_mean = jnp.sum(jnp.where(vj, net, 0.0)) / jnp.maximum(
-            jnp.sum(vj), 1)
+        net_mean = masked_mean(net, vj)
         net_sharpe = sharpe(net, vj, freq_per_year=12)
         net_t = nw_t_stat(net, vj)
         print(f"net of {args.tc_bps:g} bps half-spread turnover costs: "
@@ -800,7 +799,12 @@ def cmd_fetch(args) -> int:
             # reuse the frame fetch_daily already parsed (double-parsing the
             # CSVs is the cost the pack exists to eliminate); intraday-only
             # invocations still read the daily caches themselves
-            out = pack_csv_cache(data_dir, tickers, pack_to, df=daily_df)
+            import numpy as _np
+
+            out = pack_csv_cache(
+                data_dir, tickers, pack_to, df=daily_df,
+                dtype=_np.float32 if getattr(args, "pack_f32", False) else None,
+            )
         except ValueError as e:
             print(f"pack failed: {e}", file=sys.stderr)
             return 1
@@ -1142,6 +1146,10 @@ def build_parser() -> argparse.ArgumentParser:
                                  "to a packed binary panel directory "
                                  "(dense [A,T] .npy + manifest; loads "
                                  "memmapped via panel.load_packed)")
+            sp.add_argument("--pack-f32", dest="pack_f32",
+                            action="store_true",
+                            help="store packed values as float32 (half the "
+                                 "disk; the TPU compute dtype anyway)")
         if "model" in extra:
             sp.add_argument("--model",
                             choices=["ridge", "elastic_net", "lasso", "mlp"],

@@ -116,7 +116,8 @@ def load_packed(path: str, mmap: bool = True):
 
 
 def pack_csv_cache(data_dir: str, tickers, out: str,
-                   fields=("adj_close", "volume"), df=None) -> str:
+                   fields=("adj_close", "volume"), df=None,
+                   dtype=None) -> str:
     """One-shot CSV cache -> packed directory conversion (``csmom fetch
     --pack``): load the per-ticker daily CSVs through the normal ingest
     path, pivot each requested field to a dense panel, write the pack.
@@ -124,7 +125,12 @@ def pack_csv_cache(data_dir: str, tickers, out: str,
     Pass ``df`` (the canonical long daily frame) when the caller already
     holds it — ``csmom fetch`` does — so the CSVs are not re-parsed; that
     double parse is the exact cost this format exists to eliminate.
+    ``dtype`` (e.g. ``np.float32``) downcasts the stored values — at
+    north-star scale f32 halves the pack and matches the TPU compute
+    dtype anyway; default keeps the ingest's f64.
     """
+    import dataclasses
+
     from csmom_tpu.panel.ingest import load_daily, long_to_panel
 
     if df is None:
@@ -133,6 +139,11 @@ def pack_csv_cache(data_dir: str, tickers, out: str,
         raise ValueError(f"no readable daily caches for {len(tickers)} "
                          f"tickers under {data_dir}")
     panels = {f: long_to_panel(df, f) for f in fields}
+    if dtype is not None:
+        panels = {
+            f: dataclasses.replace(p, values=p.values.astype(dtype))
+            for f, p in panels.items()
+        }
     first = next(iter(panels.values()))
     return save_packed(
         PanelBundle(panels=panels, tickers=tuple(first.tickers),

@@ -43,6 +43,10 @@ def main(argv=None):
     zoo = [
         ("momentum J=12",        make_strategy("momentum"), {}),
         ("momentum J=6",         make_strategy("momentum", lookback=6), {}),
+        # Novy-Marx (2012) intermediate momentum: months t-12..t-7 only —
+        # pure parametrization of the same signal (lookback=6, skip=7)
+        ("intermediate mom",     make_strategy("momentum", lookback=6,
+                                               skip=7), {}),
         ("reversal 1m",          make_strategy("reversal"), {}),
         ("residual mom",         make_strategy("residual_momentum"), {}),
         # rank mode: the 52w-high score has an atom at exactly 1.0, and

@@ -197,3 +197,20 @@ def test_cli_pack_info(tmp_path, capsys, rng):
 
     assert main(["pack-info", str(tmp_path / "nope")]) == 2
     assert "not a packed panel" in capsys.readouterr().err
+
+
+@pytest.mark.reference_data
+def test_pack_f32_dtype(tmp_path, capsys):
+    from tests.conftest import REFERENCE_DATA
+
+    from csmom_tpu.cli.main import main
+
+    out = tmp_path / "p32"
+    rc = main(["fetch", "--data-dir", REFERENCE_DATA,
+               "--tickers", "AMD,NVDA", "--kind", "daily",
+               "--pack", str(out), "--pack-f32"])
+    assert rc == 0
+    b = load_packed(str(out))
+    assert np.asarray(b["adj_close"].values).dtype == np.float32
+    assert main(["pack-info", str(out)]) == 0
+    assert "float32" in capsys.readouterr().out
