@@ -224,10 +224,19 @@ class TestHistMode:
 
     def test_fuzz_matches_rank_with_inf_injection(self, rng):
         """Randomized panels with ties, holes, +/-inf and signed zeros:
-        hist and rank must agree bin-for-bin on every draw."""
+        hist and rank must agree bin-for-bin on every draw.
+
+        STATIC shapes: every draw uses one [80, 8] panel with the drawn
+        (A_eff, M_eff) realized as masked-out lanes/dates, so the whole
+        fuzz compiles 8 executables (4 bin counts x 2 modes), not 24 —
+        varying data through a fixed shape is both the framework's own
+        discipline and what keeps a compile-heavy suite inside the
+        process's executable budget (a fresh-shape-per-draw version of
+        this test segfaulted XLA CPU late in the full tier)."""
+        A, M = 80, 8
         for _ in range(12):
-            A = int(rng.integers(3, 80))
-            M = int(rng.integers(1, 8))
+            a_eff = int(rng.integers(3, A + 1))
+            m_eff = int(rng.integers(1, M + 1))
             B = int(rng.choice([3, 4, 5, 10]))
             x = rng.normal(size=(A, M))
             x[rng.random((A, M)) < 0.25] = 0.0
@@ -235,6 +244,8 @@ class TestHistMode:
             x[rng.random((A, M)) < 0.1] = -np.inf
             x[rng.random((A, M)) < 0.15] = -0.0
             valid = rng.random((A, M)) > 0.3
+            valid[a_eff:, :] = False
+            valid[:, m_eff:] = False
             x = np.where(valid, x, np.nan)
             lr, nr = decile_assign_panel(x, valid, B, mode="rank")
             lh, nh = decile_assign_panel(x, valid, B, mode="hist")
