@@ -53,6 +53,22 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_live_executables():
+    """Drop jax's compiled-program caches after every test module.
+
+    The full tier compiles hundreds of distinct shapes; every live XLA CPU
+    executable holds memory mappings, and past ~the vm.max_map_count
+    budget (65530 default) the NEXT compile segfaults inside
+    backend_compile_and_load (observed twice at different tests once the
+    suite grew past ~380 compiles; faulthandler stack in BENCH notes).
+    Modules rarely share shapes, so clearing between modules costs little
+    and bounds live executables to one module's worth.
+    """
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture()
 def rng(request):
     """Function-scoped, seeded from the test's nodeid: every test draws the
