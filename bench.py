@@ -796,6 +796,15 @@ def main():
         cached = _load_last_tpu()
         if cached is not None and _is_tpu(cached.get("record")):
             rec = cached["record"]
+            # records predating the no-bare-nulls policy carry nulls of
+            # their own; annotate rather than re-emit them
+            rex = rec.get("extra")
+            if isinstance(rex, dict):
+                rec = dict(rec, extra={
+                    k: ("null in the original cached record (predates the "
+                        "no-bare-nulls policy)" if v is None else v)
+                    for k, v in rex.items()
+                })
             result.setdefault("extra", {})["tpu_last_verified"] = {
                 # compose: how it was captured then + that it is a cache now
                 "provenance": "session-cached (originally: "

@@ -73,7 +73,7 @@ def _map_budget() -> int:
 _MAP_BUDGET = _map_budget()
 
 
-@pytest.fixture(autouse=True, scope="module")
+@pytest.fixture(autouse=True)
 def _bound_live_executables():
     """Drop jax's compiled-program caches when memory maps near the limit.
 
@@ -83,8 +83,10 @@ def _bound_live_executables():
     backend_compile_and_load (observed twice, at different tests, once the
     suite grew past ~380 compiles). Clearing after *every* module fixes
     that but costs ~2x wall in recompiles of cross-module shared helpers;
-    instead the map count is checked directly and caches are dropped only
-    when it passes 60% of the limit — the clear fires a handful of times
+    instead the map count is checked directly — per TEST, since one
+    compile-heavy module could cross the budget between module-scoped
+    checks — and caches are dropped only past 60% of the limit.  The read
+    is one /proc line-count (~50 us); the clear fires a handful of times
     per full run and never in a small one.
     """
     yield

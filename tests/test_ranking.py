@@ -190,12 +190,24 @@ class TestHistMode:
         np.testing.assert_array_equal(np.asarray(lr), np.asarray(lh))
         assert int(nr) == int(nh)
 
-    def test_f32_keys(self, rng):
+    def test_f32_keys_adversarial(self, rng):
+        """The fast-tier representative: f32 (8 cheap radix rounds) but
+        fully adversarial — ties, masked holes, +/-inf against invalid
+        lanes (the shipped sentinel-collision regression), signed zeros —
+        so the default tier keeps real coverage of the tie/sentinel logic
+        while the f64 battery stays in the full tier."""
         x = rng.normal(size=(48, 4)).astype(np.float32)
-        valid = np.ones((48, 4), bool)
-        lr, _ = decile_assign_panel(x, valid, 10, mode="rank")
-        lh, _ = decile_assign_panel(x, valid, 10, mode="hist")
-        np.testing.assert_array_equal(np.asarray(lr), np.asarray(lh))
+        x[rng.random((48, 4)) < 0.25] = 0.0
+        x[rng.random((48, 4)) < 0.1] = np.inf
+        x[rng.random((48, 4)) < 0.1] = -np.inf
+        x[rng.random((48, 4)) < 0.15] = -0.0
+        valid = rng.random((48, 4)) > 0.3
+        x = np.where(valid, x, np.float32(np.nan))
+        for B in (3, 10):
+            lr, nr = decile_assign_panel(x, valid, B, mode="rank")
+            lh, nh = decile_assign_panel(x, valid, B, mode="hist")
+            np.testing.assert_array_equal(np.asarray(lr), np.asarray(lh))
+            np.testing.assert_array_equal(np.asarray(nr), np.asarray(nh))
 
     @pytest.mark.slow
     def test_grid_engine_hist_mode_matches_rank(self, rng):
