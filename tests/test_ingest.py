@@ -1,5 +1,7 @@
 """Ingest tests: both cache dialects, fault isolation, panel pivot."""
 
+import os
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -102,3 +104,34 @@ def test_reference_intraday_roundtrip():
     assert set(df["ticker"]) == set(DEMO_TICKERS)
     per = df.groupby("ticker").size()
     assert (per > 2000).all()
+
+
+class TestVendoredDialectFixtures:
+    """Committed SYNTHETIC fixtures in both yfinance header dialects:
+    dialect handling stays tested on a bare checkout (without these, the
+    dialect-B path was only exercised through the reference mount's AAPL
+    file and skipped offline)."""
+
+    FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+    def test_dialect_a_junk_ticker_row(self):
+        df = ingest.load_daily(self.FIXTURES, ["SYNA"])
+        assert len(df) == 24                       # junk row dropped
+        assert set(df["ticker"]) == {"SYNA"}
+        assert df["adj_close"].notna().all()
+        assert str(df["date"].iloc[0].date()) == "2020-01-03"
+
+    def test_dialect_b_three_row_preamble(self):
+        """The dialect the reference's own loader silently loses (SURVEY
+        §2.1.1): must parse all rows, adj_close falling back to close."""
+        df = ingest.load_daily(self.FIXTURES, ["SYNB"])
+        assert len(df) == 24
+        assert df["adj_close"].notna().all()       # close fallback applied
+        assert df["close"].iloc[0] == df["adj_close"].iloc[0]
+
+    def test_both_dialects_pivot_to_one_panel(self):
+        df = ingest.load_daily(self.FIXTURES, ["SYNA", "SYNB"])
+        panel = ingest.long_to_panel(df, "adj_close")
+        assert panel.tickers == ("SYNA", "SYNB")
+        assert panel.shape == (2, 24)
+        assert panel.mask.all()
