@@ -44,7 +44,15 @@ while true; do
       log "running tpu_scaling.py"
       timeout 900 python benchmarks/tpu_scaling.py > "$SCALING_OUT".tmp 2>&1
       rc=$?
-      if [ "$rc" -eq 0 ]; then mv "$SCALING_OUT".tmp "$SCALING_OUT"; fi
+      if [ "$rc" -eq 0 ]; then
+        mv "$SCALING_OUT".tmp "$SCALING_OUT"
+        # also land the summary under its committed name at the repo root:
+        # raw logs are gitignored, and a window can open after the session's
+        # last turn — the driver's end-of-round auto-commit then still
+        # captures the artifact
+        grep '^{' "$SCALING_OUT" | tail -1 \
+          | python -m json.tool > /root/repo/SCALING_TPU_r04.json 2>/dev/null
+      fi
       log "tpu_scaling rc=$rc"
     fi
     if [ ! -s "$PHASES_OUT" ]; then
@@ -52,7 +60,11 @@ while true; do
       timeout 450 python benchmarks/grid_phases.py --reps 5 \
         > "$PHASES_OUT".tmp 2>&1
       rc=$?
-      if [ "$rc" -eq 0 ]; then mv "$PHASES_OUT".tmp "$PHASES_OUT"; fi
+      if [ "$rc" -eq 0 ]; then
+        mv "$PHASES_OUT".tmp "$PHASES_OUT"
+        grep '^{' "$PHASES_OUT" | tail -1 \
+          | python -m json.tool > /root/repo/PHASES_TPU_r04.json 2>/dev/null
+      fi
       log "grid_phases 1x rc=$rc"
     fi
     # 32x is best-effort extra evidence: captured separately so an OOM at
