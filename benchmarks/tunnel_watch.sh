@@ -50,8 +50,14 @@ while true; do
         # raw logs are gitignored, and a window can open after the session's
         # last turn — the driver's end-of-round auto-commit then still
         # captures the artifact
-        grep '^{' "$SCALING_OUT" | tail -1 \
-          | python -m json.tool > /root/repo/SCALING_TPU_r04.json 2>/dev/null
+        if grep '^{' "$SCALING_OUT" | tail -1 \
+            | python -m json.tool > /root/repo/SCALING_TPU_r04.json.tmp 2>/dev/null \
+            && [ -s /root/repo/SCALING_TPU_r04.json.tmp ]; then
+          mv /root/repo/SCALING_TPU_r04.json.tmp /root/repo/SCALING_TPU_r04.json
+        else
+          rm -f /root/repo/SCALING_TPU_r04.json.tmp
+          log "scaling summary extraction FAILED (artifact not written)"
+        fi
       fi
       log "tpu_scaling rc=$rc"
     fi
@@ -62,8 +68,14 @@ while true; do
       rc=$?
       if [ "$rc" -eq 0 ]; then
         mv "$PHASES_OUT".tmp "$PHASES_OUT"
-        grep '^{' "$PHASES_OUT" | tail -1 \
-          | python -m json.tool > /root/repo/PHASES_TPU_r04.json 2>/dev/null
+        if grep '^{' "$PHASES_OUT" | tail -1 \
+            | python -m json.tool > /root/repo/PHASES_TPU_r04.json.tmp 2>/dev/null \
+            && [ -s /root/repo/PHASES_TPU_r04.json.tmp ]; then
+          mv /root/repo/PHASES_TPU_r04.json.tmp /root/repo/PHASES_TPU_r04.json
+        else
+          rm -f /root/repo/PHASES_TPU_r04.json.tmp
+          log "phases summary extraction FAILED (artifact not written)"
+        fi
       fi
       log "grid_phases 1x rc=$rc"
     fi

@@ -2,6 +2,7 @@
 
 from csmom_tpu.analytics.stats import (
     sharpe,
+    rolling_sharpe,
     masked_mean,
     masked_std,
     t_stat,
@@ -23,6 +24,7 @@ from csmom_tpu.analytics.tearsheet import (
 
 __all__ = [
     "sharpe",
+    "rolling_sharpe",
     "masked_mean",
     "masked_std",
     "t_stat",

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 from functools import partial
 
+from csmom_tpu.ops.rolling import rolling_mean, rolling_std
+
 
 def masked_mean(x, valid, axis=-1):
     n = jnp.sum(valid, axis=axis)
@@ -118,3 +120,29 @@ def cumulative_growth(returns, valid):
     """Cumulative (1+r) product over valid entries (``run_demo.py:75``)."""
     lr = jnp.where(valid, jnp.log1p(returns), 0.0)
     return jnp.exp(jnp.cumsum(lr, axis=-1))
+
+
+@partial(jax.jit, static_argnames=("window", "freq_per_year", "min_periods"))
+def rolling_sharpe(returns, valid, window: int, freq_per_year: int = 12,
+                   min_periods: int | None = None):
+    """Trailing-window annualized Sharpe series (the tearsheet's
+    stability view: a single full-sample Sharpe hides regime changes the
+    rolling series shows).
+
+    Same per-window semantics as :func:`sharpe` (ddof=1; NaN on fewer
+    than ``min_periods`` valid observations — default: the full window —
+    or zero std), computed for every position of the last axis via the
+    shared prefix-sum rolling kernels, so the cost is O(T) regardless of
+    the window.
+
+    Returns ``(sharpe f[..., T], out_valid bool[..., T])``.
+    """
+    mp = window if min_periods is None else min_periods
+    mean, mv = rolling_mean(returns, valid, window, min_periods=mp)
+    sd, sv = rolling_std(returns, valid, window, min_periods=max(mp, 2),
+                         ddof=1)
+    f = jnp.asarray(freq_per_year, returns.dtype)
+    ann = jnp.nan_to_num(mean) * f
+    ann_sd = jnp.nan_to_num(sd) * jnp.sqrt(f)
+    ok = mv & sv & (ann_sd > 0)
+    return jnp.where(ok, ann / jnp.where(ok, ann_sd, 1.0), jnp.nan), ok

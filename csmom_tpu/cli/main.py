@@ -268,6 +268,18 @@ def cmd_replicate(args) -> int:
         for yy, aa in zip(np.asarray(uniq)[live], np.asarray(ann)[live]):
             print(f"  {int(yy)}  {aa * 100:+.2f}%")
 
+        from csmom_tpu.analytics import rolling_sharpe
+
+        W = 36
+        rs, rs_ok = rolling_sharpe(np.nan_to_num(spread), valid, W,
+                                   freq_per_year=12)
+        rs, rs_ok = np.asarray(rs), np.asarray(rs_ok)
+        if rs_ok.any():  # stability view: one full-sample Sharpe hides regimes
+            print(f"Rolling {W}m Sharpe: last {rs[rs_ok][-1]:+.2f}, "
+                  f"min {np.nanmin(rs[rs_ok]):+.2f}, "
+                  f"max {np.nanmax(rs[rs_ok]):+.2f} "
+                  f"({int(rs_ok.sum())} windows)")
+
     if getattr(args, "bootstrap", None):
         import jax
         import numpy as np

@@ -50,3 +50,41 @@ def test_cumulative_growth(rng):
     got = np.asarray(cumulative_growth(r, valid))
     want = np.cumprod(np.where(valid, 1 + r, 1.0))
     np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+class TestRollingSharpe:
+    def test_matches_pandas_rolling_oracle(self, rng):
+        """Trailing-window Sharpe equals pandas rolling mean/std (ddof=1)
+        annualized, with NaN-skipping window counts."""
+        import pandas as pd
+
+        from csmom_tpu.analytics import rolling_sharpe
+
+        T, W = 120, 24
+        r = rng.normal(0.004, 0.05, size=T)
+        valid = rng.random(T) > 0.15
+        r = np.where(valid, r, np.nan)
+
+        got, ok = rolling_sharpe(r, valid, W, freq_per_year=12)
+        s = pd.Series(r)
+        m = s.rolling(W, min_periods=W).mean()
+        sd = s.rolling(W, min_periods=W).std(ddof=1)
+        want = (m * 12) / (sd * np.sqrt(12))
+        wv = want.notna().values
+        np.testing.assert_array_equal(np.asarray(ok), wv)
+        np.testing.assert_allclose(np.asarray(got)[wv], want.values[wv],
+                                   rtol=1e-9)
+
+    def test_batched_and_full_window_matches_sharpe(self, rng):
+        """A window covering the whole valid history reproduces the scalar
+        sharpe() at the last position; leading axes broadcast."""
+        from csmom_tpu.analytics import rolling_sharpe, sharpe
+
+        G, T = 3, 60
+        r = rng.normal(0.002, 0.04, size=(G, T))
+        valid = np.ones((G, T), bool)
+        got, ok = rolling_sharpe(r, valid, T, freq_per_year=12)
+        assert ok[:, -1].all()
+        np.testing.assert_allclose(
+            np.asarray(got[:, -1]),
+            np.asarray(sharpe(r, valid, freq_per_year=12)), rtol=1e-9)
