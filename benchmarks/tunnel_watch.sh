@@ -9,14 +9,49 @@
 #   2. benchmarks/tpu_scaling.py      -> benchmarks/scaling_raw.log
 #   3. benchmarks/grid_phases.py      -> benchmarks/phases_raw.log
 # and exits once all three exist. All probe attempts are logged.
+#
+# ROUND parameterizes the committed artifact names (SCALING_TPU_${ROUND}.json,
+# PHASES_TPU_${ROUND}.json) so a watcher left running past its round can never
+# mislabel a later round's captures: pass it as $1 or env ROUND; there is no
+# default — the watcher refuses to start without one. It also refuses to
+# overwrite an artifact that already exists under the committed name
+# (ADVICE r4: a stale watcher must not clobber a landed capture).
+ROUND="${1:-${ROUND:-}}"
+if [ -z "$ROUND" ]; then
+  echo "tunnel_watch.sh: ROUND required (arg or env), e.g. r05" >&2
+  exit 2
+fi
+# Hard lifetime (default 13 h > one round): a watcher that never satisfied
+# have_all must still die before it can act in a later round.
+WATCH_MAX_S="${WATCH_MAX_S:-46800}"
 LOG=/root/repo/benchmarks/tunnel_watch.log
 SCALING_OUT=/root/repo/benchmarks/scaling_raw.log
 PHASES_OUT=/root/repo/benchmarks/phases_raw.log
 BENCH_MARK=/root/repo/BENCH_TPU_LAST.json
+SCALING_ART=/root/repo/SCALING_TPU_${ROUND}.json
+PHASES_ART=/root/repo/PHASES_TPU_${ROUND}.json
 START_TS=$(date +%s)
 cd /root/repo
 
-log() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
+log() { echo "$(date -u +%FT%TZ) [$ROUND] $*" >> "$LOG"; }
+
+# Extract the last JSON summary line of a raw log into a committed artifact
+# at the repo root (raw logs are gitignored, and a window can open after the
+# session's last turn — the driver's end-of-round auto-commit then still
+# captures the artifact). Refuses to overwrite an existing artifact.
+land_artifact() {  # $1 raw log, $2 committed artifact path
+  if [ -s "$2" ]; then
+    log "artifact $2 already exists — refusing to overwrite"
+    return 0
+  fi
+  if grep '^{' "$1" | tail -1 | python -m json.tool > "$2".tmp 2>/dev/null \
+      && [ -s "$2".tmp ]; then
+    mv "$2".tmp "$2"
+  else
+    rm -f "$2".tmp
+    log "summary extraction FAILED for $2 (artifact not written)"
+  fi
+}
 
 bench_fresh() {
   # BENCH_TPU_LAST.json persists across rounds as bench.py's cache: only a
@@ -33,11 +68,19 @@ while true; do
     log "all captures present — watcher done"
     exit 0
   fi
+  if [ "$(( $(date +%s) - START_TS ))" -ge "$WATCH_MAX_S" ]; then
+    log "lifetime ${WATCH_MAX_S}s reached — watcher exiting (round is over)"
+    exit 0
+  fi
   if timeout 120 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>>"$LOG"; then
     log "probe OK — tunnel up"
     if ! bench_fresh; then
       log "running bench.py (budget 900s)"
-      CSMOM_BENCH_BUDGET=900 timeout 960 python bench.py > /root/repo/benchmarks/bench_tpu_raw.log 2>&1
+      # CSMOM_ROUND gets a _watcher suffix: the full record this capture
+      # writes lands under its OWN committed name and can never clobber
+      # the driver's official end-of-round BENCH_FULL_${ROUND}.json
+      CSMOM_BENCH_BUDGET=900 CSMOM_ROUND="${ROUND}_watcher" timeout 960 \
+        python bench.py > /root/repo/benchmarks/bench_tpu_raw.log 2>&1
       log "bench.py rc=$? (fresh BENCH_TPU_LAST.json: $( bench_fresh && echo yes || echo NO ))"
     fi
     if [ ! -s "$SCALING_OUT" ]; then
@@ -46,18 +89,7 @@ while true; do
       rc=$?
       if [ "$rc" -eq 0 ]; then
         mv "$SCALING_OUT".tmp "$SCALING_OUT"
-        # also land the summary under its committed name at the repo root:
-        # raw logs are gitignored, and a window can open after the session's
-        # last turn — the driver's end-of-round auto-commit then still
-        # captures the artifact
-        if grep '^{' "$SCALING_OUT" | tail -1 \
-            | python -m json.tool > /root/repo/SCALING_TPU_r04.json.tmp 2>/dev/null \
-            && [ -s /root/repo/SCALING_TPU_r04.json.tmp ]; then
-          mv /root/repo/SCALING_TPU_r04.json.tmp /root/repo/SCALING_TPU_r04.json
-        else
-          rm -f /root/repo/SCALING_TPU_r04.json.tmp
-          log "scaling summary extraction FAILED (artifact not written)"
-        fi
+        land_artifact "$SCALING_OUT" "$SCALING_ART"
       fi
       log "tpu_scaling rc=$rc"
     fi
@@ -68,14 +100,7 @@ while true; do
       rc=$?
       if [ "$rc" -eq 0 ]; then
         mv "$PHASES_OUT".tmp "$PHASES_OUT"
-        if grep '^{' "$PHASES_OUT" | tail -1 \
-            | python -m json.tool > /root/repo/PHASES_TPU_r04.json.tmp 2>/dev/null \
-            && [ -s /root/repo/PHASES_TPU_r04.json.tmp ]; then
-          mv /root/repo/PHASES_TPU_r04.json.tmp /root/repo/PHASES_TPU_r04.json
-        else
-          rm -f /root/repo/PHASES_TPU_r04.json.tmp
-          log "phases summary extraction FAILED (artifact not written)"
-        fi
+        land_artifact "$PHASES_OUT" "$PHASES_ART"
       fi
       log "grid_phases 1x rc=$rc"
     fi

@@ -330,7 +330,7 @@ def cmd_grid(args) -> int:
     v, m = prices.device()
     n_shards = getattr(args, "shards", None) or 0
     mode = getattr(args, "mode", None) or cfg.momentum.mode
-    if (n_shards > 1 or mode == "rank_hist") and mode == "hist":
+    if n_shards > 1 and mode == "hist":
         # sharded 'hist' would all_gather and then re-run the full-panel
         # histogram kernel redundantly on every shard — strictly worse than
         # the gather+sort baseline at exactly the sizes hist targets.  The
@@ -1201,9 +1201,12 @@ def _apply_platform(args) -> int:
     platform, backend init can HANG (observed: a tunneled TPU plugin
     blocking ``jax.devices()`` for >900 s when the tunnel is down), so the
     default platform is probed in a subprocess with a hard timeout
-    (``CSMOM_PLATFORM_PROBE_S``, default 6 s) before any in-process device
+    (``CSMOM_PLATFORM_PROBE_S``, default 20 s) before any in-process device
     use; on timeout the CLI prints the workaround and exits 3 instead of
-    hanging.  ``CSMOM_PLATFORM_PROBE_S=0`` disables the probe (the "I
+    hanging.  A successful probe is cached for
+    ``CSMOM_PLATFORM_PROBE_TTL_S`` (default 120 s) in a timestamped marker
+    file, so consecutive invocations skip re-probing inside one tunnel
+    window.  ``CSMOM_PLATFORM_PROBE_S=0`` disables the probe (the "I
     know, wait for it" escape hatch — an explicit ``--platform tpu``
     is NOT that: it selects the local tpu plugin, a different backend
     than a tunneled platform like this image's 'axon').
@@ -1222,16 +1225,41 @@ def _apply_platform(args) -> int:
         if (envp and envp != "cpu"
                 and getattr(args, "command", None) not in _DEVICE_FREE_COMMANDS):
             import subprocess
+            import tempfile
+            import time as _time
 
-            probe_s = float(os.environ.get("CSMOM_PLATFORM_PROBE_S", "6"))
+            # Default raised from 6 s (ADVICE r4): cold TPU runtime init can
+            # legitimately take >6 s, and a false exit 3 on a healthy tunnel
+            # is worse than a slower first failure.
+            probe_s = float(os.environ.get("CSMOM_PLATFORM_PROBE_S", "20"))
             if probe_s <= 0:
                 return 0  # probe disabled: proceed on the env's platform
+            # A recent successful probe is cached (timestamped marker file,
+            # keyed by the platform string) so back-to-back CLI invocations
+            # pay the subprocess init once, not per command.  TTL is short:
+            # this image's tunnel flaps in ~25-min windows, so a stale "ok"
+            # must expire well inside one.
+            ttl_s = float(os.environ.get("CSMOM_PLATFORM_PROBE_TTL_S", "120"))
+            mark = os.path.join(
+                tempfile.gettempdir(),
+                f"csmom_probe_ok_{''.join(c if c.isalnum() else '_' for c in envp)}",
+            )
+            try:
+                if ttl_s > 0 and _time.time() - os.path.getmtime(mark) < ttl_s:
+                    return 0  # fresh success cached: skip the probe
+            except OSError:
+                pass  # no marker yet
             try:
                 subprocess.run(
                     [sys.executable, "-c",
                      "import jax; jax.devices()"],
                     capture_output=True, timeout=probe_s, check=True,
                 )
+                try:
+                    with open(mark, "w"):
+                        pass
+                except OSError:
+                    pass  # cache write failure only costs the next probe
             except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
                 print(
                     f"error: the environment pins JAX_PLATFORMS={envp!r} and "

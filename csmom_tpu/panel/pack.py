@@ -78,6 +78,22 @@ def save_packed(obj, path: str) -> str:
     }
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(meta, f)
+    # Re-packing over an existing directory with fewer fields must not leave
+    # the old fields' arrays orphaned: load_packed is meta-driven so they are
+    # invisible to it, but they inflate the pack's on-disk size and mislead a
+    # plain dir listing (ADVICE r4). Meta is written first, so a crash here
+    # leaves a correct pack plus removable orphans, never a broken manifest.
+    keep = {"times.npy", "meta.json"} | {
+        f"{f}.{kind}.npy" for f in panels for kind in ("values", "mask")
+    }
+    for name in os.listdir(path):
+        if name not in keep and (
+            name.endswith(".values.npy") or name.endswith(".mask.npy")
+        ):
+            try:
+                os.remove(os.path.join(path, name))
+            except OSError:
+                pass  # a vanished/locked orphan is harmless
     return path
 
 
