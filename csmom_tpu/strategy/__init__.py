@@ -10,6 +10,8 @@ from csmom_tpu.strategy.base import (
     xs_zscore,
 )
 from csmom_tpu.strategy.builtin import (
+    FiftyTwoWeekHigh,
+    IntermediateMomentum,
     Momentum,
     ResidualMomentum,
     Reversal,
@@ -25,6 +27,8 @@ __all__ = [
     "make_strategy",
     "register_strategy",
     "xs_zscore",
+    "FiftyTwoWeekHigh",
+    "IntermediateMomentum",
     "Momentum",
     "ResidualMomentum",
     "Reversal",

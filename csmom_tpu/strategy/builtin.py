@@ -19,6 +19,7 @@ from csmom_tpu.strategy.base import Strategy, register_strategy, xs_zscore
 
 __all__ = [
     "FiftyTwoWeekHigh",
+    "IntermediateMomentum",
     "Momentum",
     "Reversal",
     "ResidualMomentum",
@@ -38,6 +39,22 @@ class Momentum(Strategy):
 
     def signal(self, prices, mask, **panels):
         return momentum(prices, mask, lookback=self.lookback, skip=self.skip)
+
+
+@register_strategy("intermediate_momentum")
+@dataclasses.dataclass(frozen=True)
+class IntermediateMomentum(Momentum):
+    """Novy-Marx (2012, JFE 103) intermediate momentum: the return over
+    months t-12..t-7 only — NM's finding is that momentum's power lives in
+    this *intermediate* horizon, not the recent t-6..t-2 leg.  A pure
+    reparametrization of :class:`Momentum` (``lookback=6, skip=7``),
+    registered under its own name so the plugin registry — not a CLI or
+    example parametrization — owns the zoo entry; first valid score at
+    month ``lookback + skip + 1 = 14``, same warmup as the reference's
+    J=12 signal."""
+
+    lookback: int = 6
+    skip: int = 7
 
 
 @register_strategy("reversal")

@@ -44,9 +44,8 @@ def main(argv=None):
         ("momentum J=12",        make_strategy("momentum"), {}),
         ("momentum J=6",         make_strategy("momentum", lookback=6), {}),
         # Novy-Marx (2012) intermediate momentum: months t-12..t-7 only —
-        # pure parametrization of the same signal (lookback=6, skip=7)
-        ("intermediate mom",     make_strategy("momentum", lookback=6,
-                                               skip=7), {}),
+        # registered under its own name (strategy/builtin.py)
+        ("intermediate mom",     make_strategy("intermediate_momentum"), {}),
         ("reversal 1m",          make_strategy("reversal"), {}),
         ("residual mom",         make_strategy("residual_momentum"), {}),
         # rank mode: the 52w-high score has an atom at exactly 1.0, and

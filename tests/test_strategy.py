@@ -177,6 +177,36 @@ def test_registry_roundtrip_and_unknown():
         make_strategy("nope")
 
 
+def test_intermediate_momentum_registered(rng):
+    """NM-2012 intermediate momentum is a first-class zoo member: the
+    registry constructs it by name, `csmom strategies` lists it, and its
+    signal equals the plain momentum signal at (lookback=6, skip=7) — it
+    IS that parametrization, owned by the registry rather than a CLI row
+    (VERDICT r4 #7)."""
+    s = make_strategy("intermediate_momentum")
+    assert (s.lookback, s.skip) == (6, 7)
+    assert "intermediate_momentum" in available_strategies()
+
+    prices, mask = _toy(rng, m=40)
+    got, gv = s.signal(jnp.asarray(prices), jnp.asarray(mask))
+    want, wv = Momentum(lookback=6, skip=7).signal(
+        jnp.asarray(prices), jnp.asarray(mask)
+    )
+    np.testing.assert_array_equal(np.asarray(gv), np.asarray(wv))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=0, atol=0, equal_nan=True
+    )
+
+    from csmom_tpu.cli.main import main as cli_main
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli_main(["strategies"]) == 0
+    assert "intermediate_momentum" in buf.getvalue()
+
+
 def test_user_registered_strategy_runs_through_engine(rng):
     @register_strategy("test_price_level")
     @dataclasses.dataclass(frozen=True)

