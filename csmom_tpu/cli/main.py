@@ -596,7 +596,20 @@ def cmd_intraday(args) -> int:
         return 2
     tickers = list(cfg.universe.tickers)
     minute_df = load_intraday(cfg.universe.data_dir, tickers)
-    daily_df = load_daily(cfg.universe.data_dir, tickers)
+    daily_tickers = tickers
+    if getattr(args, "parity", False):
+        # reproduce the reference's EFFECTIVE daily universe: its loader
+        # loses dialect-B caches (SURVEY §2.1.1), so those tickers fall
+        # back to default ADV/vol in its risk maps — match that exactly,
+        # or fills diverge on the affected names (observed: AAPL)
+        from csmom_tpu.panel.ingest import reference_readable_daily
+
+        daily_tickers = reference_readable_daily(cfg.universe.data_dir, tickers)
+        lost = sorted(set(tickers) - set(daily_tickers))
+        print(f"parity mode: daily risk-map universe drops {len(lost)} "
+              f"dialect-B caches the reference's loader loses "
+              f"({','.join(lost) or 'none'})")
+    daily_df = load_daily(cfg.universe.data_dir, daily_tickers)
     model = getattr(args, "model", None) or "ridge"
     if getattr(args, "alpha", None) is not None:
         alpha = args.alpha
@@ -1174,6 +1187,12 @@ def build_parser() -> argparse.ArgumentParser:
                             help="comma-separated score thresholds: print "
                                  "PnL/trades/cost sensitivity (one vmapped "
                                  "call)")
+            sp.add_argument("--parity", action="store_true",
+                            help="reproduce the reference's EFFECTIVE daily "
+                                 "risk-map universe (drop dialect-B caches "
+                                 "its loader loses — SURVEY §2.1.1) so the "
+                                 "trade log matches results/trades.csv "
+                                 "row-for-row")
         if "strategy" in extra:
             sp.add_argument("--strategy",
                             help="registered strategy plugin to rank instead of "

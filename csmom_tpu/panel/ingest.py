@@ -219,6 +219,36 @@ def load_daily(data_dir: str, tickers: Sequence[str]) -> pd.DataFrame:
     return _load_universe(data_dir, tickers, "daily", "daily")
 
 
+def reference_readable_daily(data_dir: str, tickers: Sequence[str]) -> list:
+    """Tickers whose daily cache the REFERENCE's own loader can read.
+
+    The reference's normalizer finds no date column in dialect-B files
+    (header ``Price,Close,...``) and silently drops every row
+    (``/root/reference/src/data_io.py:55-58,163``; SURVEY §2.1.1) — on the
+    shipped data that loses AAPL and shrinks its effective daily universe
+    to 19 names.  Parity mode needs to reproduce that shrunken universe
+    for the risk maps, so this detects dialect B the same way the
+    reference fails on it: by the first header cell.  Missing files are
+    excluded too (the reference would have no rows for them either).
+    """
+    out = []
+    for t in tickers:
+        path = os.path.join(data_dir, f"{t}_daily.csv")
+        try:
+            with open(path) as f:
+                header = f.readline()
+                if header.startswith("#"):  # versioned fetch-cache marker
+                    header = f.readline()
+        except OSError:
+            continue
+        # unquote the way read_price_csv does ('"Price"' -> 'Price') so the
+        # two readers' dialect detection stays in lockstep
+        first_cell = header.split(",")[0].strip().strip('"').strip()
+        if first_cell.lower() != "price":
+            out.append(t)
+    return out
+
+
 def load_intraday(data_dir: str, tickers: Sequence[str]) -> pd.DataFrame:
     """Load the intraday universe from cached CSVs into the canonical schema."""
     return _load_universe(data_dir, tickers, "intraday", "intraday")

@@ -135,3 +135,35 @@ class TestVendoredDialectFixtures:
         assert panel.tickers == ("SYNA", "SYNB")
         assert panel.shape == (2, 24)
         assert panel.mask.all()
+
+    def test_reference_readable_daily_detects_dialect_b(self):
+        """Parity mode's universe filter: dialect-B files (the ones the
+        reference's loader loses) are excluded, dialect-A and only those
+        kept; missing files drop out rather than raise."""
+        got = ingest.reference_readable_daily(
+            self.FIXTURES, ["SYNA", "SYNB", "NOPE"]
+        )
+        assert got == ["SYNA"]
+
+    def test_reference_readable_daily_quoted_and_marker_headers(self, tmp_path):
+        """Detection matches read_price_csv's header handling: a quoted
+        '\"Price\"' header is still dialect B, and the fetch-cache marker
+        line is skipped before sniffing."""
+        (tmp_path / "QB_daily.csv").write_text(
+            '"Price","Close","High","Low","Open","Volume"\n'
+            "Ticker,QB,QB,QB,QB,QB\nDate,,,,,\n2020-01-03,1,1,1,1,10\n"
+        )
+        (tmp_path / "MA_daily.csv").write_text(
+            "# csmom-cache-v1\n"
+            "Date,Adj Close,Close,High,Low,Open,Volume\n"
+            "2020-01-03,1,1,1,1,1,10\n"
+        )
+        (tmp_path / "MB_daily.csv").write_text(
+            "# csmom-cache-v1\n"
+            "Price,Close,High,Low,Open,Volume\n"
+            "Ticker,MB,MB,MB,MB,MB\n2020-01-03,1,1,1,1,10\n"
+        )
+        got = ingest.reference_readable_daily(
+            str(tmp_path), ["QB", "MA", "MB"]
+        )
+        assert got == ["MA"]
