@@ -1,0 +1,237 @@
+"""Distributed rank across a REAL process boundary (VERDICT r4 #5).
+
+The in-process 8-virtual-device CPU mesh (bench.py's histrank child) times
+collectives that are memcpys, so its walls measure local compute and only
+the BYTES model speaks to multi-host behaviour.  This benchmark puts an
+actual process/serialization boundary under the collective: two OS
+processes, each owning half the devices of one global mesh, joined by
+``jax.distributed`` with gloo TCP CPU collectives — every ``all_gather``/
+``psum`` inside the ranked kernels now crosses process memory through a
+socket, the same topology class (if not the same bandwidth) as ICI/DCN.
+
+Honest-labeling note (printed into the artifact): localhost TCP is
+~1-5 GB/s with syscall latency in the tens of microseconds — orders of
+magnitude below ICI (~400+ GB/s) and still well below DCN.  That *favors*
+the comm-avoiding rank_hist relative to the gather, so a rank_hist loss
+here would be strong evidence against it at ICI bandwidths, while a win
+bounds the regime where comm avoidance pays (slow interconnects) rather
+than proving an ICI-wall win.
+
+Run: ``python benchmarks/histrank_multiproc.py [--repeat-runs N]`` (the
+launcher spawns the two workers of itself N times, default 3 — the hist
+leg measured 13.0 s vs 20.5 s at 49k across two idle runs, so ONE run
+cannot be trusted to place a winner).  Prints one JSON summary line in
+the committed multi-run schema (``extra.runs`` list + an auto-stub
+``conclusion``); the committed ``HISTRANK_MULTIPROC_r05.json`` is this
+output with the conclusion field replaced by the author's reading of the
+runs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+PORT = int(os.environ.get("CSMOM_MP_PORT", "12861"))
+N_PROC = 2
+LOCAL_DEVICES = 4           # per process -> 8-device global mesh, as bench's
+M, B = 120, 10
+SIZES = (3072, 12288, 49152)
+REPS = 3
+
+
+def worker(process_id: int) -> None:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        f"localhost:{PORT}", num_processes=N_PROC, process_id=process_id,
+        cluster_detection_method="deactivate",
+    )
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from csmom_tpu.parallel.collectives import _ranked_labels_local
+
+    n_dev = jax.device_count()
+    assert n_dev == N_PROC * LOCAL_DEVICES
+    mesh = Mesh(np.array(jax.devices()), ("assets",))
+    sharding = NamedSharding(mesh, P("assets", None))
+
+    def build(mode):
+        fn = shard_map(
+            lambda xl, vl: _ranked_labels_local(xl, vl, B, mode)[0],
+            mesh=mesh,
+            in_specs=(P("assets", None), P("assets", None)),
+            out_specs=P("assets", None),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    results = {}
+    for A in SIZES:
+        # identical full panel on every process (same seed); each process
+        # donates only its addressable shards to the global array
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(A, M)).astype(np.float32)
+        valid = rng.random((A, M)) > 0.1
+        x = np.where(valid, x, np.nan).astype(np.float32)
+        xg = jax.make_array_from_callback(
+            (A, M), sharding, lambda idx: x[idx]
+        )
+        vg = jax.make_array_from_callback(
+            (A, M), sharding, lambda idx: valid[idx]
+        )
+
+        walls = {}
+        for mode in ("rank", "rank_hist"):
+            f = build(mode)
+            jax.block_until_ready(f(xg, vg))  # compile + first run
+            t0 = time.perf_counter()
+            for _ in range(REPS):
+                jax.block_until_ready(f(xg, vg))
+            walls[mode] = (time.perf_counter() - t0) / REPS
+        results[A] = walls
+        if process_id == 0:
+            print(f"A={A}: gather {walls['rank']*1e3:.1f} ms  "
+                  f"hist {walls['rank_hist']*1e3:.1f} ms", file=sys.stderr)
+
+    if process_id == 0:
+        itemsize = 4
+        out = {
+            "metric": "histrank_cross_process",
+            "value": round(results[SIZES[-1]]["rank"]
+                           / results[SIZES[-1]]["rank_hist"], 3),
+            "unit": "allgather_over_hist_wall_ratio_at_largest_A",
+            "vs_baseline": 0.0,
+            "extra": {
+                "topology": f"{N_PROC} OS processes x {LOCAL_DEVICES} CPU "
+                            "devices, jax.distributed + gloo TCP collectives "
+                            "(localhost socket)",
+                "workload": f"M={M} dates, {B} bins, reps={REPS}, f32",
+                "walls_s": {
+                    str(A): {m: round(w, 4) for m, w in ws.items()}
+                    for A, ws in results.items()
+                },
+                "allgather_bytes_per_device": {
+                    str(A): A * M * (itemsize + 1) for A in SIZES
+                },
+                "note": "localhost TCP (~GB/s, tens-of-us latency) sits far "
+                        "BELOW ICI bandwidth, which favors the comm-avoiding "
+                        "rank_hist: a hist win here bounds the slow-"
+                        "interconnect regime where comm avoidance pays; only "
+                        "a real multi-host ICI run places the fast-"
+                        "interconnect crossover",
+            },
+        }
+        print(json.dumps(out))
+
+
+def _one_run() -> dict:
+    """Spawn one worker pair and return worker 0's parsed summary record."""
+    import threading
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # workers pin cpu via config.update
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for i in range(N_PROC)
+    ]
+    # drain every pipe CONCURRENTLY: the workers share collectives, so one
+    # worker blocked on a full 64KB pipe stalls its peer's matching
+    # collective and deadlocks the pair; and kill whatever is still alive
+    # on any failure so a crashed run can't orphan processes holding the
+    # coordinator port
+    outs = [None] * N_PROC
+
+    def _drain(i):
+        outs[i] = procs[i].stdout.read()
+
+    threads = [threading.Thread(target=_drain, args=(i,)) for i in range(N_PROC)]
+    for t in threads:
+        t.start()
+    try:
+        for i, p in enumerate(procs):
+            p.wait(timeout=1800)
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for t in threads:
+            t.join(timeout=60)
+    for i, p in enumerate(procs):
+        if p.returncode != 0:
+            print((outs[i] or "")[-3000:], file=sys.stderr)
+            raise SystemExit(f"worker {i} failed rc={p.returncode}")
+    # the summary JSON is the last {...} line of worker 0's stdout
+    for line in reversed((outs[0] or "").strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise SystemExit("no summary line from worker 0")
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repeat-runs", type=int, default=3,
+                    help="independent launcher runs to aggregate (the hist "
+                         "leg is high-variance at 49k; one run cannot "
+                         "place a winner)")
+    n_runs = ap.parse_args().repeat_runs
+
+    runs, ratios = [], []
+    for r in range(n_runs):
+        rec = _one_run()
+        walls = rec["extra"]["walls_s"]
+        runs.append({"label": f"run{r + 1}", "walls_s": walls})
+        big = walls[str(SIZES[-1])]
+        ratios.append(big["rank"] / big["rank_hist"])
+        print(f"run {r + 1}/{n_runs}: 49k ratio {ratios[-1]:.3f}",
+              file=sys.stderr)
+    itemsize = 4
+    print(json.dumps({
+        "metric": "histrank_cross_process",
+        "value": round(min(ratios), 3),
+        "unit": "allgather_over_hist_wall_ratio_at_49k_worst_idle_run",
+        "vs_baseline": 0.0,
+        "extra": {
+            "topology": f"{N_PROC} OS processes x {LOCAL_DEVICES} CPU "
+                        "devices, jax.distributed + gloo TCP collectives "
+                        "(localhost socket)",
+            "workload": f"M={M} dates, {B} bins, reps={REPS} per run, f32",
+            "runs": runs,
+            "allgather_bytes_per_device": {
+                str(A): A * M * (itemsize + 1) for A in SIZES
+            },
+            "conclusion": "unreviewed auto-capture: interpret runs[] "
+                          "(win/loss per size, run-to-run variance) before "
+                          "citing a winner",
+            "note": "localhost TCP sits far below ICI bandwidth, which "
+                    "FAVORS the comm-avoiding rank_hist — a loss here is "
+                    "evidence the histogram's extra local compute outweighs "
+                    "its comm savings on CPU-class nodes; only a real "
+                    "multi-host ICI/TPU run places the fast-interconnect "
+                    "answer",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--worker":
+        worker(int(sys.argv[2]))
+    else:
+        main()

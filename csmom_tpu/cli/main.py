@@ -607,8 +607,9 @@ def cmd_intraday(args) -> int:
         daily_tickers = reference_readable_daily(cfg.universe.data_dir, tickers)
         lost = sorted(set(tickers) - set(daily_tickers))
         print(f"parity mode: daily risk-map universe drops {len(lost)} "
-              f"dialect-B caches the reference's loader loses "
-              f"({','.join(lost) or 'none'})")
+              f"caches the reference's loader cannot read (dialect-B "
+              f"headers or fetch-cache marker lines): "
+              f"{','.join(lost) or 'none'}")
     daily_df = load_daily(cfg.universe.data_dir, daily_tickers)
     model = getattr(args, "model", None) or "ridge"
     if getattr(args, "alpha", None) is not None:

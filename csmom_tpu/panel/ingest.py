@@ -136,6 +136,28 @@ def _canonize(out: pd.DataFrame, kind: str, ticker: str) -> pd.DataFrame:
     return _finalize(out, INTRADAY_SCHEMA, "datetime", ticker)
 
 
+def _sniff_header(path: str):
+    """First real header of a price CSV: ``(columns, had_marker)``.
+
+    The one place header sniffing lives (native fast path and parity-
+    universe detection both use it): skips the versioned fetch-cache
+    marker line, unquotes names the way ``read_csv`` does (``'"Close"'``
+    -> ``'Close'``) — price-cache headers never contain embedded commas,
+    so a plain split is safe even when names are quoted.  Returns
+    ``(None, False)`` on an unreadable file.
+    """
+    try:
+        with open(path, "r") as f:
+            header = f.readline()
+            had_marker = header.startswith("#")
+            if had_marker:
+                header = f.readline()
+    except OSError:
+        return None, False
+    cols = [c.strip().strip('"').strip() for c in header.rstrip("\r\n").split(",")]
+    return cols, had_marker
+
+
 def _read_native(path: str, ticker: str, kind: str) -> pd.DataFrame | None:
     """C++ fast path: header sniffed host-side, data rows parsed natively.
 
@@ -144,18 +166,8 @@ def _read_native(path: str, ticker: str, kind: str) -> pd.DataFrame | None:
     """
     from csmom_tpu.native import parse_price_csv_native
 
-    try:
-        with open(path, "r") as f:
-            header = f.readline()
-            if header.startswith("#"):  # versioned fetch-cache marker line
-                header = f.readline()
-    except OSError:
-        return None
-    # unquote header names the way read_csv does ('"Close"' -> 'Close');
-    # price-cache headers never contain embedded commas, so a plain split
-    # is safe even when names are quoted
-    cols = [c.strip().strip('"').strip() for c in header.rstrip("\r\n").split(",")]
-    if len(cols) < 2:
+    cols, _ = _sniff_header(path)
+    if cols is None or len(cols) < 2:
         return None
     try:
         parsed = parse_price_csv_native(path, len(cols) - 1)
@@ -229,22 +241,19 @@ def reference_readable_daily(data_dir: str, tickers: Sequence[str]) -> list:
     to 19 names.  Parity mode needs to reproduce that shrunken universe
     for the risk maps, so this detects dialect B the same way the
     reference fails on it: by the first header cell.  Missing files are
-    excluded too (the reference would have no rows for them either).
+    excluded too (the reference would have no rows for them either), and
+    so are files carrying our fetch-cache marker line — the reference's
+    bare ``pd.read_csv`` takes the marker as a one-field header and then
+    finds no date column, losing the file regardless of its dialect.
     """
     out = []
     for t in tickers:
-        path = os.path.join(data_dir, f"{t}_daily.csv")
-        try:
-            with open(path) as f:
-                header = f.readline()
-                if header.startswith("#"):  # versioned fetch-cache marker
-                    header = f.readline()
-        except OSError:
+        cols, had_marker = _sniff_header(
+            os.path.join(data_dir, f"{t}_daily.csv")
+        )
+        if cols is None or had_marker:
             continue
-        # unquote the way read_price_csv does ('"Price"' -> 'Price') so the
-        # two readers' dialect detection stays in lockstep
-        first_cell = header.split(",")[0].strip().strip('"').strip()
-        if first_cell.lower() != "price":
+        if cols[0].lower() != "price":
             out.append(t)
     return out
 

@@ -146,24 +146,25 @@ class TestVendoredDialectFixtures:
         assert got == ["SYNA"]
 
     def test_reference_readable_daily_quoted_and_marker_headers(self, tmp_path):
-        """Detection matches read_price_csv's header handling: a quoted
-        '\"Price\"' header is still dialect B, and the fetch-cache marker
-        line is skipped before sniffing."""
+        """Detection matches what the REFERENCE's loader would do: a quoted
+        '\"Price\"' header is still dialect B (excluded), and any file with
+        our fetch-cache marker line is excluded outright — the reference's
+        bare read_csv takes the marker as a one-field header and loses the
+        file regardless of dialect."""
         (tmp_path / "QB_daily.csv").write_text(
             '"Price","Close","High","Low","Open","Volume"\n'
             "Ticker,QB,QB,QB,QB,QB\nDate,,,,,\n2020-01-03,1,1,1,1,10\n"
+        )
+        (tmp_path / "QA_daily.csv").write_text(
+            '"Date","Adj Close","Close","High","Low","Open","Volume"\n'
+            "2020-01-03,1,1,1,1,1,10\n"
         )
         (tmp_path / "MA_daily.csv").write_text(
             "# csmom-cache-v1\n"
             "Date,Adj Close,Close,High,Low,Open,Volume\n"
             "2020-01-03,1,1,1,1,1,10\n"
         )
-        (tmp_path / "MB_daily.csv").write_text(
-            "# csmom-cache-v1\n"
-            "Price,Close,High,Low,Open,Volume\n"
-            "Ticker,MB,MB,MB,MB,MB\n2020-01-03,1,1,1,1,10\n"
-        )
         got = ingest.reference_readable_daily(
-            str(tmp_path), ["QB", "MA", "MB"]
+            str(tmp_path), ["QB", "QA", "MA"]
         )
-        assert got == ["MA"]
+        assert got == ["QA"]
