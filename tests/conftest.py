@@ -22,6 +22,26 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: the fast tier's wall is ~75% jit
+# compiles (census: 1,286 compiles / ~370 s XLA on this image), and they
+# repeat identically run over run.  Caching every compile over 0.5 s makes
+# re-runs mostly load-bound (the common case while iterating); the first
+# run on a machine still pays full compile.  CSMOM_JIT_CACHE=0 disables,
+# any other value overrides the directory.
+_cache_dir = os.environ.get("CSMOM_JIT_CACHE", "")
+if _cache_dir != "0":
+    if not _cache_dir:
+        import tempfile
+
+        # uid-suffixed: a fixed path in world-writable /tmp would collide
+        # across users (and let one user feed another serialized executables)
+        _cache_dir = os.path.join(
+            tempfile.gettempdir(), f"csmom_jit_cache-{os.getuid()}"
+        )
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -71,6 +91,7 @@ def _map_budget() -> int:
 
 
 _MAP_BUDGET = _map_budget()
+_MAP_STATS = {"max_maps": 0, "clears": 0}
 
 
 @pytest.fixture(autouse=True)
@@ -90,8 +111,66 @@ def _bound_live_executables():
     per full run and never in a small one.
     """
     yield
-    if _n_memory_maps() > _MAP_BUDGET:
+    n = _n_memory_maps()
+    _MAP_STATS["max_maps"] = max(_MAP_STATS["max_maps"], n)
+    if n > _MAP_BUDGET:
+        _MAP_STATS["clears"] += 1
         jax.clear_caches()
+
+
+# -- compile census (CSMOM_COUNT_COMPILES=1) --------------------------------
+# The fast tier's wall is almost entirely jit compiles (VERDICT r4 weak #2),
+# and the full tier lives near the XLA-CPU live-executable limit, so the
+# number of DISTINCT compiles is the quantity to engineer down.  With
+# CSMOM_COUNT_COMPILES=1 every "Compiling <fn>" log line is attributed to
+# the currently running test and a per-test census prints at session end —
+# the map that says which tests to shape-dedupe or demote to slow.
+_COMPILE_COUNTS: dict = {}
+_CURRENT_TEST = [None]
+
+if os.environ.get("CSMOM_COUNT_COMPILES"):
+    import logging
+
+    jax.config.update("jax_log_compiles", True)
+
+    class _CompileCounter(logging.Handler):
+        def emit(self, record):
+            msg = record.getMessage()
+            key = _CURRENT_TEST[0] or "<collection/session>"
+            entry = _COMPILE_COUNTS.setdefault(key, [0, 0.0])
+            if msg.startswith("Compiling "):
+                entry[0] += 1
+            elif msg.startswith("Finished XLA compilation"):
+                try:
+                    entry[1] += float(msg.rsplit(" in ", 1)[1].split()[0])
+                except (IndexError, ValueError):
+                    pass
+
+    # "Compiling jit(...)" comes from pxla; "Finished XLA compilation of
+    # ... in N sec" from dispatch (verified on this image's jax 0.9.0)
+    for _name in ("jax._src.interpreters.pxla", "jax._src.dispatch"):
+        logging.getLogger(_name).addHandler(_CompileCounter())
+
+    @pytest.fixture(autouse=True)
+    def _attribute_compiles(request):
+        _CURRENT_TEST[0] = request.node.nodeid
+        yield
+        _CURRENT_TEST[0] = None
+
+    def pytest_terminal_summary(terminalreporter):
+        items = sorted(_COMPILE_COUNTS.items(), key=lambda kv: -kv[1][1])
+        total = sum(v[0] for v in _COMPILE_COUNTS.values())
+        total_s = sum(v[1] for v in _COMPILE_COUNTS.values())
+        terminalreporter.write_line(
+            f"\n== jit compile census: {total} compiles, {total_s:.0f}s "
+            f"XLA wall, {len(items)} attribution keys (top 40 by wall) =="
+        )
+        for k, (n, s) in items[:40]:
+            terminalreporter.write_line(f"{n:5d}  {s:7.1f}s  {k}")
+        terminalreporter.write_line(
+            f"memory maps: peak {_MAP_STATS['max_maps']} of budget "
+            f"{_MAP_BUDGET}; emergency cache clears: {_MAP_STATS['clears']}"
+        )
 
 
 @pytest.fixture()

@@ -267,8 +267,11 @@ def test_cli_sector_map_combo_rejected_cleanly(tmp_path, capsys):
 
 @requires_reference
 def test_cli_grid_tearsheet_tables(tmp_path, capsys):
+    # same grid cell set/statics as test_cli_grid_tc_sweep: the two CLI
+    # grid tests share one compile of the grid stack
     rc = main([
-        "grid", "--data-dir", REFERENCE_DATA, "--js", "6,12", "--ks", "3",
+        "grid", "--data-dir", REFERENCE_DATA, "--js", "6", "--ks", "1,3",
+        "--mode", "rank", "--n-bins", "5",
         "--tearsheet", "--bootstrap", "0",
     ])
     assert rc == 0

@@ -182,7 +182,11 @@ class TestGridNetOfCosts:
         from csmom_tpu.signals.residual import residual_sweep_backtest
 
         prices, mask = self._setup(rng)
-        Js, Ks = np.array([3, 6]), np.array([1, 3])
+        # single-J / short-K build: the assertions are about METADATA
+        # carrying (non-default skip included), so the cheapest grid that
+        # has distinct Js/Ks arrays suffices — compile cost scales with
+        # max(Ks) and this test was the tier's #2 compile hog
+        Js, Ks = np.array([6]), np.array([1, 3])
         grid = jk_grid_backtest(prices, mask, Js, Ks, skip=2, n_bins=5,
                                 mode="rank")
         np.testing.assert_array_equal(np.asarray(grid.Js), Js)
@@ -193,7 +197,7 @@ class TestGridNetOfCosts:
         assert net.n_bins == 5 and int(net.skip) == 2
 
         res = residual_sweep_backtest(prices, mask, np.array([6]),
-                                      np.array([24]), n_bins=5)
+                                      np.array([12]), n_bins=5)
         with pytest.raises(ValueError, match="carries none"):
             grid_net_of_costs(prices, mask, res)
 
@@ -240,7 +244,9 @@ class TestGridNetOfCosts:
                                              grid_net_of_costs,
                                              jk_grid_backtest)
 
-        prices, mask = self._setup(rng, A=60, M=140)
+        # same shapes/statics as test_net_from_unit_matches_direct below:
+        # the two tests share one jit compile of the grid + netting stack
+        prices, mask = self._setup(rng, A=40, M=140)
         Js, Ks = np.array([6]), np.array([1, 3, 6])
         grid = jk_grid_backtest(prices, mask, Js, Ks, skip=1, n_bins=5,
                                 mode="rank")
@@ -262,9 +268,10 @@ class TestGridNetOfCosts:
                                              grid_net_of_costs,
                                              jk_grid_backtest)
 
-        prices, mask = self._setup(rng)
+        # shapes/statics shared with test_break_even_bps (one compile)
+        prices, mask = self._setup(rng, A=40, M=140)
         grid = jk_grid_backtest(prices, mask, np.array([6]),
-                                np.array([1, 3]), skip=1, n_bins=5,
+                                np.array([1, 3, 6]), skip=1, n_bins=5,
                                 mode="rank")
         unit = grid_net_of_costs(prices, mask, grid, half_spread=1.0)
         hs = 13e-4

@@ -62,9 +62,13 @@ def test_matches_pandas_oracle(rng, J, skip):
 
 
 def test_cum_is_cumsum_and_shapes(rng):
-    prices, mask = _panel(rng, A=25, M=60)
-    hp = horizon_profile(prices, mask, lookback=6, max_h=12)
-    assert np.asarray(hp.mean_spread).shape == (12,)
+    # canonical horizon cell (default _panel, lookback=6, n_bins=5,
+    # max_h=8): shares ONE jit compile with the [6-1] oracle test and the
+    # persistence test — these three were ~21s of tier compile wall when
+    # each picked its own shapes
+    prices, mask = _panel(rng)
+    hp = horizon_profile(prices, mask, lookback=6, n_bins=5, max_h=8)
+    assert np.asarray(hp.mean_spread).shape == (8,)
     np.testing.assert_allclose(
         np.asarray(hp.cum_spread),
         np.cumsum(np.nan_to_num(np.asarray(hp.mean_spread))),
@@ -174,11 +178,11 @@ def test_volume_horizon_table_shape(rng):
 def test_persistence_signal_on_trending_panel(rng):
     """A panel with persistent per-asset drifts must show positive spreads
     at every horizon (winners keep winning when drifts are permanent)."""
-    A, M = 24, 80
+    A, M = 30, 70  # the canonical horizon cell's shapes (shared compile)
     drift = np.linspace(-0.02, 0.02, A)[:, None]
     prices = 50 * np.exp(np.cumsum(
         drift + rng.normal(0, 0.001, size=(A, M)), axis=1))
     mask = np.ones((A, M), bool)
-    hp = horizon_profile(prices, mask, lookback=6, max_h=10, n_bins=4)
+    hp = horizon_profile(prices, mask, lookback=6, max_h=8, n_bins=5)
     assert (np.asarray(hp.mean_spread) > 0).all()
     assert float(hp.cum_spread[-1]) > float(hp.cum_spread[0])

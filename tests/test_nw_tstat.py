@@ -1,5 +1,9 @@
 """Newey–West t-stat kernel vs an independent numpy oracle.
 
+Series lengths here stick to the suite's canonical sizes (120/240) so the
+eager-op executable cache is shared across the stats-family modules —
+every one-off length re-pays ~80 tiny XLA CPU compiles (~4 s).
+
 The replicated paper quotes NW t-stats (LeSw00.pdf Tables I–II); the
 reference framework has no t-stats at all (``src/utils.py:8-16``).  These
 tests pin the HAC conventions documented in
@@ -20,8 +24,8 @@ def oracle(x, lags=None):
 
 @pytest.mark.parametrize("lags", [None, 0, 1, 3, 6, 12])
 def test_dense_matches_oracle(rng, lags):
-    x = rng.normal(0.004, 0.02, size=180)
-    valid = np.ones(180, bool)
+    x = rng.normal(0.004, 0.02, size=240)
+    valid = np.ones(240, bool)
     got = float(nw_t_stat(x, valid, lags=lags))
     np.testing.assert_allclose(got, oracle(x, lags), rtol=1e-10)
 
@@ -40,8 +44,8 @@ def test_prefix_suffix_mask_equals_compacted(rng):
 
 def test_max_lag_invariance(rng):
     """Weights beyond L are exactly zero, so any max_lag >= L is identical."""
-    x = rng.normal(0.0, 1.0, size=90)
-    v = np.ones(90, bool)
+    x = rng.normal(0.0, 1.0, size=120)
+    v = np.ones(120, bool)
     a = float(nw_t_stat(x, v, lags=5, max_lag=8))
     b = float(nw_t_stat(x, v, lags=5, max_lag=24))
     np.testing.assert_allclose(a, b, rtol=1e-12)
@@ -49,11 +53,11 @@ def test_max_lag_invariance(rng):
 
 def test_lag_zero_vs_iid():
     """L=0 reduces to the iid t up to the n vs n-1 variance normalization."""
-    x = np.sin(np.arange(50)) + 0.3
-    v = np.ones(50, bool)
+    x = np.sin(np.arange(120)) + 0.3
+    v = np.ones(120, bool)
     t0 = float(nw_t_stat(x, v, lags=0))
     ti = float(t_stat(x, v))
-    np.testing.assert_allclose(t0, ti * np.sqrt(50 / 49), rtol=1e-10)
+    np.testing.assert_allclose(t0, ti * np.sqrt(120 / 119), rtol=1e-10)
 
 
 @pytest.mark.slow
