@@ -7,6 +7,11 @@ from csmom_tpu.backtest.monthly import (
     sector_neutral_backtest,
     MonthlyResult,
 )
+from csmom_tpu.backtest.banded import (
+    BandedResult,
+    banded_books,
+    banded_monthly_backtest,
+)
 from csmom_tpu.backtest.grid import (grid_break_even_bps, grid_net_of_costs,
                                      jk_grid_backtest, GridResult)
 from csmom_tpu.backtest.horizon import (
@@ -31,6 +36,9 @@ from csmom_tpu.backtest.walkforward import (
 )
 
 __all__ = [
+    "BandedResult",
+    "banded_books",
+    "banded_monthly_backtest",
     "monthly_spread_backtest",
     "net_of_costs",
     "net_of_costs_arrays",

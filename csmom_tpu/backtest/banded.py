@@ -1,0 +1,186 @@
+"""Hysteresis-banded monthly rebalancing: trade less, keep the signal.
+
+The reference (and our plain engine, :mod:`csmom_tpu.backtest.monthly`)
+re-forms the long-short book from scratch every month: hold decile
+``n_bins-1`` minus decile ``0`` of *this month's* sort
+(``/root/reference/run_demo.py:46-65``).  That pays full two-leg turnover
+whenever names shuffle across the decile edge — names that sit at rank
+8.9/9.1 flap in and out, and the cost framework (``costs/impact.py``,
+BASELINE config 3) charges every flap.
+
+The banded engine is the standard practitioner fix, absent from the
+reference: a no-trade hysteresis band.  A name ENTERS the long book only
+in the extreme decile (``label == n_bins-1``) but STAYS while it remains
+within ``band`` deciles of the top (``label >= n_bins-1-band``); the short
+leg is symmetric (enter at 0, stay while ``label <= band``).  Invalid
+months (no signal — delisting, gap) force an exit, and ``band=0`` reduces
+*exactly* to the plain engine's top-minus-bottom portfolio (the invariant
+test).  The band trades a little signal freshness for a lot of turnover —
+the knob that moves the break-even cost level.
+
+TPU shape: membership is a recursion over months, so it runs as one
+``lax.scan`` over the time axis carrying two ``bool[A]`` books — O(M)
+sequential steps of O(A) vectorized work, trivially small next to the
+formation/ranking kernels, and the asset axis stays shardable (the scan
+carries shard-local books; only the member counts would need a ``psum``
+in a sharded variant).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from csmom_tpu.analytics.stats import masked_mean, nw_t_stat, sharpe, t_stat
+from csmom_tpu.ops.ranking import decile_assign_panel
+from csmom_tpu.signals.momentum import momentum, monthly_returns
+
+__all__ = ["BandedResult", "banded_from_labels", "banded_monthly_backtest",
+           "banded_books"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BandedResult:
+    """Outputs of one banded monthly backtest (time-indexed arrays)."""
+
+    spread: jnp.ndarray        # f[M] long-book minus short-book next-month return
+    spread_valid: jnp.ndarray  # bool[M]
+    weights: jnp.ndarray       # f[A, M] book weights at formation (+1/nL, -1/nS)
+    n_long: jnp.ndarray        # i32[M] long-book members
+    n_short: jnp.ndarray       # i32[M] short-book members
+    turnover: jnp.ndarray      # f[M] L1 weight change vs previous month
+    mean_spread: jnp.ndarray   # scalar
+    ann_sharpe: jnp.ndarray    # scalar
+    tstat: jnp.ndarray         # scalar iid t
+    tstat_nw: jnp.ndarray      # scalar Newey–West t
+
+
+def banded_books(labels, n_bins: int, band: int):
+    """Long/short membership books under the hysteresis rule.
+
+    Args:
+      labels: i32[A, M] decile ids (-1 invalid), as produced by
+        :func:`csmom_tpu.ops.ranking.decile_assign_panel`.
+      band: stay-zone width in deciles.  0 = plain extreme-decile book.
+
+    Returns:
+      ``(long bool[A, M], short bool[A, M])``.
+    """
+    labv = labels >= 0
+    top = n_bins - 1
+
+    def step(carry, x):
+        long_prev, short_prev = carry
+        lab, lv = x
+        long_now = (lv & (lab == top)) | (long_prev & lv & (lab >= top - band))
+        short_now = (lv & (lab == 0)) | (short_prev & lv & (lab <= band))
+        return (long_now, short_now), (long_now, short_now)
+
+    A = labels.shape[0]
+    init = (jnp.zeros(A, bool), jnp.zeros(A, bool))
+    _, (longT, shortT) = lax.scan(step, init, (labels.T, labv.T))
+    return longT.T, shortT.T
+
+
+@partial(jax.jit, static_argnames=("lookback", "skip", "n_bins", "mode",
+                                   "band", "freq"))
+def banded_monthly_backtest(
+    prices,
+    mask,
+    lookback: int = 12,
+    skip: int = 1,
+    n_bins: int = 10,
+    mode: str = "qcut",
+    band: int = 1,
+    freq: int = 12,
+) -> BandedResult:
+    """Monthly momentum with a no-trade hysteresis band.
+
+    Same formation pipeline as :func:`monthly_spread_backtest` (signal,
+    per-date decile sort — identical labels), then the book recursion of
+    :func:`banded_books` instead of a fresh extreme-decile book.  The
+    spread is the equal-weighted mean next-month return of the long book
+    minus the short book (members with a missing next-month return drop
+    from the mean, exactly as in the plain engine); ``turnover`` is the L1
+    change of the membership weights, ready for
+    ``cost[t] = half_spread * turnover[t]`` netting.
+
+    ``band`` must satisfy ``2*band < n_bins - 1`` so the two stay-zones
+    cannot overlap (a name can never qualify for both books).
+    """
+    ret, ret_valid = monthly_returns(prices, mask)
+    mom, mom_valid = momentum(prices, mask, lookback=lookback, skip=skip)
+    labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
+    return banded_from_labels(labels, ret, ret_valid, n_bins=n_bins,
+                              band=band, freq=freq)
+
+
+@partial(jax.jit, static_argnames=("n_bins", "band", "freq"))
+def banded_from_labels(
+    labels,
+    ret,
+    ret_valid,
+    n_bins: int = 10,
+    band: int = 1,
+    freq: int = 12,
+) -> BandedResult:
+    """Banded backtest from precomputed decile labels + monthly returns.
+
+    The labels-level entry point: callers that already ranked (the CLI
+    holds ``rep.labels`` from the plain run; a research loop may sweep
+    ``band`` over one ranking) skip re-running formation — the band
+    recursion and portfolio tail are all that compile here.
+    """
+    if band < 0 or 2 * band >= n_bins - 1:
+        raise ValueError(
+            f"band={band} with n_bins={n_bins}: need 0 <= 2*band < n_bins-1 "
+            "so the long and short stay-zones cannot overlap"
+        )
+
+    long_b, short_b = banded_books(labels, n_bins, band)
+    n_long = long_b.sum(axis=0, dtype=jnp.int32)
+    n_short = short_b.sum(axis=0, dtype=jnp.int32)
+
+    next_ret = jnp.roll(ret, -1, axis=1)
+    next_valid = jnp.roll(ret_valid, -1, axis=1).at[:, -1].set(False)
+    lv = long_b & next_valid
+    sv = short_b & next_valid
+    r0 = jnp.where(next_valid, jnp.nan_to_num(next_ret), 0.0)
+    nl = lv.sum(axis=0)
+    ns = sv.sum(axis=0)
+    lmean = jnp.sum(jnp.where(lv, r0, 0.0), axis=0) / jnp.maximum(nl, 1)
+    smean = jnp.sum(jnp.where(sv, r0, 0.0), axis=0) / jnp.maximum(ns, 1)
+    spread_valid = (nl > 0) & (ns > 0)
+    spread = jnp.where(spread_valid, lmean - smean, jnp.nan)
+
+    # weight conventions mirror long_short_weights/turnover_cost EXACTLY
+    # (denominators and live-gating use next-VALID member counts, while
+    # every book member carries a weight) so band=0 reproduces the plain
+    # cost path's charge to the last month — the invariant that keeps one
+    # cost semantics across engines
+    dt = ret.dtype
+    w = (
+        long_b.astype(dt) / jnp.maximum(nl, 1).astype(dt)
+        - short_b.astype(dt) / jnp.maximum(ns, 1).astype(dt)
+    )
+    w = jnp.where(spread_valid[None, :], w, 0.0)
+    prev = jnp.roll(w, 1, axis=1).at[:, 0].set(0.0)
+    turnover = jnp.sum(jnp.abs(w - prev), axis=0)
+
+    return BandedResult(
+        spread=spread,
+        spread_valid=spread_valid,
+        weights=w,
+        n_long=n_long,
+        n_short=n_short,
+        turnover=turnover,
+        mean_spread=masked_mean(spread, spread_valid),
+        ann_sharpe=sharpe(spread, spread_valid, freq_per_year=freq),
+        tstat=t_stat(spread, spread_valid),
+        tstat_nw=nw_t_stat(spread, spread_valid),
+    )

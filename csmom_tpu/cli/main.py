@@ -178,6 +178,18 @@ def cmd_replicate(args) -> int:
         ids, n_sectors = _load_sector_map(args.sector_map, prices.tickers)
         sector_kw = {"sector_ids": ids, "n_sectors": n_sectors}
         print(f"sector-neutral ranking: {n_sectors} sectors")
+    if getattr(args, "band", None) is not None:
+        # validate BEFORE the plain run so misuse really does fail fast
+        if strategy is not None or sector_kw or cfg.backend != "tpu":
+            print("--band uses the TPU engine's built-in momentum path "
+                  "(drop --strategy / --sector-map / --backend pandas)",
+                  file=sys.stderr)
+            return 2
+        if args.band < 0 or 2 * args.band >= cfg.momentum.n_bins - 1:
+            print(f"--band {args.band}: need 0 <= 2*band < n_bins-1 "
+                  f"(n_bins={cfg.momentum.n_bins}) so the long and short "
+                  "stay-zones cannot overlap", file=sys.stderr)
+            return 2
     rep = run_monthly(
         prices,
         lookback=cfg.momentum.lookback,
@@ -238,6 +250,57 @@ def cmd_replicate(args) -> int:
             be = float(rep.mean_spread) / mean_turn * 1e4
             print(f"break-even half-spread: {be:+.1f} bps "
                   f"(mean monthly turnover {mean_turn:.3f})")
+
+    if getattr(args, "band", None) is not None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from csmom_tpu.backtest.banded import banded_from_labels
+        from csmom_tpu.signals.momentum import monthly_returns
+
+        # formation already ran: reuse rep.labels (identical ranking — the
+        # guard above excluded strategy/sector/pandas variants) so only the
+        # band recursion + portfolio tail compile here
+        v, m = prices.device()
+        mret, mret_valid = monthly_returns(v, m)
+        bres = banded_from_labels(
+            jnp.asarray(rep.labels), mret, mret_valid,
+            n_bins=cfg.momentum.n_bins, band=args.band,
+        )
+        bt = np.asarray(bres.turnover)
+        bv = np.asarray(bres.spread_valid)
+        pvalid = np.isfinite(np.asarray(rep.spread))
+        if getattr(args, "tc_bps", None) is not None:
+            # cost1 from the --tc-bps block IS the plain unit-turnover
+            # series; don't recompute it
+            plain_turn = mean_turn if mean_turn > 0 else None
+        else:
+            from csmom_tpu.costs.impact import long_short_weights, turnover_cost
+
+            w_plain = long_short_weights(
+                jnp.asarray(rep.labels), jnp.asarray(rep.decile_counts),
+                cfg.momentum.n_bins,
+            )
+            pt = np.asarray(turnover_cost(w_plain, half_spread=1.0))
+            plain_turn = float(pt[pvalid].mean()) if pvalid.any() else None
+        print(f"\nhysteresis band {args.band} (enter at extreme decile, "
+              f"stay within {args.band}):")
+        print(f"  gross mean {float(bres.mean_spread):+.6f}, Sharpe "
+              f"{float(bres.ann_sharpe):.4f}, NW t {float(bres.tstat_nw):+.3f}")
+        b_turn = float(bt[bv].mean()) if bv.any() else float("nan")
+        msg = f"  mean monthly turnover {b_turn:.3f}"
+        if plain_turn is not None and plain_turn > 0:
+            msg += (f" vs plain {plain_turn:.3f} "
+                    f"({(1 - b_turn / plain_turn) * 100:.0f}% less trading)")
+        print(msg)
+        if getattr(args, "tc_bps", None) is not None:
+            hs = args.tc_bps / 1e4
+            bnet = np.where(bv, np.asarray(bres.spread) - hs * bt, np.nan)
+            bmean = float(np.nanmean(bnet)) if bv.any() else float("nan")
+            print(f"  net of {args.tc_bps:g} bps: mean {bmean:+.6f}")
+            if b_turn > 0:
+                print(f"  break-even half-spread: "
+                      f"{float(bres.mean_spread) / b_turn * 1e4:+.1f} bps")
 
     if getattr(args, "tables", False):
         from csmom_tpu.analytics.tables import decile_table
@@ -1140,6 +1203,12 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--sector-map", dest="sector_map",
                             help="ticker,sector CSV: rank within sectors "
                                  "(sector-neutral momentum; TPU engine)")
+            sp.add_argument("--band", type=int, metavar="B",
+                            help="also run the hysteresis-banded book: "
+                                 "enter at the extreme decile, stay within "
+                                 "B deciles of it (cuts turnover; with "
+                                 "--tc-bps also reports the banded net and "
+                                 "break-even)")
         if "doublesort" in extra:
             _add_turnover_flags(sp)
         if "horizons" in extra:

@@ -498,3 +498,35 @@ def test_cli_replicate_break_even_line(capsys, tmp_path):
     # tolerances reflect the printed precision (be at 0.1 bps, turn at 1e-3)
     assert abs(gross - 5e-4 * turn - net5) < 2e-6
     assert abs(be / 1e4 * turn - gross) < 0.06 / 1e4 * turn + 1e-6
+
+
+@requires_reference
+def test_cli_replicate_band(capsys, tmp_path):
+    """--band smoke on the reference data: banded turnover is reported,
+    lower than plain, and the banded break-even exceeds the plain one
+    (the band's whole point); incompatible modes fail fast."""
+    rc = main(["replicate", "--data-dir", REFERENCE_DATA, "--tc-bps", "10",
+               "--band", "1", "--out", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import re
+
+    m = re.search(r"turnover ([\d.]+) vs plain ([\d.]+)", out)
+    assert m, out
+    banded_turn, plain_turn = float(m.group(1)), float(m.group(2))
+    assert banded_turn < plain_turn
+    bes = [float(x) for x in
+           re.findall(r"break-even half-spread: \+?([-\d.]+) bps", out)]
+    assert len(bes) == 2 and bes[1] > bes[0]
+
+    # band incompatible with the pandas backend: fail fast, rc=2
+    rc = main(["replicate", "--data-dir", REFERENCE_DATA, "--band", "1",
+               "--backend", "pandas", "--out", str(tmp_path)])
+    assert rc == 2
+    assert "--band" in capsys.readouterr().err
+
+    # invalid band width: readable error, rc=2
+    rc = main(["replicate", "--data-dir", REFERENCE_DATA, "--band", "7",
+               "--out", str(tmp_path)])
+    assert rc == 2
+    assert "stay-zones" in capsys.readouterr().err
