@@ -3,6 +3,7 @@
 from csmom_tpu.analytics.stats import (
     sharpe,
     rolling_sharpe,
+    vol_managed,
     masked_mean,
     masked_std,
     t_stat,
@@ -25,6 +26,7 @@ from csmom_tpu.analytics.tearsheet import (
 __all__ = [
     "sharpe",
     "rolling_sharpe",
+    "vol_managed",
     "masked_mean",
     "masked_std",
     "t_stat",

@@ -190,6 +190,11 @@ def cmd_replicate(args) -> int:
                   f"(n_bins={cfg.momentum.n_bins}) so the long and short "
                   "stay-zones cannot overlap", file=sys.stderr)
             return 2
+    if getattr(args, "vol_target", None) is not None and args.vol_target <= 0:
+        # validate BEFORE the plain run, like --band
+        print(f"--vol-target {args.vol_target:g}: the annualized vol "
+              "target must be positive (percent, e.g. 12)", file=sys.stderr)
+        return 2
     rep = run_monthly(
         prices,
         lookback=cfg.momentum.lookback,
@@ -301,6 +306,42 @@ def cmd_replicate(args) -> int:
             if b_turn > 0:
                 print(f"  break-even half-spread: "
                       f"{float(bres.mean_spread) / b_turn * 1e4:+.1f} bps")
+
+    if getattr(args, "vol_target", None) is not None:
+        import numpy as np
+
+        from csmom_tpu.analytics import vol_managed
+        from csmom_tpu.analytics.stats import nw_t_stat, sharpe
+
+        tgt = args.vol_target / 100.0
+        _VM_WINDOW, _VM_CAP = 6, 2.0
+        sp_arr = np.asarray(rep.spread, dtype=float)
+        sv = np.isfinite(sp_arr)
+        managed, mok, scale = vol_managed(
+            np.nan_to_num(sp_arr), sv, window=_VM_WINDOW,
+            target_ann_vol=tgt, max_leverage=_VM_CAP,
+        )
+        mok_np = np.asarray(mok)
+        if not mok_np.any():
+            print(f"vol target {args.vol_target:g}%: no months with a full "
+                  "6-month prior vol window — series too short",
+                  file=sys.stderr)
+        else:
+            m = np.asarray(managed)
+            mmean = float(np.nanmean(m[mok_np]))
+            msharpe = float(sharpe(np.nan_to_num(m), mok, freq_per_year=12))
+            mt = float(nw_t_stat(np.nan_to_num(m), mok))
+            raw_vol = float(np.std(sp_arr[sv], ddof=1) * np.sqrt(12))
+            man_vol = float(np.std(m[mok_np], ddof=1) * np.sqrt(12))
+            sc = np.asarray(scale)[mok_np]
+            print(f"\nvol-managed overlay (BSC 2015, target "
+                  f"{args.vol_target:g}% ann, {_VM_WINDOW}m trailing, "
+                  f"{_VM_CAP:g}x cap):")
+            print(f"  mean {mmean:+.6f}, Sharpe {msharpe:.4f}, NW t {mt:+.3f}"
+                  f"  ({int(mok_np.sum())} of {int(sv.sum())} live months)")
+            print(f"  realized ann vol: raw {raw_vol * 100:.1f}% -> managed "
+                  f"{man_vol * 100:.1f}%; scale range "
+                  f"[{sc.min():.2f}, {sc.max():.2f}]")
 
     if getattr(args, "tables", False):
         from csmom_tpu.analytics.tables import decile_table
@@ -1209,6 +1250,13 @@ def build_parser() -> argparse.ArgumentParser:
                                  "B deciles of it (cuts turnover; with "
                                  "--tc-bps also reports the banded net and "
                                  "break-even)")
+            sp.add_argument("--vol-target", dest="vol_target", type=float,
+                            metavar="PCT",
+                            help="also report the volatility-managed "
+                                 "overlay (Barroso-Santa-Clara 2015): "
+                                 "scale exposure to this annualized vol "
+                                 "target (percent, e.g. 12) using the "
+                                 "trailing 6-month realized vol")
         if "doublesort" in extra:
             _add_turnover_flags(sp)
         if "horizons" in extra:
