@@ -40,7 +40,7 @@ from csmom_tpu.ops.ranking import decile_assign_panel
 from csmom_tpu.signals.momentum import momentum, monthly_returns
 
 __all__ = ["BandedResult", "banded_from_labels", "banded_monthly_backtest",
-           "banded_books"]
+           "banded_books", "book_partials", "finalize_book_spread"]
 
 
 @jax.tree_util.register_dataclass
@@ -120,6 +120,40 @@ def banded_monthly_backtest(
                               band=band, freq=freq)
 
 
+def book_partials(long_b, short_b, ret, ret_valid):
+    """Shard-local per-month partials of the book aggregation.
+
+    The ONE definition of how books turn into portfolio sums — the
+    single-device engine finalizes these directly; the sharded engine
+    (:func:`csmom_tpu.parallel.collectives.sharded_banded_backtest`)
+    ``psum``s the stack over the asset mesh axis first, which is the only
+    difference between the two.  Returns f[4, M]: long return sum, short
+    return sum, long valid-member count, short valid-member count, where
+    "valid" means the member has a next-month return (the plain engine's
+    drop-from-the-mean convention).
+    """
+    next_ret = jnp.roll(ret, -1, axis=1)
+    next_valid = jnp.roll(ret_valid, -1, axis=1).at[:, -1].set(False)
+    lv = long_b & next_valid
+    sv = short_b & next_valid
+    r0 = jnp.where(next_valid, jnp.nan_to_num(next_ret), 0.0)
+    return jnp.stack([
+        jnp.sum(jnp.where(lv, r0, 0.0), axis=0),
+        jnp.sum(jnp.where(sv, r0, 0.0), axis=0),
+        lv.sum(axis=0).astype(r0.dtype),
+        sv.sum(axis=0).astype(r0.dtype),
+    ])
+
+
+def finalize_book_spread(partials):
+    """(possibly psum'd) book partials -> ``(spread, valid, nl, ns)``."""
+    lsum, ssum, nl, ns = partials
+    lmean = lsum / jnp.maximum(nl, 1.0)
+    smean = ssum / jnp.maximum(ns, 1.0)
+    valid = (nl > 0) & (ns > 0)
+    return jnp.where(valid, lmean - smean, jnp.nan), valid, nl, ns
+
+
 @partial(jax.jit, static_argnames=("n_bins", "band", "freq"))
 def banded_from_labels(
     labels,
@@ -146,17 +180,8 @@ def banded_from_labels(
     n_long = long_b.sum(axis=0, dtype=jnp.int32)
     n_short = short_b.sum(axis=0, dtype=jnp.int32)
 
-    next_ret = jnp.roll(ret, -1, axis=1)
-    next_valid = jnp.roll(ret_valid, -1, axis=1).at[:, -1].set(False)
-    lv = long_b & next_valid
-    sv = short_b & next_valid
-    r0 = jnp.where(next_valid, jnp.nan_to_num(next_ret), 0.0)
-    nl = lv.sum(axis=0)
-    ns = sv.sum(axis=0)
-    lmean = jnp.sum(jnp.where(lv, r0, 0.0), axis=0) / jnp.maximum(nl, 1)
-    smean = jnp.sum(jnp.where(sv, r0, 0.0), axis=0) / jnp.maximum(ns, 1)
-    spread_valid = (nl > 0) & (ns > 0)
-    spread = jnp.where(spread_valid, lmean - smean, jnp.nan)
+    partials = book_partials(long_b, short_b, ret, ret_valid)
+    spread, spread_valid, nl, ns = finalize_book_spread(partials)
 
     # weight conventions mirror long_short_weights/turnover_cost EXACTLY
     # (denominators and live-gating use next-VALID member counts, while

@@ -27,6 +27,7 @@ from csmom_tpu.parallel.mesh import (
     mesh_topology,
 )
 from csmom_tpu.parallel.collectives import (
+    sharded_banded_backtest,
     sharded_monthly_spread_backtest,
     sharded_jk_grid_backtest,
 )
@@ -40,6 +41,7 @@ __all__ = [
     "make_hybrid_mesh",
     "mesh_topology",
     "distributed_init",
+    "sharded_banded_backtest",
     "sharded_monthly_spread_backtest",
     "sharded_jk_grid_backtest",
     "sharded_block_bootstrap",

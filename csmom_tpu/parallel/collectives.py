@@ -121,6 +121,72 @@ def sharded_monthly_spread_backtest(
     )
 
 
+def sharded_banded_backtest(
+    prices,
+    mask,
+    mesh: Mesh,
+    lookback: int = 12,
+    skip: int = 1,
+    n_bins: int = 10,
+    mode: str = "qcut",
+    band: int = 1,
+    freq: int = 12,
+):
+    """Asset-sharded hysteresis-banded backtest (``backtest/banded.py``).
+
+    The band recursion is per-asset, so the ``lax.scan`` over months runs
+    entirely shard-local on each shard's book slice — distribution adds
+    exactly two communication steps: the shared distributed rank
+    (:func:`_ranked_labels_local`) and one ``psum`` of the four per-month
+    book partials (long/short sums and counts).  Bit-equal to the
+    single-device :func:`banded_from_labels` on the same panel (pinned by
+    ``tests/test_sharding.py``).
+
+    Returns replicated ``(spread f[M], spread_valid bool[M], mean,
+    sharpe, tstat_nw)``.
+    """
+    from csmom_tpu.backtest.banded import (
+        banded_books,
+        book_partials,
+        finalize_book_spread,
+    )
+
+    if band < 0 or 2 * band >= n_bins - 1:
+        raise ValueError(
+            f"band={band} with n_bins={n_bins}: need 0 <= 2*band < n_bins-1 "
+            "so the long and short stay-zones cannot overlap"
+        )
+
+    def local_fn(pv, mv):
+        ret_l, retv_l = monthly_returns(pv, mv)
+        mom_l, momv_l = momentum_dynamic(pv, mv, lookback, skip)
+        labels_l, _ = _ranked_labels_local(mom_l, momv_l, n_bins, mode)
+        long_l, short_l = banded_books(labels_l, n_bins, band)
+        # the single-device aggregation, distributed by exactly one psum
+        partials = lax.psum(
+            book_partials(long_l, short_l, ret_l, retv_l), "assets"
+        )
+        spread, valid, _, _ = finalize_book_spread(partials)
+        return spread, valid
+
+    spec_in = P("assets", None)
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(spec_in, spec_in),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    spread, valid = jax.jit(fn)(prices, mask)
+    return (
+        spread,
+        valid,
+        masked_mean(spread, valid),
+        sharpe(spread, valid, freq_per_year=freq),
+        nw_t_stat(spread, valid),
+    )
+
+
 def sharded_jk_grid_backtest(
     prices,
     mask,

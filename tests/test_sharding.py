@@ -140,3 +140,29 @@ def test_sharded_grid_bf16_impl_close_counts_exact(rng, eight_devices):
     np.testing.assert_allclose(np.asarray(res_b.spreads)[v],
                                np.asarray(res_x.spreads)[v],
                                rtol=0, atol=2e-3)
+
+
+def test_sharded_banded_matches_single(rng, eight_devices):
+    """The band recursion is per-asset, so sharding it must be exact: the
+    8-device banded engine reproduces banded_from_labels bit-for-bit
+    (padded lanes have no signal, so they never enter a book)."""
+    from csmom_tpu.backtest import banded_monthly_backtest
+    from csmom_tpu.parallel import sharded_banded_backtest
+
+    prices, mask = _panel(rng)
+    mesh = make_mesh(eight_devices, grid_axis=1)
+    pv, mv, A = pad_assets(prices, mask, mesh.shape["assets"])
+
+    for band in (0, 1):
+        spread, valid, mean, sh, tnw = sharded_banded_backtest(
+            pv, mv, mesh, lookback=12, skip=1, n_bins=5, band=band)
+        single = banded_monthly_backtest(prices, mask, lookback=12, skip=1,
+                                         n_bins=5, band=band)
+        np.testing.assert_array_equal(np.asarray(valid),
+                                      np.asarray(single.spread_valid))
+        np.testing.assert_allclose(
+            np.asarray(spread)[np.asarray(valid)],
+            np.asarray(single.spread)[np.asarray(single.spread_valid)],
+            rtol=1e-12)
+        assert abs(float(mean) - float(single.mean_spread)) < 1e-12
+        assert abs(float(tnw) - float(single.tstat_nw)) < 1e-11
