@@ -40,7 +40,8 @@ from csmom_tpu.ops.ranking import decile_assign_panel
 from csmom_tpu.signals.momentum import momentum, monthly_returns
 
 __all__ = ["BandedResult", "banded_from_labels", "banded_monthly_backtest",
-           "banded_books", "book_partials", "finalize_book_spread"]
+           "banded_books", "book_partials", "finalize_book_spread",
+           "validate_band"]
 
 
 @jax.tree_util.register_dataclass
@@ -120,6 +121,17 @@ def banded_monthly_backtest(
                               band=band, freq=freq)
 
 
+def validate_band(band: int, n_bins: int) -> None:
+    """The ONE band-rule validator (engines raise it; the CLI catches it):
+    stay-zones must not overlap, so a name can never qualify for both
+    books."""
+    if band < 0 or 2 * band >= n_bins - 1:
+        raise ValueError(
+            f"band={band} with n_bins={n_bins}: need 0 <= 2*band < n_bins-1 "
+            "so the long and short stay-zones cannot overlap"
+        )
+
+
 def book_partials(long_b, short_b, ret, ret_valid):
     """Shard-local per-month partials of the book aggregation.
 
@@ -170,11 +182,7 @@ def banded_from_labels(
     ``band`` over one ranking) skip re-running formation — the band
     recursion and portfolio tail are all that compile here.
     """
-    if band < 0 or 2 * band >= n_bins - 1:
-        raise ValueError(
-            f"band={band} with n_bins={n_bins}: need 0 <= 2*band < n_bins-1 "
-            "so the long and short stay-zones cannot overlap"
-        )
+    validate_band(band, n_bins)
 
     long_b, short_b = banded_books(labels, n_bins, band)
     n_long = long_b.sum(axis=0, dtype=jnp.int32)

@@ -178,17 +178,37 @@ def cmd_replicate(args) -> int:
         ids, n_sectors = _load_sector_map(args.sector_map, prices.tickers)
         sector_kw = {"sector_ids": ids, "n_sectors": n_sectors}
         print(f"sector-neutral ranking: {n_sectors} sectors")
-    if getattr(args, "band", None) is not None:
-        # validate BEFORE the plain run so misuse really does fail fast
+    # --band/--band-sweep: validate BEFORE the plain run so misuse really
+    # does fail fast; validity rule lives once in banded.validate_band
+    band_sweep = None
+    want_band = getattr(args, "band", None) is not None
+    if want_band or getattr(args, "band_sweep", None):
+        from csmom_tpu.backtest.banded import validate_band
+
+        flag = "--band" if want_band else "--band-sweep"
         if strategy is not None or sector_kw or cfg.backend != "tpu":
-            print("--band uses the TPU engine's built-in momentum path "
+            print(f"{flag} uses the TPU engine's built-in momentum path "
                   "(drop --strategy / --sector-map / --backend pandas)",
                   file=sys.stderr)
             return 2
-        if args.band < 0 or 2 * args.band >= cfg.momentum.n_bins - 1:
-            print(f"--band {args.band}: need 0 <= 2*band < n_bins-1 "
-                  f"(n_bins={cfg.momentum.n_bins}) so the long and short "
-                  "stay-zones cannot overlap", file=sys.stderr)
+        if getattr(args, "band_sweep", None):
+            try:
+                band_sweep = [int(s) for s in args.band_sweep.split(",")
+                              if s.strip()]
+            except ValueError:
+                print(f"--band-sweep {args.band_sweep!r}: widths must be "
+                      "plain integers, e.g. --band-sweep 0,1,2",
+                      file=sys.stderr)
+                return 2
+            if not band_sweep:
+                print("--band-sweep: empty width list", file=sys.stderr)
+                return 2
+        try:
+            for b in ([args.band] if want_band else []) + (band_sweep or []):
+                validate_band(b, cfg.momentum.n_bins)
+        except ValueError as e:
+            print(f"{flag}: invalid widths — {e} (stay-zones must not "
+                  "overlap)", file=sys.stderr)
             return 2
     if getattr(args, "vol_target", None) is not None and args.vol_target <= 0:
         # validate BEFORE the plain run, like --band
@@ -256,20 +276,24 @@ def cmd_replicate(args) -> int:
             print(f"break-even half-spread: {be:+.1f} bps "
                   f"(mean monthly turnover {mean_turn:.3f})")
 
-    if getattr(args, "band", None) is not None:
+    if want_band or band_sweep is not None:
+        # shared setup for both banded surfaces: formation already ran, so
+        # reuse rep.labels (identical ranking — the guard above excluded
+        # strategy/sector/pandas variants); only the band recursion +
+        # portfolio tail compile below, and the device transfer happens once
         import jax.numpy as jnp
         import numpy as np
 
         from csmom_tpu.backtest.banded import banded_from_labels
         from csmom_tpu.signals.momentum import monthly_returns
 
-        # formation already ran: reuse rep.labels (identical ranking — the
-        # guard above excluded strategy/sector/pandas variants) so only the
-        # band recursion + portfolio tail compile here
         v, m = prices.device()
         mret, mret_valid = monthly_returns(v, m)
+        lab = jnp.asarray(rep.labels)
+
+    if want_band:
         bres = banded_from_labels(
-            jnp.asarray(rep.labels), mret, mret_valid,
+            lab, mret, mret_valid,
             n_bins=cfg.momentum.n_bins, band=args.band,
         )
         bt = np.asarray(bres.turnover)
@@ -283,7 +307,7 @@ def cmd_replicate(args) -> int:
             from csmom_tpu.costs.impact import long_short_weights, turnover_cost
 
             w_plain = long_short_weights(
-                jnp.asarray(rep.labels), jnp.asarray(rep.decile_counts),
+                lab, jnp.asarray(rep.decile_counts),
                 cfg.momentum.n_bins,
             )
             pt = np.asarray(turnover_cost(w_plain, half_spread=1.0))
@@ -306,6 +330,30 @@ def cmd_replicate(args) -> int:
             if b_turn > 0:
                 print(f"  break-even half-spread: "
                       f"{float(bres.mean_spread) / b_turn * 1e4:+.1f} bps")
+
+    if band_sweep is not None:
+        hs_bps = getattr(args, "tc_bps", None)
+        hdr = f"{'band':>4}  {'gross/mo':>9}  {'turnover':>8}  {'b/e bps':>8}"
+        if hs_bps is not None:
+            hdr += f"  {'net@' + format(hs_bps, 'g') + 'bps':>12}"
+        print("\nhysteresis band sweep (formation ranked once):")
+        print(hdr)
+        for b in band_sweep:
+            r = banded_from_labels(lab, mret, mret_valid,
+                                   n_bins=cfg.momentum.n_bins, band=b)
+            rv = np.asarray(r.spread_valid)
+            turn = np.asarray(r.turnover)
+            mt = float(turn[rv].mean()) if rv.any() else float("nan")
+            be = (float(r.mean_spread) / mt * 1e4 if mt > 0
+                  else float("nan"))
+            row = (f"{b:>4}  {float(r.mean_spread):>+9.6f}  {mt:>8.3f}  "
+                   f"{be:>+8.1f}")
+            if hs_bps is not None:
+                net = np.where(rv, np.asarray(r.spread)
+                               - hs_bps / 1e4 * turn, np.nan)
+                nm = float(np.nanmean(net)) if rv.any() else float("nan")
+                row += f"  {nm:>+12.6f}"
+            print(row)
 
     if getattr(args, "vol_target", None) is not None:
         import numpy as np
@@ -1257,6 +1305,14 @@ def build_parser() -> argparse.ArgumentParser:
                                  "scale exposure to this annualized vol "
                                  "target (percent, e.g. 12) using the "
                                  "trailing 6-month realized vol")
+            sp.add_argument("--band-sweep", dest="band_sweep",
+                            metavar="B,B,...",
+                            help="with --band surfaces: compare several "
+                                 "hysteresis band widths in one table "
+                                 "(gross mean / turnover / break-even; "
+                                 "net at --tc-bps when given) — formation "
+                                 "runs once, only the book tail re-runs "
+                                 "per band")
         if "doublesort" in extra:
             _add_turnover_flags(sp)
         if "horizons" in extra:

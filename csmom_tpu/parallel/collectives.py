@@ -149,13 +149,10 @@ def sharded_banded_backtest(
         banded_books,
         book_partials,
         finalize_book_spread,
+        validate_band,
     )
 
-    if band < 0 or 2 * band >= n_bins - 1:
-        raise ValueError(
-            f"band={band} with n_bins={n_bins}: need 0 <= 2*band < n_bins-1 "
-            "so the long and short stay-zones cannot overlap"
-        )
+    validate_band(band, n_bins)
 
     def local_fn(pv, mv):
         ret_l, retv_l = monthly_returns(pv, mv)

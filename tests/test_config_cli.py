@@ -553,3 +553,30 @@ def test_cli_replicate_vol_target(capsys, tmp_path):
                "0", "--out", str(tmp_path)])
     assert rc == 2
     assert "must be positive" in capsys.readouterr().err
+
+
+@requires_reference
+def test_cli_replicate_band_sweep(capsys, tmp_path):
+    """--band-sweep: one table row per width, turnover strictly falling
+    with the band (its purpose); malformed widths fail fast."""
+    rc = main(["replicate", "--data-dir", REFERENCE_DATA, "--band-sweep",
+               "0,1,2", "--tc-bps", "10", "--out", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import re
+
+    rows = re.findall(r"^\s+([012])\s+([+-][\d.]+)\s+([\d.]+)\s+", out,
+                      flags=re.M)
+    assert [r[0] for r in rows] == ["0", "1", "2"]
+    turns = [float(r[2]) for r in rows]
+    assert turns[0] > turns[1] > turns[2]
+
+    rc = main(["replicate", "--data-dir", "/nonexistent",
+               "--band-sweep", "1,zig", "--out", str(tmp_path)])
+    assert rc == 2
+    assert "plain integers" in capsys.readouterr().err
+
+    rc = main(["replicate", "--data-dir", "/nonexistent",
+               "--band-sweep", "0,7", "--out", str(tmp_path)])
+    assert rc == 2
+    assert "invalid widths" in capsys.readouterr().err
