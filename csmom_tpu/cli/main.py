@@ -316,6 +316,19 @@ def cmd_replicate(args) -> int:
               f"stay within {args.band}):")
         print(f"  gross mean {float(bres.mean_spread):+.6f}, Sharpe "
               f"{float(bres.ann_sharpe):.4f}, NW t {float(bres.tstat_nw):+.3f}")
+        if getattr(args, "bootstrap", None):
+            import jax as _jax
+
+            from csmom_tpu.analytics import block_bootstrap
+
+            bbs = block_bootstrap(
+                np.asarray(bres.spread), bv, _jax.random.PRNGKey(0),
+                n_samples=args.bootstrap,
+                block_len=getattr(args, "block_len", None) or 6,
+            )
+            blo, bhi = np.asarray(bbs.mean_ci)
+            print(f"  95% CI mean: [{blo:.6f}, {bhi:.6f}] "
+                  f"({args.bootstrap} block-bootstrap resamples)")
         b_turn = float(bt[bv].mean()) if bv.any() else float("nan")
         msg = f"  mean monthly turnover {b_turn:.3f}"
         if plain_turn is not None and plain_turn > 0:

@@ -506,10 +506,13 @@ def test_cli_replicate_band(capsys, tmp_path):
     lower than plain, and the banded break-even exceeds the plain one
     (the band's whole point); incompatible modes fail fast."""
     rc = main(["replicate", "--data-dir", REFERENCE_DATA, "--tc-bps", "10",
-               "--band", "1", "--out", str(tmp_path)])
+               "--band", "1", "--bootstrap", "50", "--out", str(tmp_path)])
     assert rc == 0
     out = capsys.readouterr().out
     import re
+
+    # the banded series gets its own block-bootstrap CI line
+    assert re.search(r"95% CI mean: \[[-\d.]+, [-\d.]+\] \(50 block", out)
 
     m = re.search(r"turnover ([\d.]+) vs plain ([\d.]+)", out)
     assert m, out
