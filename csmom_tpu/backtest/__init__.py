@@ -26,6 +26,7 @@ from csmom_tpu.backtest.event import (
     EventResult,
     cost_attribution,
     event_backtest,
+    hysteresis_event_backtest,
     threshold_sweep,
     trades_dataframe,
 )
@@ -61,6 +62,7 @@ __all__ = [
     "EventResult",
     "cost_attribution",
     "event_backtest",
+    "hysteresis_event_backtest",
     "threshold_sweep",
     "trades_dataframe",
 ]

@@ -50,7 +50,10 @@ class EventResult:
     portfolio_value: jnp.ndarray  # f[T]
     cash: jnp.ndarray         # f[T] cash path
     positions: jnp.ndarray    # i32[A, T] share positions
-    trade_side: jnp.ndarray   # i8[A, T] +1/-1/0
+    trade_side: jnp.ndarray   # i8[A, T] signed trade UNITS: +1/-1/0 in the
+                              # threshold engine; the hysteresis engine's
+                              # flips store ±2 (one 2-unit fill), so every
+                              # consumer (TCA, the trade log) sees true size
     exec_price: jnp.ndarray   # f[A, T] fill price where traded
     impact: jnp.ndarray       # f[A] per-asset impact fraction
     total_pnl: jnp.ndarray    # f[] sum of pnl
@@ -228,6 +231,22 @@ def event_backtest(
         shares_settle = shares
         notional_settle = fill * shares.astype(dtype)
 
+    return _settle_mark_and_wrap(
+        price, valid, shares_settle, notional_settle, side, fill, traded,
+        impact, cash0, allsum,
+    )
+
+
+def _settle_mark_and_wrap(price, valid, shares_settle, notional_settle,
+                          side, fill, traded, impact, cash0, allsum):
+    """Shared tail of every event engine: settled shares/notional ->
+    positions, cash, forward-filled marks, portfolio value, per-bar PnL,
+    trade counts — one definition of the accounting, used by the plain
+    threshold engine and the hysteresis engine so the two cannot drift."""
+    A, T = price.shape
+    dtype = price.dtype
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+
     positions = jnp.cumsum(shares_settle, axis=1)
     flow = allsum(jnp.sum(notional_settle, axis=0))   # signed notional per bar
     cash = cash0 - jnp.cumsum(flow)
@@ -266,6 +285,105 @@ def event_backtest(
         n_buys=allsum(jnp.sum(side > 0)).astype(jnp.int32),
         n_sells=allsum(jnp.sum(side < 0)).astype(jnp.int32),
         net_notional=jnp.sum(flow),
+    )
+
+
+def hysteresis_event_backtest(
+    price,
+    valid,
+    score,
+    adv,
+    vol,
+    threshold_hi: float = 1e-4,
+    threshold_lo: float = 1e-5,
+    size_shares: int = 50,
+    cash0: float = 1_000_000.0,
+    spread: float = 0.001,
+) -> EventResult:
+    """Event backtest with a Schmitt-trigger position state per asset.
+
+    The plain engine fires an order at EVERY bar whose |score| clears one
+    threshold (``backtester.py:29-32``) — at minute frequency that is a
+    new 50-share order nearly every bar (28,020 trades on the golden
+    workload) and the position book grows without bound.  The hysteresis
+    engine instead targets a bounded state with two thresholds, the
+    classic two-threshold trigger:
+
+    - enter long  (+1 unit) when ``score >  threshold_hi``;
+    - enter short (-1 unit) when ``score < -threshold_hi``;
+    - go flat when ``|score| < threshold_lo``;
+    - otherwise (``threshold_lo <= |score| <= threshold_hi``) HOLD the
+      previous state — the no-trade band that absorbs score flutter.
+
+    Trades happen only on state changes (enter/exit/flip; a flip trades
+    2x ``size_shares``), filled at the reference's market-fill formula.
+    Positions are therefore bounded at one unit per asset — this is a
+    different product from the reference's accumulate-every-signal book,
+    not a parametrization of it (``threshold_hi == threshold_lo`` gives a
+    1-unit-target engine, still not the accumulating one; documented, not
+    hidden).
+
+    TPU shape: the state machine is resolved WITHOUT a scan — the state
+    at t is decided by the most recent event among {enter-long,
+    enter-short, exit} at or before t, and "most recent event index" is
+    an associative running max per event type; three cummaxes and a
+    comparison replace the sequential trigger.  ``threshold_lo <=
+    threshold_hi`` is validated HOST-side on the Python floats; the
+    compiled body keeps both thresholds traced, so repeated calls with
+    different float thresholds share one compile.  A ``vmap`` over
+    thresholds would hit the host-side ``float()`` — vmap
+    ``_hysteresis_body`` directly for that (and validate the grid
+    yourself), the same pattern as :func:`threshold_sweep`.
+    """
+    if float(threshold_lo) > float(threshold_hi):
+        raise ValueError(
+            f"threshold_lo={threshold_lo} > threshold_hi={threshold_hi}: "
+            "the exit threshold must not exceed the entry threshold"
+        )
+    return _hysteresis_body(price, valid, score, adv, vol, threshold_hi,
+                            threshold_lo, size_shares, cash0, spread)
+
+
+@partial(jax.jit, static_argnames=("size_shares",))
+def _hysteresis_body(price, valid, score, adv, vol, threshold_hi,
+                     threshold_lo, size_shares, cash0, spread) -> EventResult:
+    A, T = price.shape
+    dtype = price.dtype
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+
+    e_long = valid & (score > threshold_hi)
+    e_short = valid & (score < -threshold_hi)
+    e_exit = valid & (jnp.abs(score) < threshold_lo)
+
+    def last_idx(ev):
+        return jax.lax.associative_scan(
+            jnp.maximum, jnp.where(ev, t_idx[None, :], -1), axis=1
+        )
+    iL, iS, iX = last_idx(e_long), last_idx(e_short), last_idx(e_exit)
+    target = jnp.where(
+        (iL > iS) & (iL > iX), 1, jnp.where((iS > iL) & (iS > iX), -1, 0)
+    ).astype(jnp.int32)
+
+    prev_target = jnp.pad(target, ((0, 0), (1, 0)))[:, :T]
+    delta = target - prev_target                    # i32[A, T], in {-2..2}
+    sgn = jnp.sign(delta).astype(jnp.int32)         # fill-price direction
+    traded = sgn != 0
+
+    impact = square_root_impact(
+        jnp.asarray(float(size_shares), dtype), adv.astype(dtype),
+        vol.astype(dtype),
+    )
+    fill = market_fill_prices(jnp.nan_to_num(price), sgn, traded, impact,
+                              spread)
+    shares = delta * size_shares
+    notional = fill * shares.astype(dtype)
+    # the stored side is the SIGNED UNIT COUNT (delta: flips are ±2) so
+    # cost_attribution and trades_dataframe see the true trade size; the
+    # fill PRICE above uses only the direction (the market-fill formula's
+    # side is ±1 — execution_models.py:9-12)
+    return _settle_mark_and_wrap(
+        price, valid, shares, notional, delta, fill, traded, impact, cash0,
+        lambda x: x,
     )
 
 
@@ -343,19 +461,21 @@ def cost_attribution(result: EventResult, price, size_shares: int = 50,
             "stores fills at decision cells, so a delayed fill's slippage "
             "against the decision-bar mid would mix drift into cost"
         )
-    side = result.trade_side.astype(price.dtype)
+    side = result.trade_side.astype(price.dtype)   # signed units (flips ±2)
+    units = jnp.abs(side)
     traded = result.trade_side != 0
     mid = jnp.where(traded, jnp.nan_to_num(price), 0.0)
     fill = jnp.where(traded, jnp.nan_to_num(result.exec_price), 0.0)
     sz = jnp.asarray(size_shares, price.dtype)
 
-    # exact: signed slippage against the same-bar mid, per fill
+    # exact: signed slippage against the same-bar mid, per UNIT — a
+    # hysteresis flip (2 units at one fill price) costs twice
     total_cost = jnp.sum((fill - mid) * side) * sz
     # formula split (market fills): mid * (spread/2 + impact_a) per share
-    spread_cost = jnp.sum(mid * traded) * (spread / 2.0) * sz
-    impact_cost = jnp.sum(mid * result.impact[:, None] * traded) * sz
+    spread_cost = jnp.sum(mid * units) * (spread / 2.0) * sz
+    impact_cost = jnp.sum(mid * result.impact[:, None] * units) * sz
 
-    gross_notional = jnp.sum(mid) * sz
+    gross_notional = jnp.sum(mid * units) * sz
     net = result.total_pnl
     return CostAttribution(
         gross_pnl=net + total_cost,

@@ -816,12 +816,22 @@ def cmd_intraday(args) -> int:
           f"impact ${float(tca.impact_cost):,.2f}) — "
           f"gross PnL ${float(tca.gross_pnl):,.2f}")
 
-    if getattr(args, "threshold_sweep", None):
+    if (getattr(args, "threshold_hi", None) is not None
+            and getattr(args, "threshold_lo", None) is None):
+        print("--threshold-hi sets the hysteresis ENTRY threshold and does "
+              "nothing alone: add --threshold-lo (the exit threshold) to "
+              "run the Schmitt-trigger engine", file=sys.stderr)
+        return 2
+    if (getattr(args, "threshold_sweep", None)
+            or getattr(args, "threshold_lo", None) is not None):
         from csmom_tpu.api import daily_risk_maps
+
+        adv, vol = daily_risk_maps(daily_df, compact.tickers)
+
+    if getattr(args, "threshold_sweep", None):
         from csmom_tpu.backtest.event import threshold_sweep
 
         ths = [float(t) for t in args.threshold_sweep.split(",")]
-        adv, vol = daily_risk_maps(daily_df, compact.tickers)
         pnl, ntr, bps = threshold_sweep(
             dense_price, dense_valid, np.nan_to_num(np.asarray(dense_score)),
             np.asarray(adv), np.asarray(vol),
@@ -833,6 +843,27 @@ def cmd_intraday(args) -> int:
         for t, p, n, b in zip(ths, np.asarray(pnl), np.asarray(ntr),
                               np.asarray(bps)):
             print(f"{t:>12g} {int(n):>8d} {float(p):>16,.2f} {float(b):>9.2f}")
+
+    if getattr(args, "threshold_lo", None) is not None:
+        from csmom_tpu.backtest import hysteresis_event_backtest
+
+        hi = (args.threshold_hi if getattr(args, "threshold_hi", None)
+              is not None else cfg.intraday.threshold)
+        if args.threshold_lo > hi:
+            print(f"--threshold-lo {args.threshold_lo:g} must not exceed "
+                  f"the entry threshold {hi:g} (--threshold-hi)",
+                  file=sys.stderr)
+            return 2
+        hres = hysteresis_event_backtest(
+            dense_price, dense_valid, np.nan_to_num(np.asarray(dense_score)),
+            np.asarray(adv), np.asarray(vol),
+            threshold_hi=hi, threshold_lo=args.threshold_lo,
+            size_shares=cfg.intraday.size_shares, cash0=cfg.intraday.cash0,
+        )
+        print(f"\nhysteresis trigger (enter |score|>{hi:g}, exit "
+              f"|score|<{args.threshold_lo:g}, bounded 1-unit book):")
+        print(f"  trades {int(hres.n_trades)} (plain engine: "
+              f"{int(res.n_trades)}), total PnL ${float(hres.total_pnl):,.2f}")
 
     if getattr(args, "tearsheet", False):
         import pandas as pd
@@ -1374,6 +1405,19 @@ def build_parser() -> argparse.ArgumentParser:
                             help="comma-separated score thresholds: print "
                                  "PnL/trades/cost sensitivity (one vmapped "
                                  "call)")
+            sp.add_argument("--threshold-hi", dest="threshold_hi",
+                            type=float, metavar="S",
+                            help="hysteresis entry threshold (default: the "
+                                 "config threshold); used with "
+                                 "--threshold-lo")
+            sp.add_argument("--threshold-lo", dest="threshold_lo",
+                            type=float, metavar="S",
+                            help="ALSO run the Schmitt-trigger event "
+                                 "engine: enter a bounded 1-unit position "
+                                 "when |score| > entry, exit when |score| "
+                                 "< this, hold in between (cuts intraday "
+                                 "churn; reports trades/PnL vs the plain "
+                                 "engine)")
             sp.add_argument("--parity", action="store_true",
                             help="reproduce the reference's EFFECTIVE daily "
                                  "risk-map universe (drop dialect-B caches "

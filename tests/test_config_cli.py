@@ -583,3 +583,31 @@ def test_cli_replicate_band_sweep(capsys, tmp_path):
                "--band-sweep", "0,7", "--out", str(tmp_path)])
     assert rc == 2
     assert "invalid widths" in capsys.readouterr().err
+
+
+@requires_reference
+def test_cli_intraday_hysteresis(capsys, tmp_path):
+    """--threshold-lo adds the Schmitt-trigger report: far fewer trades
+    than the accumulate-every-signal engine; bad threshold order fails."""
+    rc = main(["intraday", "--data-dir", REFERENCE_DATA, "--out",
+               str(tmp_path), "--threshold-hi", "1e-4",
+               "--threshold-lo", "2e-5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import re
+
+    m = re.search(r"trades (\d+) \(plain engine: (\d+)\)", out)
+    assert m, out
+    assert int(m.group(1)) < int(m.group(2)) // 10
+
+    rc = main(["intraday", "--data-dir", REFERENCE_DATA, "--out",
+               str(tmp_path), "--threshold-hi", "1e-5",
+               "--threshold-lo", "1e-4"])
+    assert rc == 2
+    assert "must not exceed" in capsys.readouterr().err
+
+    # --threshold-hi alone would silently do nothing: refuse it instead
+    rc = main(["intraday", "--data-dir", REFERENCE_DATA, "--out",
+               str(tmp_path), "--threshold-hi", "1e-4"])
+    assert rc == 2
+    assert "--threshold-lo" in capsys.readouterr().err
