@@ -179,18 +179,16 @@ def cmd_replicate(args) -> int:
         sector_kw = {"sector_ids": ids, "n_sectors": n_sectors}
         print(f"sector-neutral ranking: {n_sectors} sectors")
     # --band/--band-sweep: validate BEFORE the plain run so misuse really
-    # does fail fast; validity rule lives once in banded.validate_band
+    # does fail fast; validity rule lives once in banded.validate_band.
+    # The band applies to WHATEVER labels the plain run produces — built-in
+    # momentum, any --strategy plugin, sector-neutral ranks, either backend
+    # (banded_from_labels needs only labels + monthly returns).
     band_sweep = None
     want_band = getattr(args, "band", None) is not None
     if want_band or getattr(args, "band_sweep", None):
         from csmom_tpu.backtest.banded import validate_band
 
         flag = "--band" if want_band else "--band-sweep"
-        if strategy is not None or sector_kw or cfg.backend != "tpu":
-            print(f"{flag} uses the TPU engine's built-in momentum path "
-                  "(drop --strategy / --sector-map / --backend pandas)",
-                  file=sys.stderr)
-            return 2
         if getattr(args, "band_sweep", None):
             try:
                 band_sweep = [int(s) for s in args.band_sweep.split(",")
