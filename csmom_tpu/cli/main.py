@@ -276,9 +276,10 @@ def cmd_replicate(args) -> int:
 
     if want_band or band_sweep is not None:
         # shared setup for both banded surfaces: formation already ran, so
-        # reuse rep.labels (identical ranking — the guard above excluded
-        # strategy/sector/pandas variants); only the band recursion +
-        # portfolio tail compile below, and the device transfer happens once
+        # reuse rep.labels — WHATEVER produced them (built-in momentum, a
+        # --strategy plugin, sector-neutral ranks, either backend); only
+        # the band recursion + portfolio tail compile below, and the
+        # device transfer happens once
         import jax.numpy as jnp
         import numpy as np
 

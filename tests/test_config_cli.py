@@ -523,14 +523,16 @@ def test_cli_replicate_band(capsys, tmp_path):
     assert len(bes) == 2 and bes[1] > bes[0]
 
     # the band applies to whatever labels the plain run made: the pandas
-    # backend produces identical labels, so its banded numbers match the
-    # TPU run's exactly
+    # backend produces identical labels, so its banded numbers must equal
+    # the TPU run's above (parity tested against the captured output, not
+    # a hardcoded golden)
+    tpu_gross = re.search(r"gross mean ([+-][\d.]+)", out).group(1)
     rc = main(["replicate", "--data-dir", REFERENCE_DATA, "--band", "1",
                "--backend", "pandas", "--out", str(tmp_path)])
     assert rc == 0
     pd_out = capsys.readouterr().out
     m2 = re.search(r"gross mean ([+-][\d.]+)", pd_out)
-    assert m2 and abs(float(m2.group(1)) - 0.002847) < 5e-6
+    assert m2 and m2.group(1) == tpu_gross
 
     # invalid band width: readable error, rc=2
     rc = main(["replicate", "--data-dir", REFERENCE_DATA, "--band", "7",

@@ -54,3 +54,11 @@ def test_pack_at_scale_example(capsys, tmp_path):
                               "--keep", str(tmp_path / "pack")])
     out = capsys.readouterr().out
     assert "bit-identical" in out and "pack kept" in out
+
+
+@requires_reference
+def test_cost_frontier_example(capsys):
+    """The band x cost-level frontier runs with its own sanity asserts
+    (falling turnover; widest band wins at the highest cost level)."""
+    _run("cost_frontier.py", ["--data-dir", REFERENCE_DATA])
+    assert "frontier sanity checks passed" in capsys.readouterr().out
