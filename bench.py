@@ -673,18 +673,18 @@ def _run_histrank_child():
     return obj
 
 
-def _load_histrank_multiproc():
-    """Most recent committed cross-process histrank capture, or the reason
-    there is none.  The measurement itself lives in
-    benchmarks/histrank_multiproc.py (2 OS processes, gloo TCP collectives);
-    bench only reports it — re-running two workers inside the bench budget
-    would starve the probe loop."""
+def _load_committed_json(pattern: str, absent_reason: str):
+    """Most recent committed capture matching ``pattern`` (repo root), as a
+    compact dict, or the reason there is none.  Cross-process captures
+    (histrank walls, multihost equality) are measured by their own
+    two-worker scripts and committed — bench only reports them, because
+    re-running worker pairs inside the bench budget would starve the
+    probe loop."""
     import glob
 
-    paths = sorted(glob.glob(os.path.join(_REPO, "HISTRANK_MULTIPROC_*.json")))
+    paths = sorted(glob.glob(os.path.join(_REPO, pattern)))
     if not paths:
-        return ("not measured: run benchmarks/histrank_multiproc.py to put "
-                "a cross-process wall next to the in-process bytes model")
+        return absent_reason
     try:
         with open(paths[-1]) as f:
             rec = json.load(f)
@@ -692,6 +692,14 @@ def _load_histrank_multiproc():
                 **(rec.get("extra") or {})}
     except (OSError, json.JSONDecodeError) as e:
         return f"unreadable {os.path.basename(paths[-1])}: {e}"[:200]
+
+
+def _load_histrank_multiproc():
+    return _load_committed_json(
+        "HISTRANK_MULTIPROC_*.json",
+        "not measured: run benchmarks/histrank_multiproc.py to put a "
+        "cross-process wall next to the in-process bytes model",
+    )
 
 
 TPU_CHILD_MIN_S = 300   # floor for a useful accelerator child: the child
@@ -954,6 +962,11 @@ def main():
         # histrank_multiproc.py) is captured separately and committed; join
         # it to the in-process bytes model rather than re-measuring here
         result["extra"]["histrank_cross_process"] = _load_histrank_multiproc()
+        result["extra"]["multihost_equality"] = _load_committed_json(
+            "MULTIHOST_CPU_*.json",
+            "not captured: run benchmarks/multihost_dryrun.py for the "
+            "cross-process sharded==single equality record",
+        )
     else:
         # last resort: a parseable record so the driver captures *something*
         result = {

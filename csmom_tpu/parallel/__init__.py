@@ -33,7 +33,10 @@ from csmom_tpu.parallel.collectives import (
 )
 from csmom_tpu.parallel.bootstrap import sharded_block_bootstrap
 from csmom_tpu.parallel.event import sharded_event_backtest
-from csmom_tpu.parallel.event_time import time_sharded_event_backtest
+from csmom_tpu.parallel.event_time import (
+    time_sharded_event_backtest,
+    time_sharded_hysteresis_backtest,
+)
 
 __all__ = [
     "make_mesh",
@@ -42,6 +45,7 @@ __all__ = [
     "mesh_topology",
     "distributed_init",
     "sharded_banded_backtest",
+    "time_sharded_hysteresis_backtest",
     "sharded_monthly_spread_backtest",
     "sharded_jk_grid_backtest",
     "sharded_block_bootstrap",

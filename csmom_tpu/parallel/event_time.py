@@ -239,6 +239,103 @@ def _latency_settle(price, valid, side, traded, impact, spread, size_shares,
     return side, traded, fill, settle_sh, settle_no
 
 
+def _validate_time_layout(mesh, A: int, T: int, time_axis: str,
+                          asset_axis) -> int:
+    """Shared layout validation for the time-sharded engines; returns the
+    time-shard count."""
+    if time_axis not in mesh.shape:
+        raise ValueError(
+            f"mesh has axes {tuple(mesh.shape)}, no {time_axis!r}; build it "
+            "with make_mesh(devices, grid_axis=a, axis_names=('assets', 'time'))"
+        )
+    nt = mesh.shape[time_axis]
+    if T % nt:
+        raise ValueError(f"T={T} not divisible by {nt} time shards; pad_time first")
+    if asset_axis is not None:
+        na = mesh.shape[asset_axis]
+        if A % na:
+            raise ValueError(f"A={A} not divisible by {na} asset shards; pad_assets first")
+    return nt
+
+
+def _blocked_settle_tail(price, valid, shares_settle, notional_settle, side,
+                         fill, traded, impact, cash0, asum, time_axis: str):
+    """Blocked form of the event engines' shared accounting tail (the
+    single-device twin is ``event._settle_mark_and_wrap``): every global
+    prefix becomes a block-local prefix plus one small carry exchange —
+    position/cash cumsums via :func:`_exclusive_prefix_sum`, marks and
+    prev-bar PV via :func:`_carry_from_left`.  Used by the plain and
+    hysteresis time-sharded engines so the accounting cannot drift."""
+    A_l, T_l = price.shape
+    dtype = price.dtype
+
+    # ---- position book: blocked cumsum + position carry ----
+    pos_local = jnp.cumsum(shares_settle, axis=1)
+    positions = pos_local + _exclusive_prefix_sum(pos_local[:, -1], time_axis)[:, None]
+
+    # ---- cash ledger: blocked cumsum of cross-asset order flow ----
+    flow = asum(jnp.sum(notional_settle, axis=0))   # [T_l]
+    cum_flow = jnp.cumsum(flow)
+    cash = cash0 - (cum_flow + _exclusive_prefix_sum(cum_flow[-1], time_axis))
+
+    # ---- mark price: blocked last-observed + (has, price) carry ----
+    pz = jnp.nan_to_num(price)
+    t_loc = jnp.arange(T_l, dtype=jnp.int32)
+    obs = jnp.where(valid, t_loc[None, :], -1)
+    last_obs = lax.associative_scan(jnp.maximum, obs, axis=1)
+    mark_local = jnp.take_along_axis(pz, jnp.clip(last_obs, 0, T_l - 1), axis=1)
+    blk_has = last_obs[:, -1] >= 0
+    blk_price = jnp.take_along_axis(
+        pz, jnp.clip(last_obs[:, -1:], 0, T_l - 1), axis=1
+    )[:, 0]
+    prev_has, prev_price = _carry_from_left(
+        blk_has, jnp.where(blk_has, blk_price, 0.0), time_axis
+    )
+    mark = jnp.where(
+        last_obs >= 0,
+        mark_local,
+        jnp.where(prev_has[:, None], prev_price[:, None], 0.0),
+    )
+
+    pv = cash + asum(jnp.sum(positions.astype(dtype) * mark, axis=0))
+
+    # ---- per-bar PnL: blocked prev-bar gather + (has, pv) carry ----
+    bar_mask = asum(jnp.sum(valid, axis=0)) > 0
+    obs_bar = jnp.where(bar_mask, t_loc, -1)
+    last_bar = lax.associative_scan(jnp.maximum, obs_bar)
+    prev_bar = jnp.where(bar_mask, jnp.roll(last_bar, 1).at[0].set(-1), -1)
+    pv_prev = pv[jnp.clip(prev_bar, 0, T_l - 1)]
+    blk_has_bar = last_bar[-1:] >= 0
+    blk_pv = jnp.where(blk_has_bar, pv[jnp.clip(last_bar[-1:], 0, T_l - 1)], 0.0)
+    pv_carry_has, pv_carry = _carry_from_left(blk_has_bar, blk_pv, time_axis)
+    pnl = jnp.where(
+        bar_mask,
+        jnp.where(
+            prev_bar >= 0,
+            pv - pv_prev,
+            jnp.where(pv_carry_has[0], pv - pv_carry[0], 0.0),
+        ),
+        0.0,
+    )
+
+    tsum = lambda x: lax.psum(x, time_axis)
+    return EventResult(
+        pnl=pnl,
+        bar_mask=bar_mask,
+        portfolio_value=pv,
+        cash=cash,
+        positions=positions,
+        trade_side=side.astype(jnp.int8),
+        exec_price=fill,
+        impact=impact,
+        total_pnl=tsum(jnp.sum(pnl)),
+        n_trades=tsum(asum(jnp.sum(traded))).astype(jnp.int32),
+        n_buys=tsum(asum(jnp.sum(side > 0))).astype(jnp.int32),
+        n_sells=tsum(asum(jnp.sum(side < 0))).astype(jnp.int32),
+        net_notional=tsum(jnp.sum(flow)),
+    )
+
+
 @lru_cache(maxsize=32)
 def _compiled(mesh, time_axis, asset_axis, size_shares, threshold, cash0, spread,
               latency_bars=0, order_type="market", aggressiveness=0.5):
@@ -285,70 +382,9 @@ def _compiled(mesh, time_axis, asset_axis, size_shares, threshold, cash0, spread
             shares_settle = shares
             notional_settle = fill * shares.astype(dtype)
 
-        # ---- position book: blocked cumsum + position carry ----
-        pos_local = jnp.cumsum(shares_settle, axis=1)
-        positions = pos_local + _exclusive_prefix_sum(pos_local[:, -1], time_axis)[:, None]
-
-        # ---- cash ledger: blocked cumsum of cross-asset order flow ----
-        flow = asum(jnp.sum(notional_settle, axis=0))   # [T_l]
-        cum_flow = jnp.cumsum(flow)
-        cash = cash0 - (cum_flow + _exclusive_prefix_sum(cum_flow[-1], time_axis))
-
-        # ---- mark price: blocked last-observed + (has, price) carry ----
-        pz = jnp.nan_to_num(price)
-        t_loc = jnp.arange(T_l, dtype=jnp.int32)
-        obs = jnp.where(valid, t_loc[None, :], -1)
-        last_obs = lax.associative_scan(jnp.maximum, obs, axis=1)
-        mark_local = jnp.take_along_axis(pz, jnp.clip(last_obs, 0, T_l - 1), axis=1)
-        blk_has = last_obs[:, -1] >= 0
-        blk_price = jnp.take_along_axis(
-            pz, jnp.clip(last_obs[:, -1:], 0, T_l - 1), axis=1
-        )[:, 0]
-        prev_has, prev_price = _carry_from_left(
-            blk_has, jnp.where(blk_has, blk_price, 0.0), time_axis
-        )
-        mark = jnp.where(
-            last_obs >= 0,
-            mark_local,
-            jnp.where(prev_has[:, None], prev_price[:, None], 0.0),
-        )
-
-        pv = cash + asum(jnp.sum(positions.astype(dtype) * mark, axis=0))
-
-        # ---- per-bar PnL: blocked prev-bar gather + (has, pv) carry ----
-        bar_mask = asum(jnp.sum(valid, axis=0)) > 0
-        obs_bar = jnp.where(bar_mask, t_loc, -1)
-        last_bar = lax.associative_scan(jnp.maximum, obs_bar)
-        prev_bar = jnp.where(bar_mask, jnp.roll(last_bar, 1).at[0].set(-1), -1)
-        pv_prev = pv[jnp.clip(prev_bar, 0, T_l - 1)]
-        blk_has_bar = last_bar[-1:] >= 0
-        blk_pv = jnp.where(blk_has_bar, pv[jnp.clip(last_bar[-1:], 0, T_l - 1)], 0.0)
-        pv_carry_has, pv_carry = _carry_from_left(blk_has_bar, blk_pv, time_axis)
-        pnl = jnp.where(
-            bar_mask,
-            jnp.where(
-                prev_bar >= 0,
-                pv - pv_prev,
-                jnp.where(pv_carry_has[0], pv - pv_carry[0], 0.0),
-            ),
-            0.0,
-        )
-
-        tsum = lambda x: lax.psum(x, time_axis)
-        return EventResult(
-            pnl=pnl,
-            bar_mask=bar_mask,
-            portfolio_value=pv,
-            cash=cash,
-            positions=positions,
-            trade_side=side.astype(jnp.int8),
-            exec_price=fill,
-            impact=impact,
-            total_pnl=tsum(jnp.sum(pnl)),
-            n_trades=tsum(asum(jnp.sum(traded))).astype(jnp.int32),
-            n_buys=tsum(asum(jnp.sum(side > 0))).astype(jnp.int32),
-            n_sells=tsum(asum(jnp.sum(side < 0))).astype(jnp.int32),
-            net_notional=tsum(jnp.sum(flow)),
+        return _blocked_settle_tail(
+            price, valid, shares_settle, notional_settle, side, fill,
+            traded, impact, cash0, asum, time_axis,
         )
 
     aspec = asset_axis  # None -> unsharded axis
@@ -419,24 +455,13 @@ def time_sharded_event_backtest(
     elif order_type != "market":
         raise ValueError(f"unknown order_type {order_type!r}")
     A, T = price.shape
-    if time_axis not in mesh.shape:
-        raise ValueError(
-            f"mesh has axes {tuple(mesh.shape)}, no {time_axis!r}; build it "
-            "with make_mesh(devices, grid_axis=a, axis_names=('assets', 'time'))"
-        )
-    nt = mesh.shape[time_axis]
-    if T % nt:
-        raise ValueError(f"T={T} not divisible by {nt} time shards; pad_time first")
+    nt = _validate_time_layout(mesh, A, T, time_axis, asset_axis)
     if latency_bars < 0 or latency_bars > T // nt:
         raise ValueError(
             f"latency_bars={latency_bars} exceeds the time-block length "
             f"{T // nt}; a fill target would skip past the halo neighbor — "
             "use fewer time shards or the asset-sharded engine"
         )
-    if asset_axis is not None:
-        na = mesh.shape[asset_axis]
-        if A % na:
-            raise ValueError(f"A={A} not divisible by {na} asset shards; pad_assets first")
 
     fn = _compiled(
         mesh, time_axis, asset_axis, int(size_shares), float(threshold),
@@ -446,3 +471,137 @@ def time_sharded_event_backtest(
     if fill_key is None:
         fill_key = jax.random.PRNGKey(0)  # unused dummy in market mode
     return fn(price, valid, score, adv, vol, fill_key)
+
+
+@lru_cache(maxsize=32)
+def _compiled_hysteresis(mesh, time_axis, asset_axis, size_shares,
+                         threshold_hi, threshold_lo, cash0, spread):
+    """Build + jit the time-sharded Schmitt-trigger program once per
+    (mesh, axes, params)."""
+    asum = (lambda x: lax.psum(x, asset_axis)) if asset_axis else (lambda x: x)
+
+    def local_fn(price, valid, score, adv, vol):
+        A_l, T_l = price.shape
+        dtype = price.dtype
+        t_loc = jnp.arange(T_l, dtype=jnp.int32)
+        t_glob = lax.axis_index(time_axis) * T_l + t_loc  # global bar ids
+
+        # the single-device engine's state resolution (backtest/event.py:
+        # hysteresis_event_backtest) blockwise: last-event indices become
+        # block-local cummaxes over GLOBAL bar ids plus one small
+        # rightmost-earlier-block carry per event type
+        e_long = valid & (score > threshold_hi)
+        e_short = valid & (score < -threshold_hi)
+        e_exit = valid & (jnp.abs(score) < threshold_lo)
+
+        def last_idx(ev):
+            loc = lax.associative_scan(
+                jnp.maximum, jnp.where(ev, t_glob[None, :], -1), axis=1
+            )
+            blk_last = loc[:, -1]
+            has = blk_last >= 0
+            prev_has, prev_val = _carry_from_left(
+                has, jnp.where(has, blk_last, 0), time_axis
+            )
+            prev = jnp.where(prev_has, prev_val, -1)
+            return jnp.maximum(loc, prev[:, None]), prev
+
+        iL, pL = last_idx(e_long)
+        iS, pS = last_idx(e_short)
+        iX, pX = last_idx(e_exit)
+
+        def resolve(l, s, x):
+            return jnp.where(
+                (l > s) & (l > x), 1, jnp.where((s > l) & (s > x), -1, 0)
+            ).astype(jnp.int32)
+
+        target = resolve(iL, iS, iX)
+        # state entering this block: resolved from the carries alone
+        boundary = resolve(pL, pS, pX)
+        prev_target = jnp.concatenate(
+            [boundary[:, None], target[:, :-1]], axis=1
+        )
+        delta = target - prev_target
+        sgn = jnp.sign(delta).astype(jnp.int32)
+        traded = sgn != 0
+
+        impact = square_root_impact(
+            jnp.asarray(float(size_shares), dtype), adv.astype(dtype),
+            vol.astype(dtype),
+        )
+        fill = market_fill_prices(jnp.nan_to_num(price), sgn, traded,
+                                  impact, spread)
+        shares = delta * size_shares
+        notional = fill * shares.astype(dtype)
+        # stored side = signed UNITS (delta; flips ±2), as single-device
+        return _blocked_settle_tail(
+            price, valid, shares, notional, delta, fill, traded, impact,
+            cash0, asum, time_axis,
+        )
+
+    aspec = asset_axis
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            P(aspec, time_axis), P(aspec, time_axis), P(aspec, time_axis),
+            P(aspec), P(aspec),
+        ),
+        out_specs=EventResult(
+            pnl=P(time_axis),
+            bar_mask=P(time_axis),
+            portfolio_value=P(time_axis),
+            cash=P(time_axis),
+            positions=P(aspec, time_axis),
+            trade_side=P(aspec, time_axis),
+            exec_price=P(aspec, time_axis),
+            impact=P(aspec),
+            total_pnl=P(),
+            n_trades=P(),
+            n_buys=P(),
+            n_sells=P(),
+            net_notional=P(),
+        ),
+    )
+    return jax.jit(fn)
+
+
+def time_sharded_hysteresis_backtest(
+    price,
+    valid,
+    score,
+    adv,
+    vol,
+    mesh: Mesh,
+    time_axis: str = "time",
+    asset_axis: str | None = None,
+    threshold_hi: float = 1e-4,
+    threshold_lo: float = 1e-5,
+    size_shares: int = 50,
+    cash0: float = 1_000_000.0,
+    spread: float = 0.001,
+) -> EventResult:
+    """Schmitt-trigger event engine with the minute axis sharded.
+
+    The trigger's sequential state is three "last event index" prefixes,
+    so time sharding follows the module's standard recipe: block-local
+    cummaxes over global bar ids + one rightmost-earlier-block carry per
+    event type (:func:`_carry_from_left`), with the block-boundary state
+    resolved from the carries alone.  Equals
+    :func:`csmom_tpu.backtest.event.hysteresis_event_backtest` on any
+    (assets x time) layout — integer state (positions, sides) exactly,
+    float state to tight tolerance (blocked summation reassociates fp,
+    per the module header) — pinned in tests/test_sequence_parallel.py.
+    """
+    if float(threshold_lo) > float(threshold_hi):
+        raise ValueError(
+            f"threshold_lo={threshold_lo} > threshold_hi={threshold_hi}: "
+            "the exit threshold must not exceed the entry threshold"
+        )
+    A, T = price.shape
+    _validate_time_layout(mesh, A, T, time_axis, asset_axis)
+    fn = _compiled_hysteresis(
+        mesh, time_axis, asset_axis, int(size_shares), float(threshold_hi),
+        float(threshold_lo), float(cash0), float(spread),
+    )
+    return fn(price, valid, score, adv, vol)
