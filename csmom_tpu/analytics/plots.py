@@ -48,18 +48,44 @@ def _line_plot(x, y, title: str, ylabel: str, out_path: str):
 
 
 def save_monthly_cum_plot(times, spread, results_dir: str,
-                          fname: str = "monthly_mom_cum.png") -> str:
+                          fname: str = "monthly_mom_cum.png",
+                          overlays=None) -> str:
     """Cumulative growth of the monthly spread, ``(1+r).cumprod()``
-    (``run_demo.py:75-79``), over valid months only."""
+    (``run_demo.py:75-79``), over valid months only.
+
+    ``overlays`` is an optional ``{label: spread_series}`` dict drawn as
+    extra lines (each over its own valid months) — the CLI uses it to put
+    the banded / vol-managed variants next to the plain spread in the
+    same reference-schema artifact.
+    """
     ensure_dir(results_dir)
-    valid = np.isfinite(np.asarray(spread, dtype=float))
-    cum = np.cumprod(1.0 + np.asarray(spread, dtype=float)[valid])
-    return _line_plot(
-        np.asarray(times)[valid], cum,
-        "Monthly momentum: cumulative spread growth",
-        "growth of $1",
-        os.path.join(results_dir, fname),
-    )
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(9, 5))
+
+    def _cum(s):
+        s = np.asarray(s, dtype=float)
+        v = np.isfinite(s)
+        return np.asarray(times)[v], np.cumprod(1.0 + s[v])
+
+    x, y = _cum(spread)
+    ax.plot(x, y, label="spread" if overlays else None)
+    for label, s in (overlays or {}).items():
+        xo, yo = _cum(s)
+        ax.plot(xo, yo, label=label)
+    ax.set_title("Monthly momentum: cumulative spread growth")
+    ax.set_ylabel("growth of $1")
+    if overlays:
+        ax.legend()
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    path = os.path.join(results_dir, fname)
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
 
 
 def save_intraday_pnl_plot(times, pnl, results_dir: str,

@@ -240,6 +240,7 @@ def cmd_replicate(args) -> int:
     print(f"Annualized Sharpe:   {rep.ann_sharpe:.4f}")
     print(f"t-stat (NW):         {rep.tstat_nw:.3f}")
     print(f"t-stat (iid):        {rep.tstat:.3f}")
+    plot_overlays = {}  # extra cum-growth lines (banded / vol-managed)
 
     if getattr(args, "tc_bps", None) is not None:
         import jax.numpy as jnp
@@ -295,6 +296,7 @@ def cmd_replicate(args) -> int:
             lab, mret, mret_valid,
             n_bins=cfg.momentum.n_bins, band=args.band,
         )
+        plot_overlays[f"band {args.band}"] = np.asarray(bres.spread)
         bt = np.asarray(bres.turnover)
         bv = np.asarray(bres.spread_valid)
         pvalid = np.isfinite(np.asarray(rep.spread))
@@ -402,6 +404,9 @@ def cmd_replicate(args) -> int:
             print(f"  realized ann vol: raw {raw_vol * 100:.1f}% -> managed "
                   f"{man_vol * 100:.1f}%; scale range "
                   f"[{sc.min():.2f}, {sc.max():.2f}]")
+            plot_overlays[f"vol-managed {args.vol_target:g}%"] = np.where(
+                mok_np, m, np.nan
+            )
 
     if getattr(args, "tables", False):
         from csmom_tpu.analytics.tables import decile_table
@@ -462,7 +467,10 @@ def cmd_replicate(args) -> int:
 
     from csmom_tpu.analytics.plots import save_monthly_cum_plot
 
-    out = save_monthly_cum_plot(prices.times, rep.spread, cfg.results_dir)
+    out = save_monthly_cum_plot(
+        prices.times, rep.spread, cfg.results_dir,
+        overlays=plot_overlays or None,
+    )
     log.info("wrote %s", out)
     return 0
 
