@@ -7,8 +7,9 @@ The reference emits three artifacts (``run_demo.py:79,183-189``):
 Keeping names and schemas identical means a reference user's downstream
 tooling keeps working unchanged.
 
-Plot style: single-series line charts — one hue, thin 2px line, recessive
-grid, neutral ink for text, no legend (the title names the series).
+Plot style: line charts — primary hue + a small categorical cycle for
+overlays, thin 2px line, recessive grid, neutral ink for text, legend only
+when more than one series is drawn (otherwise the title names the series).
 """
 
 from __future__ import annotations
@@ -17,7 +18,8 @@ import os
 
 import numpy as np
 
-_LINE = "#3b82b4"   # single categorical hue
+_LINE = "#3b82b4"   # primary hue
+_OVERLAYS = ("#b45a3b", "#5a9e6f", "#8a6db1")  # overlay cycle
 _INK = "#333333"
 _GRID = "#dddddd"
 
@@ -27,20 +29,28 @@ def ensure_dir(path: str) -> str:
     return path
 
 
-def _line_plot(x, y, title: str, ylabel: str, out_path: str):
+def _line_plot(x, y, title: str, ylabel: str, out_path: str, extra=None,
+               label=None):
+    """One styled line chart; ``extra`` is an optional list of
+    ``(label, x, y)`` overlay series drawn in the overlay hue cycle."""
     import matplotlib
 
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
     fig, ax = plt.subplots(figsize=(9, 4.5))
-    ax.plot(x, y, color=_LINE, linewidth=2)
+    ax.plot(x, y, color=_LINE, linewidth=2, label=label)
+    for i, (lab, xo, yo) in enumerate(extra or ()):
+        ax.plot(xo, yo, color=_OVERLAYS[i % len(_OVERLAYS)], linewidth=2,
+                label=lab)
     ax.set_title(title, color=_INK)
     ax.set_ylabel(ylabel, color=_INK)
     ax.grid(True, color=_GRID, linewidth=0.6)
     for spine in ("top", "right"):
         ax.spines[spine].set_visible(False)
     ax.tick_params(colors=_INK)
+    if extra:
+        ax.legend(frameon=False, labelcolor=_INK)
     fig.tight_layout()
     fig.savefig(out_path, dpi=120)
     plt.close(fig)
@@ -54,17 +64,11 @@ def save_monthly_cum_plot(times, spread, results_dir: str,
     (``run_demo.py:75-79``), over valid months only.
 
     ``overlays`` is an optional ``{label: spread_series}`` dict drawn as
-    extra lines (each over its own valid months) — the CLI uses it to put
-    the banded / vol-managed variants next to the plain spread in the
-    same reference-schema artifact.
+    extra lines (each over its own valid months, in the module's overlay
+    hue cycle) — the CLI uses it to put the banded / vol-managed variants
+    next to the plain spread in the same reference-schema artifact.
     """
     ensure_dir(results_dir)
-    import matplotlib
-
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
-
-    fig, ax = plt.subplots(figsize=(9, 5))
 
     def _cum(s):
         s = np.asarray(s, dtype=float)
@@ -72,20 +76,15 @@ def save_monthly_cum_plot(times, spread, results_dir: str,
         return np.asarray(times)[v], np.cumprod(1.0 + s[v])
 
     x, y = _cum(spread)
-    ax.plot(x, y, label="spread" if overlays else None)
-    for label, s in (overlays or {}).items():
-        xo, yo = _cum(s)
-        ax.plot(xo, yo, label=label)
-    ax.set_title("Monthly momentum: cumulative spread growth")
-    ax.set_ylabel("growth of $1")
-    if overlays:
-        ax.legend()
-    ax.grid(True, alpha=0.3)
-    fig.tight_layout()
-    path = os.path.join(results_dir, fname)
-    fig.savefig(path, dpi=120)
-    plt.close(fig)
-    return path
+    extra = [(label, *_cum(s)) for label, s in (overlays or {}).items()]
+    return _line_plot(
+        x, y,
+        "Monthly momentum: cumulative spread growth",
+        "growth of $1",
+        os.path.join(results_dir, fname),
+        extra=extra or None,
+        label="spread" if extra else None,
+    )
 
 
 def save_intraday_pnl_plot(times, pnl, results_dir: str,

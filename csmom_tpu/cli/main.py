@@ -183,31 +183,50 @@ def cmd_replicate(args) -> int:
     # The band applies to WHATEVER labels the plain run produces — built-in
     # momentum, any --strategy plugin, sector-neutral ranks, either backend
     # (banded_from_labels needs only labels + monthly returns).
-    band_sweep = None
+    band_sweep = band_select = None
     want_band = getattr(args, "band", None) is not None
-    if want_band or getattr(args, "band_sweep", None):
+    if (want_band or getattr(args, "band_sweep", None)
+            or getattr(args, "band_select", None)):
         from csmom_tpu.backtest.banded import validate_band
 
-        flag = "--band" if want_band else "--band-sweep"
-        if getattr(args, "band_sweep", None):
+        def _parse_widths(spec, flag):
             try:
-                band_sweep = [int(s) for s in args.band_sweep.split(",")
-                              if s.strip()]
+                widths = [int(s) for s in spec.split(",") if s.strip()]
             except ValueError:
-                print(f"--band-sweep {args.band_sweep!r}: widths must be "
-                      "plain integers, e.g. --band-sweep 0,1,2",
-                      file=sys.stderr)
+                print(f"{flag} {spec!r}: widths must be plain integers, "
+                      f"e.g. {flag} 0,1,2", file=sys.stderr)
+                return None
+            if not widths:
+                print(f"{flag}: empty width list", file=sys.stderr)
+                return None
+            return widths
+
+        if getattr(args, "band_sweep", None):
+            band_sweep = _parse_widths(args.band_sweep, "--band-sweep")
+            if band_sweep is None:
                 return 2
-            if not band_sweep:
-                print("--band-sweep: empty width list", file=sys.stderr)
+        if getattr(args, "band_select", None):
+            band_select = _parse_widths(args.band_select, "--band-select")
+            if band_select is None:
                 return 2
-        try:
-            for b in ([args.band] if want_band else []) + (band_sweep or []):
-                validate_band(b, cfg.momentum.n_bins)
-        except ValueError as e:
-            print(f"{flag}: invalid widths — {e} (stay-zones must not "
-                  "overlap)", file=sys.stderr)
-            return 2
+            if len(band_select) < 2:
+                print("--band-select: need at least two widths to select "
+                      "among", file=sys.stderr)
+                return 2
+        # validate each flag's widths under its OWN name, so the error
+        # points at the flag whose value is actually invalid
+        for flag, widths in (
+            ("--band", [args.band] if want_band else []),
+            ("--band-sweep", band_sweep or []),
+            ("--band-select", band_select or []),
+        ):
+            try:
+                for b in widths:
+                    validate_band(b, cfg.momentum.n_bins)
+            except ValueError as e:
+                print(f"{flag}: invalid widths — {e} (stay-zones must not "
+                      "overlap)", file=sys.stderr)
+                return 2
     if getattr(args, "vol_target", None) is not None and args.vol_target <= 0:
         # validate BEFORE the plain run, like --band
         print(f"--vol-target {args.vol_target:g}: the annualized vol "
@@ -275,8 +294,8 @@ def cmd_replicate(args) -> int:
             print(f"break-even half-spread: {be:+.1f} bps "
                   f"(mean monthly turnover {mean_turn:.3f})")
 
-    if want_band or band_sweep is not None:
-        # shared setup for both banded surfaces: formation already ran, so
+    if want_band or band_sweep is not None or band_select is not None:
+        # shared setup for the banded surfaces: formation already ran, so
         # reuse rep.labels — WHATEVER produced them (built-in momentum, a
         # --strategy plugin, sector-neutral ranks, either backend); only
         # the band recursion + portfolio tail compile below, and the
@@ -368,6 +387,35 @@ def cmd_replicate(args) -> int:
                 nm = float(np.nanmean(net)) if rv.any() else float("nan")
                 row += f"  {nm:>+12.6f}"
             print(row)
+
+    if band_select is not None:
+        from csmom_tpu.backtest import walk_forward_select
+
+        hs = (getattr(args, "tc_bps", None) or 0.0) / 1e4
+        series, valids = [], []
+        for b in band_select:
+            r = banded_from_labels(lab, mret, mret_valid,
+                                   n_bins=cfg.momentum.n_bins, band=b)
+            rv = np.asarray(r.spread_valid)
+            net = np.asarray(r.spread) - hs * np.asarray(r.turnover)
+            series.append(np.where(rv, net, 0.0))
+            valids.append(rv)
+        wf = walk_forward_select(np.stack(series), np.stack(valids),
+                                 min_months=24)
+        basis = (f"net of {args.tc_bps:g} bps" if hs else "gross")
+        ov = np.asarray(wf.oos_valid)
+        choice = np.asarray(wf.choice)
+        print(f"\nwalk-forward band selection over {band_select} "
+              f"({basis}; expanding Sharpe, 24-month warmup):")
+        print(f"  OOS months {int(ov.sum())}, mean "
+              f"{float(wf.mean_spread):+.6f}, Sharpe "
+              f"{float(wf.ann_sharpe):.4f}, NW t {float(wf.tstat_nw):+.3f}")
+        picks = ", ".join(
+            f"band {b} x{int(((choice == i) & ov).sum())}"
+            for i, b in enumerate(band_select)
+            if ((choice == i) & ov).any()
+        )
+        print(f"  selections: {picks or 'none'}")
 
     if getattr(args, "vol_target", None) is not None:
         import numpy as np
@@ -1364,6 +1412,14 @@ def build_parser() -> argparse.ArgumentParser:
                                  "net at --tc-bps when given) — formation "
                                  "runs once, only the book tail re-runs "
                                  "per band")
+            sp.add_argument("--band-select", dest="band_select",
+                            metavar="B,B,...",
+                            help="walk-forward band selection: at every "
+                                 "month pick the width with the best "
+                                 "expanding-window Sharpe over PRIOR "
+                                 "months (net of --tc-bps when given) and "
+                                 "realize its month — the out-of-sample "
+                                 "answer to 'which band?'")
         if "doublesort" in extra:
             _add_turnover_flags(sp)
         if "horizons" in extra:

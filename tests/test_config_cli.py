@@ -617,3 +617,28 @@ def test_cli_intraday_hysteresis(capsys, tmp_path):
                str(tmp_path), "--threshold-hi", "1e-4"])
     assert rc == 2
     assert "--threshold-lo" in capsys.readouterr().err
+
+
+@requires_reference
+def test_cli_replicate_band_select(capsys, tmp_path):
+    """--band-select: strictly out-of-sample width selection through the
+    generic walk_forward_select; selection counts only name given widths."""
+    rc = main(["replicate", "--data-dir", REFERENCE_DATA, "--band-select",
+               "0,1,2", "--tc-bps", "10", "--out", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import re
+
+    m = re.search(r"OOS months (\d+), mean ([+-][\d.]+)", out)
+    assert m and int(m.group(1)) > 20
+    assert re.search(r"selections: (band [012] x\d+(, )?)+", out)
+
+    rc = main(["replicate", "--data-dir", "/nonexistent", "--band-select",
+               "1", "--out", str(tmp_path)])
+    assert rc == 2
+    assert "at least two" in capsys.readouterr().err
+
+    rc = main(["replicate", "--data-dir", "/nonexistent", "--band-select",
+               "0,9", "--out", str(tmp_path)])
+    assert rc == 2
+    assert "invalid widths" in capsys.readouterr().err
