@@ -82,3 +82,19 @@ def test_downweights_high_vol_regime(rng):
     # skip the transition window: vol estimates straddling the break mix regimes
     turb = sc[T // 2 + 13:][ok[T // 2 + 13:]]
     assert quiet.mean() > 2 * turb.mean()
+
+
+def test_batched_leading_axes_match_per_series(rng):
+    """vol_managed over a [G, T] stack equals per-series calls — the shape
+    contract that lets a grid of spread series be managed in one call."""
+    G, T = 4, 120
+    r = rng.normal(0.004, 0.05, size=(G, T))
+    valid = rng.random((G, T)) > 0.1
+    managed, ok, scale = vol_managed(np.where(valid, r, np.nan), valid,
+                                     window=6)
+    for g in range(G):
+        m1, o1, s1 = vol_managed(np.where(valid[g], r[g], np.nan), valid[g],
+                                 window=6)
+        np.testing.assert_array_equal(np.asarray(ok)[g], np.asarray(o1))
+        np.testing.assert_allclose(np.asarray(managed)[g], np.asarray(m1),
+                                   rtol=1e-12, equal_nan=True)
