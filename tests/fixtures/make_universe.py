@@ -1,0 +1,78 @@
+"""Regenerate the committed synthetic CSV universe (tests/fixtures/universe).
+
+The reference vendors its data assets in-repo (20 tickers of cached
+yfinance CSVs — SURVEY §2 row 16); licensing keeps real price data out of
+this repo, so the committed universe is SYNTHETIC: 8 tickers of daily bars
+from the seeded generator, written in the two real cache dialects (6 in
+dialect A, 2 in dialect B) so a bare checkout exercises the entire
+CSV-ingest path — dialect detection, preamble stripping, pivot — at
+universe scale, not just on the two single-file dialect fixtures.
+
+Deterministic: re-running reproduces the committed files byte-for-byte
+(PCG64 + fixed formatting).  If the generator's stream ever changes
+(numpy NEP 19), re-run this and re-pin the golden constants in
+tests/test_synthetic_golden.py::test_csv_universe_golden.
+"""
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "universe")
+TICKERS = ["SYNAA", "SYNBB", "SYNCC", "SYNDD", "SYNEE", "SYNFF",
+           "SYNGG", "SYNHH"]
+N_DAYS = 500
+SEED = 2026
+
+
+def main() -> None:
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
+    from csmom_tpu.panel.synthetic import synthetic_daily_panel
+
+    panel = synthetic_daily_panel(len(TICKERS), N_DAYS, seed=SEED,
+                                  listing_gaps=True)
+    rng = np.random.default_rng(SEED + 1)
+    os.makedirs(OUT, exist_ok=True)
+    dates = np.datetime_as_string(panel.times.astype("datetime64[D]"))
+    for i, t in enumerate(TICKERS):
+        close = panel.values[i]
+        m = panel.mask[i]
+        vol = rng.integers(2e5, 5e6, size=N_DAYS)
+        # OHLC around the close path, plausibly ordered
+        spread = np.abs(rng.normal(0, 0.01, size=N_DAYS)) * close
+        o = close * (1 + rng.normal(0, 0.005, size=N_DAYS))
+        hi = np.maximum(o, close) + spread
+        lo = np.minimum(o, close) - spread
+        rows = [
+            f"{dates[d]},{close[d]:.6f},{close[d]:.6f},{hi[d]:.6f},"
+            f"{lo[d]:.6f},{o[d]:.6f},{vol[d]}"
+            for d in range(N_DAYS) if m[d]
+        ]
+        if i < 6:  # dialect A: Date header + junk ticker row
+            text = (
+                "Date,Adj Close,Close,High,Low,Open,Volume\n"
+                + f",{t},{t},{t},{t},{t},{t}\n"
+                + "\n".join(rows) + "\n"
+            )
+        else:      # dialect B: Price/Ticker/Date 3-row preamble, no Adj Close
+            rows_b = [
+                f"{dates[d]},{close[d]:.6f},{hi[d]:.6f},{lo[d]:.6f},"
+                f"{o[d]:.6f},{vol[d]}"
+                for d in range(N_DAYS) if m[d]
+            ]
+            text = (
+                "Price,Close,High,Low,Open,Volume\n"
+                + f"Ticker,{t},{t},{t},{t},{t}\n"
+                + "Date,,,,,\n"
+                + "\n".join(rows_b) + "\n"
+            )
+        with open(os.path.join(OUT, f"{t}_daily.csv"), "w") as f:
+            f.write(text)
+    print(f"wrote {len(TICKERS)} daily CSVs to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

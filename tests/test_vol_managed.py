@@ -98,3 +98,5 @@ def test_batched_leading_axes_match_per_series(rng):
         np.testing.assert_array_equal(np.asarray(ok)[g], np.asarray(o1))
         np.testing.assert_allclose(np.asarray(managed)[g], np.asarray(m1),
                                    rtol=1e-12, equal_nan=True)
+        np.testing.assert_allclose(np.asarray(scale)[g], np.asarray(s1),
+                                   rtol=1e-12, equal_nan=True)
