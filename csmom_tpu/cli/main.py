@@ -919,6 +919,14 @@ def cmd_intraday(args) -> int:
               f"|score|<{args.threshold_lo:g}, bounded 1-unit book):")
         print(f"  trades {int(hres.n_trades)} (plain engine: "
               f"{int(res.n_trades)}), total PnL ${float(hres.total_pnl):,.2f}")
+        from csmom_tpu.analytics.plots import save_trades_csv as _stc
+        from csmom_tpu.backtest.event import trades_dataframe as _tdf
+
+        h_trades = _tdf(hres, compact.tickers, compact.times,
+                        np.nan_to_num(np.asarray(dense_score)),
+                        size_shares=cfg.intraday.size_shares)
+        h_csv = _stc(h_trades, cfg.results_dir, fname="trades_hysteresis.csv")
+        print(f"  trade log: {h_csv} (flips are single ±2-unit rows)")
 
     if getattr(args, "tearsheet", False):
         import pandas as pd
