@@ -1138,7 +1138,17 @@ def cmd_bench(args) -> int:
     """Run the headline benchmark (same as ``python bench.py``)."""
     import subprocess
 
-    return subprocess.call([sys.executable, "bench.py"])
+    # resolve bench.py from the repo checkout this package lives in, not
+    # the caller's cwd (the CLI is routinely invoked from /tmp)
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    bench = os.path.join(repo, "bench.py")
+    if not os.path.isfile(bench):
+        print(f"bench.py not found at {bench}: the benchmark is a repo-"
+              "checkout script, not an installed module — run it from the "
+              "source tree", file=sys.stderr)
+        return 2
+    return subprocess.call([sys.executable, bench])
 
 
 def _most_picked(choice, row_labels, col_labels, row_name, col_name, top_n=3):
