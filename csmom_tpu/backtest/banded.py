@@ -18,12 +18,12 @@ months (no signal — delisting, gap) force an exit, and ``band=0`` reduces
 test).  The band trades a little signal freshness for a lot of turnover —
 the knob that moves the break-even cost level.
 
-TPU shape: membership is a recursion over months, so it runs as one
-``lax.scan`` over the time axis carrying two ``bool[A]`` books — O(M)
-sequential steps of O(A) vectorized work, trivially small next to the
-formation/ranking kernels, and the asset axis stays shardable (the scan
-carries shard-local books; only the member counts would need a ``psum``
-in a sharded variant).
+TPU shape: the membership recursion ``x' = enter | (stay & x)`` is a
+boolean affine map, and those compose associatively — so the book is a
+``lax.associative_scan`` (parallel prefix, O(log M) depth), not a
+sequential ``lax.scan``; see :func:`banded_books`.  The asset axis stays
+shardable (books are per-asset; only the member counts need a ``psum``
+in the sharded variant).
 """
 
 from __future__ import annotations
@@ -64,6 +64,17 @@ class BandedResult:
 def banded_books(labels, n_bins: int, band: int):
     """Long/short membership books under the hysteresis rule.
 
+    The recursion per month is ``x' = enter | (stay & x)`` — a boolean
+    affine map, and those compose associatively::
+
+        (later ∘ earlier): a = a2 | (b2 & a1),  b = b2 & b1
+
+    so the "sequential" trigger is really a parallel prefix: one
+    ``lax.associative_scan`` over (enter, stay) pairs, O(log M) depth
+    instead of an O(M) ``lax.scan`` — the same transformation the event
+    engine applies to its running state, now for the monthly book.  With
+    the initial state False, the book IS the scanned ``a`` component.
+
     Args:
       labels: i32[A, M] decile ids (-1 invalid), as produced by
         :func:`csmom_tpu.ops.ranking.decile_assign_panel`.
@@ -75,17 +86,18 @@ def banded_books(labels, n_bins: int, band: int):
     labv = labels >= 0
     top = n_bins - 1
 
-    def step(carry, x):
-        long_prev, short_prev = carry
-        lab, lv = x
-        long_now = (lv & (lab == top)) | (long_prev & lv & (lab >= top - band))
-        short_now = (lv & (lab == 0)) | (short_prev & lv & (lab <= band))
-        return (long_now, short_now), (long_now, short_now)
+    def compose(earlier, later):
+        a1, b1 = earlier
+        a2, b2 = later
+        return a2 | (b2 & a1), b2 & b1
 
-    A = labels.shape[0]
-    init = (jnp.zeros(A, bool), jnp.zeros(A, bool))
-    _, (longT, shortT) = lax.scan(step, init, (labels.T, labv.T))
-    return longT.T, shortT.T
+    def book(enter, stay):
+        a, _ = lax.associative_scan(compose, (enter, stay), axis=1)
+        return a
+
+    long_b = book(labv & (labels == top), labv & (labels >= top - band))
+    short_b = book(labv & (labels == 0), labv & (labels <= band))
+    return long_b, short_b
 
 
 @partial(jax.jit, static_argnames=("lookback", "skip", "n_bins", "mode",

@@ -134,8 +134,9 @@ def sharded_banded_backtest(
 ):
     """Asset-sharded hysteresis-banded backtest (``backtest/banded.py``).
 
-    The band recursion is per-asset, so the ``lax.scan`` over months runs
-    entirely shard-local on each shard's book slice — distribution adds
+    The band recursion is per-asset (an associative-scan parallel prefix,
+    see ``banded_books``), so it runs entirely shard-local on each
+    shard's book slice — distribution adds
     exactly two communication steps: the shared distributed rank
     (:func:`_ranked_labels_local`) and one ``psum`` of the four per-month
     book partials (long/short sums and counts).  Bit-equal to the
