@@ -79,7 +79,12 @@ while true; do
       # CSMOM_ROUND gets a _watcher suffix: the full record this capture
       # writes lands under its OWN committed name and can never clobber
       # the driver's official end-of-round BENCH_FULL_${ROUND}.json
-      CSMOM_BENCH_BUDGET=900 CSMOM_ROUND="${ROUND}_watcher" timeout 960 \
+      # 1800s: the supervisor gives the TPU child up to 1200s of this —
+      # tunneled compiles alone overran the old 900/450 split (r5: the
+      # 03:47 window's child was killed at 477s with nothing printed).
+      # The child's own deadline watchdog + persistent compile cache make
+      # even a short window land at least a partial on-chip record.
+      CSMOM_BENCH_BUDGET=1800 CSMOM_ROUND="${ROUND}_watcher" timeout 1860 \
         python bench.py > /root/repo/benchmarks/bench_tpu_raw.log 2>&1
       log "bench.py rc=$? (fresh BENCH_TPU_LAST.json: $( bench_fresh && echo yes || echo NO ))"
     fi
