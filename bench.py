@@ -139,21 +139,12 @@ def child_main():
     # Persistent compile cache: tunneled-TPU compiles are the dominant cost
     # of a child (r4: they alone overran the attempt's external timeout), and
     # they are identical across attempts — let a partial first attempt pay
-    # for a complete second one.  Same uid-suffixed location as the test
-    # tier's cache (tests/conftest.py) but a separate dir: bench shapes are
-    # north-star-sized and would evict nothing useful from the test cache.
-    try:
-        import tempfile
+    # for a complete second one.  Shared with the scaling/phases capture
+    # scripts ("bench" dir); separate from the test tier's cache, whose
+    # shapes are deliberately tiny.
+    from csmom_tpu.utils.jit_cache import enable_persistent_cache
 
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(tempfile.gettempdir(),
-                         f"csmom_bench_cache-{os.getuid()}"),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:
-        pass  # cache is an optimization; never fail the child over it
+    enable_persistent_cache("bench")
 
     if os.environ.get("CSMOM_BENCH_FORCE_CPU"):
         # env JAX_PLATFORMS=cpu is set too, but this image's sitecustomize can

@@ -57,6 +57,11 @@ def main():
 
     if args.platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    from csmom_tpu.utils.jit_cache import enable_persistent_cache
+
+    # share bench.py's cache dir — a tunnel window must never be spent
+    # recompiling shapes a previous capture attempt already paid for
+    enable_persistent_cache("bench")
     import jax.numpy as jnp
 
     from csmom_tpu.backtest.grid import (

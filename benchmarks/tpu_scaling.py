@@ -51,7 +51,14 @@ def monthly_panel(A: int, M: int, seed: int = 7):
 
 
 def main():
-    import jax
+    import jax  # noqa: F401  (cache config must precede first compile)
+
+    from csmom_tpu.utils.jit_cache import enable_persistent_cache
+
+    # share bench.py's cache dir: the grid shapes here are supersets of the
+    # bench child's, and a tunnel window must never be spent recompiling
+    # what a previous attempt already paid for
+    enable_persistent_cache("bench")
 
     from csmom_tpu.backtest.grid import jk_grid_backtest
     from csmom_tpu.ops.ranking import decile_assign_panel

@@ -1625,6 +1625,15 @@ def main(argv=None) -> int:
     rc = _apply_platform(args)
     if rc:
         return rc
+    # Persistent compile cache: consecutive CLI invocations re-jit identical
+    # shapes (a replicate's kernels, a grid's cells); on the tunneled TPU
+    # backend each costs ~30s+.  CSMOM_JIT_CACHE=0 opts out.  Device-free
+    # subcommands stay jax-free: the helper imports jax, and these commands
+    # never compile anything.
+    if getattr(args, "command", None) not in _DEVICE_FREE_COMMANDS:
+        from csmom_tpu.utils.jit_cache import enable_persistent_cache
+
+        enable_persistent_cache("cli")
     return args.fn(args)
 
 

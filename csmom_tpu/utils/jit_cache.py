@@ -1,0 +1,58 @@
+"""Persistent XLA compile cache, one call to turn on.
+
+On this image's tunneled 'axon' TPU backend a fresh jit compile costs
+~30 s+ per computation shape, and the tunnel is only up in short windows
+— recompiling shapes that were already compiled in an earlier process is
+the single largest waste of a window.  The serialized-executable cache
+keys on (HLO, backend), so it is correct across processes and survives
+restarts; CPU runs benefit too (the test tier's warm wall dropped from
+6m34 to 2m55 with the same mechanism — tests/conftest.py).
+
+The reference has no analogue (single process, no compilation —
+`/root/reference/run_demo.py` is plain pandas); this is TPU-runtime
+plumbing the rebuild needs and the reference never did.
+
+Callers: the CLI (device-using subcommands re-jit the same shapes between
+invocations), the test tier (tests/conftest.py, "jit" dir), and — sharing
+one "bench" dir so no tunnel window recompiles what a previous attempt
+paid for — bench.py children, benchmarks/tpu_scaling.py, and
+benchmarks/grid_phases.py.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+__all__ = ["enable_persistent_cache"]
+
+
+def enable_persistent_cache(subdir: str = "cli") -> str | None:
+    """Point jax at a uid-suffixed on-disk compile cache; returns its path.
+
+    ``CSMOM_JIT_CACHE=0`` disables (same contract as the test tier's
+    conftest); any other non-empty value overrides the directory.  Must be
+    called after ``import jax`` and before the first compilation; calling
+    it later is harmless (already-live executables just aren't cached).
+    Never raises — the cache is an optimization, not a dependency.
+    """
+    configured = os.environ.get("CSMOM_JIT_CACHE", "")
+    if configured == "0":
+        return None
+    if configured:
+        path = configured
+    else:
+        # uid-suffixed: a fixed path in world-writable /tmp would collide
+        # across users (and let one user feed another serialized executables)
+        path = os.path.join(
+            tempfile.gettempdir(), f"csmom_{subdir}_cache-{os.getuid()}"
+        )
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return path
+    except Exception:
+        return None

@@ -28,19 +28,9 @@ jax.config.update("jax_enable_x64", True)
 # re-runs mostly load-bound (the common case while iterating); the first
 # run on a machine still pays full compile.  CSMOM_JIT_CACHE=0 disables,
 # any other value overrides the directory.
-_cache_dir = os.environ.get("CSMOM_JIT_CACHE", "")
-if _cache_dir != "0":
-    if not _cache_dir:
-        import tempfile
+from csmom_tpu.utils.jit_cache import enable_persistent_cache  # noqa: E402
 
-        # uid-suffixed: a fixed path in world-writable /tmp would collide
-        # across users (and let one user feed another serialized executables)
-        _cache_dir = os.path.join(
-            tempfile.gettempdir(), f"csmom_jit_cache-{os.getuid()}"
-        )
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+enable_persistent_cache("jit")  # -> csmom_jit_cache-{uid}, the tier's dir
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,43 @@
+"""The persistent-compile-cache helper honors its env contract.
+
+The cache is what keeps tunnel windows from being spent on recompiles
+(bench children, scaling/phases captures) and what makes consecutive CLI
+invocations warm — so the opt-out and override paths must actually work.
+jax config is process-global state; each test restores the prior value.
+"""
+
+import os
+
+import jax
+import pytest
+
+from csmom_tpu.utils.jit_cache import enable_persistent_cache
+
+
+@pytest.fixture()
+def restore_cache_dir():
+    prev = jax.config.jax_compilation_cache_dir
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_default_path_is_uid_suffixed(monkeypatch, restore_cache_dir):
+    monkeypatch.delenv("CSMOM_JIT_CACHE", raising=False)
+    path = enable_persistent_cache("unittest")
+    assert path is not None
+    assert path.endswith(f"csmom_unittest_cache-{os.getuid()}")
+    assert jax.config.jax_compilation_cache_dir == path
+
+
+def test_env_zero_disables(monkeypatch, restore_cache_dir):
+    monkeypatch.setenv("CSMOM_JIT_CACHE", "0")
+    before = jax.config.jax_compilation_cache_dir
+    assert enable_persistent_cache("unittest") is None
+    assert jax.config.jax_compilation_cache_dir == before
+
+
+def test_env_value_overrides_directory(monkeypatch, tmp_path, restore_cache_dir):
+    monkeypatch.setenv("CSMOM_JIT_CACHE", str(tmp_path / "override"))
+    path = enable_persistent_cache("unittest")
+    assert path == str(tmp_path / "override")
+    assert jax.config.jax_compilation_cache_dir == path
