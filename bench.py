@@ -178,46 +178,37 @@ def child_main():
         """A measured wall rounds; a skip/fail reason string passes through."""
         return round(x, 4) if isinstance(x, float) else x
 
-    # Deadline watchdog (r4 failure mode: the TPU child overran its external
+    # Deadline guard (r4 failure mode: the TPU child overran its external
     # timeout — tunneled compiles are slow — and was SIGKILLed, losing the
     # already-measured headline and with it the round's on-chip record).
-    # _PROG is filled progressively as legs complete; if the deadline
-    # approaches, dump whatever is measured as an explicitly-partial record
-    # and exit 0 so the supervisor still gets a parseable on-platform line.
-    import threading
+    # _PROG is filled progressively as legs complete; at the deadline the
+    # guard dumps whatever is measured as an explicitly-partial record so
+    # the supervisor still gets a parseable on-platform line.  Anchored to
+    # _CHILD_T0 (process start): jax init time must count against the
+    # budget, not extend it past the external SIGKILL.
+    from csmom_tpu.utils.deadline import deadline_guard
 
     _PROG: dict = {}
-    # One line ever reaches stdout: the timer and the main thread both print
-    # under _emit_lock, and whoever prints first wins (_final set by the main
-    # thread before its full-record print; checked by the timer under the
-    # lock — cancel() alone cannot stop an already-executing timer callback).
-    _emit_lock = threading.Lock()
-    _final = threading.Event()
 
-    def _emit_partial():
-        with _emit_lock:
-            if _final.is_set():
-                return  # full record already printed (or printing won race)
-            if "value" not in _PROG:
-                os._exit(3)  # headline not yet measured: nothing worth a line
-            ex = dict(_PROG.get("extra", {}))
-            ex["partial"] = (
-                "child deadline hit before every leg completed; unmeasured "
-                "legs are absent (watchdog dump, not a full record)"
-            )
-            print(json.dumps({
-                "metric": "intraday_event_backtest_bar_groups_per_sec",
-                "value": _PROG["value"],
-                "unit": "bar_groups/s",
-                "vs_baseline": _PROG["vs_baseline"],
-                "extra": ex,
-            }), flush=True)
-            os._exit(0)
+    def _partial_line():
+        if "value" not in _PROG:
+            return None  # headline not yet measured: nothing worth a line
+        ex = dict(_PROG.get("extra", {}))
+        ex["partial"] = (
+            "child deadline hit before every leg completed; unmeasured "
+            "legs are absent (watchdog dump, not a full record)"
+        )
+        return json.dumps({
+            "metric": "intraday_event_backtest_bar_groups_per_sec",
+            "value": _PROG["value"],
+            "unit": "bar_groups/s",
+            "vs_baseline": _PROG["vs_baseline"],
+            "extra": ex,
+        })
 
-    if _child_budget:
-        _wd = threading.Timer(max(30.0, _child_left() - 45.0), _emit_partial)
-        _wd.daemon = True
-        _wd.start()
+    _finish = deadline_guard(
+        "CSMOM_BENCH_CHILD_BUDGET", _partial_line, t0=_CHILD_T0
+    )
 
     # Timing discipline: every timed rep fetches a scalar result to host
     # (see csmom_tpu.utils.profiling.fetch — block_until_ready does not
@@ -558,11 +549,7 @@ def child_main():
             "extra": extra,
         }
     )
-    with _emit_lock:  # exactly one line wins — see _emit_partial
-        _final.set()
-        if _child_budget:
-            _wd.cancel()
-        print(line, flush=True)
+    _finish(line)
 
 
 def histrank_child_main():

@@ -35,6 +35,10 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))  # repo root: csmom_tpu package
 sys.path.insert(0, _HERE)                   # sibling benchmark modules
 
+# deadline anchor: module-import time ~= process start (tunneled jax setup
+# runs inside main, after this — see csmom_tpu.utils.deadline)
+_T0 = time.monotonic()
+
 from tpu_scaling import monthly_panel  # noqa: E402  (sibling module)
 
 
@@ -106,6 +110,38 @@ def main():
         return (time.perf_counter() - t0) / reps
 
     rows = []
+
+    from csmom_tpu.utils.profiling import PEAK_HBM_GBPS
+
+    peak = PEAK_HBM_GBPS.get(kind)
+
+    def summary(partial=None):
+        d = {
+            "metric": "grid_phase_breakdown",
+            "platform": platform,
+            "device_kind": kind,
+            "A": A, "M": M, "H": H,
+            "tiny_op_rtt_s": round(rtt_s, 6),
+            "chip_peak_hbm_gbps": peak or "unknown device kind",
+            "timing": "per-rep device_get of an in-jit scalar reduction",
+            "phases": list(rows),
+        }
+        if partial:
+            d["partial"] = partial
+        return d
+
+    # Deadline guard (same as bench.py's child and tpu_scaling.py): an
+    # external timeout must never discard the phases already measured.
+    from csmom_tpu.utils.deadline import deadline_guard
+
+    finish = deadline_guard(
+        "CSMOM_PHASES_BUDGET_S",
+        lambda: json.dumps(summary(
+            partial="deadline hit: unmeasured phases are absent "
+                    "(watchdog dump, not a full breakdown)"
+        )) if rows else None,
+        t0=_T0,
+    )
 
     def report(phase, wall, gbytes, gflops, note):
         row = {
@@ -219,19 +255,7 @@ def main():
         "everything under one jit: XLA fuses phases 1-4",
     )
 
-    from csmom_tpu.utils.profiling import PEAK_HBM_GBPS
-
-    peak = PEAK_HBM_GBPS.get(kind)
-    print(json.dumps({
-        "metric": "grid_phase_breakdown",
-        "platform": platform,
-        "device_kind": kind,
-        "A": A, "M": M, "H": H,
-        "tiny_op_rtt_s": round(rtt_s, 6),
-        "chip_peak_hbm_gbps": peak or "unknown device kind",
-        "timing": "per-rep device_get of an in-jit scalar reduction",
-        "phases": rows,
-    }), flush=True)
+    finish(json.dumps(summary()))
 
 
 if __name__ == "__main__":

@@ -38,6 +38,12 @@ import numpy as np
 # lives at the repo root, one level up from this script
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# deadline anchor: module-import time ~= process start.  Tunneled jax setup
+# (import, device init, RTT probe) can eat 60-120s before main() arms the
+# guard; anchoring there would let the external SIGKILL win (see
+# csmom_tpu.utils.deadline).
+_T0 = time.monotonic()
+
 
 def monthly_panel(A: int, M: int, seed: int = 7):
     """Month-end price panel with staggered listings: ``(prices, valid)``."""
@@ -87,6 +93,39 @@ def main():
     )
     rows = []
 
+    def summary(partial=None):
+        d = {
+            "metric": "grid16_scaling",
+            "platform": platform,
+            "device_kind": kind,
+            "grid": "16 cells (J,K in {3,6,9,12}), 60yr monthly, mode=rank",
+            "north_star": "A=3000 row",
+            "tiny_op_rtt_s": round(rtt_s, 6),
+            "timing": "per-rep device_get of an in-jit scalar reduction "
+                      "(block_until_ready does not reliably sync on "
+                      "tunneled backends)",
+            "rows": list(rows),
+        }
+        if partial:
+            d["partial"] = partial
+        return d
+
+    # Deadline guard (same failure mode as bench.py's child, r5: a
+    # 900s-timeout scaling run was SIGKILLed mid-compile and every point it
+    # HAD measured was discarded).  If CSMOM_SCALING_BUDGET_S is set, the
+    # summary of whatever points completed is emitted before the external
+    # timeout fires; exactly one summary line ever prints.
+    from csmom_tpu.utils.deadline import deadline_guard
+
+    finish = deadline_guard(
+        "CSMOM_SCALING_BUDGET_S",
+        lambda: json.dumps(summary(
+            partial="deadline hit: unmeasured sizes/impls are absent "
+                    "(watchdog dump, not a full sweep)"
+        )) if rows else None,
+        t0=_T0,
+    )
+
     for A in sizes:
         pm, mm = monthly_panel(A, M)
         pm_d, mm_d = jax.device_put(pm), jax.device_put(mm)
@@ -128,23 +167,7 @@ def main():
         rows.append(row)
         print(json.dumps(row), flush=True)
 
-    print(
-        json.dumps(
-            {
-                "metric": "grid16_scaling",
-                "platform": platform,
-                "device_kind": kind,
-                "grid": "16 cells (J,K in {3,6,9,12}), 60yr monthly, mode=rank",
-                "north_star": "A=3000 row",
-                "tiny_op_rtt_s": round(rtt_s, 6),
-                "timing": "per-rep device_get of an in-jit scalar reduction "
-                          "(block_until_ready does not reliably sync on "
-                          "tunneled backends)",
-                "rows": rows,
-            }
-        ),
-        flush=True,
-    )
+    finish(json.dumps(summary()))
 
 
 if __name__ == "__main__":

@@ -38,18 +38,42 @@ log() { echo "$(date -u +%FT%TZ) [$ROUND] $*" >> "$LOG"; }
 # Extract the last JSON summary line of a raw log into a committed artifact
 # at the repo root (raw logs are gitignored, and a window can open after the
 # session's last turn — the driver's end-of-round auto-commit then still
-# captures the artifact). Refuses to overwrite an existing artifact.
+# captures the artifact). Refuses to overwrite an existing artifact — with
+# one exception: a FULL capture may replace a PARTIAL one (a deadline-hit
+# dump is better than nothing at round end, but must never block the
+# upgrade a later window can provide).
 land_artifact() {  # $1 raw log, $2 committed artifact path
+  new_line=$(grep '^{' "$1" | tail -1)
   if [ -s "$2" ]; then
-    log "artifact $2 already exists — refusing to overwrite"
-    return 0
+    if grep -q '"partial":' "$2" \
+        && ! printf '%s' "$new_line" | grep -q '"partial":'; then
+      log "artifact $2 is a partial — upgrading with full capture"
+    else
+      log "artifact $2 already exists — refusing to overwrite"
+      return 0
+    fi
   fi
-  if grep '^{' "$1" | tail -1 | python -m json.tool > "$2".tmp 2>/dev/null \
+  if printf '%s\n' "$new_line" | python -m json.tool > "$2".tmp 2>/dev/null \
       && [ -s "$2".tmp ]; then
     mv "$2".tmp "$2"
   else
     rm -f "$2".tmp
     log "summary extraction FAILED for $2 (artifact not written)"
+  fi
+}
+
+# Promote a finished raw .tmp: a FULL summary claims the done-marker path
+# ($2) so the loop stops re-running that capture; a PARTIAL one is kept
+# aside (.partial) and lands only as a provisional artifact — the done
+# marker stays absent so the next window retries for the full sweep.
+promote_capture() {  # $1 name for logs, $2 raw out path, $3 artifact path
+  if grep '^{' "$2".tmp | tail -1 | grep -q '"partial":'; then
+    mv "$2".tmp "$2".partial
+    land_artifact "$2".partial "$3"
+    log "$1 partial capture kept as .partial — will retry for a full one"
+  else
+    mv "$2".tmp "$2"
+    land_artifact "$2" "$3"
   fi
 }
 
@@ -75,7 +99,7 @@ while true; do
   if timeout 120 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>>"$LOG"; then
     log "probe OK — tunnel up"
     if ! bench_fresh; then
-      log "running bench.py (budget 900s)"
+      log "running bench.py (budget 1800s)"
       # CSMOM_ROUND gets a _watcher suffix: the full record this capture
       # writes lands under its OWN committed name and can never clobber
       # the driver's official end-of-round BENCH_FULL_${ROUND}.json
@@ -90,22 +114,21 @@ while true; do
     fi
     if [ ! -s "$SCALING_OUT" ]; then
       log "running tpu_scaling.py"
-      timeout 900 python benchmarks/tpu_scaling.py > "$SCALING_OUT".tmp 2>&1
+      CSMOM_SCALING_BUDGET_S=870 timeout 900 \
+        python benchmarks/tpu_scaling.py > "$SCALING_OUT".tmp 2>&1
       rc=$?
       if [ "$rc" -eq 0 ]; then
-        mv "$SCALING_OUT".tmp "$SCALING_OUT"
-        land_artifact "$SCALING_OUT" "$SCALING_ART"
+        promote_capture "tpu_scaling" "$SCALING_OUT" "$SCALING_ART"
       fi
       log "tpu_scaling rc=$rc"
     fi
     if [ ! -s "$PHASES_OUT" ]; then
       log "running grid_phases.py (north-star size)"
-      timeout 450 python benchmarks/grid_phases.py --reps 5 \
-        > "$PHASES_OUT".tmp 2>&1
+      CSMOM_PHASES_BUDGET_S=420 timeout 450 python benchmarks/grid_phases.py \
+        --reps 5 > "$PHASES_OUT".tmp 2>&1
       rc=$?
       if [ "$rc" -eq 0 ]; then
-        mv "$PHASES_OUT".tmp "$PHASES_OUT"
-        land_artifact "$PHASES_OUT" "$PHASES_ART"
+        promote_capture "grid_phases" "$PHASES_OUT" "$PHASES_ART"
       fi
       log "grid_phases 1x rc=$rc"
     fi
@@ -114,8 +137,8 @@ while true; do
     PHASES32_OUT=/root/repo/benchmarks/phases32_raw.log
     if [ -s "$PHASES_OUT" ] && [ ! -s "$PHASES32_OUT" ]; then
       log "running grid_phases.py --ax 32 (best-effort)"
-      timeout 450 python benchmarks/grid_phases.py --ax 32 --reps 3 \
-        > "$PHASES32_OUT".tmp 2>&1
+      CSMOM_PHASES_BUDGET_S=420 timeout 450 python benchmarks/grid_phases.py \
+        --ax 32 --reps 3 > "$PHASES32_OUT".tmp 2>&1
       rc=$?
       if [ "$rc" -eq 0 ]; then mv "$PHASES32_OUT".tmp "$PHASES32_OUT"; fi
       log "grid_phases 32x rc=$rc"

@@ -1,0 +1,82 @@
+"""Deadline guard for externally-timeout'd measurement processes.
+
+The capture processes (bench.py children, benchmarks/tpu_scaling.py,
+benchmarks/grid_phases.py) run under a hard external ``timeout`` because
+the tunneled TPU backend can hang at any point.  A SIGKILL at that
+timeout must never discard what the process already measured — r4/r5
+lost complete on-chip headlines exactly this way.  This guard arms a
+timer that prints a caller-built partial summary and exits 0 just before
+the external deadline, under a lock so exactly one summary line ever
+reaches stdout.
+
+The deadline is anchored at ``t0`` — the CALLER's module-import time,
+not guard-arm time: tunneled jax startup (import, device init, RTT
+probe) can eat 60-120 s before the guard is armed, and an unanchored
+timer would fire after the external SIGKILL, which is the bug this
+module exists to prevent.
+
+The reference has no analogue (no benchmarks, no timeouts —
+``/root/reference/README.md`` is a bare title); this is capture-harness
+plumbing for the TPU rebuild's evidence discipline.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["deadline_guard"]
+
+
+def deadline_guard(
+    env_var: str,
+    partial_line: Callable[[], Optional[str]],
+    t0: float,
+    margin_s: float = 45.0,
+    min_delay_s: float = 30.0,
+) -> Callable[[str], None]:
+    """Arm a partial-dump watchdog; returns ``finish(line)`` for the caller.
+
+    ``env_var`` names the wall-budget env (seconds since ``t0``); unset or
+    0 arms nothing.  When the budget (minus ``margin_s``) expires,
+    ``partial_line()`` is called: a string is printed and the process
+    exits 0 (an explicitly-partial but parseable record); ``None`` means
+    nothing worth a line was measured yet and the process exits 3.  The
+    caller's normal path ends with ``finish(full_line)``, which wins the
+    lock, cancels the timer, and prints — whichever of the two prints
+    first is the process's single stdout summary line.
+    """
+    budget = float(os.environ.get(env_var, "0") or 0)
+    lock = threading.Lock()
+    done = threading.Event()
+
+    def _fire():
+        with lock:
+            if done.is_set():
+                return  # full line already printed (or printing won race)
+            line = partial_line()
+            if line is None:
+                os._exit(3)  # nothing measured: no artifact-worthy line
+            print(line, flush=True)
+            os._exit(0)
+
+    timer = None
+    if budget:
+        # min_delay_s floors the fuse so a guard armed late (or a tiny
+        # budget) still gives the measurement a beat to land its first
+        # result; tests shrink it to exercise the firing path quickly
+        delay = max(min_delay_s, budget - (time.monotonic() - t0) - margin_s)
+        timer = threading.Timer(delay, _fire)
+        timer.daemon = True
+        timer.start()
+
+    def finish(line: str) -> None:
+        with lock:
+            done.set()
+            if timer is not None:
+                timer.cancel()
+            print(line, flush=True)
+
+    return finish

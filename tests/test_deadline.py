@@ -1,0 +1,85 @@
+"""The shared deadline guard: one summary line, partial or full, always.
+
+The guard backs every capture process (bench children, scaling, phases);
+its contract — partial dump on deadline, exit 3 when nothing is
+measured, full line wins when it gets there first — is what keeps an
+external SIGKILL from discarding measured data.  The firing paths need a
+subprocess (the guard calls os._exit); the cancel path runs in-process.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_guard_script(body: str, budget: str = "1"):
+    env = dict(os.environ)
+    env["GUARD_TEST_BUDGET"] = budget
+    return subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(f"""
+            import sys, time
+            sys.path.insert(0, {_REPO!r})
+            from csmom_tpu.utils.deadline import deadline_guard
+            t0 = time.monotonic()
+            {body}
+        """)],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+
+
+def test_deadline_fires_partial_and_exits_zero():
+    p = _run_guard_script("""
+            finish = deadline_guard("GUARD_TEST_BUDGET", lambda: '{"partial": true}',
+                                    t0=t0, margin_s=0.0, min_delay_s=0.3)
+            time.sleep(30)  # the hang the guard exists to outrun
+    """)
+    assert p.returncode == 0
+    assert p.stdout.strip() == '{"partial": true}'
+
+
+def test_deadline_with_nothing_measured_exits_three_silently():
+    p = _run_guard_script("""
+            finish = deadline_guard("GUARD_TEST_BUDGET", lambda: None,
+                                    t0=t0, margin_s=0.0, min_delay_s=0.3)
+            time.sleep(30)
+    """)
+    assert p.returncode == 3
+    assert p.stdout.strip() == ""
+
+
+def test_finish_beats_timer_and_prints_once():
+    p = _run_guard_script("""
+            finish = deadline_guard("GUARD_TEST_BUDGET", lambda: '{"partial": true}',
+                                    t0=t0, margin_s=0.0, min_delay_s=0.5)
+            finish('{"full": true}')
+            time.sleep(1.5)  # outlive the timer: it must never also print
+    """)
+    assert p.returncode == 0
+    assert p.stdout.strip() == '{"full": true}'
+
+
+def test_unset_budget_arms_nothing():
+    p = _run_guard_script("""
+            finish = deadline_guard("GUARD_TEST_BUDGET_UNSET", lambda: None,
+                                    t0=t0, min_delay_s=0.1)
+            finish('{"full": true}')
+    """)
+    assert p.returncode == 0
+    assert p.stdout.strip() == '{"full": true}'
+
+
+def test_late_armed_guard_still_fires_before_external_budget():
+    """The t0 anchor: a guard armed 0.8s after 'process start' with a 1s
+    budget must compute a near-zero fuse (floored by min_delay_s), not a
+    fresh full-budget one — jax init time counts against the budget."""
+    p = _run_guard_script("""
+            time.sleep(0.8)  # slow 'jax init' before the guard is armed
+            finish = deadline_guard("GUARD_TEST_BUDGET", lambda: '{"partial": true}',
+                                    t0=t0, margin_s=0.0, min_delay_s=0.1)
+            time.sleep(30)
+    """)
+    assert p.returncode == 0
+    assert p.stdout.strip() == '{"partial": true}'
