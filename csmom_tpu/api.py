@@ -198,7 +198,9 @@ def intraday_pipeline(
 
     The panel-world equivalent of ``intraday_pipeline`` + ``backtest_run``
     (``run_demo.py:81-191``).  ``model`` selects the score model:
-    ``'ridge'`` (the reference's, ``models.py:8-22``), ``'elastic_net'``
+    ``'ridge'`` (the reference's, ``models.py:8-22``), ``'online_ridge'``
+    (leak-free walk-forward via one Sherman-Morrison scan —
+    models/online_ridge.py), ``'elastic_net'``
     / ``'lasso'`` (sparse extensions; ``alpha``/``l1_ratio`` apply), or
     ``'mlp'`` (nonlinear extension; ``alpha`` is its weight decay).
     Note the scales differ: ridge's ``alpha`` is the reference's 1.0, but
@@ -210,7 +212,8 @@ def intraday_pipeline(
     callers get the same sane defaults.
     Returns (EventResult, fit, compact, dense_score, dense_price,
     dense_valid) — ``fit`` is the selected model's fit object (RidgeFit
-    for the linear family, MLPFit for ``'mlp'``; all carry
+    for the batch linear family, OnlineRidgeFit for ``'online_ridge'``,
+    MLPFit for ``'mlp'``; distinct dataclasses, but all carry
     ``scores`` / ``cv_mse`` / ``n_train``).
     """
     from csmom_tpu.signals.intraday import compact_minutes, minute_features, next_row_return
@@ -218,6 +221,7 @@ def intraday_pipeline(
         as_ridge_fit,
         elastic_net_time_series_cv,
         mlp_time_series_cv,
+        online_ridge_scores,
         ridge_time_series_cv,
     )
     from csmom_tpu.backtest.event import event_backtest
@@ -234,8 +238,9 @@ def intraday_pipeline(
     if alpha is None:
         # per-model scales: ridge's 1.0 is the reference's (run_demo.py:140);
         # elastic-net penalties are per-row on ~1e-4 labels; for the MLP,
-        # alpha is AdamW weight decay
-        alpha = {"ridge": 1.0, "mlp": 1e-4}.get(model, 1e-8)
+        # alpha is AdamW weight decay; online_ridge standardizes causally so
+        # ridge's unit penalty carries over
+        alpha = {"ridge": 1.0, "online_ridge": 1.0, "mlp": 1e-4}.get(model, 1e-8)
     compact = compact_minutes(minute_df)
     price = jnp.asarray(compact.price, dtype)
     volume = jnp.asarray(compact.volume, dtype)
@@ -245,6 +250,12 @@ def intraday_pipeline(
     y, y_valid = next_row_return(price, feat_valid)
     if model == "ridge":
         fit = ridge_time_series_cv(feats, y, y_valid, n_splits=n_splits, alpha=alpha)
+    elif model == "online_ridge":
+        # leak-free walk-forward: every score strictly out-of-sample
+        # (the reference's scaffold scores its own training rows —
+        # run_demo.py:139-147; this is the causal counterpart)
+        fit = online_ridge_scores(feats, y, y_valid, n_splits=n_splits,
+                                  alpha=alpha)
     elif model in ("elastic_net", "lasso"):
         enet = elastic_net_time_series_cv(
             feats, y, y_valid, n_splits=n_splits, alpha=alpha,
@@ -265,8 +276,8 @@ def intraday_pipeline(
                                  weight_decay=alpha)
     else:
         raise ValueError(
-            f"unknown model {model!r} (expected 'ridge', 'elastic_net', "
-            f"'lasso', or 'mlp')"
+            f"unknown model {model!r} (expected 'ridge', 'online_ridge', "
+            f"'elastic_net', 'lasso', or 'mlp')"
         )
 
     # scatter compacted rows onto the global minute axis; padded/non-model

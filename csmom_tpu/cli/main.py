@@ -799,7 +799,8 @@ def cmd_sweep(args) -> int:
 
 def cmd_intraday(args) -> int:
     """Intraday pipeline + event backtest (``run_demo.py:81-191``): features,
-    score-model CV (--model ridge|elastic_net|lasso|mlp), per-minute fills;
+    score-model CV (--model ridge|online_ridge|elastic_net|lasso|mlp),
+    per-minute fills;
     writes trades.csv + intraday_cum_pnl.png."""
     import numpy as np
 
@@ -834,7 +835,10 @@ def cmd_intraday(args) -> int:
     model = getattr(args, "model", None) or "ridge"
     if getattr(args, "alpha", None) is not None:
         alpha = args.alpha
-    elif model == "ridge":
+    elif model in ("ridge", "online_ridge"):
+        # same penalty scale (online_ridge standardizes causally, so
+        # ridge's unit alpha carries over) — the leaky-vs-causal
+        # comparison must not silently run at two different penalties
         alpha = cfg.intraday.alpha
     else:
         # non-ridge scales differ (l1 penalties live on the per-row
@@ -1476,7 +1480,7 @@ def build_parser() -> argparse.ArgumentParser:
                                  "disk; the TPU compute dtype anyway)")
         if "model" in extra:
             sp.add_argument("--model",
-                            choices=["ridge", "elastic_net", "lasso", "mlp"],
+                            choices=["ridge", "online_ridge", "elastic_net", "lasso", "mlp"],
                             help="score model (default: ridge, the reference's)")
             sp.add_argument("--alpha", type=float,
                             help="regularization strength (mlp: weight decay)")
@@ -1627,10 +1631,27 @@ def main(argv=None) -> int:
         return rc
     # Persistent compile cache: consecutive CLI invocations re-jit identical
     # shapes (a replicate's kernels, a grid's cells); on the tunneled TPU
-    # backend each costs ~30s+.  CSMOM_JIT_CACHE=0 opts out.  Device-free
+    # backend each costs ~30s+, so the cache is decisive there.  On CPU the
+    # compiles are seconds AND XLA's AOT loader logs a spurious
+    # machine-feature-mismatch ERROR for every cached entry (tuning
+    # pseudo-features like prefer-no-scatter are recorded at serialize time
+    # but absent from the host CPUID list) — stderr spam a demo user would
+    # read as breakage.  So: cache by default off-CPU; on CPU only when the
+    # user points CSMOM_JIT_CACHE somewhere explicitly.  Device-free
     # subcommands stay jax-free: the helper imports jax, and these commands
     # never compile anything.
-    if getattr(args, "command", None) not in _DEVICE_FREE_COMMANDS:
+    explicit_cache = os.environ.get("CSMOM_JIT_CACHE", "") not in ("", "0")
+    resolved_cpu = (
+        getattr(args, "platform", None) == "cpu"
+        or os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    )
+    if not resolved_cpu and "jax" in sys.modules:
+        import jax
+
+        resolved_cpu = (jax.config.jax_platforms or "") == "cpu"
+    if getattr(args, "command", None) not in _DEVICE_FREE_COMMANDS and (
+        explicit_cache or not resolved_cpu
+    ):
         from csmom_tpu.utils.jit_cache import enable_persistent_cache
 
         enable_persistent_cache("cli")

@@ -1,6 +1,8 @@
 """Predictive models: linear family (ridge closed-form, elastic-net/lasso
-via FISTA) and a small MLP (full-batch AdamW), all on one shared
-expanding-window time-series-CV harness."""
+via FISTA, online ridge via Sherman-Morrison scan) and a small MLP
+(full-batch AdamW).  The batch models share one expanding-window
+time-series-CV harness; the online model is its leak-free walk-forward
+counterpart (strictly-causal scores, prequential MSE)."""
 
 from csmom_tpu.models.ridge import ridge_time_series_cv, RidgeFit
 from csmom_tpu.models.elastic_net import (
@@ -9,6 +11,7 @@ from csmom_tpu.models.elastic_net import (
     elastic_net_time_series_cv,
 )
 from csmom_tpu.models.mlp import MLPFit, mlp_time_series_cv
+from csmom_tpu.models.online_ridge import OnlineRidgeFit, online_ridge_scores
 
 __all__ = [
     "ridge_time_series_cv",
@@ -18,4 +21,6 @@ __all__ = [
     "as_ridge_fit",
     "MLPFit",
     "mlp_time_series_cv",
+    "OnlineRidgeFit",
+    "online_ridge_scores",
 ]

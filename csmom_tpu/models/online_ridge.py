@@ -1,0 +1,205 @@
+"""Online (recursive) ridge: strictly-causal walk-forward scores in one scan.
+
+The reference's modeling scaffold scores its own training rows by design
+(``/root/reference/run_demo.py:139-147``; SURVEY §2.1.4 documents the
+leak), and the rebuild replicates that for parity (``models/ridge.py``).
+This module is the leak-free counterpart the reference never had: every
+row t is scored by a model fit ONLY on rows seen before t, and the whole
+walk-forward — scaler, fit, one-step-ahead prediction at every row — is
+ONE ``lax.scan``, not R refits.
+
+Recursions (rank-1 Sherman–Morrison on the regularized inverse Gram):
+
+    P_t = P_{t-1} - (P_{t-1} x_t x_t^T P_{t-1}) / (1 + x_t^T P_{t-1} x_t)
+    b_t = b_{t-1} + x_t y_t            =>   w_t = P_t b_t
+
+with ``P_0 = I/alpha`` so ``P_t = (X_{1..t}^T X_{1..t} + alpha I)^{-1}``
+exactly.  Each step is O(F^2) on a (F+1)-sized augmented state — this is
+the recursive-least-squares filter family (same sequential structure as a
+Kalman update), expressed as a scan carry so XLA compiles one kernel for
+the whole history.
+
+Design choices, stated plainly:
+
+- **Intercept is a penalized augmented column.**  ``x_aug = [x, 1]`` and
+  the SAME alpha applies to the intercept weight (sklearn's
+  ``fit_intercept=True`` centers instead and does not penalize it).
+  Minute-return labels are ~1e-4, so the intercept is ~0 and the
+  deviation is immaterial; the batch-parity test pins the augmented
+  formulation exactly.
+- **Causal standardization.**  With ``standardize=True`` each row is
+  scaled by the running mean/std of the rows BEFORE it (Welford moments
+  carried in the same scan).  The representation therefore drifts early
+  on — standard online-learning behaviour; the oracle test replays the
+  identical recursion sequentially, so parity is exact, and the
+  ``standardize=False`` path is additionally pinned against the batch
+  closed form.
+- **Row-blocked time order.**  The scan iterates over rows r; at each
+  step EVERY asset's row r is scored with the state from rows < r, and
+  only then do row r's (x, y) pairs update the state (a static inner
+  fold of rank-1 updates).  Scoring asset B's row r after updating with
+  asset A's row r would leak: y[A, r] is the r -> r+1 return —
+  unknowable at decision time r, and cross-sectionally correlated with
+  y[B, r] through the market factor.  The running scaler moments update
+  after the row for the same reason of determinism (features at r are
+  observable at r, so either order is causal for x; labels are not).
+  Asset-major flattening (the reference's (ticker, datetime) TRAIN/TEST
+  split order, fine for a static split) would be worse still — asset
+  B's early rows scored by a model that has seen asset A's late rows.
+- **Prequential quality.**  ``cv_mse[i]`` is the mean squared one-step-
+  ahead error over the i-th of ``n_splits`` contiguous blocks of scored
+  rows — the online analogue of the expanding-window fold MSEs, except
+  every row is out-of-sample by construction.
+
+Masked rows (``valid == False``) are true no-ops: they neither update the
+state nor receive a score.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class OnlineRidgeFit:
+    coef: jnp.ndarray        # f[F] final weights on (causally) scaled features
+    intercept: jnp.ndarray   # f[] final augmented-intercept weight
+    scale_mean: jnp.ndarray  # f[F] final running mean (causal scaler state)
+    scale_std: jnp.ndarray   # f[F] final running std
+    cv_mse: jnp.ndarray      # f[n_splits] prequential MSE per contiguous block
+    scores: jnp.ndarray      # f[A, R] strictly-causal one-step-ahead predictions
+    n_train: jnp.ndarray     # i32 rows ever updated on (== n valid rows)
+
+
+@partial(jax.jit, static_argnames=("n_splits", "burn_in", "standardize"))
+def online_ridge_scores(
+    features,
+    y,
+    valid,
+    alpha: float = 1.0,
+    n_splits: int = 3,
+    burn_in: int = 30,
+    standardize: bool = True,
+) -> OnlineRidgeFit:
+    """Walk-forward ridge scores for every valid row, in one compiled scan.
+
+    Args:
+      features: f[A, R, F] compacted feature tensor (padded rows arbitrary).
+      y: f[A, R] next-row return labels.
+      valid: bool[A, R] modeling rows (features and label all defined).
+      alpha: ridge penalty (applies to the augmented intercept too — see
+        module docstring).
+      n_splits: number of contiguous prequential-MSE blocks reported.
+      burn_in: rows that must have updated the state before scores start
+        (earlier rows update but score NaN — a 5-row model is noise).
+      standardize: causally standardize features by prior running moments.
+
+    Returns OnlineRidgeFit; ``scores[a, r]`` used none of row (a, r) itself
+    nor any row at a later scan position.
+    """
+    A, R, F = features.shape
+    dt = features.dtype
+    # row-blocked time order: scan over rows, [R, A, ...] leading axis
+    Xr = jnp.nan_to_num(jnp.swapaxes(features, 0, 1))  # f[R, A, F]
+    yr = jnp.nan_to_num(jnp.swapaxes(y, 0, 1))         # f[R, A]
+    wr = jnp.swapaxes(valid, 0, 1).astype(dt)          # f[R, A]
+
+    eye = jnp.eye(F + 1, dtype=dt)
+
+    def step(carry, inp):
+        P, b, cnt, mean, M2 = carry
+        X, yt, w = inp  # X f[A, F], yt f[A], w f[A]
+
+        # causal scaling by the moments of rows strictly BEFORE this one
+        if standardize:
+            std = jnp.sqrt(jnp.maximum(M2 / jnp.maximum(cnt, 1.0), 1e-24))
+            std = jnp.where(std > 1e-12, std, 1.0)
+            Xs = (X - mean) / std
+        else:
+            Xs = X
+        Xa = jnp.concatenate([Xs, jnp.ones((A, 1), dt)], axis=1)
+
+        # EVERY asset's row scored with the prior weights, before any of
+        # this row's labels touch the state (y[., r] is the r -> r+1
+        # return — updating asset A then scoring asset B would leak the
+        # contemporaneous future through cross-sectional correlation)
+        preds = Xa @ (P @ b)
+
+        # then this row's rank-1 Sherman-Morrison updates, masked by w
+        def upd(a, Pb):
+            P_, b_ = Pb
+            xw = Xa[a] * w[a]  # w=0 zeroes the update exactly
+            Px = P_ @ xw
+            return (P_ - jnp.outer(Px, Px) / (1.0 + xw @ Px),
+                    b_ + xw * yt[a])
+
+        P_new, b_new = jax.lax.fori_loop(0, A, upd, (P, b))
+
+        # Welford running moments on the RAW features, also post-scoring
+        def upd_m(a, state):
+            cnt_, mean_, M2_ = state
+            cnt2 = cnt_ + w[a]
+            delta = X[a] - mean_
+            mean2 = mean_ + w[a] * delta / jnp.maximum(cnt2, 1.0)
+            M22 = M2_ + w[a] * delta * (X[a] - mean2)
+            return cnt2, mean2, M22
+
+        cnt_new, mean_new, M2_new = jax.lax.fori_loop(
+            0, A, upd_m, (cnt, mean, M2)
+        )
+
+        seen_enough = cnt >= burn_in  # prior count: the model behind preds
+        return (
+            (P_new, b_new, cnt_new, mean_new, M2_new),
+            (preds, jnp.broadcast_to(seen_enough, (A,))),
+        )
+
+    carry0 = (
+        eye / jnp.asarray(alpha, dt),
+        jnp.zeros(F + 1, dt),
+        jnp.zeros((), dt),
+        jnp.zeros(F, dt),
+        jnp.zeros(F, dt),
+    )
+    (P, b, cnt, mean, M2), (preds, seen) = jax.lax.scan(
+        step, carry0, (Xr, yr, wr)
+    )
+
+    scored = (wr > 0) & seen  # bool[R, A]
+    preds = jnp.where(scored, preds, jnp.nan)
+    scores = jnp.swapaxes(preds, 0, 1)
+
+    # prequential MSE over n_splits contiguous blocks of scored rows
+    scored_f = scored.reshape(R * A)
+    yf = yr.reshape(R * A)
+    preds_f = preds.reshape(R * A)
+    ordinal = jnp.cumsum(scored_f) - 1
+    n_scored = jnp.sum(scored_f)
+    block = jnp.minimum(
+        (ordinal * n_splits) // jnp.maximum(n_scored, 1), n_splits - 1
+    )
+    err2 = jnp.where(scored_f, (jnp.nan_to_num(preds_f) - yf) ** 2, 0.0)
+
+    def block_mse(i):
+        wb = (scored_f & (block == i)).astype(dt)
+        return jnp.sum(wb * err2) / jnp.maximum(jnp.sum(wb), 1.0)
+
+    cv_mse = jnp.stack([block_mse(i) for i in range(n_splits)])
+
+    w_final = P @ b
+    std = jnp.sqrt(jnp.maximum(M2 / jnp.maximum(cnt, 1.0), 1e-24))
+    std = jnp.where(std > 1e-12, std, 1.0)
+    return OnlineRidgeFit(
+        coef=w_final[:F],
+        intercept=w_final[F],
+        scale_mean=mean,
+        scale_std=std,
+        cv_mse=cv_mse,
+        scores=scores,
+        n_train=jnp.sum(wr).astype(jnp.int32),
+    )

@@ -1,0 +1,187 @@
+"""Online ridge: sequential-oracle parity, batch parity, causality, masking.
+
+The scan implementation must equal a plain Python replay of the same
+recursions (implementation parity), and — with the causal scaler off —
+its one-step-ahead prediction must equal the batch closed form fit on
+exactly the prior rows (algorithmic correctness of the Sherman–Morrison
+update).  Causality is pinned adversarially: perturbing any future row
+must not move an earlier score.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from csmom_tpu.models.online_ridge import online_ridge_scores
+
+
+def _panel(A=3, R=40, F=4, seed=0):
+    rng = np.random.default_rng(seed)
+    feats = rng.normal(size=(A, R, F))
+    y = rng.normal(scale=1e-2, size=(A, R))
+    valid = rng.random((A, R)) > 0.15
+    return feats, y, valid
+
+
+def _oracle(feats, y, valid, alpha, burn_in, standardize):
+    """Sequential replay of the documented row-blocked recursions: score the
+    whole row with the prior state, THEN apply the row's updates."""
+    A, R, F = feats.shape
+    P = np.eye(F + 1) / alpha
+    b = np.zeros(F + 1)
+    cnt, mean, M2 = 0.0, np.zeros(F), np.zeros(F)
+    scores = np.full((A, R), np.nan)
+    for r in range(R):
+        # score every asset's row r with the state from rows < r
+        if cnt >= burn_in:
+            for a in range(A):
+                if not valid[a, r]:
+                    continue
+                x = feats[a, r]
+                if standardize:
+                    std = np.sqrt(np.maximum(M2 / max(cnt, 1.0), 1e-24))
+                    std = np.where(std > 1e-12, std, 1.0)
+                    xs = (x - mean) / std
+                else:
+                    xs = x
+                scores[a, r] = np.concatenate([xs, [1.0]]) @ (P @ b)
+        # then apply the row's updates (scaling still by the PRIOR moments)
+        if standardize:
+            std = np.sqrt(np.maximum(M2 / max(cnt, 1.0), 1e-24))
+            std = np.where(std > 1e-12, std, 1.0)
+        for a in range(A):
+            if not valid[a, r]:
+                continue
+            x = feats[a, r]
+            xs = (x - mean) / std if standardize else x
+            xa = np.concatenate([xs, [1.0]])
+            Px = P @ xa
+            P = P - np.outer(Px, Px) / (1.0 + xa @ Px)
+            b = b + xa * y[a, r]
+        for a in range(A):
+            if not valid[a, r]:
+                continue
+            x = feats[a, r]
+            cnt += 1.0
+            delta = x - mean
+            mean = mean + delta / cnt
+            M2 = M2 + delta * (x - mean)
+    return scores
+
+
+@pytest.mark.parametrize("standardize", [True, False])
+def test_matches_sequential_oracle(standardize):
+    feats, y, valid, = _panel()
+    fit = online_ridge_scores(
+        jnp.asarray(feats), jnp.asarray(y), jnp.asarray(valid),
+        alpha=0.5, burn_in=10, standardize=standardize,
+    )
+    want = _oracle(feats, y, valid, alpha=0.5, burn_in=10,
+                   standardize=standardize)
+    np.testing.assert_allclose(np.asarray(fit.scores), want,
+                               rtol=1e-9, atol=1e-12)
+
+
+def test_prediction_equals_batch_closed_form():
+    """With the causal scaler off, the score at any row equals ridge fit on
+    the augmented prior rows: (Xa'Xa + aI)^-1 Xa'y — Sherman-Morrison is
+    exactly the batch inverse, not an approximation of it."""
+    feats, y, valid = _panel(A=2, R=30, F=3, seed=1)
+    alpha, burn_in = 2.0, 8
+    fit = online_ridge_scores(
+        jnp.asarray(feats), jnp.asarray(y), jnp.asarray(valid),
+        alpha=alpha, burn_in=burn_in, standardize=False,
+    )
+    # the state behind scores at row r holds exactly the rows of r' < r
+    A, R, F = feats.shape
+    for r in (12, 20, R - 1):
+        prior = np.array([np.concatenate([feats[pa, pr], [1.0]])
+                          for pr in range(r) for pa in range(A)
+                          if valid[pa, pr]])
+        if len(prior) < burn_in:
+            continue
+        ypri = np.array([y[pa, pr] for pr in range(r) for pa in range(A)
+                         if valid[pa, pr]])
+        w = np.linalg.solve(prior.T @ prior + alpha * np.eye(F + 1),
+                            prior.T @ ypri)
+        for a in range(A):
+            if not valid[a, r]:
+                continue
+            want = np.concatenate([feats[a, r], [1.0]]) @ w
+            np.testing.assert_allclose(float(fit.scores[a, r]), want,
+                                       rtol=1e-8, atol=1e-12)
+
+
+def test_scores_are_strictly_causal():
+    feats, y, valid = _panel(seed=2)
+    base = online_ridge_scores(jnp.asarray(feats), jnp.asarray(y),
+                               jnp.asarray(valid), burn_in=5)
+    # nuke everything at row >= 25: earlier scores must not move at all
+    y2, f2 = y.copy(), feats.copy()
+    y2[:, 25:] += 100.0
+    f2[:, 25:] *= -3.0
+    pert = online_ridge_scores(jnp.asarray(f2), jnp.asarray(y2),
+                               jnp.asarray(valid), burn_in=5)
+    np.testing.assert_array_equal(np.asarray(base.scores)[:, :25],
+                                  np.asarray(pert.scores)[:, :25])
+
+
+def test_no_same_row_cross_asset_label_leak():
+    """y[0, r] is the r -> r+1 return — unknown at decision time r.  The
+    scores of OTHER assets at row r must not move when it changes (the
+    asset-sequential formulation this replaced failed exactly here:
+    asset 0's row-r label updated the state before asset 1's row r was
+    scored, leaking the contemporaneous future through the market
+    factor)."""
+    feats, y, valid = _panel(seed=5)
+    valid[:, :] = True  # every asset present at the probed row
+    r = 25
+    base = online_ridge_scores(jnp.asarray(feats), jnp.asarray(y),
+                               jnp.asarray(valid), burn_in=5)
+    y2 = y.copy()
+    y2[0, r] += 1e3
+    f2 = feats.copy()
+    f2[0, r] *= -7.0
+    pert = online_ridge_scores(jnp.asarray(f2), jnp.asarray(y2),
+                               jnp.asarray(valid), burn_in=5)
+    # other assets' same-row scores: bit-identical
+    np.testing.assert_array_equal(np.asarray(base.scores)[1:, r],
+                                  np.asarray(pert.scores)[1:, r])
+    # and everything strictly earlier too
+    np.testing.assert_array_equal(np.asarray(base.scores)[:, :r],
+                                  np.asarray(pert.scores)[:, :r])
+
+
+def test_invalid_rows_are_noops_and_unscored():
+    feats, y, valid = _panel(seed=3)
+    fit = online_ridge_scores(jnp.asarray(feats), jnp.asarray(y),
+                              jnp.asarray(valid), burn_in=5)
+    assert np.all(np.isnan(np.asarray(fit.scores)[~valid]))
+    # garbage on invalid rows must not change anything
+    f2 = feats.copy()
+    y2 = y.copy()
+    f2[~valid] = 1e6
+    y2[~valid] = -1e6
+    fit2 = online_ridge_scores(jnp.asarray(f2), jnp.asarray(y2),
+                               jnp.asarray(valid), burn_in=5)
+    np.testing.assert_array_equal(
+        np.nan_to_num(np.asarray(fit.scores)),
+        np.nan_to_num(np.asarray(fit2.scores)),
+    )
+    assert int(fit.n_train) == int(valid.sum())
+
+
+def test_prequential_blocks_cover_scored_rows():
+    feats, y, valid = _panel(seed=4)
+    fit = online_ridge_scores(jnp.asarray(feats), jnp.asarray(y),
+                              jnp.asarray(valid), n_splits=3, burn_in=5)
+    mses = np.asarray(fit.cv_mse)
+    assert mses.shape == (3,)
+    assert np.all(np.isfinite(mses)) and np.all(mses >= 0)
+    # overall prequential MSE equals the weighted combination of blocks
+    s = np.asarray(fit.scores)
+    scored = np.isfinite(s)
+    total = np.mean((s[scored] - y[scored]) ** 2)
+    # blocks are near-equal-sized: their mean ~= the overall MSE
+    assert abs(np.mean(mses) - total) < 0.5 * total + 1e-12
