@@ -1640,21 +1640,27 @@ def main(argv=None) -> int:
     # user points CSMOM_JIT_CACHE somewhere explicitly.  Device-free
     # subcommands stay jax-free: the helper imports jax, and these commands
     # never compile anything.
-    explicit_cache = os.environ.get("CSMOM_JIT_CACHE", "") not in ("", "0")
-    resolved_cpu = (
-        getattr(args, "platform", None) == "cpu"
-        or os.environ.get("JAX_PLATFORMS", "") == "cpu"
-    )
-    if not resolved_cpu and "jax" in sys.modules:
-        import jax
+    if getattr(args, "command", None) not in _DEVICE_FREE_COMMANDS:
+        explicit_cache = os.environ.get("CSMOM_JIT_CACHE", "") not in ("", "0")
+        resolved_cpu = (
+            getattr(args, "platform", None) == "cpu"
+            or os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        )
+        if not resolved_cpu:
+            # ask the backend itself (covers jax defaulting to CPU on an
+            # accelerator-less box with a clean env).  This command is
+            # device-using, so the backend init happens momentarily anyway,
+            # and _apply_platform's probe has already vetted it.
+            import jax
 
-        resolved_cpu = (jax.config.jax_platforms or "") == "cpu"
-    if getattr(args, "command", None) not in _DEVICE_FREE_COMMANDS and (
-        explicit_cache or not resolved_cpu
-    ):
-        from csmom_tpu.utils.jit_cache import enable_persistent_cache
+            resolved_cpu = (
+                (jax.config.jax_platforms or "") == "cpu"
+                or jax.default_backend() == "cpu"
+            )
+        if explicit_cache or not resolved_cpu:
+            from csmom_tpu.utils.jit_cache import enable_persistent_cache
 
-        enable_persistent_cache("cli")
+            enable_persistent_cache("cli")
     return args.fn(args)
 
 

@@ -10,6 +10,7 @@ from csmom_tpu.strategy.base import (
     xs_zscore,
 )
 from csmom_tpu.strategy.builtin import (
+    LowVolatility,
     FiftyTwoWeekHigh,
     IntermediateMomentum,
     Momentum,
@@ -29,6 +30,7 @@ __all__ = [
     "xs_zscore",
     "FiftyTwoWeekHigh",
     "IntermediateMomentum",
+    "LowVolatility",
     "Momentum",
     "ResidualMomentum",
     "Reversal",

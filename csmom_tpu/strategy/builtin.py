@@ -20,6 +20,7 @@ from csmom_tpu.strategy.base import Strategy, register_strategy, xs_zscore
 __all__ = [
     "FiftyTwoWeekHigh",
     "IntermediateMomentum",
+    "LowVolatility",
     "Momentum",
     "Reversal",
     "ResidualMomentum",
@@ -55,6 +56,35 @@ class IntermediateMomentum(Momentum):
 
     lookback: int = 6
     skip: int = 7
+
+
+@register_strategy("low_volatility")
+@dataclasses.dataclass(frozen=True)
+class LowVolatility(Strategy):
+    """Blitz–van Vliet (2007, JPM 34) volatility effect: rank on the
+    NEGATED trailing standard deviation of monthly returns, so the top
+    decile is the lowest-volatility book and the spread is long-low /
+    short-high vol.  A risk-sorted signal rather than a return-sorted
+    one — the one zoo member whose cross-section is built from second
+    moments — expressed through the same masked ``rolling_std`` kernel
+    the intraday features use, so it needed no new engine code.
+
+    ``min_obs`` months of valid returns must exist inside the trailing
+    ``window`` (the paper uses 36 of 36; the default tolerates listing
+    gaps the way the rest of the zoo does)."""
+
+    window: int = 36
+    min_obs: int = 12
+
+    def signal(self, prices, mask, **panels):
+        from csmom_tpu.ops.rolling import rolling_std
+        from csmom_tpu.signals.momentum import monthly_returns
+
+        ret, rvalid = monthly_returns(prices, mask)
+        vol, vvalid = rolling_std(
+            ret, rvalid, self.window, min_periods=self.min_obs, ddof=1
+        )
+        return jnp.where(vvalid, -vol, jnp.nan), vvalid
 
 
 @register_strategy("reversal")

@@ -48,6 +48,11 @@ def main(argv=None):
         ("intermediate mom",     make_strategy("intermediate_momentum"), {}),
         ("reversal 1m",          make_strategy("reversal"), {}),
         ("residual mom",         make_strategy("residual_momentum"), {}),
+        # Blitz-van Vliet (2007) volatility effect: the one risk-sorted
+        # zoo member, at the paper's 36m window — the 84-month demo panel
+        # still yields ~4 years of scored months (min_obs=12 starts it
+        # earlier than a strict 36-of-36 would)
+        ("low vol (36m)",        make_strategy("low_volatility"), {}),
         # rank mode: the 52w-high score has an atom at exactly 1.0, and
         # qcut's duplicate-edge dropping would empty the top bin on
         # strong-market months (see the strategy's docstring); GH rank on

@@ -207,6 +207,38 @@ def test_intermediate_momentum_registered(rng):
     assert "intermediate_momentum" in buf.getvalue()
 
 
+def test_low_volatility_matches_pandas_rolling_std_oracle(rng):
+    """Blitz-van Vliet low-vol: the signal is the NEGATED pandas
+    ``pct_change().rolling(window, min_periods).std(ddof=1)`` per asset,
+    and it runs through the unmodified engine by registry name."""
+    import pandas as pd
+
+    prices, mask = _toy(rng, m=60, gaps=True)
+    s = make_strategy("low_volatility", window=12, min_obs=6)
+    got, gv = s.signal(jnp.asarray(prices), jnp.asarray(mask))
+
+    want = np.full_like(prices, np.nan)
+    for a in range(prices.shape[0]):
+        ser = pd.Series(prices[a])
+        # adjacent-months return (NaN unless both ends exist — the
+        # monthly_returns contract), then a CALENDAR-axis rolling window:
+        # pandas rolling skips NaNs inside the window and counts only
+        # non-NaN toward min_periods, matching the masked kernel
+        ret = ser / ser.shift(1) - 1.0
+        vol = ret.rolling(12, min_periods=6).std(ddof=1)
+        want[a] = -vol.to_numpy()
+    got_np = np.asarray(got)
+    ok = np.isfinite(want)
+    np.testing.assert_allclose(got_np[ok], want[ok], rtol=1e-5, atol=1e-9)
+    # invalid slots carry no signal
+    assert np.all(np.isnan(got_np[~np.asarray(gv)]))
+
+    # and the strategy runs end-to-end through the engine by name
+    res = strategy_backtest(prices, mask, s, n_bins=5)
+    assert np.isfinite(np.asarray(res.spread)).any()
+    assert "low_volatility" in available_strategies()
+
+
 def test_user_registered_strategy_runs_through_engine(rng):
     @register_strategy("test_price_level")
     @dataclasses.dataclass(frozen=True)
