@@ -1,0 +1,45 @@
+# Artifact-landing rules shared by tunnel_watch.sh (sourced, no side
+# effects) and pinned by tests/test_capture_lib.py.
+#
+# Contract:
+#  - land_artifact RAW ART: extract RAW's last JSON line into ART.
+#    Refuses to overwrite an existing ART — unless ART is a PARTIAL
+#    (deadline-hit dump) and the new line is FULL: a partial is
+#    provisional evidence, never a blocker for its own upgrade.
+#  - promote_capture NAME RAW ART: a finished RAW.tmp with a FULL
+#    summary claims RAW (the done-marker the watcher loop checks); a
+#    PARTIAL one is kept aside as RAW.partial and landed provisionally,
+#    so the loop retries that capture on the next window.
+#
+# Callers define log() (tunnel_watch.sh logs to its file; tests stub it).
+
+land_artifact() {  # $1 raw log, $2 committed artifact path
+  new_line=$(grep '^{' "$1" | tail -1)
+  if [ -s "$2" ]; then
+    if grep -q '"partial":' "$2" \
+        && ! printf '%s' "$new_line" | grep -q '"partial":'; then
+      log "artifact $2 is a partial — upgrading with full capture"
+    else
+      log "artifact $2 already exists — refusing to overwrite"
+      return 0
+    fi
+  fi
+  if printf '%s\n' "$new_line" | python -m json.tool > "$2".tmp 2>/dev/null \
+      && [ -s "$2".tmp ]; then
+    mv "$2".tmp "$2"
+  else
+    rm -f "$2".tmp
+    log "summary extraction FAILED for $2 (artifact not written)"
+  fi
+}
+
+promote_capture() {  # $1 name for logs, $2 raw out path, $3 artifact path
+  if grep '^{' "$2".tmp | tail -1 | grep -q '"partial":'; then
+    mv "$2".tmp "$2".partial
+    land_artifact "$2".partial "$3"
+    log "$1 partial capture kept as .partial — will retry for a full one"
+  else
+    mv "$2".tmp "$2"
+    land_artifact "$2" "$3"
+  fi
+}

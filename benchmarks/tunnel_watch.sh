@@ -35,47 +35,9 @@ cd /root/repo
 
 log() { echo "$(date -u +%FT%TZ) [$ROUND] $*" >> "$LOG"; }
 
-# Extract the last JSON summary line of a raw log into a committed artifact
-# at the repo root (raw logs are gitignored, and a window can open after the
-# session's last turn — the driver's end-of-round auto-commit then still
-# captures the artifact). Refuses to overwrite an existing artifact — with
-# one exception: a FULL capture may replace a PARTIAL one (a deadline-hit
-# dump is better than nothing at round end, but must never block the
-# upgrade a later window can provide).
-land_artifact() {  # $1 raw log, $2 committed artifact path
-  new_line=$(grep '^{' "$1" | tail -1)
-  if [ -s "$2" ]; then
-    if grep -q '"partial":' "$2" \
-        && ! printf '%s' "$new_line" | grep -q '"partial":'; then
-      log "artifact $2 is a partial — upgrading with full capture"
-    else
-      log "artifact $2 already exists — refusing to overwrite"
-      return 0
-    fi
-  fi
-  if printf '%s\n' "$new_line" | python -m json.tool > "$2".tmp 2>/dev/null \
-      && [ -s "$2".tmp ]; then
-    mv "$2".tmp "$2"
-  else
-    rm -f "$2".tmp
-    log "summary extraction FAILED for $2 (artifact not written)"
-  fi
-}
-
-# Promote a finished raw .tmp: a FULL summary claims the done-marker path
-# ($2) so the loop stops re-running that capture; a PARTIAL one is kept
-# aside (.partial) and lands only as a provisional artifact — the done
-# marker stays absent so the next window retries for the full sweep.
-promote_capture() {  # $1 name for logs, $2 raw out path, $3 artifact path
-  if grep '^{' "$2".tmp | tail -1 | grep -q '"partial":'; then
-    mv "$2".tmp "$2".partial
-    land_artifact "$2".partial "$3"
-    log "$1 partial capture kept as .partial — will retry for a full one"
-  else
-    mv "$2".tmp "$2"
-    land_artifact "$2" "$3"
-  fi
-}
+# land_artifact / promote_capture live in capture_lib.sh (sourced) so the
+# partial-vs-full landing rules are testable (tests/test_capture_lib.py).
+. "$(dirname "$0")"/capture_lib.sh
 
 bench_fresh() {
   # BENCH_TPU_LAST.json persists across rounds as bench.py's cache: only a

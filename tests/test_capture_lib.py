@@ -1,0 +1,103 @@
+"""The watcher's artifact-landing rules (benchmarks/capture_lib.sh).
+
+These shell functions decide what the round's committed TPU evidence is;
+their partial-vs-full rules mirror bench.py's BENCH_TPU_LAST cache
+policy (pinned in test_bench_record.py), so they get the same pinning:
+a partial never blocks its own upgrade, a full capture is never
+displaced, and a partial sweep never claims the done-marker the watcher
+loop re-checks.
+"""
+
+import json
+import os
+import subprocess
+
+_LIB = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks", "capture_lib.sh",
+)
+
+FULL = json.dumps({"metric": "grid16_scaling", "rows": [1, 2, 3]})
+PARTIAL = json.dumps({"metric": "grid16_scaling", "rows": [1],
+                      "partial": "deadline hit"})
+
+
+def _sh(cwd, body):
+    return subprocess.run(
+        ["bash", "-c", f'log() {{ :; }}; . "{_LIB}"; {body}'],
+        cwd=cwd, capture_output=True, text=True, timeout=30,
+    )
+
+
+def _write(path, *lines):
+    path.write_text("".join(f"{ln}\n" for ln in lines))
+
+
+def test_land_artifact_extracts_last_json_line(tmp_path):
+    raw = tmp_path / "raw.log"
+    _write(raw, "noise", '{"point": 1}', FULL)
+    r = _sh(tmp_path, f'land_artifact "{raw}" "{tmp_path}/art.json"')
+    assert r.returncode == 0, r.stderr
+    art = json.loads((tmp_path / "art.json").read_text())
+    assert art["rows"] == [1, 2, 3]
+
+
+def test_land_artifact_never_overwrites_full_with_anything(tmp_path):
+    art = tmp_path / "art.json"
+    art.write_text(FULL)
+    raw = tmp_path / "raw.log"
+    for newer in (PARTIAL, json.dumps({"metric": "x", "rows": []})):
+        _write(raw, newer)
+        _sh(tmp_path, f'land_artifact "{raw}" "{art}"')
+        assert json.loads(art.read_text())["rows"] == [1, 2, 3]
+
+
+def test_land_artifact_upgrades_partial_with_full(tmp_path):
+    art = tmp_path / "art.json"
+    art.write_text(json.dumps(json.loads(PARTIAL), indent=1))
+    raw = tmp_path / "raw.log"
+    _write(raw, FULL)
+    _sh(tmp_path, f'land_artifact "{raw}" "{art}"')
+    got = json.loads(art.read_text())
+    assert "partial" not in got and got["rows"] == [1, 2, 3]
+
+
+def test_land_artifact_partial_does_not_refresh_partial(tmp_path):
+    """Unlike bench's in-file cache (where newer partial beats older
+    partial), a committed artifact stays as first landed: the watcher
+    retries via the absent done-marker, not by churning the artifact."""
+    art = tmp_path / "art.json"
+    art.write_text(json.dumps(json.loads(PARTIAL), indent=1))
+    raw = tmp_path / "raw.log"
+    newer_partial = json.dumps({"metric": "grid16_scaling",
+                                "rows": [9], "partial": "deadline hit"})
+    _write(raw, newer_partial)
+    _sh(tmp_path, f'land_artifact "{raw}" "{art}"')
+    assert json.loads(art.read_text())["rows"] == [1]
+
+
+def test_promote_capture_full_claims_done_marker(tmp_path):
+    raw = tmp_path / "scaling_raw.log"
+    _write(tmp_path / "scaling_raw.log.tmp", '{"point": 1}', FULL)
+    r = _sh(tmp_path,
+            f'promote_capture sc "{raw}" "{tmp_path}/art.json"')
+    assert r.returncode == 0, r.stderr
+    assert raw.exists() and not (tmp_path / "scaling_raw.log.tmp").exists()
+    assert json.loads((tmp_path / "art.json").read_text())["rows"] == [1, 2, 3]
+
+
+def test_promote_capture_partial_keeps_done_marker_absent(tmp_path):
+    raw = tmp_path / "scaling_raw.log"
+    _write(tmp_path / "scaling_raw.log.tmp", PARTIAL)
+    _sh(tmp_path, f'promote_capture sc "{raw}" "{tmp_path}/art.json"')
+    # done-marker absent -> the watcher loop will re-run this capture
+    assert not raw.exists()
+    assert (tmp_path / "scaling_raw.log.partial").exists()
+    # but the partial still lands provisionally for end-of-round evidence
+    assert json.loads((tmp_path / "art.json").read_text())["rows"] == [1]
+    # and a later full window upgrades the artifact and claims the marker
+    _write(tmp_path / "scaling_raw.log.tmp", FULL)
+    _sh(tmp_path, f'promote_capture sc "{raw}" "{tmp_path}/art.json"')
+    assert raw.exists()
+    got = json.loads((tmp_path / "art.json").read_text())
+    assert "partial" not in got and got["rows"] == [1, 2, 3]
