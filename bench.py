@@ -778,17 +778,33 @@ def _write_full_record(record: dict) -> str:
 
     Returns the repo-relative filename (for the headline pointer), or a
     reason string if the write failed — the headline must never be lost to
-    a record-file IO error."""
-    path = os.path.join(
-        os.environ.get("CSMOM_BENCH_FULL_DIR", _REPO), FULL_RECORD_NAME
-    )
+    a record-file IO error.
+
+    A TOTAL failure (every attempt failed, value 0) never overwrites an
+    existing measured round record: an ad-hoc run on a dead tunnel (or
+    the driver's own run on a bad day) must not erase the round's
+    evidence.  The failure record lands under a ``_failed`` sibling name
+    instead, and the headline points there — both files tell the truth."""
+    name = FULL_RECORD_NAME
+    out_dir = os.environ.get("CSMOM_BENCH_FULL_DIR", _REPO)
+    if record.get("value") == 0.0 and (record.get("extra") or {}).get("error"):
+        main_path = os.path.join(out_dir, name)
+        try:
+            with open(main_path) as f:
+                existing = json.load(f).get("value")
+            if isinstance(existing, (int, float)) and existing > 0:
+                name = name.replace(".json", "_failed.json")
+        except Exception:
+            pass  # no measured record to protect: claim the main name
+                  # (never die here — the headline must still print)
+    path = os.path.join(out_dir, name)
     tmp = f"{path}.tmp{os.getpid()}"
     try:
         with open(tmp, "w") as f:
             json.dump(record, f, indent=1)
             f.write("\n")
         os.replace(tmp, path)
-        return FULL_RECORD_NAME
+        return name
     except OSError as e:
         # never leave a half-written .tmp at the repo root for the driver's
         # end-of-round auto-commit to sweep up

@@ -31,13 +31,15 @@ BENCH_MARK=/root/repo/BENCH_TPU_LAST.json
 SCALING_ART=/root/repo/SCALING_TPU_${ROUND}.json
 PHASES_ART=/root/repo/PHASES_TPU_${ROUND}.json
 START_TS=$(date +%s)
+# resolve BEFORE cd: a relative $0 from another cwd must still source
+LIB_DIR=$(cd "$(dirname "$0")" && pwd)
 cd /root/repo
 
 log() { echo "$(date -u +%FT%TZ) [$ROUND] $*" >> "$LOG"; }
 
 # land_artifact / promote_capture live in capture_lib.sh (sourced) so the
 # partial-vs-full landing rules are testable (tests/test_capture_lib.py).
-. "$(dirname "$0")"/capture_lib.sh
+. "$LIB_DIR"/capture_lib.sh || { echo "capture_lib.sh missing" >&2; exit 2; }
 
 bench_fresh() {
   # BENCH_TPU_LAST.json persists across rounds as bench.py's cache: only a

@@ -162,6 +162,38 @@ def test_child_deadline_dumps_partial_record():
     assert "grid16_rank_s" not in obj["extra"]
 
 
+def test_total_failure_never_clobbers_a_measured_round_record(bench, tmp_path, monkeypatch):
+    """An all-attempts-failed run (dead tunnel, tiny budget) must not erase
+    the round's measured full record: the failure lands under a _failed
+    sibling and the headline points there.  With no measured record to
+    protect, the failure claims the main name (the round still gets a
+    record)."""
+    monkeypatch.setenv("CSMOM_BENCH_FULL_DIR", str(tmp_path))
+    good = {"metric": "m", "value": 123.4, "unit": "u", "vs_baseline": 1.0,
+            "extra": {"platform": "cpu"}}
+    failed = {"metric": "m", "value": 0.0, "unit": "u", "vs_baseline": 0.0,
+              "extra": {"error": "all benchmark attempts failed"}}
+
+    # no existing record: the failure claims the main name
+    ref = bench._write_full_record(dict(failed))
+    assert ref == bench.FULL_RECORD_NAME
+
+    # measured record present: the failure is diverted to the sibling
+    (tmp_path / bench.FULL_RECORD_NAME).write_text(json.dumps(good))
+    ref = bench._write_full_record(dict(failed))
+    assert ref == bench.FULL_RECORD_NAME.replace(".json", "_failed.json")
+    kept = json.loads((tmp_path / bench.FULL_RECORD_NAME).read_text())
+    assert kept["value"] == 123.4
+    diverted = json.loads((tmp_path / ref).read_text())
+    assert diverted["value"] == 0.0
+
+    # a measured result always claims the main name
+    ref = bench._write_full_record(dict(good, value=555.5))
+    assert ref == bench.FULL_RECORD_NAME
+    assert json.loads(
+        (tmp_path / bench.FULL_RECORD_NAME).read_text())["value"] == 555.5
+
+
 def test_exhausted_budget_still_prints_valid_headline(tmp_path):
     """VERDICT r4 #8: a run whose probes/children all hit the budget
     ceiling must still emit one parseable, capped headline line AND write
