@@ -642,3 +642,41 @@ def test_cli_replicate_band_select(capsys, tmp_path):
                "0,9", "--out", str(tmp_path)])
     assert rc == 2
     assert "invalid widths" in capsys.readouterr().err
+
+
+def test_cli_run_chains_replicate_then_intraday(monkeypatch):
+    """`csmom run` is the reference's one-shot ``main()`` analogue
+    (``run_demo.py:193-207``): replicate first, intraday second, and a
+    failing monthly leg short-circuits (its rc propagates, the intraday
+    leg never starts)."""
+    import csmom_tpu.cli.main as climod
+
+    calls = []
+
+    def fake_replicate(args):
+        """stub (the parser reads each command fn's docstring)"""
+        calls.append("replicate")
+        return 0
+
+    def fake_intraday(args):
+        """stub"""
+        calls.append("intraday")
+        return 0
+
+    monkeypatch.setattr(climod, "cmd_replicate", fake_replicate)
+    monkeypatch.setattr(climod, "cmd_intraday", fake_intraday)
+    rc = main(["run", "--platform", "cpu"])
+    assert rc == 0
+    assert calls == ["replicate", "intraday"]
+
+    calls.clear()
+
+    def failing_replicate(args):
+        """stub"""
+        calls.append("replicate")
+        return 3
+
+    monkeypatch.setattr(climod, "cmd_replicate", failing_replicate)
+    rc = main(["run", "--platform", "cpu"])
+    assert rc == 3
+    assert calls == ["replicate"]  # intraday never ran
