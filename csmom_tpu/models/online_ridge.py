@@ -53,6 +53,12 @@ Design choices, stated plainly:
 
 Masked rows (``valid == False``) are true no-ops: they neither update the
 state nor receive a score.
+
+The building blocks (`_causal_scale`, `_row_sm_update`,
+`_row_moment_update`, `_make_row_step`, `_prequential_fit`) are module-
+level so the time-sharded sequence-parallel variant
+(:mod:`csmom_tpu.parallel.online_ridge`) runs the SAME per-row math
+inside each shard — only the carry seeding differs there.
 """
 
 from __future__ import annotations
@@ -74,6 +80,109 @@ class OnlineRidgeFit:
     cv_mse: jnp.ndarray      # f[n_splits] prequential MSE per contiguous block
     scores: jnp.ndarray      # f[A, R] strictly-causal one-step-ahead predictions
     n_train: jnp.ndarray     # i32 rows ever updated on (== n valid rows)
+
+
+def _causal_scale(X, cnt, mean, M2, standardize: bool):
+    """Scale a row's features by the moments of rows strictly before it."""
+    if not standardize:
+        return X
+    std = jnp.sqrt(jnp.maximum(M2 / jnp.maximum(cnt, 1.0), 1e-24))
+    std = jnp.where(std > 1e-12, std, 1.0)
+    return (X - mean) / std
+
+
+def _row_sm_update(P, b, Xa, yt, w):
+    """Fold one row's per-asset rank-1 Sherman-Morrison updates (masked)."""
+    def upd(a, Pb):
+        P_, b_ = Pb
+        xw = Xa[a] * w[a]  # w=0 zeroes the update exactly (Px=0, denom=1)
+        Px = P_ @ xw
+        return (P_ - jnp.outer(Px, Px) / (1.0 + xw @ Px), b_ + xw * yt[a])
+
+    return jax.lax.fori_loop(0, Xa.shape[0], upd, (P, b))
+
+
+def _row_moment_update(cnt, mean, M2, X, w):
+    """Fold one row's per-asset Welford updates on the RAW features."""
+    def upd_m(a, state):
+        cnt_, mean_, M2_ = state
+        cnt2 = cnt_ + w[a]
+        delta = X[a] - mean_
+        mean2 = mean_ + w[a] * delta / jnp.maximum(cnt2, 1.0)
+        M22 = M2_ + w[a] * delta * (X[a] - mean2)
+        return cnt2, mean2, M22
+
+    return jax.lax.fori_loop(0, X.shape[0], upd_m, (cnt, mean, M2))
+
+
+def _make_row_step(A: int, dt, burn_in: int, standardize: bool):
+    """The per-row scan step: score the whole row with the prior state,
+    then apply the row's updates.  Carry: ``(P, b, cnt, mean, M2)``."""
+    def step(carry, inp):
+        P, b, cnt, mean, M2 = carry
+        X, yt, w = inp  # X f[A, F], yt f[A], w f[A]
+        Xs = _causal_scale(X, cnt, mean, M2, standardize)
+        Xa = jnp.concatenate([Xs, jnp.ones((A, 1), dt)], axis=1)
+        # EVERY asset's row scored with the prior weights, before any of
+        # this row's labels touch the state (y[., r] is the r -> r+1
+        # return — updating asset A then scoring asset B would leak the
+        # contemporaneous future through cross-sectional correlation)
+        preds = Xa @ (P @ b)
+        P_new, b_new = _row_sm_update(P, b, Xa, yt, w)
+        cnt_new, mean_new, M2_new = _row_moment_update(cnt, mean, M2, X, w)
+        seen_enough = cnt >= burn_in  # prior count: the model behind preds
+        return (
+            (P_new, b_new, cnt_new, mean_new, M2_new),
+            (preds, jnp.broadcast_to(seen_enough, (A,))),
+        )
+
+    return step
+
+
+def _prequential_fit(
+    preds, seen, wr, yr, n_splits: int, w_final, cnt, mean, M2
+) -> OnlineRidgeFit:
+    """Assemble OnlineRidgeFit from scan outputs + final state.
+
+    ``preds/seen/wr/yr`` are time-major ``[R, A]``; ``w_final`` the final
+    augmented weights; ``(cnt, mean, M2)`` the final raw-feature moments.
+    """
+    R, A = preds.shape
+    dt = preds.dtype
+    F = mean.shape[0]
+
+    scored = (wr > 0) & seen  # bool[R, A]
+    preds = jnp.where(scored, preds, jnp.nan)
+    scores = jnp.swapaxes(preds, 0, 1)
+
+    # prequential MSE over n_splits contiguous blocks of scored rows
+    scored_f = scored.reshape(R * A)
+    yf = yr.reshape(R * A)
+    preds_f = preds.reshape(R * A)
+    ordinal = jnp.cumsum(scored_f) - 1
+    n_scored = jnp.sum(scored_f)
+    block = jnp.minimum(
+        (ordinal * n_splits) // jnp.maximum(n_scored, 1), n_splits - 1
+    )
+    err2 = jnp.where(scored_f, (jnp.nan_to_num(preds_f) - yf) ** 2, 0.0)
+
+    def block_mse(i):
+        wb = (scored_f & (block == i)).astype(dt)
+        return jnp.sum(wb * err2) / jnp.maximum(jnp.sum(wb), 1.0)
+
+    cv_mse = jnp.stack([block_mse(i) for i in range(n_splits)])
+
+    std = jnp.sqrt(jnp.maximum(M2 / jnp.maximum(cnt, 1.0), 1e-24))
+    std = jnp.where(std > 1e-12, std, 1.0)
+    return OnlineRidgeFit(
+        coef=w_final[:F],
+        intercept=w_final[F],
+        scale_mean=mean,
+        scale_std=std,
+        cv_mse=cv_mse,
+        scores=scores,
+        n_train=jnp.sum(wr).astype(jnp.int32),
+    )
 
 
 @partial(jax.jit, static_argnames=("n_splits", "burn_in", "standardize"))
@@ -109,97 +218,15 @@ def online_ridge_scores(
     yr = jnp.nan_to_num(jnp.swapaxes(y, 0, 1))         # f[R, A]
     wr = jnp.swapaxes(valid, 0, 1).astype(dt)          # f[R, A]
 
-    eye = jnp.eye(F + 1, dtype=dt)
-
-    def step(carry, inp):
-        P, b, cnt, mean, M2 = carry
-        X, yt, w = inp  # X f[A, F], yt f[A], w f[A]
-
-        # causal scaling by the moments of rows strictly BEFORE this one
-        if standardize:
-            std = jnp.sqrt(jnp.maximum(M2 / jnp.maximum(cnt, 1.0), 1e-24))
-            std = jnp.where(std > 1e-12, std, 1.0)
-            Xs = (X - mean) / std
-        else:
-            Xs = X
-        Xa = jnp.concatenate([Xs, jnp.ones((A, 1), dt)], axis=1)
-
-        # EVERY asset's row scored with the prior weights, before any of
-        # this row's labels touch the state (y[., r] is the r -> r+1
-        # return — updating asset A then scoring asset B would leak the
-        # contemporaneous future through cross-sectional correlation)
-        preds = Xa @ (P @ b)
-
-        # then this row's rank-1 Sherman-Morrison updates, masked by w
-        def upd(a, Pb):
-            P_, b_ = Pb
-            xw = Xa[a] * w[a]  # w=0 zeroes the update exactly
-            Px = P_ @ xw
-            return (P_ - jnp.outer(Px, Px) / (1.0 + xw @ Px),
-                    b_ + xw * yt[a])
-
-        P_new, b_new = jax.lax.fori_loop(0, A, upd, (P, b))
-
-        # Welford running moments on the RAW features, also post-scoring
-        def upd_m(a, state):
-            cnt_, mean_, M2_ = state
-            cnt2 = cnt_ + w[a]
-            delta = X[a] - mean_
-            mean2 = mean_ + w[a] * delta / jnp.maximum(cnt2, 1.0)
-            M22 = M2_ + w[a] * delta * (X[a] - mean2)
-            return cnt2, mean2, M22
-
-        cnt_new, mean_new, M2_new = jax.lax.fori_loop(
-            0, A, upd_m, (cnt, mean, M2)
-        )
-
-        seen_enough = cnt >= burn_in  # prior count: the model behind preds
-        return (
-            (P_new, b_new, cnt_new, mean_new, M2_new),
-            (preds, jnp.broadcast_to(seen_enough, (A,))),
-        )
-
     carry0 = (
-        eye / jnp.asarray(alpha, dt),
+        jnp.eye(F + 1, dtype=dt) / jnp.asarray(alpha, dt),
         jnp.zeros(F + 1, dt),
         jnp.zeros((), dt),
         jnp.zeros(F, dt),
         jnp.zeros(F, dt),
     )
+    step = _make_row_step(A, dt, burn_in, standardize)
     (P, b, cnt, mean, M2), (preds, seen) = jax.lax.scan(
         step, carry0, (Xr, yr, wr)
     )
-
-    scored = (wr > 0) & seen  # bool[R, A]
-    preds = jnp.where(scored, preds, jnp.nan)
-    scores = jnp.swapaxes(preds, 0, 1)
-
-    # prequential MSE over n_splits contiguous blocks of scored rows
-    scored_f = scored.reshape(R * A)
-    yf = yr.reshape(R * A)
-    preds_f = preds.reshape(R * A)
-    ordinal = jnp.cumsum(scored_f) - 1
-    n_scored = jnp.sum(scored_f)
-    block = jnp.minimum(
-        (ordinal * n_splits) // jnp.maximum(n_scored, 1), n_splits - 1
-    )
-    err2 = jnp.where(scored_f, (jnp.nan_to_num(preds_f) - yf) ** 2, 0.0)
-
-    def block_mse(i):
-        wb = (scored_f & (block == i)).astype(dt)
-        return jnp.sum(wb * err2) / jnp.maximum(jnp.sum(wb), 1.0)
-
-    cv_mse = jnp.stack([block_mse(i) for i in range(n_splits)])
-
-    w_final = P @ b
-    std = jnp.sqrt(jnp.maximum(M2 / jnp.maximum(cnt, 1.0), 1e-24))
-    std = jnp.where(std > 1e-12, std, 1.0)
-    return OnlineRidgeFit(
-        coef=w_final[:F],
-        intercept=w_final[F],
-        scale_mean=mean,
-        scale_std=std,
-        cv_mse=cv_mse,
-        scores=scores,
-        n_train=jnp.sum(wr).astype(jnp.int32),
-    )
+    return _prequential_fit(preds, seen, wr, yr, n_splits, P @ b, cnt, mean, M2)

@@ -33,12 +33,14 @@ from csmom_tpu.parallel.collectives import (
 )
 from csmom_tpu.parallel.bootstrap import sharded_block_bootstrap
 from csmom_tpu.parallel.event import sharded_event_backtest
+from csmom_tpu.parallel.online_ridge import time_sharded_online_ridge_scores
 from csmom_tpu.parallel.event_time import (
     time_sharded_event_backtest,
     time_sharded_hysteresis_backtest,
 )
 
 __all__ = [
+    "time_sharded_online_ridge_scores",
     "make_mesh",
     "auto_mesh",
     "make_hybrid_mesh",

@@ -1,0 +1,231 @@
+"""Sequence-parallel online ridge: the walk-forward scan, time-sharded.
+
+The single-device online ridge (:mod:`csmom_tpu.models.online_ridge`) is
+an R-step sequential scan — the classic long-context problem.  It
+parallelizes because everything the recursion carries is a sum of
+per-row contributions:
+
+- the regularized Gram ``G_t = sum w x x^T`` and label vector
+  ``b_t = sum w x y`` are plain additions, and
+- the raw-feature scaler moments ``(count, mean, M2)`` merge with
+  Chan's parallel-Welford formula,
+
+so each time shard can be seeded with an EXCLUSIVE prefix of tiny block
+summaries and then run the same per-row scan locally.  Three phases, all
+shard-local scans plus two ``all_gather``s of O(F^2) summaries over the
+``'time'`` mesh axis:
+
+1. **moment summaries** — each block computes its raw-feature
+   ``(count, mean, M2)`` in one batch pass; an exclusive Chan-merge fold
+   over the gathered summaries gives every block the scaler state it
+   inherits.
+2. **scaled Gram** — each block scans its rows (seeded with phase 1's
+   carry, so the causal scaling is identical to the sequential run)
+   accumulating its ``(dG, db)``; an exclusive prefix-sum gives every
+   block the Gram/label state it inherits.
+3. **local Sherman–Morrison** — each block seeds
+   ``P = inv(alpha I + G_carry)`` (ONE (F+1)x(F+1) inverse per shard —
+   this is what the rank-1 recursion avoids per row and what makes the
+   seed cheap per block) and runs the SAME row step as the single-device
+   scan (:func:`csmom_tpu.models.online_ridge._make_row_step`), emitting
+   strictly-causal predictions.
+
+The result is mathematically identical to the sequential scan (same
+Gram, same moments, same per-row updates — only float association
+differs at the seeds), pinned by an equality test on the virtual CPU
+mesh.  Wall-clock depth drops from O(R) to O(R / n_shards) + O(F^3).
+
+The reference has no analogue of any of this (single thread, no model
+beyond one sklearn fit — SURVEY §2 rows 9/14/15); this is the
+long-context treatment of the MODEL layer, sibling to the event
+engine's time sharding (:mod:`csmom_tpu.parallel.event_time`).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from csmom_tpu.models.online_ridge import (
+    OnlineRidgeFit,
+    _causal_scale,
+    _make_row_step,
+    _prequential_fit,
+    _row_moment_update,
+)
+from csmom_tpu.parallel.event_time import _exclusive_prefix_sum
+
+__all__ = ["time_sharded_online_ridge_scores"]
+
+
+def _block_moment_summary(Xb, wb):
+    """Batch ``(count, mean, M2)`` of this block's valid raw features.
+
+    ``Xb f[R_l, A, F]``, ``wb f[R_l, A]``; one pass, no scan — block
+    summaries are order-free (the variance of a set is not a chain).
+    """
+    cnt = jnp.sum(wb)
+    mean = jnp.einsum("ra,raf->f", wb, Xb) / jnp.maximum(cnt, 1.0)
+    M2 = jnp.einsum("ra,raf->f", wb, (Xb - mean) ** 2)
+    return cnt, mean, M2
+
+
+def _exclusive_moment_carry(cnt_b, mean_b, M2_b, axis_name: str):
+    """Chan-merge of all EARLIER blocks' moment summaries, in block order."""
+    g_cnt = lax.all_gather(cnt_b, axis_name)    # [nb]
+    g_mean = lax.all_gather(mean_b, axis_name)  # [nb, F]
+    g_M2 = lax.all_gather(M2_b, axis_name)      # [nb, F]
+    i = lax.axis_index(axis_name)
+    nb = g_cnt.shape[0]
+
+    def fold(j, st):
+        cnt, mean, M2 = st
+        n2, m2, M22 = g_cnt[j], g_mean[j], g_M2[j]
+        n = cnt + n2
+        delta = m2 - mean
+        merged = (
+            n,
+            mean + delta * n2 / jnp.maximum(n, 1.0),
+            M2 + M22 + delta * delta * cnt * n2 / jnp.maximum(n, 1.0),
+        )
+        take = j < i
+        return jax.tree_util.tree_map(
+            lambda new, old: jnp.where(take, new, old), merged, st
+        )
+
+    zero = (
+        jnp.zeros((), g_mean.dtype),
+        jnp.zeros(g_mean.shape[1], g_mean.dtype),
+        jnp.zeros(g_mean.shape[1], g_mean.dtype),
+    )
+    return lax.fori_loop(0, nb, fold, zero)
+
+
+@lru_cache(maxsize=32)
+def _compiled(mesh: Mesh, time_axis: str, A: int, F: int, dt,
+              alpha: float, burn_in: int, standardize: bool):
+    spec_x = P(time_axis, None, None)  # [R, A, F] sharded on rows
+    spec_v = P(time_axis, None)        # [R, A]
+
+    def block(Xb, yb, wb):
+        # phase 1: scaler state this block inherits
+        cnt_b, mean_b, M2_b = _block_moment_summary(Xb, wb)
+        cnt0, mean0, M20 = _exclusive_moment_carry(
+            cnt_b, mean_b, M2_b, time_axis
+        )
+
+        # phase 2: scaled Gram/label contribution of this block (seeded
+        # with the carry so the causal scaling equals the sequential run)
+        def gstep(carry, inp):
+            cnt, mean, M2, G, bsum = carry
+            X, yt, w = inp
+            Xs = _causal_scale(X, cnt, mean, M2, standardize)
+            Xa = jnp.concatenate([Xs, jnp.ones((A, 1), dt)], axis=1)
+            xw = Xa * w[:, None]
+            G = G + xw.T @ xw        # sum_a w * outer(x_a, x_a): order-free
+            bsum = bsum + xw.T @ yt
+            cnt, mean, M2 = _row_moment_update(cnt, mean, M2, X, w)
+            return (cnt, mean, M2, G, bsum), None
+
+        (_, _, _, dG, db), _ = lax.scan(
+            gstep,
+            (cnt0, mean0, M20,
+             jnp.zeros((F + 1, F + 1), dt), jnp.zeros(F + 1, dt)),
+            (Xb, yb, wb),
+        )
+        G0 = _exclusive_prefix_sum(dG, time_axis)
+        b0 = _exclusive_prefix_sum(db, time_axis)
+
+        # phase 3: the single-device row step, seeded.  inv() here is the
+        # one O(F^3) cost per shard that replaces R/n_shards rank-1 steps
+        # of sequential depth.
+        P0 = jnp.linalg.inv(
+            alpha * jnp.eye(F + 1, dtype=dt) + G0
+        )
+        step = _make_row_step(A, dt, burn_in, standardize)
+        (_, _, _, _, _), (preds, seen) = lax.scan(
+            step, (P0, b0, cnt0, mean0, M20), (Xb, yb, wb)
+        )
+
+        # full-history totals for the final fit, identical on every shard
+        G_tot = lax.psum(dG, time_axis)
+        b_tot = lax.psum(db, time_axis)
+        # inclusive moment merge = this block's own summary folded into
+        # its phase-1 exclusive carry (no second gather needed)
+        cnt_f, mean_f, M2_f = cnt0, mean0, M20
+        n2, m2, M22 = cnt_b, mean_b, M2_b
+        n = cnt_f + n2
+        delta = m2 - mean_f
+        cnt_f, mean_f, M2_f = (
+            n,
+            mean_f + delta * n2 / jnp.maximum(n, 1.0),
+            M2_f + M22 + delta * delta * cnt_f * n2 / jnp.maximum(n, 1.0),
+        )
+        # leading length-1 axis: shard_map stacks these per block along
+        # the time spec, and the caller takes the LAST block's (full
+        # history) values
+        return (preds, seen, G_tot, b_tot,
+                (cnt_f[None], mean_f[None], M2_f[None]))
+
+    return jax.jit(shard_map(
+        block,
+        mesh=mesh,
+        in_specs=(spec_x, spec_v, spec_v),
+        out_specs=(spec_v, spec_v, P(), P(),
+                   (P(time_axis), P(time_axis, None), P(time_axis, None))),
+        check_vma=False,
+    ))
+
+
+def time_sharded_online_ridge_scores(
+    features,
+    y,
+    valid,
+    mesh: Mesh,
+    time_axis: str = "time",
+    alpha: float = 1.0,
+    n_splits: int = 3,
+    burn_in: int = 30,
+    standardize: bool = True,
+) -> OnlineRidgeFit:
+    """Time-sharded walk-forward ridge, equal to the single-device scan.
+
+    Args mirror :func:`csmom_tpu.models.online_ridge.online_ridge_scores`
+    plus the mesh whose ``time_axis`` shards the row axis.  Rows are
+    padded to a multiple of the shard count with invalid no-op rows.
+    """
+    A, R, F = features.shape
+    dt = features.dtype
+    n_shards = mesh.shape[time_axis]
+
+    Xr = np.nan_to_num(np.swapaxes(np.asarray(features), 0, 1))  # [R, A, F]
+    yr = np.nan_to_num(np.swapaxes(np.asarray(y), 0, 1))
+    wr = np.swapaxes(np.asarray(valid), 0, 1).astype(dt)
+
+    pad = (-R) % n_shards
+    if pad:
+        Xr = np.concatenate([Xr, np.zeros((pad, A, F), Xr.dtype)], axis=0)
+        yr = np.concatenate([yr, np.zeros((pad, A), yr.dtype)], axis=0)
+        wr = np.concatenate([wr, np.zeros((pad, A), wr.dtype)], axis=0)
+
+    fn = _compiled(mesh, time_axis, A, F, dt, alpha, burn_in, standardize)
+    with mesh:
+        preds, seen, G_tot, b_tot, (cnt_f, mean_f, M2_f) = fn(
+            jnp.asarray(Xr), jnp.asarray(yr), jnp.asarray(wr)
+        )
+
+    # the LAST block's inclusive moment merge covers the full history
+    cnt_f, mean_f, M2_f = cnt_f[-1], mean_f[-1], M2_f[-1]
+    w_final = jnp.linalg.solve(
+        alpha * jnp.eye(F + 1, dtype=dt) + G_tot, b_tot
+    )
+    return _prequential_fit(
+        preds[:R], seen[:R], jnp.asarray(wr[:R]), jnp.asarray(yr[:R]),
+        n_splits, w_final, cnt_f, mean_f, M2_f,
+    )

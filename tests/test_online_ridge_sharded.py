@@ -1,0 +1,95 @@
+"""Time-sharded online ridge equals the single-device scan on a CPU mesh.
+
+The sequence-parallel decomposition (exclusive Chan/Gram carries + local
+Sherman-Morrison scans) is mathematically identical to the sequential
+recursion; these tests pin that across shard counts, padding, and
+standardization modes, plus the strict-causality property surviving the
+sharding.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from csmom_tpu.models.online_ridge import online_ridge_scores
+from csmom_tpu.parallel.mesh import make_mesh
+from csmom_tpu.parallel.online_ridge import time_sharded_online_ridge_scores
+
+# 8-device-mesh / compile-heavy: excluded from the default fast tier
+pytestmark = pytest.mark.slow
+
+
+def _panel(A=4, R=90, F=3, seed=0):
+    rng = np.random.default_rng(seed)
+    feats = rng.normal(size=(A, R, F))
+    y = rng.normal(scale=1e-2, size=(A, R))
+    valid = rng.random((A, R)) > 0.15
+    return feats, y, valid
+
+
+def _mesh(n):
+    return make_mesh(grid_axis=8 // n, axis_names=("grid", "time"))
+
+
+def _assert_fit_equal(got, ref, rtol=1e-8):
+    np.testing.assert_allclose(np.asarray(got.scores), np.asarray(ref.scores),
+                               rtol=rtol, atol=1e-12, equal_nan=True)
+    np.testing.assert_allclose(np.asarray(got.cv_mse), np.asarray(ref.cv_mse),
+                               rtol=rtol)
+    np.testing.assert_allclose(np.asarray(got.coef), np.asarray(ref.coef),
+                               rtol=1e-7, atol=1e-12)
+    np.testing.assert_allclose(float(got.intercept), float(ref.intercept),
+                               rtol=1e-6, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(got.scale_mean),
+                               np.asarray(ref.scale_mean), rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(got.scale_std),
+                               np.asarray(ref.scale_std), rtol=1e-8)
+    assert int(got.n_train) == int(ref.n_train)
+
+
+@pytest.mark.parametrize("standardize", [True, False])
+@pytest.mark.parametrize("n_shards", [2, 8])
+def test_sharded_equals_single_device(standardize, n_shards):
+    feats, y, valid = _panel()
+    ref = online_ridge_scores(jnp.asarray(feats), jnp.asarray(y),
+                              jnp.asarray(valid), alpha=0.7, burn_in=12,
+                              standardize=standardize)
+    mesh = _mesh(n_shards)
+    got = time_sharded_online_ridge_scores(
+        feats, y, valid, mesh=mesh, time_axis="time",
+        alpha=0.7, burn_in=12, standardize=standardize,
+    )
+    _assert_fit_equal(got, ref)
+
+
+def test_sharded_with_row_padding():
+    """R not divisible by the shard count: padded no-op rows change nothing."""
+    feats, y, valid = _panel(R=85, seed=1)  # 85 % 8 != 0
+    ref = online_ridge_scores(jnp.asarray(feats), jnp.asarray(y),
+                              jnp.asarray(valid), burn_in=10)
+    mesh = _mesh(8)
+    got = time_sharded_online_ridge_scores(
+        feats, y, valid, mesh=mesh, burn_in=10,
+    )
+    assert got.scores.shape == ref.scores.shape
+    _assert_fit_equal(got, ref)
+
+
+def test_sharded_is_still_strictly_causal():
+    """Perturbing a late row moves no earlier (or same-row other-asset)
+    score — the carries must not smuggle future labels backwards."""
+    feats, y, valid = _panel(seed=2)
+    valid[:, :] = True
+    mesh = _mesh(8)
+    base = time_sharded_online_ridge_scores(feats, y, valid, mesh=mesh,
+                                            burn_in=5)
+    r = 60  # inside a late shard
+    y2 = y.copy()
+    y2[0, r] += 1e3
+    pert = time_sharded_online_ridge_scores(feats, y2, valid, mesh=mesh,
+                                            burn_in=5)
+    np.testing.assert_array_equal(np.asarray(base.scores)[1:, r],
+                                  np.asarray(pert.scores)[1:, r])
+    np.testing.assert_array_equal(np.asarray(base.scores)[:, :r],
+                                  np.asarray(pert.scores)[:, :r])
