@@ -41,6 +41,7 @@ def worker(process_id: int) -> None:
         cluster_detection_method="deactivate",
     )
     import numpy as np
+    import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from csmom_tpu.backtest import banded_monthly_backtest, monthly_spread_backtest
@@ -72,6 +73,37 @@ def worker(process_id: int) -> None:
     jax.block_until_ready(b_spread)
     banded_wall = time.perf_counter() - t0
 
+    # sequence-parallel online ridge: the time axis sharded across BOTH
+    # processes (exclusive Chan/Gram carries + local Sherman-Morrison —
+    # csmom_tpu/parallel/online_ridge.py), gather_outputs=True so the
+    # replicated results are process-local readable
+    from csmom_tpu.models.online_ridge import online_ridge_scores
+    from csmom_tpu.parallel.online_ridge import _compiled as or_compiled
+
+    A_or, R_or, F_or = 4, 64, 3
+    rng_or = np.random.default_rng(SEED + 1)
+    feats = rng_or.normal(size=(A_or, R_or, F_or))
+    y_or = rng_or.normal(scale=1e-2, size=(A_or, R_or))
+    w_or = (rng_or.random((A_or, R_or)) > 0.1).astype(np.float64)
+
+    mesh_t = Mesh(np.array(jax.devices()), ("time",))
+    Xr = np.ascontiguousarray(np.swapaxes(feats, 0, 1))       # [R, A, F]
+    yr = np.ascontiguousarray(np.swapaxes(y_or, 0, 1))
+    wr = np.ascontiguousarray(np.swapaxes(w_or, 0, 1))
+    sh_x = NamedSharding(mesh_t, P("time", None, None))
+    sh_v = NamedSharding(mesh_t, P("time", None))
+    Xg = jax.make_array_from_callback(Xr.shape, sh_x, lambda i: Xr[i])
+    yg = jax.make_array_from_callback(yr.shape, sh_v, lambda i: yr[i])
+    wg = jax.make_array_from_callback(wr.shape, sh_v, lambda i: wr[i])
+
+    or_fn = or_compiled(mesh_t, "time", A_or, F_or, np.dtype(np.float64),
+                        0.8, 8, True, gather_outputs=True)
+    t0 = time.perf_counter()
+    with mesh_t:
+        preds_g, seen_g, _, _, _ = or_fn(Xg, yg, wg)
+    jax.block_until_ready(preds_g)
+    online_wall = time.perf_counter() - t0
+
     if process_id != 0:
         return
 
@@ -95,21 +127,43 @@ def worker(process_id: int) -> None:
     banded_equal = _eq(b_spread, sb.spread) and bool(
         abs(float(b_tnw) - float(sb.tstat_nw)) < 1e-11
     )
+
+    # cross-process online-ridge equality: same mask/NaN shaping as the
+    # single-device fit's scores (seeded rank-1 chain vs the sequential
+    # one differs only in float association at the block seeds)
+    or_single = online_ridge_scores(
+        jnp.asarray(feats), jnp.asarray(y_or), jnp.asarray(w_or > 0),
+        alpha=0.8, burn_in=8,
+    )
+    got_scores = np.where(
+        (np.asarray(wr) > 0) & np.asarray(seen_g),
+        np.asarray(preds_g), np.nan,
+    ).T
+    ref_scores = np.asarray(or_single.scores)
+    live_or = np.isfinite(ref_scores)
+    online_equal = bool(
+        np.array_equal(np.isfinite(got_scores), live_or)
+        and np.allclose(got_scores[live_or], ref_scores[live_or], rtol=1e-9)
+    )
     print(json.dumps({
         "metric": "multihost_sharded_equals_single",
-        "value": float(monthly_equal and banded_equal),
+        "value": float(monthly_equal and banded_equal and online_equal),
         "unit": "bool",
         "vs_baseline": 0.0,
         "extra": {
             "topology": f"{N_PROC} OS processes x {LOCAL_DEVICES} CPU "
                         "devices, jax.distributed + gloo TCP collectives",
             "workload": f"{A} assets x {M} months f64, masked lanes; "
-                        "monthly (qcut rank, all_gather + psum) and "
-                        "banded (band recursion + one psum), J=12 skip=1",
+                        "monthly (qcut rank, all_gather + psum), "
+                        "banded (band recursion + one psum), J=12 skip=1; "
+                        f"online ridge {A_or}x{R_or}x{F_or} time-sharded "
+                        "across both processes",
             "monthly_equal": monthly_equal,
             "banded_equal": banded_equal,
+            "online_ridge_equal": online_equal,
             "monthly_wall_s": round(monthly_wall, 3),
             "banded_wall_s": round(banded_wall, 3),
+            "online_ridge_wall_s": round(online_wall, 3),
             "note": "walls are compile-dominated one-shot runs, recorded "
                     "for provenance only; the payload of this capture is "
                     "the cross-process EQUALITY, which extends the "

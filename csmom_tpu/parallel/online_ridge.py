@@ -109,7 +109,13 @@ def _exclusive_moment_carry(cnt_b, mean_b, M2_b, axis_name: str):
 
 @lru_cache(maxsize=32)
 def _compiled(mesh: Mesh, time_axis: str, A: int, F: int, dt,
-              alpha: float, burn_in: int, standardize: bool):
+              alpha: float, burn_in: int, standardize: bool,
+              gather_outputs: bool = False):
+    # gather_outputs=True all_gathers every output over the time axis and
+    # returns them replicated (out_specs P()): the form a MULTI-PROCESS
+    # controller can read whole (process-local addressability), used by
+    # benchmarks/multihost_dryrun.py.  The default keeps outputs sharded —
+    # no gather traffic — for the single-controller wrapper below.
     spec_x = P(time_axis, None, None)  # [R, A, F] sharded on rows
     spec_v = P(time_axis, None)        # [R, A]
 
@@ -167,18 +173,30 @@ def _compiled(mesh: Mesh, time_axis: str, A: int, F: int, dt,
             mean_f + delta * n2 / jnp.maximum(n, 1.0),
             M2_f + M22 + delta * delta * cnt_f * n2 / jnp.maximum(n, 1.0),
         )
+        if gather_outputs:
+            preds_g = lax.all_gather(preds, time_axis).reshape(-1, A)
+            seen_g = lax.all_gather(seen, time_axis).reshape(-1, A)
+            # every block's inclusive merge, replicated; caller takes [-1]
+            cnt_g = lax.all_gather(cnt_f, time_axis)
+            mean_g = lax.all_gather(mean_f, time_axis)
+            M2_g = lax.all_gather(M2_f, time_axis)
+            return (preds_g, seen_g, G_tot, b_tot, (cnt_g, mean_g, M2_g))
         # leading length-1 axis: shard_map stacks these per block along
         # the time spec, and the caller takes the LAST block's (full
         # history) values
         return (preds, seen, G_tot, b_tot,
                 (cnt_f[None], mean_f[None], M2_f[None]))
 
+    if gather_outputs:
+        out_specs = (P(), P(), P(), P(), (P(), P(), P()))
+    else:
+        out_specs = (spec_v, spec_v, P(), P(),
+                     (P(time_axis), P(time_axis, None), P(time_axis, None)))
     return jax.jit(shard_map(
         block,
         mesh=mesh,
         in_specs=(spec_x, spec_v, spec_v),
-        out_specs=(spec_v, spec_v, P(), P(),
-                   (P(time_axis), P(time_axis, None), P(time_axis, None))),
+        out_specs=out_specs,
         check_vma=False,
     ))
 
