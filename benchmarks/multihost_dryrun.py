@@ -143,7 +143,10 @@ def worker(process_id: int) -> None:
     live_or = np.isfinite(ref_scores)
     online_equal = bool(
         np.array_equal(np.isfinite(got_scores), live_or)
-        and np.allclose(got_scores[live_or], ref_scores[live_or], rtol=1e-9)
+        # atol=0: allclose's default 1e-8 absolute slack would swamp the
+        # rtol on ~1e-3-magnitude scores and let a real carry bug pass
+        and np.allclose(got_scores[live_or], ref_scores[live_or],
+                        rtol=1e-9, atol=0.0)
     )
     print(json.dumps({
         "metric": "multihost_sharded_equals_single",

@@ -176,10 +176,15 @@ def _compiled(mesh: Mesh, time_axis: str, A: int, F: int, dt,
         if gather_outputs:
             preds_g = lax.all_gather(preds, time_axis).reshape(-1, A)
             seen_g = lax.all_gather(seen, time_axis).reshape(-1, A)
-            # every block's inclusive merge, replicated; caller takes [-1]
-            cnt_g = lax.all_gather(cnt_f, time_axis)
-            mean_g = lax.all_gather(mean_f, time_axis)
-            M2_g = lax.all_gather(M2_f, time_axis)
+            # full-history moments: the LAST block's inclusive merge,
+            # broadcast to every shard so the output is replicated (the
+            # multihost benchmark reads only preds/seen; a multi-process
+            # fit assembly would consume these)
+            nb = lax.psum(jnp.ones((), jnp.int32), time_axis)
+            is_last = lax.axis_index(time_axis) == nb - 1
+            cnt_g = lax.psum(jnp.where(is_last, cnt_f, 0.0), time_axis)
+            mean_g = lax.psum(jnp.where(is_last, mean_f, 0.0), time_axis)
+            M2_g = lax.psum(jnp.where(is_last, M2_f, 0.0), time_axis)
             return (preds_g, seen_g, G_tot, b_tot, (cnt_g, mean_g, M2_g))
         # leading length-1 axis: shard_map stacks these per block along
         # the time spec, and the caller takes the LAST block's (full
