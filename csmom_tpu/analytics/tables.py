@@ -229,11 +229,17 @@ def volume_horizon_table(vhp, group: int = 6) -> pd.DataFrame:
     return pd.DataFrame(rows).T
 
 
-def double_sort_table(ds, freq: int = 12) -> pd.DataFrame:
+def double_sort_table(ds, freq: int = 12,
+                      half_spread_bps: float | None = None) -> pd.DataFrame:
     """Momentum spread by volume tercile (paper Table II shape).
 
     Args:
       ds: :class:`csmom_tpu.backtest.double_sort.DoubleSortResult`.
+      half_spread_bps: when given, each tercile row also carries its book's
+        mean |dw| turnover, the spread net of linear costs at this
+        half-spread, and the break-even half-spread (the bps level at
+        which that tercile's gross mean is fully consumed) — the same
+        cost treatment the replicate/grid paths print.
 
     Returns a DataFrame indexed V1 (low volume) .. V{n} (high volume) with
     mean spread, Sharpe, t-stat, months, and the high-minus-low volume
@@ -246,8 +252,20 @@ def double_sort_table(ds, freq: int = 12) -> pd.DataFrame:
     names = tercile_labels(V)
     for v in range(V):
         x, m = _masked_rows(spreads[v], valid[v])
-        rows[names[v]] = _row_stats(x, m, freq)
+        r = _row_stats(x, m, freq)
+        if half_spread_bps is not None:
+            turn = np.asarray(ds.book_turnover, dtype=float)[v]
+            mt = float(np.mean(turn[valid[v]])) if valid[v].any() else np.nan
+            hs = half_spread_bps / 1e4
+            r["mean_turnover"] = mt
+            r["net_mean"] = r["mean_ret"] - hs * mt
+            r["be_bps"] = (r["mean_ret"] / mt * 1e4) if mt > 0 else np.nan
+        rows[names[v]] = r
     both = valid[V - 1] & valid[0]
     diff = np.where(both, spreads[V - 1] - spreads[0], np.nan)
-    rows[f"V{V}-V1"] = _row_stats(*_masked_rows(diff, both), freq)
+    drow = _row_stats(*_masked_rows(diff, both), freq)
+    if half_spread_bps is not None:
+        # the diff row is a comparison, not a tradable book
+        drow["mean_turnover"] = drow["net_mean"] = drow["be_bps"] = np.nan
+    rows[f"V{V}-V1"] = drow
     return pd.DataFrame(rows).T

@@ -24,6 +24,7 @@ from csmom_tpu.ops.ranking import decile_assign_panel
 from csmom_tpu.signals.momentum import momentum_dynamic, monthly_returns
 from csmom_tpu.signals.turnover import volume_tercile_labels
 from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat, nw_t_stat
+from csmom_tpu.costs.impact import long_short_weights, turnover_cost
 
 
 @jax.tree_util.register_dataclass
@@ -36,6 +37,9 @@ class DoubleSortResult:
     tstat: jnp.ndarray         # f[V] plain iid t-stat
     tstat_nw: jnp.ndarray      # f[V] Newey–West t-stat (paper Table II form)
     cell_counts: jnp.ndarray   # i32[V, 2, M] members in (bottom, top) cells
+    book_turnover: jnp.ndarray  # f[V, M] sum |dw| of the tercile's long-short
+                                # book (equal-weight legs; dead months hold
+                                # no book) — price at any half-spread later
 
 
 @partial(jax.jit, static_argnames=("n_bins", "n_vol_bins", "mode", "freq"))
@@ -92,9 +96,25 @@ def volume_double_sort(
         bot_r, bot_n = cell(0)
         valid = (top_n > 0) & (bot_n > 0)
         spread = jnp.where(valid, top_r - bot_r, jnp.nan)
-        return spread, valid, jnp.stack([bot_n, top_n]).astype(jnp.int32)
 
-    spreads, valids, counts = jax.vmap(per_tercile)(jnp.arange(n_vol_bins))
+        # the tercile's long-short book and its |dw| turnover, through the
+        # SAME weight/cost kernels every other cost path uses
+        # (costs/impact.py long_short_weights + turnover_cost) — the
+        # double-sort's net numbers can never diverge in convention
+        t_labels = jnp.where(in_v, mom_labels, -1)
+        counts_bm = (
+            jnp.zeros((n_bins,) + top_n.shape, top_n.dtype)
+            .at[0].set(bot_n)
+            .at[n_bins - 1].set(top_n)
+        )
+        w = long_short_weights(t_labels, counts_bm, n_bins)
+        turn = turnover_cost(w, half_spread=1.0)  # unit spread -> raw |dw|
+        return (spread, valid,
+                jnp.stack([bot_n, top_n]).astype(jnp.int32), turn)
+
+    spreads, valids, counts, turns = jax.vmap(per_tercile)(
+        jnp.arange(n_vol_bins)
+    )
     return DoubleSortResult(
         spreads=spreads,
         spread_valid=valids,
@@ -103,4 +123,5 @@ def volume_double_sort(
         tstat=t_stat(spreads, valids),
         tstat_nw=nw_t_stat(spreads, valids),
         cell_counts=counts,
+        book_turnover=turns,
     )

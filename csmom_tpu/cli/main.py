@@ -747,7 +747,11 @@ def cmd_doublesort(args) -> int:
     print("Momentum spread by volume tercile "
           f"(J={cfg.momentum.lookback}, skip={cfg.momentum.skip}, "
           f"turnover avg over {turn_lb} months):")
-    print(double_sort_table(res).round(4).to_string())
+    hs_bps = getattr(args, "tc_bps", None)
+    print(double_sort_table(res, half_spread_bps=hs_bps).round(4).to_string())
+    if hs_bps is not None:
+        print(f"(net_mean at {hs_bps:g} bps half-spread; be_bps = the cost "
+              "level that consumes each tercile's gross mean)")
     return 0
 
 
@@ -1392,11 +1396,16 @@ def build_parser() -> argparse.ArgumentParser:
                             help="print the full risk tearsheet (drawdown, "
                                  "Calmar, Sortino, tails; per-cell tables "
                                  "for grid)")
-        if "monthly_extras" in extra or "tc" in extra or "tc_bps" in extra:
+        if ("monthly_extras" in extra or "tc" in extra
+                or "tc_bps" in extra or "doublesort" in extra):
             if "tc_bps" in extra:  # the sweep: costs change the SELECTION
                 tc_help = ("select cells and report OOS performance NET of "
                            "linear transaction costs at this half-spread "
                            "(bps per unit weight turnover)")
+            elif "doublesort" in extra:
+                tc_help = ("also report each tercile's book turnover, the "
+                           "spread net of linear costs at this half-spread, "
+                           "and its break-even bps")
             else:
                 tc_help = ("also report the spread net of linear "
                            "transaction costs at this half-spread (bps per "

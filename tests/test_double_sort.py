@@ -69,3 +69,47 @@ def test_volume_amplifies_planted_momentum(rng):
     means = np.asarray(res.mean_spread)
     assert np.isfinite(means).all()
     assert means[2] > means[0], means
+
+
+def test_book_turnover_matches_weight_oracle(rng):
+    """Each tercile's book_turnover equals sum |dw| of the equal-weight
+    long-short book recomputed by loops from the same memberships (dead
+    months hold no book; the first live month pays full entry)."""
+    M, A = 48, 40
+    prices = pd.DataFrame(
+        50 * np.exp(np.cumsum(rng.normal(0.004, 0.08, (M, A)), axis=0))
+    )
+    turn = pd.DataFrame(rng.lognormal(-4, 1, size=(M, A)))
+    pv = prices.values.T
+    tv = turn.values.T
+    res = volume_double_sort(
+        pv, np.isfinite(pv), tv, np.isfinite(tv), lookback=6, skip=1
+    )
+
+    ret = prices.pct_change()
+    mom = prices.shift(1) / prices.shift(1 + 6) - 1
+    bad = ret.isna().astype(int)
+    wb = bad.shift(1).rolling(6, min_periods=6).sum()
+    mom = mom.where(wb == 0)
+
+    got_turn = np.asarray(res.book_turnover)
+    got_valid = np.asarray(res.spread_valid)
+    for v in range(3):
+        w_prev = np.zeros(A)
+        for s in range(M):
+            w = np.zeros(A)
+            if got_valid[v, s]:
+                mlab = oracle_deciles(mom.iloc[s].values)
+                both = (mlab >= 0) & turn.iloc[s].notna().values
+                vlab = oracle_deciles(
+                    np.where(both, turn.iloc[s].values, np.nan), n=3
+                )
+                nr = ret.iloc[s + 1].values if s + 1 < M else np.full(A, np.nan)
+                live = both & (vlab >= 0) & np.isfinite(nr)
+                top = live & (vlab == v) & (mlab == 9)
+                bot = live & (vlab == v) & (mlab == 0)
+                w[top] = 1.0 / top.sum()
+                w[bot] -= 1.0 / bot.sum()
+            want = np.abs(w - w_prev).sum()
+            np.testing.assert_allclose(got_turn[v, s], want, atol=1e-9)
+            w_prev = w
