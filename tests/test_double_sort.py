@@ -7,21 +7,29 @@ from csmom_tpu.backtest import volume_double_sort
 from tests.test_ranking import oracle_deciles
 
 
-def oracle_double_sort(prices: pd.DataFrame, turn: pd.DataFrame, J=6, skip=1, n_vol=3):
+def oracle_memberships(prices: pd.DataFrame, turn: pd.DataFrame,
+                       s: int, J=6, skip=1, n_vol=3):
+    """Month s's (mlab, vlab, live, next_ret) — the ONE oracle rendering of
+    the engine's sort convention, shared by the spread and turnover tests
+    so the two cannot drift apart."""
     ret = prices.pct_change()
     mom = prices.shift(skip) / prices.shift(skip + J) - 1
     bad = ret.isna().astype(int)
     wb = bad.shift(skip).rolling(J, min_periods=J).sum()
     mom = mom.where(wb == 0)
-
-    out = {v: {} for v in range(n_vol)}
+    mlab = oracle_deciles(mom.iloc[s].values)
+    both = (mlab >= 0) & turn.iloc[s].notna().values
+    vlab = oracle_deciles(np.where(both, turn.iloc[s].values, np.nan), n=n_vol)
     M = len(prices)
-    for s in range(M - 1):
-        mlab = oracle_deciles(mom.iloc[s].values)
-        both = (mlab >= 0) & turn.iloc[s].notna().values
-        vlab = oracle_deciles(np.where(both, turn.iloc[s].values, np.nan), n=n_vol)
-        nr = ret.iloc[s + 1].values
-        live = both & (vlab >= 0) & np.isfinite(nr)
+    nr = ret.iloc[s + 1].values if s + 1 < M else np.full(prices.shape[1], np.nan)
+    live = both & (vlab >= 0) & np.isfinite(nr)
+    return mlab, vlab, live, nr
+
+
+def oracle_double_sort(prices: pd.DataFrame, turn: pd.DataFrame, J=6, skip=1, n_vol=3):
+    out = {v: {} for v in range(n_vol)}
+    for s in range(len(prices) - 1):
+        mlab, vlab, live, nr = oracle_memberships(prices, turn, s, J, skip, n_vol)
         for v in range(n_vol):
             top = live & (vlab == v) & (mlab == 9)
             bot = live & (vlab == v) & (mlab == 0)
@@ -86,12 +94,6 @@ def test_book_turnover_matches_weight_oracle(rng):
         pv, np.isfinite(pv), tv, np.isfinite(tv), lookback=6, skip=1
     )
 
-    ret = prices.pct_change()
-    mom = prices.shift(1) / prices.shift(1 + 6) - 1
-    bad = ret.isna().astype(int)
-    wb = bad.shift(1).rolling(6, min_periods=6).sum()
-    mom = mom.where(wb == 0)
-
     got_turn = np.asarray(res.book_turnover)
     got_valid = np.asarray(res.spread_valid)
     for v in range(3):
@@ -99,13 +101,7 @@ def test_book_turnover_matches_weight_oracle(rng):
         for s in range(M):
             w = np.zeros(A)
             if got_valid[v, s]:
-                mlab = oracle_deciles(mom.iloc[s].values)
-                both = (mlab >= 0) & turn.iloc[s].notna().values
-                vlab = oracle_deciles(
-                    np.where(both, turn.iloc[s].values, np.nan), n=3
-                )
-                nr = ret.iloc[s + 1].values if s + 1 < M else np.full(A, np.nan)
-                live = both & (vlab >= 0) & np.isfinite(nr)
+                mlab, vlab, live, _ = oracle_memberships(prices, turn, s)
                 top = live & (vlab == v) & (mlab == 9)
                 bot = live & (vlab == v) & (mlab == 0)
                 w[top] = 1.0 / top.sum()

@@ -48,6 +48,16 @@ EVENT = {
     "cv_mse": [1.111000906788e-06, 1.028217201301e-06, 1.515819594342e-06],
     "n_train": 21_828,
 }
+# same minute frame through the causal model (--model online_ridge): the
+# Sherman-Morrison scan, causal scaler, burn-in gating, and prequential
+# blocks all pinned offline (computed 2026-07-31, f64, xla cpu)
+ONLINE = {
+    "n_trades": 28_545,
+    "total_pnl": -12_923.9031903070,
+    "final_cash": 1_270_969.0140300414,
+    "cv_mse": [1.284104689967e-06, 1.622457984344e-06, 1.779746464592e-06],
+    "n_train": 31_184,
+}
 
 
 def _monthly_panel():
@@ -80,8 +90,8 @@ def test_monthly_pipeline_golden():
     np.testing.assert_allclose(cum, MONTHLY["cum_return"], rtol=1e-9)
 
 
-def test_event_pipeline_golden():
-    from csmom_tpu.api import intraday_pipeline, synthetic_minute_frame
+def _synthetic_minutes():
+    from csmom_tpu.api import synthetic_minute_frame
 
     daily = synthetic_daily_panel(8, 10, seed=77)
     a, t = len(daily.tickers), len(daily.times)
@@ -97,6 +107,13 @@ def test_event_pipeline_golden():
     )
     minute_df = synthetic_minute_frame(df, seed=5)
     assert len(minute_df) == a * t * 390
+    return minute_df, df
+
+
+def test_event_pipeline_golden():
+    from csmom_tpu.api import intraday_pipeline
+
+    minute_df, df = _synthetic_minutes()
     res, fit, compact, *_ = intraday_pipeline(minute_df, df)
 
     # the trade count is the fingerprint: every threshold crossing, exactly
@@ -142,4 +159,26 @@ def test_csv_universe_golden():
     np.testing.assert_allclose(
         float(nw_t_stat(res.spread, res.spread_valid)), 0.249081731114,
         rtol=1e-9,
+    )
+
+
+def test_online_ridge_pipeline_golden():
+    """The causal model's offline fingerprint: one different trade, one
+    shifted burn-in row, or one changed prequential block fails this on a
+    bare checkout."""
+    from csmom_tpu.api import intraday_pipeline
+
+    minute_df, df = _synthetic_minutes()
+    res, fit, compact, *_ = intraday_pipeline(
+        minute_df, df, model="online_ridge"
+    )
+    assert int(res.n_trades) == ONLINE["n_trades"]
+    np.testing.assert_allclose(
+        float(res.total_pnl), ONLINE["total_pnl"], rtol=1e-9
+    )
+    final_cash = float(np.asarray(res.cash).reshape(-1)[-1])
+    np.testing.assert_allclose(final_cash, ONLINE["final_cash"], rtol=1e-9)
+    assert int(fit.n_train) == ONLINE["n_train"]
+    np.testing.assert_allclose(
+        np.asarray(fit.cv_mse, dtype=np.float64), ONLINE["cv_mse"], rtol=1e-8
     )
