@@ -93,3 +93,33 @@ def test_sharded_is_still_strictly_causal():
                                   np.asarray(pert.scores)[1:, r])
     np.testing.assert_array_equal(np.asarray(base.scores)[:, :r],
                                   np.asarray(pert.scores)[:, :r])
+
+
+def test_gather_outputs_mode_equals_sharded_default():
+    """gather_outputs=True (the multi-process readable form) returns the
+    same predictions as the default sharded path, replicated."""
+    import numpy as np
+
+    from csmom_tpu.parallel.online_ridge import _compiled
+
+    feats, y, valid = _panel(R=88, seed=3)  # 88 % 8 == 0: no padding
+    A, R, F = feats.shape
+    mesh = _mesh(8)
+    ref = time_sharded_online_ridge_scores(feats, y, valid, mesh=mesh,
+                                           burn_in=9)
+
+    Xr = np.ascontiguousarray(np.swapaxes(feats, 0, 1))
+    yr = np.ascontiguousarray(np.swapaxes(y, 0, 1))
+    wr = np.ascontiguousarray(np.swapaxes(valid, 0, 1)).astype(feats.dtype)
+    fn = _compiled(mesh, "time", A, F, feats.dtype, 1.0, 9, True,
+                   gather_outputs=True)
+    with mesh:
+        preds, seen, G_tot, b_tot, (cnt_f, mean_f, M2_f) = fn(
+            jnp.asarray(Xr), jnp.asarray(yr), jnp.asarray(wr)
+        )
+    got = np.where((wr > 0) & np.asarray(seen), np.asarray(preds), np.nan).T
+    np.testing.assert_array_equal(got, np.asarray(ref.scores))
+    # the gathered moments are the full history's (drive the scaler state)
+    np.testing.assert_allclose(float(cnt_f), float(valid.sum()), rtol=0)
+    np.testing.assert_allclose(np.asarray(mean_f), np.asarray(ref.scale_mean),
+                               rtol=1e-9)
