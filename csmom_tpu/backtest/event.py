@@ -123,6 +123,23 @@ def market_fill_prices(exec_base, side, traded, impact, spread):
     )
 
 
+
+def _settlement_fill_idx(valid, latency_bars: int):
+    """The engine's latency fill rule: first valid row at or after
+    decision + latency, per asset (reverse running min over the event
+    mask).  Shared by :func:`event_backtest` and :func:`cost_attribution`
+    so the TCA can never attribute against a different settlement bar
+    than the engine filled at.  Returns i32[A, T]; T marks "no such row"
+    (the engine treats those as unfillable)."""
+    T = valid.shape[1]
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    nxt = jax.lax.associative_scan(
+        jnp.minimum, jnp.where(valid, t_idx[None, :], T), axis=1, reverse=True
+    )
+    target = jnp.clip(t_idx + latency_bars, 0, T - 1)
+    return nxt[:, target]
+
+
 @partial(jax.jit, static_argnames=("size_shares", "latency_bars", "order_type", "axis_name"))
 def event_backtest(
     price,
@@ -199,12 +216,7 @@ def event_backtest(
 
     t_idx = jnp.arange(T, dtype=jnp.int32)
     if latency_bars > 0:
-        # first event row at or after t, per asset (reverse running min)
-        nxt = jax.lax.associative_scan(
-            jnp.minimum, jnp.where(valid, t_idx[None, :], T), axis=1, reverse=True
-        )
-        target = jnp.clip(t_idx + latency_bars, 0, T - 1)
-        fill_idx = nxt[:, target]                          # i32[A, T]
+        fill_idx = _settlement_fill_idx(valid, latency_bars)  # i32[A, T]
         fillable = traded & (t_idx[None, :] + latency_bars <= T - 1) & (fill_idx < T)
         side = jnp.where(fillable, side, 0)
         traded = side != 0
@@ -418,49 +430,55 @@ class CostAttribution:
     """Execution-cost decomposition of an event backtest (all scalars).
 
     ``total_cost`` is exact in any order mode (signed slippage of every
-    fill against the same-bar mid); the spread/impact split is the market
-    -fill formula's decomposition (``execution_models.py:9-12``:
+    fill against the DECISION-bar mid — the implementation-shortfall
+    benchmark); the spread/impact split is the market-fill formula's
+    decomposition (``execution_models.py:9-12``:
     ``exec = mid * (1 + side*(spread/2 + impact))``), so ``residual`` is
     ~0 for market orders and absorbs the difference for limit fills
     (which can earn, not pay, the half-spread).
+
+    With ``latency_bars > 0`` the shortfall additionally carries
+    ``delay_cost`` — the market's signed move from the decision-bar mid
+    to the settlement-bar mid, the part of the shortfall that is drift
+    during the delay rather than execution: ``total = delay + spread +
+    impact + residual`` in every mode (``delay_cost == 0`` at latency 0).
     """
 
-    gross_pnl: jnp.ndarray      # f[] PnL had every fill been at mid
+    gross_pnl: jnp.ndarray      # f[] PnL had every fill been at decision mid
     net_pnl: jnp.ndarray        # f[] realized PnL (== EventResult.total_pnl)
-    total_cost: jnp.ndarray     # f[] gross - net
+    total_cost: jnp.ndarray     # f[] gross - net (implementation shortfall)
+    delay_cost: jnp.ndarray     # f[] decision->settlement mid drift leg
     spread_cost: jnp.ndarray    # f[] half-spread leg of the fill formula
     impact_cost: jnp.ndarray    # f[] sqrt-impact leg
-    residual: jnp.ndarray       # f[] total - spread - impact
-    gross_notional: jnp.ndarray # f[] sum of |size| * mid over fills
+    residual: jnp.ndarray       # f[] total - delay - spread - impact
+    gross_notional: jnp.ndarray # f[] sum of |size| * decision mid over fills
     cost_bps: jnp.ndarray       # f[] total_cost / gross_notional * 1e4
 
 
 def cost_attribution(result: EventResult, price, size_shares: int = 50,
                      spread: float = 0.001,
-                     latency_bars: int = 0) -> CostAttribution:
+                     latency_bars: int = 0, valid=None) -> CostAttribution:
     """Decompose an :class:`EventResult` into gross PnL and cost legs.
 
     Args:
       result: the backtest output.
       price: f[A, T] the same mid-price panel the backtest ran on.
       size_shares / spread: the constants the backtest ran with.
-      latency_bars: must echo the backtest's value, and must be 0 — with
-        delayed fills the result stores exec prices against *decision*
-        cells, so slippage against the decision-bar mid conflates market
-        drift during the delay with execution cost; raising here is the
-        loud guard against confidently-wrong TCA on latency runs.
+      latency_bars: must echo the backtest's value.  With a delay, the
+        shortfall against the decision-bar mid is decomposed into the
+        drift leg (decision mid -> settlement mid, ``delay_cost``) and
+        the execution legs measured against the SETTLEMENT-bar mid —
+        the standard implementation-shortfall treatment; ``valid`` is
+        required to recompute the engine's settlement bars.
+      valid: bool[A, T] the backtest's event mask (latency runs only —
+        settlement bars are the next valid rows, ``event_backtest``'s
+        own fill rule).
 
     The reference's analytics never separate costs from alpha even though
     its trade log stores the impact leg per fill
     (``run_demo.py:188-189``); this is the standard TCA summary built
     from the same panel outputs.
     """
-    if latency_bars:
-        raise NotImplementedError(
-            "cost_attribution requires latency_bars=0 runs: EventResult "
-            "stores fills at decision cells, so a delayed fill's slippage "
-            "against the decision-bar mid would mix drift into cost"
-        )
     side = result.trade_side.astype(price.dtype)   # signed units (flips ±2)
     units = jnp.abs(side)
     traded = result.trade_side != 0
@@ -468,12 +486,29 @@ def cost_attribution(result: EventResult, price, size_shares: int = 50,
     fill = jnp.where(traded, jnp.nan_to_num(result.exec_price), 0.0)
     sz = jnp.asarray(size_shares, price.dtype)
 
-    # exact: signed slippage against the same-bar mid, per UNIT — a
+    if latency_bars:
+        if valid is None:
+            raise ValueError(
+                "cost_attribution with latency_bars > 0 needs the "
+                "backtest's `valid` mask to recompute settlement bars"
+            )
+        # the engine's own settlement rule, via the shared helper
+        T = price.shape[1]
+        fill_idx = jnp.clip(_settlement_fill_idx(valid, latency_bars), 0, T - 1)
+        settle_mid = jnp.take_along_axis(jnp.nan_to_num(price), fill_idx, axis=1)
+        settle_mid = jnp.where(traded, settle_mid, 0.0)
+    else:
+        settle_mid = mid
+
+    # exact: signed slippage against the DECISION-bar mid, per UNIT — a
     # hysteresis flip (2 units at one fill price) costs twice
     total_cost = jnp.sum((fill - mid) * side) * sz
-    # formula split (market fills): mid * (spread/2 + impact_a) per share
-    spread_cost = jnp.sum(mid * units) * (spread / 2.0) * sz
-    impact_cost = jnp.sum(mid * result.impact[:, None] * units) * sz
+    # drift during the delay: decision mid -> settlement mid (0 at lat=0)
+    delay_cost = jnp.sum((settle_mid - mid) * side) * sz
+    # formula split (market fills) against the mid the fill was priced
+    # off: settle_mid * (spread/2 + impact_a) per share
+    spread_cost = jnp.sum(settle_mid * units) * (spread / 2.0) * sz
+    impact_cost = jnp.sum(settle_mid * result.impact[:, None] * units) * sz
 
     gross_notional = jnp.sum(mid * units) * sz
     net = result.total_pnl
@@ -481,9 +516,10 @@ def cost_attribution(result: EventResult, price, size_shares: int = 50,
         gross_pnl=net + total_cost,
         net_pnl=net,
         total_cost=total_cost,
+        delay_cost=delay_cost,
         spread_cost=spread_cost,
         impact_cost=impact_cost,
-        residual=total_cost - spread_cost - impact_cost,
+        residual=total_cost - delay_cost - spread_cost - impact_cost,
         gross_notional=gross_notional,
         cost_bps=jnp.where(
             gross_notional > 0, total_cost / gross_notional * 1e4, jnp.nan
@@ -509,8 +545,8 @@ def threshold_sweep(price, valid, score, adv, vol, thresholds, **kwargs):
     Returns ``(total_pnl f[N], n_trades i32[N], cost_bps f[N])`` —
     ``cost_bps`` is :func:`cost_attribution`'s total slippage over gross
     mid notional per threshold (NaN where nothing traded).  Latency runs
-    raise, via the same guard: delayed fills cannot be attributed against
-    the decision-bar mid.
+    attribute through the implementation-shortfall path (drift +
+    execution legs; the engine's ``valid`` mask is in scope here).
     """
     thresholds = jnp.asarray(thresholds)
     size_shares = kwargs.get("size_shares", 50)
@@ -522,7 +558,8 @@ def threshold_sweep(price, valid, score, adv, vol, thresholds, **kwargs):
         r = event_backtest(price, valid, score, adv, vol, threshold=th,
                            **kwargs)
         tca = cost_attribution(r, price, size_shares=size_shares,
-                               spread=spread, latency_bars=latency_bars)
+                               spread=spread, latency_bars=latency_bars,
+                               valid=valid)
         return r.total_pnl, r.n_trades, tca.cost_bps
 
     return jax.vmap(one)(thresholds)
