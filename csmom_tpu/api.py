@@ -193,6 +193,7 @@ def intraday_pipeline(
     dtype=np.float64,
     model: str = "ridge",
     l1_ratio: float = 0.5,
+    latency_bars: int = 0,
 ):
     """Minute bars -> features -> model scores -> event backtest.
 
@@ -306,5 +307,6 @@ def intraday_pipeline(
         size_shares=size_shares,
         threshold=threshold,
         cash0=cash0,
+        latency_bars=latency_bars,
     )
     return result, fit, compact, dense_score, dense_price, dense_valid

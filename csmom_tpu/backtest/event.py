@@ -486,7 +486,7 @@ def cost_attribution(result: EventResult, price, size_shares: int = 50,
     fill = jnp.where(traded, jnp.nan_to_num(result.exec_price), 0.0)
     sz = jnp.asarray(size_shares, price.dtype)
 
-    if latency_bars:
+    if latency_bars > 0:  # same gate as the engine: <=0 means same-bar fills
         if valid is None:
             raise ValueError(
                 "cost_attribution with latency_bars > 0 needs the "

@@ -836,6 +836,10 @@ def cmd_intraday(args) -> int:
               f"headers or fetch-cache marker lines): "
               f"{','.join(lost) or 'none'}")
     daily_df = load_daily(cfg.universe.data_dir, daily_tickers)
+    lat = getattr(args, "latency_bars", None) or 0
+    if lat < 0:
+        print("--latency-bars must be >= 0", file=sys.stderr)
+        return 2
     model = getattr(args, "model", None) or "ridge"
     if getattr(args, "alpha", None) is not None:
         alpha = args.alpha
@@ -861,6 +865,7 @@ def cmd_intraday(args) -> int:
         threshold=cfg.intraday.threshold,
         cash0=cfg.intraday.cash0,
         model=model,
+        latency_bars=lat,
         **extra,
     )
     if model == "online_ridge":
@@ -880,10 +885,13 @@ def cmd_intraday(args) -> int:
 
     bar = np.asarray(res.bar_mask)
     tca = cost_attribution(res, dense_price,
-                           size_shares=cfg.intraday.size_shares)
+                           size_shares=cfg.intraday.size_shares,
+                           latency_bars=lat, valid=dense_valid)
+    delay_leg = (f"delay drift ${float(tca.delay_cost):,.2f}, "
+                 if lat else "")
     print(f"Costs:       ${float(tca.total_cost):,.2f} "
           f"({float(tca.cost_bps):.2f} bps of ${float(tca.gross_notional):,.0f}"
-          f" traded; spread ${float(tca.spread_cost):,.2f}, "
+          f" traded; {delay_leg}spread ${float(tca.spread_cost):,.2f}, "
           f"impact ${float(tca.impact_cost):,.2f}) — "
           f"gross PnL ${float(tca.gross_pnl):,.2f}")
 
@@ -907,7 +915,7 @@ def cmd_intraday(args) -> int:
             dense_price, dense_valid, np.nan_to_num(np.asarray(dense_score)),
             np.asarray(adv), np.asarray(vol),
             np.asarray(ths), size_shares=cfg.intraday.size_shares,
-            cash0=cfg.intraday.cash0,
+            cash0=cfg.intraday.cash0, latency_bars=lat,
         )
         print("\nthreshold sensitivity (one vmapped call):")
         print(f"{'threshold':>12} {'trades':>8} {'PnL':>16} {'cost bps':>9}")
@@ -1520,6 +1528,12 @@ def build_parser() -> argparse.ArgumentParser:
                                  "< this, hold in between (cuts intraday "
                                  "churn; reports trades/PnL vs the plain "
                                  "engine)")
+            sp.add_argument("--latency-bars", dest="latency_bars",
+                            type=int, metavar="N",
+                            help="order-to-fill delay in bars (fills at the "
+                                 "next valid row >= decision+N; the cost "
+                                 "print adds the delay-drift leg of the "
+                                 "implementation shortfall)")
             sp.add_argument("--parity", action="store_true",
                             help="reproduce the reference's EFFECTIVE daily "
                                  "risk-map universe (drop dialect-B caches "
