@@ -402,7 +402,12 @@ def _hysteresis_body(price, valid, score, adv, vol, threshold_hi,
 def trades_dataframe(result: EventResult, tickers, times, score, size_shares: int = 50):
     """Reconstruct the reference's trade log (``results/trades.csv`` schema:
     datetime,ticker,size,price,impact,score — sorted by datetime then ticker,
-    which is the backtester's row order, backtester.py:9).  Host-side."""
+    which is the backtester's row order, backtester.py:9).  Host-side.
+
+    Latency runs: rows are DECISION bars (datetime/score are the order's),
+    while ``price`` is the delayed fill — an order blotter, not a print
+    tape; the settlement bar is recoverable via
+    :func:`_settlement_fill_idx` on the run's ``valid`` mask."""
     import pandas as pd
 
     side = np.asarray(result.trade_side)
