@@ -140,6 +140,45 @@ def _settlement_fill_idx(valid, latency_bars: int):
     return nxt[:, target]
 
 
+
+def _apply_latency(price, valid, units, latency_bars: int):
+    """Shared delayed-fill plumbing for both intraday engines.
+
+    ``units i32[A, T]`` are the signed trade units decided per cell (the
+    threshold engine's side, the hysteresis engine's delta).  Returns
+    ``(kept_units, fill_idx, exec_base)``: decisions whose settlement row
+    (first valid row >= decision + latency) does not exist are dropped,
+    and ``exec_base`` is the settlement-bar price gathered back onto the
+    decision cells.  ``latency_bars == 0`` is the identity (same-bar)."""
+    A, T = price.shape
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    if latency_bars <= 0:
+        return units, jnp.broadcast_to(t_idx[None, :], (A, T)), jnp.nan_to_num(price)
+    fill_idx = _settlement_fill_idx(valid, latency_bars)
+    fillable = ((units != 0)
+                & (t_idx[None, :] + latency_bars <= T - 1)
+                & (fill_idx < T))
+    units = jnp.where(fillable, units, 0)
+    fill_idx = jnp.clip(fill_idx, 0, T - 1)
+    exec_base = jnp.take_along_axis(jnp.nan_to_num(price), fill_idx, axis=1)
+    return units, fill_idx, exec_base
+
+
+def _scatter_settle(shares, fill, fill_idx, latency_bars: int, dtype):
+    """Scatter decided shares/notional onto their settlement rows (identity
+    at latency 0).  Shared by both engines for the same no-drift reason as
+    :func:`_settlement_fill_idx`."""
+    if latency_bars <= 0:
+        return shares, fill * shares.astype(dtype)
+    A, T = shares.shape
+    rows = jnp.arange(A)[:, None]
+    shares_settle = jnp.zeros((A, T), jnp.int32).at[rows, fill_idx].add(shares)
+    notional_settle = (
+        jnp.zeros((A, T), dtype).at[rows, fill_idx].add(fill * shares.astype(dtype))
+    )
+    return shares_settle, notional_settle
+
+
 @partial(jax.jit, static_argnames=("size_shares", "latency_bars", "order_type", "axis_name"))
 def event_backtest(
     price,
@@ -215,16 +254,8 @@ def event_backtest(
     )
 
     t_idx = jnp.arange(T, dtype=jnp.int32)
-    if latency_bars > 0:
-        fill_idx = _settlement_fill_idx(valid, latency_bars)  # i32[A, T]
-        fillable = traded & (t_idx[None, :] + latency_bars <= T - 1) & (fill_idx < T)
-        side = jnp.where(fillable, side, 0)
-        traded = side != 0
-        fill_idx = jnp.clip(fill_idx, 0, T - 1)
-        exec_base = jnp.take_along_axis(jnp.nan_to_num(price), fill_idx, axis=1)
-    else:
-        fill_idx = jnp.broadcast_to(t_idx[None, :], (A, T))
-        exec_base = jnp.nan_to_num(price)
+    side, fill_idx, exec_base = _apply_latency(price, valid, side, latency_bars)
+    traded = side != 0
 
     if order_type == "limit":
         fill = jnp.where(traded, limit_fill_price(exec_base, aggressiveness, spread), 0.0)
@@ -232,16 +263,9 @@ def event_backtest(
         fill = market_fill_prices(exec_base, side, traded, impact, spread)
 
     shares = side * size_shares                       # i32[A, T] at decision rows
-    if latency_bars > 0:
-        # settle at fill time: scatter-add shares/notional onto fill rows
-        rows = jnp.arange(A)[:, None]
-        shares_settle = jnp.zeros((A, T), jnp.int32).at[rows, fill_idx].add(shares)
-        notional_settle = (
-            jnp.zeros((A, T), dtype).at[rows, fill_idx].add(fill * shares.astype(dtype))
-        )
-    else:
-        shares_settle = shares
-        notional_settle = fill * shares.astype(dtype)
+    shares_settle, notional_settle = _scatter_settle(
+        shares, fill, fill_idx, latency_bars, dtype
+    )
 
     return _settle_mark_and_wrap(
         price, valid, shares_settle, notional_settle, side, fill, traded,
@@ -311,6 +335,7 @@ def hysteresis_event_backtest(
     size_shares: int = 50,
     cash0: float = 1_000_000.0,
     spread: float = 0.001,
+    latency_bars: int = 0,
 ) -> EventResult:
     """Event backtest with a Schmitt-trigger position state per asset.
 
@@ -346,6 +371,14 @@ def hysteresis_event_backtest(
     thresholds would hit the host-side ``float()`` — vmap
     ``_hysteresis_body`` directly for that (and validate the grid
     yourself), the same pattern as :func:`threshold_sweep`.
+
+    With ``latency_bars > 0`` each state-change trade settles at the next
+    valid row >= decision + latency (the threshold engine's rule, via the
+    shared :func:`_settlement_fill_idx`); unfillable tail decisions are
+    dropped, and because the deltas telescope, the position path still
+    sums to the decided target wherever settlement completes.  The
+    time-sharded variant (:mod:`csmom_tpu.parallel.event_time`) remains
+    latency-0 only.
     """
     if float(threshold_lo) > float(threshold_hi):
         raise ValueError(
@@ -353,12 +386,14 @@ def hysteresis_event_backtest(
             "the exit threshold must not exceed the entry threshold"
         )
     return _hysteresis_body(price, valid, score, adv, vol, threshold_hi,
-                            threshold_lo, size_shares, cash0, spread)
+                            threshold_lo, size_shares, cash0, spread,
+                            latency_bars)
 
 
-@partial(jax.jit, static_argnames=("size_shares",))
+@partial(jax.jit, static_argnames=("size_shares", "latency_bars"))
 def _hysteresis_body(price, valid, score, adv, vol, threshold_hi,
-                     threshold_lo, size_shares, cash0, spread) -> EventResult:
+                     threshold_lo, size_shares, cash0, spread,
+                     latency_bars: int = 0) -> EventResult:
     A, T = price.shape
     dtype = price.dtype
     t_idx = jnp.arange(T, dtype=jnp.int32)
@@ -378,6 +413,12 @@ def _hysteresis_body(price, valid, score, adv, vol, threshold_hi,
 
     prev_target = jnp.pad(target, ((0, 0), (1, 0)))[:, :T]
     delta = target - prev_target                    # i32[A, T], in {-2..2}
+
+    # shared settlement rule: fills land at the next valid row >=
+    # decision + latency; unfillable tail decisions are dropped (the
+    # deltas telescope, so kept positions still sum to the decided target)
+    delta, fill_idx, exec_base = _apply_latency(price, valid, delta, latency_bars)
+
     sgn = jnp.sign(delta).astype(jnp.int32)         # fill-price direction
     traded = sgn != 0
 
@@ -385,17 +426,18 @@ def _hysteresis_body(price, valid, score, adv, vol, threshold_hi,
         jnp.asarray(float(size_shares), dtype), adv.astype(dtype),
         vol.astype(dtype),
     )
-    fill = market_fill_prices(jnp.nan_to_num(price), sgn, traded, impact,
-                              spread)
+    fill = market_fill_prices(exec_base, sgn, traded, impact, spread)
     shares = delta * size_shares
-    notional = fill * shares.astype(dtype)
+    shares_settle, notional_settle = _scatter_settle(
+        shares, fill, fill_idx, latency_bars, dtype
+    )
     # the stored side is the SIGNED UNIT COUNT (delta: flips are ±2) so
     # cost_attribution and trades_dataframe see the true trade size; the
     # fill PRICE above uses only the direction (the market-fill formula's
     # side is ±1 — execution_models.py:9-12)
     return _settle_mark_and_wrap(
-        price, valid, shares, notional, delta, fill, traded, impact, cash0,
-        lambda x: x,
+        price, valid, shares_settle, notional_settle, delta, fill, traded,
+        impact, cash0, lambda x: x,
     )
 
 

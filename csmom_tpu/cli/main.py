@@ -938,6 +938,7 @@ def cmd_intraday(args) -> int:
             np.asarray(adv), np.asarray(vol),
             threshold_hi=hi, threshold_lo=args.threshold_lo,
             size_shares=cfg.intraday.size_shares, cash0=cfg.intraday.cash0,
+            latency_bars=lat,
         )
         print(f"\nhysteresis trigger (enter |score|>{hi:g}, exit "
               f"|score|<{args.threshold_lo:g}, bounded 1-unit book):")

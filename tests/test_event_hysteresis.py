@@ -133,3 +133,65 @@ def test_flip_reports_two_units(rng):
     np.testing.assert_allclose(float(tca.gross_notional), 4 * 50 * 100.0)
     # exact slippage == formula split (market fills): residual ~ 0
     np.testing.assert_allclose(float(tca.residual), 0.0, atol=1e-9)
+
+
+def test_latency_settles_at_next_valid_row(rng):
+    """Delayed hysteresis fills: per-trade loop oracle — each kept
+    decision's shares land at the first valid row >= decision+L at that
+    row's fill price; tail decisions with no settlement row are dropped;
+    positions are the cumsum of settled shares."""
+    price, valid, score, adv, vol = _workload(rng)
+    hi, lo, L, sz = 1.2e-4, 4e-5, 3, 50
+    res = hysteresis_event_backtest(price, valid, score, adv, vol,
+                                    threshold_hi=hi, threshold_lo=lo,
+                                    size_shares=sz, latency_bars=L)
+    A, T = price.shape
+    tgt = _oracle_states(valid, score, hi, lo)
+    delta = np.diff(np.pad(tgt, ((0, 0), (1, 0))), axis=1)
+    imp = np.asarray(square_root_impact(float(sz), adv, vol))
+
+    shares_settle = np.zeros((A, T))
+    notional = np.zeros((A, T))
+    kept = np.zeros((A, T), np.int32)
+    for a in range(A):
+        vrows = np.where(valid[a])[0]
+        for t in np.where(delta[a] != 0)[0]:
+            if t + L > T - 1:
+                continue
+            later = vrows[vrows >= t + L]
+            if len(later) == 0:
+                continue
+            f = later[0]
+            sgn = np.sign(delta[a, t])
+            px = price[a, f] * (1 + sgn * (0.001 / 2 + imp[a]))
+            shares_settle[a, f] += delta[a, t] * sz
+            notional[a, f] += px * delta[a, t] * sz
+            kept[a, t] = delta[a, t]
+    np.testing.assert_array_equal(
+        np.asarray(res.positions), np.cumsum(shares_settle, axis=1)
+    )
+    np.testing.assert_array_equal(np.asarray(res.trade_side), kept)
+    # cash path: cash0 - cumulative settled notional
+    np.testing.assert_allclose(
+        np.asarray(res.cash),
+        1_000_000.0 - np.cumsum(notional.sum(axis=0)),
+        rtol=1e-12,
+    )
+
+
+def test_latency_tca_on_hysteresis(rng):
+    """Shortfall decomposition holds for the ±2-unit flips under delay."""
+    from csmom_tpu.backtest import cost_attribution
+
+    price, valid, score, adv, vol = _workload(rng)
+    res = hysteresis_event_backtest(price, valid, score, adv, vol,
+                                    threshold_hi=1.2e-4, threshold_lo=4e-5,
+                                    size_shares=50, latency_bars=2)
+    if int(res.n_trades) == 0:
+        pytest.skip("no trades under this seed")
+    tca = cost_attribution(res, price, latency_bars=2, valid=valid)
+    assert float(tca.gross_pnl) == pytest.approx(
+        float(tca.net_pnl) + float(tca.total_cost), abs=1e-9
+    )
+    scale = max(1.0, abs(float(tca.total_cost)))
+    assert abs(float(tca.residual)) < 1e-9 * scale
