@@ -71,10 +71,15 @@ def run_monthly(
         same tail, so callers never branch on signal choice.
       sector_ids: optional i32[A] sector id per asset (negative =
         unclassified, excluded from ranking) with ``n_sectors`` the id
-        count — switches the TPU engine to sector-neutral ranking
-        (BASELINE config 3).  Not supported with ``strategy`` or the
-        pandas backend.
+        count (required, >= 1) — switches the TPU engine to
+        sector-neutral ranking (BASELINE config 3), with or without a
+        ``strategy`` (any plugged-in signal ranks within sectors).  Not
+        supported on the pandas backend.
     """
+    if sector_ids is not None and (n_sectors is None or int(n_sectors) < 1):
+        raise ValueError(
+            "sector_ids requires n_sectors >= 1 (the sector id count)"
+        )
     if strategy is None and panels:
         raise TypeError(
             f"unexpected keyword arguments {sorted(panels)} — extra panels are "
@@ -93,10 +98,10 @@ def run_monthly(
                 "— misspelled? A strategy's **panels catch-all exists to ignore "
                 "panels other strategies need, not to swallow typos."
             )
-    if sector_ids is not None and (strategy is not None or backend != "tpu"):
+    if sector_ids is not None and backend != "tpu":
         raise NotImplementedError(
-            "sector-neutral ranking runs on the TPU engine's built-in "
-            "momentum path only (no strategy=, backend='tpu')"
+            "sector-neutral ranking runs on the TPU engine only "
+            "(backend='tpu'; works with or without strategy=)"
         )
     if backend == "tpu":
         from csmom_tpu.backtest import monthly_spread_backtest
@@ -105,8 +110,15 @@ def run_monthly(
         if strategy is not None:
             from csmom_tpu.strategy import strategy_backtest
 
+            sector_kw = {}
+            if sector_ids is not None:
+                sector_kw = dict(
+                    sector_ids=np.asarray(sector_ids, np.int32),
+                    n_sectors=int(n_sectors),
+                )
             res = strategy_backtest(
-                v, m, strategy, n_bins=n_bins, mode=mode, freq=freq, **panels
+                v, m, strategy, n_bins=n_bins, mode=mode, freq=freq,
+                **sector_kw, **panels,
             )
         elif sector_ids is not None:
             from csmom_tpu.backtest import sector_neutral_backtest

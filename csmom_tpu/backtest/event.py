@@ -253,7 +253,6 @@ def event_backtest(
         jnp.asarray(float(size_shares), dtype), adv.astype(dtype), vol.astype(dtype)
     )
 
-    t_idx = jnp.arange(T, dtype=jnp.int32)
     side, fill_idx, exec_base = _apply_latency(price, valid, side, latency_bars)
     traded = side != 0
 

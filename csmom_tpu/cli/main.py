@@ -170,14 +170,15 @@ def cmd_replicate(args) -> int:
         panels = {k: v for k, v in offered.items() if k in allowed}
     sector_kw = {}
     if getattr(args, "sector_map", None):
-        if strategy is not None or cfg.backend != "tpu":
-            print("--sector-map needs the TPU engine's built-in momentum "
-                  "path (drop --strategy / --backend pandas)",
+        if cfg.backend != "tpu":
+            print("--sector-map needs the TPU engine (drop "
+                  "--backend pandas); any --strategy plugin works",
                   file=sys.stderr)
             return 2
         ids, n_sectors = _load_sector_map(args.sector_map, prices.tickers)
         sector_kw = {"sector_ids": ids, "n_sectors": n_sectors}
-        print(f"sector-neutral ranking: {n_sectors} sectors")
+        print(f"sector-neutral ranking: {n_sectors} sectors"
+              + (f" (signal: {args.strategy})" if strategy is not None else ""))
     # --band/--band-sweep: validate BEFORE the plain run so misuse really
     # does fail fast; validity rule lives once in banded.validate_band.
     # The band applies to WHATEVER labels the plain run produces — built-in

@@ -23,7 +23,8 @@ from csmom_tpu.strategy.base import Strategy
 __all__ = ["strategy_backtest", "strategy_backtest_pandas"]
 
 
-@partial(jax.jit, static_argnames=("strategy", "n_bins", "mode", "freq", "impl"))
+@partial(jax.jit, static_argnames=("strategy", "n_bins", "mode", "freq",
+                                   "impl", "n_sectors"))
 def strategy_backtest(
     prices,
     mask,
@@ -32,6 +33,8 @@ def strategy_backtest(
     mode: str = "qcut",
     freq: int = 12,
     impl: str = "xla",
+    sector_ids=None,
+    n_sectors: int | None = None,
     **panels,
 ) -> MonthlyResult:
     """Monthly decile backtest of an arbitrary plugged-in strategy.
@@ -39,12 +42,25 @@ def strategy_backtest(
     Args:
       prices: f[A, M] month-end prices; mask: bool[A, M].
       strategy: hashable :class:`Strategy`; compiled once per instance.
+      sector_ids / n_sectors: when given, the strategy's scores rank
+        WITHIN each sector (``sector_decile_assign_panel``) and the
+        pooled extreme bins form the legs — sector-neutral ranking for
+        ANY plugged-in signal, the same labeler the built-in momentum
+        sector engine uses.  ``sector_ids`` is i32[A]; negative ids are
+        excluded from ranking.
       **panels: extra named panels forwarded to ``strategy.signal`` (e.g.
         ``volumes=``, ``volumes_mask=``).
     """
     ret, ret_valid = monthly_returns(prices, mask)
     score, valid = strategy.signal(prices, mask, **panels)
-    labels, _ = decile_assign_panel(score, valid, n_bins=n_bins, mode=mode)
+    if sector_ids is not None:
+        from csmom_tpu.ops.ranking import sector_decile_assign_panel
+
+        labels, _ = sector_decile_assign_panel(
+            score, valid, sector_ids, n_sectors, n_bins=n_bins, mode=mode
+        )
+    else:
+        labels, _ = decile_assign_panel(score, valid, n_bins=n_bins, mode=mode)
     return _assemble_result(ret, ret_valid, labels, n_bins, freq, impl=impl)
 
 

@@ -248,9 +248,23 @@ def test_run_monthly_sector_guards(rng):
     ids = np.zeros(A, np.int32)
     with pytest.raises(NotImplementedError, match="sector"):
         run_monthly(panel, backend="pandas", sector_ids=ids, n_sectors=1)
-    with pytest.raises(NotImplementedError, match="sector"):
-        run_monthly(panel, strategy=make_strategy("momentum"),
-                    sector_ids=ids, n_sectors=1)
+    # strategy + sector on the TPU backend is now supported: with the
+    # built-in momentum strategy it must equal the dedicated sector engine
+    from csmom_tpu.backtest import sector_neutral_backtest
+
+    ids = np.arange(A, dtype=np.int32) % 3
+    rep = run_monthly(panel, strategy=make_strategy("momentum"),
+                      sector_ids=ids, n_sectors=3)
+    want = sector_neutral_backtest(prices, np.ones((A, M), bool), ids, 3,
+                                   lookback=12, skip=1)
+    got_spread = np.asarray(rep.spread)
+    want_spread = np.where(np.asarray(want.spread_valid),
+                           np.asarray(want.spread), np.nan)
+    np.testing.assert_array_equal(np.isfinite(got_spread),
+                                  np.isfinite(want_spread))
+    live = np.isfinite(want_spread)
+    np.testing.assert_allclose(got_spread[live], want_spread[live],
+                               rtol=0, atol=0)
 
 
 @requires_reference
