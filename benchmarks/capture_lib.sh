@@ -4,8 +4,10 @@
 # Contract:
 #  - land_artifact RAW ART: extract RAW's last JSON line into ART.
 #    Refuses to overwrite an existing ART — unless ART is a PARTIAL
-#    (deadline-hit dump) and the new line is FULL: a partial is
-#    provisional evidence, never a blocker for its own upgrade.
+#    (deadline-hit dump) and the new line is FULL, or both are partials
+#    and the new one measured STRICTLY more rows/phases: a partial is
+#    provisional evidence, never a blocker for its own upgrade, and a
+#    richer deadline-hit capture upgrades a thinner one.
 #  - promote_capture NAME RAW ART: a finished RAW.tmp with a FULL
 #    summary claims RAW (the done-marker the watcher loop checks); a
 #    PARTIAL one is kept aside as RAW.partial and landed provisionally,
@@ -13,12 +15,38 @@
 #
 # Callers define log() (tunnel_watch.sh logs to its file; tests stub it).
 
+_measured_rows() {  # stdin: one JSON record -> its measured-row count
+  # a capture's substance is its measurement list ("rows" for the scaling
+  # sweep, "phases" for the phase profile); unparseable or listless -> 0
+  python -c '
+import json, sys
+try:
+    d = json.load(sys.stdin)
+except Exception:
+    print(0); raise SystemExit
+for k in ("rows", "phases"):
+    if isinstance(d.get(k), list):
+        print(len(d[k])); break
+else:
+    print(0)' 2>/dev/null || echo 0
+}
+
 land_artifact() {  # $1 raw log, $2 committed artifact path
   new_line=$(grep '^{' "$1" | tail -1)
   if [ -s "$2" ]; then
-    if grep -q '"partial":' "$2" \
-        && ! printf '%s' "$new_line" | grep -q '"partial":'; then
-      log "artifact $2 is a partial — upgrading with full capture"
+    if grep -q '"partial":' "$2"; then
+      if ! printf '%s' "$new_line" | grep -q '"partial":'; then
+        log "artifact $2 is a partial — upgrading with full capture"
+      else
+        old_rows=$(_measured_rows < "$2")
+        new_rows=$(printf '%s' "$new_line" | _measured_rows)
+        if [ "$new_rows" -gt "$old_rows" ] 2>/dev/null; then
+          log "artifact $2 is a partial ($old_rows rows) — upgrading with richer partial ($new_rows rows)"
+        else
+          log "artifact $2 already exists — refusing to overwrite"
+          return 0
+        fi
+      fi
     else
       log "artifact $2 already exists — refusing to overwrite"
       return 0

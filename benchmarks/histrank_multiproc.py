@@ -55,7 +55,7 @@ def worker(process_id: int) -> None:
     )
     import numpy as np
     import jax.numpy as jnp
-    from jax import shard_map
+    from csmom_tpu.parallel.compat import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from csmom_tpu.parallel.collectives import _ranked_labels_local

@@ -255,7 +255,12 @@ def double_sort_table(ds, freq: int = 12,
         r = _row_stats(x, m, freq)
         if half_spread_bps is not None:
             turn = np.asarray(ds.book_turnover, dtype=float)[v]
-            mt = float(np.mean(turn[valid[v]])) if valid[v].any() else np.nan
+            # average over every month with book ACTIVITY, not just valid
+            # months: a full-book unwind lands its |dw| on the first month
+            # the book goes invalid, and dropping those months understates
+            # turnover — overstating net_mean and be_bps
+            active = valid[v] | (np.nan_to_num(turn) > 0)
+            mt = float(np.mean(turn[active])) if active.any() else np.nan
             hs = half_spread_bps / 1e4
             r["mean_turnover"] = mt
             r["net_mean"] = r["mean_ret"] - hs * mt

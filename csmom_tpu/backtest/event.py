@@ -179,8 +179,10 @@ def _scatter_settle(shares, fill, fill_idx, latency_bars: int, dtype):
     return shares_settle, notional_settle
 
 
-@partial(jax.jit, static_argnames=("size_shares", "latency_bars", "order_type", "axis_name"))
-def event_backtest(
+_EVENT_STATICS = ("size_shares", "latency_bars", "order_type", "axis_name")
+
+
+def _event_backtest_impl(
     price,
     valid,
     score,
@@ -272,6 +274,21 @@ def event_backtest(
     )
 
 
+# One body, two jit wrappings: ``event_backtest`` (the public engine — every
+# caller that reuses its panels, including the vmapped threshold sweep and
+# the sharded wrappers) and ``event_backtest_donated``, which donates the
+# [A, T] price/valid/score panels so XLA reuses their memory for the
+# engine's prefix-sum intermediates.  Donation cannot be toggled per-call on
+# one jit; callers of the donated form give up their input buffers
+# (``.is_deleted()`` afterwards) in exchange for the smaller peak footprint.
+event_backtest = partial(
+    jax.jit, static_argnames=_EVENT_STATICS
+)(_event_backtest_impl)
+event_backtest_donated = jax.jit(
+    _event_backtest_impl, static_argnames=_EVENT_STATICS, donate_argnums=(0, 1, 2)
+)
+
+
 def _settle_mark_and_wrap(price, valid, shares_settle, notional_settle,
                           side, fill, traded, impact, cash0, allsum):
     """Shared tail of every event engine: settled shares/notional ->
@@ -335,6 +352,7 @@ def hysteresis_event_backtest(
     cash0: float = 1_000_000.0,
     spread: float = 0.001,
     latency_bars: int = 0,
+    donate_panels: bool = False,
 ) -> EventResult:
     """Event backtest with a Schmitt-trigger position state per asset.
 
@@ -384,15 +402,17 @@ def hysteresis_event_backtest(
             f"threshold_lo={threshold_lo} > threshold_hi={threshold_hi}: "
             "the exit threshold must not exceed the entry threshold"
         )
-    return _hysteresis_body(price, valid, score, adv, vol, threshold_hi,
-                            threshold_lo, size_shares, cash0, spread,
-                            latency_bars)
+    # donate_panels: same contract as event_backtest_donated — the caller's
+    # price/valid/score buffers are deleted on return
+    body = _hysteresis_body_donated if donate_panels else _hysteresis_body
+    return body(price, valid, score, adv, vol, threshold_hi,
+                threshold_lo, size_shares, cash0, spread,
+                latency_bars)
 
 
-@partial(jax.jit, static_argnames=("size_shares", "latency_bars"))
-def _hysteresis_body(price, valid, score, adv, vol, threshold_hi,
-                     threshold_lo, size_shares, cash0, spread,
-                     latency_bars: int = 0) -> EventResult:
+def _hysteresis_body_impl(price, valid, score, adv, vol, threshold_hi,
+                          threshold_lo, size_shares, cash0, spread,
+                          latency_bars: int = 0) -> EventResult:
     A, T = price.shape
     dtype = price.dtype
     t_idx = jnp.arange(T, dtype=jnp.int32)
@@ -438,6 +458,13 @@ def _hysteresis_body(price, valid, score, adv, vol, threshold_hi,
         price, valid, shares_settle, notional_settle, delta, fill, traded,
         impact, cash0, lambda x: x,
     )
+
+
+_HYST_STATICS = ("size_shares", "latency_bars")
+_hysteresis_body = jax.jit(_hysteresis_body_impl, static_argnames=_HYST_STATICS)
+_hysteresis_body_donated = jax.jit(
+    _hysteresis_body_impl, static_argnames=_HYST_STATICS, donate_argnums=(0, 1, 2)
+)
 
 
 def trades_dataframe(result: EventResult, tickers, times, score, size_shares: int = 50):

@@ -263,6 +263,7 @@ def jk_grid_backtest(
     max_hold: int | None = None,
     freq: int = 12,
     impl: str = "xla",
+    donate_panels: bool = False,
 ) -> GridResult:
     """Run the full J x K momentum grid in one compiled call.
 
@@ -280,16 +281,29 @@ def jk_grid_backtest(
         (same with bf16 operands / f32 accumulation — opt-in reduced
         precision for the MXU fast path), or 'pallas' (fused VMEM kernel,
         TPU).
+      donate_panels: donate the ``prices``/``mask`` device buffers to the
+        call (``donate_argnums``) — at the north star the panel pair is
+        the largest allocation on chip.  XLA realizes donation as
+        input-output aliasing, so how much memory it actually reclaims is
+        backend-dependent (the grid's outputs are [nJ, nK, M]-shaped, so
+        current XLA may decline the alias with a "donated buffers were
+        not usable" warning); what the flag GUARANTEES is the contract:
+        the caller must treat its arrays as consumed after the call, and
+        a loop re-feeding the same panels (bench's timed reps) must keep
+        the default.
     """
     max_hold = validate_grid_args(Ks, max_hold)
-    return _jk_grid_backtest(
+    fn = _jk_grid_backtest_donated if donate_panels else _jk_grid_backtest
+    return fn(
         prices, mask, Js, Ks, skip=skip, n_bins=n_bins, mode=mode,
         max_hold=max_hold, freq=freq, impl=impl,
     )
 
 
-@partial(jax.jit, static_argnames=("n_bins", "mode", "max_hold", "freq", "impl"))
-def _jk_grid_backtest(
+_GRID_STATICS = ("n_bins", "mode", "max_hold", "freq", "impl")
+
+
+def _jk_grid_backtest_impl(
     prices, mask, Js, Ks, skip, n_bins, mode, max_hold, freq, impl="xla"
 ) -> GridResult:
     Js = jnp.asarray(Js)
@@ -320,8 +334,19 @@ def _jk_grid_backtest(
     )
 
 
+# two jit wrappings of ONE body: the hot path must offer buffer donation
+# (the AOT warm-start pipeline's dispatch-hygiene leg) without breaking the
+# many callers that reuse their panels across calls — donation cannot be
+# toggled per-call on a single jit, so the public wrapper picks the variant
+_jk_grid_backtest = jax.jit(_jk_grid_backtest_impl, static_argnames=_GRID_STATICS)
+_jk_grid_backtest_donated = jax.jit(
+    _jk_grid_backtest_impl, static_argnames=_GRID_STATICS, donate_argnums=(0, 1)
+)
+
+
 def grid_net_of_costs(prices, mask, grid: GridResult,
-                      half_spread: float = 0.0005, freq: int = 12):
+                      half_spread: float = 0.0005, freq: int = 12,
+                      donate_panels: bool = False):
     """Cost-netted J x K grid: exact overlapping-portfolio turnover.
 
     The month-m (J, K) portfolio is the 1/K average of the K most recent
@@ -374,7 +399,12 @@ def grid_net_of_costs(prices, mask, grid: GridResult,
             "trace — materialize the GridResult first, then net costs"
         )
     Ks_c = tuple(int(k) for k in np.asarray(grid.Ks))
-    return _grid_net_core(
+    # donate_panels: the netting pass re-ranks the full panel, so its
+    # prices/mask buffers are as donation-worthy as the grid's.  jnp.asarray
+    # of a HOST array commits a fresh device buffer (safe to donate); only a
+    # caller handing over live DEVICE panels gives up its copies.
+    fn = _grid_net_core_donated if donate_panels else _grid_net_core
+    return fn(
         jnp.asarray(prices), jnp.asarray(mask), jnp.asarray(grid.Js),
         grid.spreads, grid.spread_valid, half_spread,
         Ks_c=Ks_c, skip=int(np.asarray(grid.skip)), n_bins=grid.n_bins,
@@ -448,9 +478,12 @@ def grid_net_from_unit(grid: GridResult, unit: GridResult,
     )
 
 
-@partial(jax.jit, static_argnames=("Ks_c", "skip", "n_bins", "mode", "freq"))
-def _grid_net_core(prices, mask, Js, spreads, spread_valid, half_spread,
-                   Ks_c: tuple, skip: int, n_bins: int, mode: str, freq: int):
+_NET_STATICS = ("Ks_c", "skip", "n_bins", "mode", "freq")
+
+
+def _grid_net_core_impl(prices, mask, Js, spreads, spread_valid, half_spread,
+                        Ks_c: tuple, skip: int, n_bins: int, mode: str,
+                        freq: int):
     from csmom_tpu.costs.impact import long_short_weights, turnover_cost
     from csmom_tpu.ops.rolling import _windowed_prefix_diff
 
@@ -500,3 +533,9 @@ def _grid_net_core(prices, mask, Js, spreads, spread_valid, half_spread,
         n_bins=n_bins,
         mode=mode,
     )
+
+
+_grid_net_core = jax.jit(_grid_net_core_impl, static_argnames=_NET_STATICS)
+_grid_net_core_donated = jax.jit(
+    _grid_net_core_impl, static_argnames=_NET_STATICS, donate_argnums=(0, 1)
+)

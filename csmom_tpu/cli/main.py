@@ -1177,6 +1177,67 @@ def cmd_bench(args) -> int:
     return subprocess.call([sys.executable, bench])
 
 
+def cmd_warmup(args) -> int:
+    """AOT-compile the hot-path shape manifest into the persistent cache.
+
+    The warm-start half of the bench pipeline: enumerates every hot jitted
+    entry point at its canonical bench/CLI shapes (csmom_tpu.compile
+    .manifest), runs ``jit(...).lower(shapes).compile()`` for each with the
+    serialized-executable cache enabled, and writes a per-shape report
+    (trace wall, compile wall, hit/miss) next to the cache.  Run it any
+    time BEFORE a measurement window — a later ``bench.py`` (or CLI)
+    process at the same shapes loads executables from disk instead of
+    compiling, so the window is spent measuring, not compiling.
+    """
+    profiles = [p.strip() for p in (args.profiles or "").split(",") if p.strip()]
+    if not profiles:
+        # platform-appropriate default: the CPU fallback's shapes plus the
+        # CLI-facing golden kernels; on an accelerator, its bench shapes
+        import jax
+
+        on_cpu = jax.devices()[0].platform == "cpu"
+        profiles = ["bench-cpu", "golden"] if on_cpu else ["bench-tpu", "golden"]
+
+    from csmom_tpu.compile.manifest import PROFILES, build_manifest
+
+    unknown = [p for p in profiles if p not in PROFILES]
+    if unknown:
+        print(f"unknown profile(s) {unknown}: choose from {list(PROFILES)}",
+              file=sys.stderr)
+        return 2
+
+    if args.list:
+        # enumerate + validate without compiling (manifest drift surfaces
+        # here as a TypeError naming the stale entry)
+        for profile in profiles:
+            for e in build_manifest(profile):
+                e.validate()
+                print(f"{profile:10s} {e.name:44s} {e.shape_summary()}")
+        return 0
+
+    from csmom_tpu.compile.aot import warmup
+
+    report = warmup(
+        profiles=tuple(profiles),
+        subdir=args.cache_subdir,
+        include_golden_event=not args.no_golden_event,
+    )
+    for r in report["entries"]:
+        status = ("HIT" if r.get("cache_hit")
+                  else ("ERROR " + r["error"] if "error" in r else "compiled"))
+        print(f"{r.get('name', '?'):44s} trace {r.get('trace_s', 0.0):7.2f}s "
+              f"compile {r.get('compile_s', 0.0):7.2f}s  {status}")
+    print(f"\n{report['n_entries']} entries, {report['n_cache_hits']} served "
+          f"from cache, {report['n_errors']} errors in {report['wall_s']}s "
+          f"(platform {report['platform']})")
+    print(f"cache: {report['cache_dir']}")
+    print(f"inputs: {report['input_builders']}")
+    print(f"golden event: {report['golden_event']}")
+    if report["n_errors"] and args.strict:
+        return 1
+    return 0
+
+
 def _most_picked(choice, row_labels, col_labels, row_name, col_name, top_n=3):
     """Decode a walk-forward flat cell index path into the top-N
     most-selected (row, col) cells: ``[((row, col), count), ...]``.
@@ -1370,10 +1431,40 @@ def build_parser() -> argparse.ArgumentParser:
         ("strategies", cmd_strategies, ()),
         ("pack-info", cmd_packinfo, ()),
         ("bench", cmd_bench, ()),
+        ("warmup", cmd_warmup, ()),
     ):
         sp = sub.add_parser(name, help=(fn.__doc__ or "").splitlines()[0])
         if name == "pack-info":
             sp.add_argument("pack_dir", help="packed panel directory")
+            sp.set_defaults(fn=fn)
+            continue
+        if name == "warmup":
+            sp.add_argument("--profiles",
+                            help="comma-separated warmup profiles "
+                                 "(bench-cpu, bench-tpu, golden, smoke; "
+                                 "default: platform-appropriate bench + "
+                                 "golden)")
+            sp.add_argument("--platform", choices=["cpu", "tpu", "default"],
+                            help="pin the jax platform before compiling "
+                                 "(shapes are cached per backend: warm CPU "
+                                 "shapes any time, TPU shapes during a "
+                                 "tunnel window)")
+            sp.add_argument("--cache-subdir", dest="cache_subdir",
+                            default="bench",
+                            help="persistent-cache namespace (default "
+                                 "'bench' — the directory bench children "
+                                 "and the capture scripts share)")
+            sp.add_argument("--list", action="store_true",
+                            help="print the manifest (validated against the "
+                                 "live signatures) without compiling")
+            sp.add_argument("--no-golden-event", dest="no_golden_event",
+                            action="store_true",
+                            help="skip resolving the event engine at the "
+                                 "actual golden workload shapes (skips the "
+                                 "intraday pipeline build)")
+            sp.add_argument("--strict", action="store_true",
+                            help="exit 1 when any manifest entry fails to "
+                                 "compile")
             sp.set_defaults(fn=fn)
             continue
         _add_common(sp, tickers=(name != "fetch"))  # fetch has its own

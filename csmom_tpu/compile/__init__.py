@@ -1,0 +1,27 @@
+"""AOT warm-start pipeline: shape manifest + ahead-of-time compilation.
+
+The tunneled 'axon' TPU backend flaps in ~25-minute windows and a fresh
+jit compile costs ~30 s per hot shape, so a window spent compiling is a
+window lost to measurement.  This package makes the hot path mechanically
+warm:
+
+- :mod:`csmom_tpu.compile.workloads` — the canonical bench/CLI input
+  builders (golden 20-ticker event panel, 512x3780 CPU grid, 3000x15120
+  north-star grid), shared by ``bench.py`` and the warmup so both sides
+  compile byte-identical programs;
+- :mod:`csmom_tpu.compile.entries` — the shared jitted entry wrappers
+  (one callable per hot computation, used by bench AND warmup: identical
+  HLO in, identical serialized-executable cache key out);
+- :mod:`csmom_tpu.compile.manifest` — the shape manifest: every hot
+  jitted entry point with its canonical argument shapes, bound against
+  the functions' real signatures so the manifest cannot silently drift
+  from the code;
+- :mod:`csmom_tpu.compile.aot` — ``lower().compile()`` per manifest
+  entry with the persistent serialized-executable cache enabled
+  (``utils.jit_cache``), per-shape trace/compile walls, and cache
+  hit/miss accounting.  Exposed as the ``csmom warmup`` CLI subcommand
+  and invoked by ``bench.py``'s supervisor during its probe/sleep loop.
+"""
+
+from csmom_tpu.compile.manifest import ManifestEntry, build_manifest  # noqa: F401
+from csmom_tpu.compile.aot import aot_compile, warmup  # noqa: F401

@@ -1,0 +1,210 @@
+"""AOT lowering/compilation of the shape manifest, with cache accounting.
+
+``aot_compile`` runs one manifest entry through
+``jit(...).lower(shapes).compile()`` with the persistent
+serialized-executable cache enabled, so the compiled artifact lands
+on disk keyed by (HLO, backend) — any later process that traces the same
+computation at the same shapes loads it instead of compiling
+(``utils.jit_cache``).  ``warmup`` does that for a whole profile and
+writes a per-shape report (trace wall, compile wall, hit/miss) next to
+the cache, which ``bench.py`` attaches to the round's FULL record.
+
+The point: on the flapping tunneled TPU backend a fresh compile is
+~30 s/shape and tunnel windows are ~25 min — compilation must happen
+BEFORE a window opens (CPU shapes any time; TPU shapes during an earlier
+window, after which they persist).  ``csmom warmup`` is the operator
+knob; bench's supervisor also fires a CPU warmup from its probe/sleep
+loop so even a cold machine's fallback record is compile-free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from csmom_tpu.utils.logging import get_logger
+
+log = get_logger("compile.aot")
+
+REPORT_NAME = "warmup_report.json"
+
+
+def aot_compile(entry) -> dict:
+    """Lower + compile one :class:`ManifestEntry`; return its record.
+
+    The record carries the trace-vs-compile wall split and whether the
+    backend compile was served from the serialized-executable cache
+    (``cache_hit``) — the per-shape evidence the bench record embeds.
+    The compiled executable object itself is discarded: the product is
+    the on-disk cache entry, not the in-process handle.
+    """
+    from csmom_tpu.utils.profiling import compile_stats
+
+    entry.validate()
+    before = compile_stats()
+    t0 = time.perf_counter()
+    lowered = entry.fn.lower(*entry.args, **dict(entry.kwargs))
+    trace_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    lowered.compile()
+    compile_s = time.perf_counter() - t1
+    d = compile_stats().delta(before)
+    return {
+        "name": entry.name,
+        "shapes": entry.shape_summary(),
+        "trace_s": round(trace_s, 4),
+        "compile_s": round(compile_s, 4),
+        "cache_hits": d.cache_hits,
+        "cache_writes": d.cache_misses,  # jax's "miss" event fires on WRITE
+        # hit iff at least one serialized executable was READ and none had
+        # to be compiled+written — a compile below the persistence floor
+        # records neither, which warmup() rules out by zeroing the floor
+        "cache_hit": bool(d.cache_hits and d.cache_misses == 0),
+    }
+
+
+def warmup(profiles=("bench-cpu", "golden"), *, subdir: str = "bench",
+           include_golden_event: bool = True, write_report: bool = True) -> dict:
+    """AOT-compile every manifest entry of the given profiles.
+
+    Enables the persistent compile cache under ``subdir`` (the SAME
+    "bench" directory bench children and the capture scripts share — the
+    whole point is that their compiles become loads), builds each
+    profile's manifest, compiles each entry, and (for bench profiles,
+    when ``include_golden_event``) resolves + compiles the event engine
+    at the actual golden workload shapes, which warms the full intraday
+    pipeline as a side effect.
+
+    Returns the report dict (also written to ``<cache_dir>/warmup_report
+    .json`` unless disabled): per-entry walls + hit/miss, totals, and the
+    cache directory.  Never raises on a single entry — a failed entry is
+    recorded with its error so one bad shape cannot void the rest of the
+    warm-start.
+    """
+    import datetime
+
+    import jax
+
+    from csmom_tpu.compile.manifest import build_manifest, golden_event_entries
+    from csmom_tpu.compile.workloads import bench_platform
+    from csmom_tpu.utils.jit_cache import enable_persistent_cache
+    from csmom_tpu.utils.profiling import compile_stats, measure_rtt
+
+    # min_compile_s=0: warmup's contract is EVERY manifest shape on disk,
+    # including the ones XLA compiles in milliseconds — a later process
+    # asserts hit-count == manifest size against exactly this guarantee
+    cache_dir = enable_persistent_cache(subdir, min_compile_s=0.0)
+    platform, on_cpu, dtype = bench_platform(jax)
+    t_start = time.perf_counter()
+    base = compile_stats()
+
+    entries = []
+    for profile in profiles:
+        entries += [(profile, e) for e in build_manifest(profile)]
+
+    rows = []
+    for profile, entry in entries:
+        try:
+            rec = aot_compile(entry)
+        except Exception as e:  # record, keep warming the rest
+            rec = {"name": entry.name,
+                   "error": f"{type(e).__name__}: {e}"[:200]}
+        rec["profile"] = profile
+        rows.append(rec)
+        log.info("warmup %-40s trace %.2fs compile %.2fs %s",
+                 rec.get("name"), rec.get("trace_s", 0.0),
+                 rec.get("compile_s", 0.0),
+                 "HIT" if rec.get("cache_hit") else
+                 ("ERROR" if "error" in rec else "miss"))
+
+    # the bench child's wall is not only its entry-point compiles: building
+    # the grid inputs (pack synthesis on a cold machine, memmap ingest,
+    # month-end aggregation) compiles auxiliary kernels and eager ops of
+    # its own.  Run the SAME builders here so all of that is warm too —
+    # the pack lands in /tmp, the aux compiles land in the cache.
+    inputs_note = "skipped: no bench profile requested"
+    if any(p.startswith("bench") for p in profiles):
+        from csmom_tpu.compile.workloads import (
+            NORTH_STAR_GRID,
+            REDUCED_GRID,
+            grid_month_inputs,
+        )
+
+        sizes = ([REDUCED_GRID, NORTH_STAR_GRID]
+                 if "bench-cpu" in profiles else [NORTH_STAR_GRID])
+        t0_in = time.perf_counter()
+        try:
+            for A, T in sizes:
+                grid_month_inputs(A, T, dtype)
+            inputs_note = (f"grid month panels built for {sizes} in "
+                           f"{time.perf_counter() - t0_in:.1f}s "
+                           "(pack + aux kernels warmed)")
+        except Exception as e:
+            inputs_note = f"failed: {type(e).__name__}: {e}"[:200]
+
+    golden_note = "skipped: include_golden_event=False"
+    if include_golden_event and any(p.startswith("bench") for p in profiles):
+        # resolve the event engine at the REAL golden shapes; building the
+        # inputs executes the intraday pipeline, warming its kernels too.
+        # Off-CPU, also the 32-wide vmapped batch (bench's RTT-amortizing
+        # TPU leg; on CPU bench skips it, so compiling it would be waste)
+        try:
+            for entry in golden_event_entries(dtype,
+                                              batch=None if on_cpu else 32):
+                rec = aot_compile(entry)
+                rec["profile"] = "golden-event"
+                rows.append(rec)
+            measure_rtt(dtype)  # bench's first compile is the RTT tiny op
+            golden_note = "resolved from the golden input build"
+        except Exception as e:
+            golden_note = f"failed: {type(e).__name__}: {e}"[:200]
+
+    total = compile_stats().delta(base)
+    report = {
+        "metric": "aot_warmup",
+        "utc": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "platform": platform,
+        "profiles": list(profiles),
+        "cache_dir": cache_dir or "disabled (CSMOM_JIT_CACHE=0)",
+        "n_entries": len(rows),
+        "n_cache_hits": sum(1 for r in rows if r.get("cache_hit")),
+        "n_errors": sum(1 for r in rows if "error" in r),
+        "input_builders": inputs_note,
+        "golden_event": golden_note,
+        "wall_s": round(time.perf_counter() - t_start, 2),
+        "totals": total.as_dict(),
+        "entries": rows,
+    }
+    if write_report and cache_dir:
+        path = os.path.join(cache_dir, REPORT_NAME)
+        tmp = f"{path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(report, f, indent=1)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return report
+
+
+def read_warmup_report(subdir: str = "bench") -> dict | str:
+    """The most recent warmup report for ``subdir``'s cache dir, or a
+    reason string.  Used by bench to attach warm-start provenance to the
+    FULL record without re-running the warmup."""
+    from csmom_tpu.utils.jit_cache import cache_dir
+
+    d = cache_dir(subdir)
+    if d is None:
+        return "not available: persistent cache disabled (CSMOM_JIT_CACHE=0)"
+    path = os.path.join(d, REPORT_NAME)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return (f"not available: no warmup report at {path} — run "
+                "`csmom warmup` (or let bench's supervisor fire one)")

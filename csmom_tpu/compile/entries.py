@@ -1,0 +1,62 @@
+"""Shared jitted entry wrappers for the grid/event hot path.
+
+bench.py and the AOT warmup must compile BYTE-IDENTICAL programs or the
+serialized-executable cache cannot connect them.  The jit-of-a-lambda
+wrappers bench used to build inline (grid -> in-jit scalar reduction, so
+each timed rep is one dispatch + one 4-byte fetch) therefore live here,
+``lru_cache``d so every caller in one process shares one callable and
+every caller across processes lowers the same HLO module.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+
+@lru_cache(maxsize=64)
+def grid_scalar_fn(Js: tuple, Ks: tuple, skip: int, mode: str, impl: str):
+    """The grid hot entry: full J x K backtest -> in-jit scalar, one
+    dispatch per call.  ``Js``/``Ks`` are baked in as compile-time
+    constants (tuples, hashable), matching bench's closed-over arrays."""
+    import jax
+
+    from csmom_tpu.backtest.grid import jk_grid_backtest
+
+    Js_a = np.asarray(Js)
+    Ks_a = np.asarray(Ks)
+    return jax.jit(
+        lambda p, v: jk_grid_backtest(
+            p, v, Js_a, Ks_a, skip=skip, mode=mode, impl=impl
+        ).mean_spread.sum()
+    )
+
+
+@lru_cache(maxsize=8)
+def batched_event_fn(batch: int):
+    """The TPU RTT-amortizing leg: a ``batch``-wide vmapped event backtest
+    summed to one scalar (bench's throughput number for sweeps)."""
+    import jax
+
+    from csmom_tpu.backtest.event import event_backtest
+
+    def fn(price, valid, bscore, adv, vol):
+        return jax.vmap(
+            lambda sc: event_backtest(price, valid, sc, adv, vol).total_pnl
+        )(bscore).sum()
+
+    return jax.jit(fn)
+
+
+@lru_cache(maxsize=8)
+def histrank_labels_fn(n_bins: int):
+    """Single-device histogram-rank labels (the sort-free binning kernel;
+    with ``axis_name=None`` the collectives degenerate to identities)."""
+    import jax
+
+    from csmom_tpu.parallel.histrank import histogram_rank_labels
+
+    return jax.jit(
+        lambda x, v: histogram_rank_labels(x, v, n_bins, axis_name=None)
+    )

@@ -1,0 +1,332 @@
+"""Shape manifest: every hot jitted entry point + its canonical shapes.
+
+The manifest is the warm-start pipeline's contract: the list of
+``(jitted function, argument shapes)`` pairs that ``csmom warmup`` AOT
+compiles so a later process — a bench child inside a tunnel window, a
+CLI invocation — finds every hot shape already serialized in the
+persistent executable cache (``utils.jit_cache``).
+
+Two properties keep it honest:
+
+- **no drift**: every entry is BOUND against its function's real
+  signature (``inspect.signature(...).bind``) at validation time, so a
+  renamed, removed, or re-ordered parameter breaks manifest construction
+  loudly instead of letting warmup compile a stale call;
+- **no duplicate shape definitions**: panel sizes come from
+  :mod:`csmom_tpu.compile.workloads` (the same constants bench builds its
+  inputs from) and month counts are derived from the same calendar
+  generator the packs use — there is no hand-maintained shape table to
+  fall out of sync.
+
+Entries cover the hot jitted computations across the engine layers:
+``backtest/grid.py`` (``_jk_grid_backtest`` plain + donated, and
+``_grid_net_core``), ``backtest/monthly.py``'s three jitted kernels,
+``backtest/event.py``'s panel engines (threshold + hysteresis, plain +
+donated), ``parallel/histrank.py``'s histogram rank, and
+``parallel/online_ridge.py``'s time-sharded scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from csmom_tpu.compile import workloads as wl
+
+
+@dataclasses.dataclass(frozen=True)
+class ManifestEntry:
+    """One hot jitted entry point at one canonical argument signature.
+
+    ``args``/``kwargs`` hold ``jax.ShapeDtypeStruct`` leaves for arrays
+    (``fn.lower`` accepts abstract values) and plain Python scalars for
+    traced scalars / static arguments.
+    """
+
+    name: str
+    fn: Callable
+    args: tuple
+    kwargs: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+    def validate(self) -> None:
+        """Bind the abstract arguments against the function's signature.
+
+        Raises ``TypeError`` when the manifest and the code have drifted
+        (renamed/removed parameter, wrong arity) — the failure mode this
+        method exists to surface at warmup/test time instead of silently
+        compiling a stale call.
+        """
+        inspect.signature(self.fn).bind(*self.args, **dict(self.kwargs))
+
+    def shape_summary(self) -> str:
+        """Human/record-readable digest of the array arguments."""
+        def one(v):
+            shape = getattr(v, "shape", None)
+            dtype = getattr(v, "dtype", None)
+            if shape is None or dtype is None:
+                return repr(v)
+            return f"{np.dtype(dtype).name}[{','.join(map(str, shape))}]"
+
+        parts = [one(a) for a in self.args]
+        parts += [f"{k}={one(v)}" for k, v in self.kwargs.items()]
+        return ", ".join(parts)
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+def _grid_entries(A: int, M: int, dtype, *, modes_impls, tag: str,
+                  donated: bool = False) -> list[ManifestEntry]:
+    """Grid scalar entries (the bench hot path) at one panel size, plus —
+    when ``donated`` — the donated full-result grid entry point."""
+    from csmom_tpu.backtest.grid import _jk_grid_backtest_donated
+    from csmom_tpu.compile.entries import grid_scalar_fn
+
+    p = _sds((A, M), dtype)
+    m = _sds((A, M), bool)
+    out = [
+        ManifestEntry(
+            name=f"grid.jk16.{mode}.{impl}@{tag}",
+            fn=grid_scalar_fn(wl.GRID_JS, wl.GRID_KS, wl.GRID_SKIP, mode, impl),
+            args=(p, m),
+        )
+        for mode, impl in modes_impls
+    ]
+    if donated:
+        idx = np.dtype(np.int64 if np.dtype(dtype) == np.float64 else np.int32)
+        out.append(ManifestEntry(
+            name=f"grid.jk16.rank.xla.donated@{tag}",
+            fn=_jk_grid_backtest_donated,
+            args=(p, m, _sds((len(wl.GRID_JS),), idx),
+                  _sds((len(wl.GRID_KS),), idx), 1),
+            kwargs=dict(n_bins=10, mode="rank", max_hold=max(wl.GRID_KS),
+                        freq=12, impl="xla"),
+        ))
+    return out
+
+
+def _monthly_entries(A: int, M: int, dtype, tag: str) -> list[ManifestEntry]:
+    """The three jitted monthly kernels at the golden monthly panel size."""
+    from csmom_tpu.backtest.monthly import (
+        monthly_spread_backtest,
+        net_of_costs_arrays,
+        sector_neutral_backtest,
+    )
+
+    p = _sds((A, M), dtype)
+    m = _sds((A, M), bool)
+    i32 = np.int32
+    return [
+        ManifestEntry(
+            name=f"monthly.spread@{tag}",
+            fn=monthly_spread_backtest,
+            args=(p, m),
+            kwargs=dict(lookback=12, skip=1, n_bins=10, mode="qcut"),
+        ),
+        ManifestEntry(
+            name=f"monthly.sector_neutral@{tag}",
+            fn=sector_neutral_backtest,
+            args=(p, m, _sds((A,), i32)),
+            kwargs=dict(n_sectors=5, lookback=12, skip=1, n_bins=10,
+                        mode="qcut"),
+        ),
+        ManifestEntry(
+            name=f"monthly.net_of_costs@{tag}",
+            fn=net_of_costs_arrays,
+            args=(_sds((A, M), i32), _sds((10, M), i32), _sds((M,), dtype),
+                  _sds((M,), bool), 0.0005),
+            kwargs=dict(n_bins=10),
+        ),
+    ]
+
+
+def _grid_net_entry(A: int, M: int, dtype, tag: str) -> ManifestEntry:
+    """``_grid_net_core`` (the CLI --tc-bps netting pass) at the grid size."""
+    from csmom_tpu.backtest.grid import _grid_net_core
+
+    nJ, nK = len(wl.GRID_JS), len(wl.GRID_KS)
+    idx = np.dtype(np.int64 if np.dtype(dtype) == np.float64 else np.int32)
+    return ManifestEntry(
+        name=f"grid.net_core@{tag}",
+        fn=_grid_net_core,
+        args=(_sds((A, M), dtype), _sds((A, M), bool), _sds((nJ,), idx),
+              _sds((nJ, nK, M), dtype), _sds((nJ, nK, M), bool), 1.0),
+        kwargs=dict(Ks_c=wl.GRID_KS, skip=wl.GRID_SKIP, n_bins=10,
+                    mode="rank", freq=12),
+    )
+
+
+def _event_entries(A: int, T: int, dtype, tag: str) -> list[ManifestEntry]:
+    """The event panel engines (threshold plain + donated, hysteresis) at
+    one minute-panel size."""
+    from csmom_tpu.backtest.event import (
+        _hysteresis_body,
+        event_backtest,
+        event_backtest_donated,
+    )
+
+    p = _sds((A, T), dtype)
+    v = _sds((A, T), bool)
+    s = _sds((A, T), dtype)
+    a = _sds((A,), dtype)
+    vo = _sds((A,), dtype)
+    return [
+        ManifestEntry(name=f"event.threshold@{tag}", fn=event_backtest,
+                      args=(p, v, s, a, vo)),
+        ManifestEntry(name=f"event.threshold.donated@{tag}",
+                      fn=event_backtest_donated, args=(p, v, s, a, vo)),
+        ManifestEntry(
+            name=f"event.hysteresis@{tag}", fn=_hysteresis_body,
+            args=(p, v, s, a, vo, 1e-4, 1e-5, 50, 1_000_000.0, 0.001),
+        ),
+    ]
+
+
+def _histrank_entry(A: int, M: int, dtype, tag: str) -> ManifestEntry:
+    from csmom_tpu.compile.entries import histrank_labels_fn
+
+    return ManifestEntry(
+        name=f"parallel.histrank@{tag}",
+        fn=histrank_labels_fn(10),
+        args=(_sds((A, M), dtype), _sds((A, M), bool)),
+    )
+
+
+def _online_ridge_entry(R: int, A: int, F: int, dtype, tag: str) -> ManifestEntry:
+    """The time-sharded online-ridge scan on a 1-device mesh (the warmup
+    process may not have the test tier's 8 virtual devices; the scan's
+    compiled structure is shard-count-generic)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from csmom_tpu.parallel.online_ridge import _compiled
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("time",))
+    fn = _compiled(mesh, "time", A, F, np.dtype(dtype), 1.0, 8, True)
+    return ManifestEntry(
+        name=f"parallel.online_ridge@{tag}",
+        fn=fn,
+        args=(_sds((R, A, F), dtype), _sds((R, A), dtype), _sds((R, A), dtype)),
+    )
+
+
+# month counts for the grid panel sizes are derived from the pack calendar
+# (cached here per process; workloads.months_in_days is the single source)
+_MONTH_CACHE: dict[int, int] = {}
+
+
+def _months(T: int) -> int:
+    if T not in _MONTH_CACHE:
+        _MONTH_CACHE[T] = wl.months_in_days(T)
+    return _MONTH_CACHE[T]
+
+
+PROFILES = ("bench-cpu", "bench-tpu", "golden", "smoke")
+
+
+def build_manifest(profile: str, dtype=None) -> list[ManifestEntry]:
+    """Manifest entries for one warmup profile.
+
+    Profiles:
+
+    - ``"bench-cpu"``: every shape a CPU bench child compiles
+      unconditionally or budget-permitting — the golden event panel, the
+      reduced 512-stock grid (rank/qcut/matmul + donated), the full
+      north-star-size grid legs (rank xla/matmul), and the netting core.
+      f64 (bench enables x64 on CPU).
+    - ``"bench-tpu"``: the accelerator child's shapes — golden event
+      (+32-wide batched), the north-star grid in every impl, netting
+      core.  f32.
+    - ``"golden"``: the CLI-facing reference-scale kernels — monthly
+      spread / sector-neutral / net-of-costs at the 20-ticker monthly
+      panel, histrank, online ridge.
+    - ``"smoke"``: tiny shapes of every entry kind — the test tier's
+      profile (fast to compile, exercises every manifest code path).
+
+    ``dtype`` overrides the profile's default float dtype.
+    """
+    if profile == "bench-cpu":
+        dt = np.dtype(dtype or np.float64)
+        A_r, T_r = wl.REDUCED_GRID
+        A_f, T_f = wl.NORTH_STAR_GRID
+        M_r, M_f = _months(T_r), _months(T_f)
+        entries = _grid_entries(
+            A_r, M_r, dt, tag=f"{A_r}x{M_r}", donated=True,
+            modes_impls=[("rank", "xla"), ("qcut", "xla"), ("rank", "matmul")],
+        )
+        entries += _grid_entries(
+            A_f, M_f, dt, tag=f"{A_f}x{M_f}",
+            modes_impls=[("rank", "xla"), ("rank", "matmul")],
+        )
+        entries.append(_grid_net_entry(A_r, M_r, dt, tag=f"{A_r}x{M_r}"))
+        return entries
+    if profile == "bench-tpu":
+        dt = np.dtype(dtype or np.float32)
+        A_f, T_f = wl.NORTH_STAR_GRID
+        M_f = _months(T_f)
+        entries = _grid_entries(
+            A_f, M_f, dt, tag=f"{A_f}x{M_f}", donated=True,
+            modes_impls=[("rank", "xla"), ("qcut", "xla"), ("rank", "matmul"),
+                         ("rank", "matmul_bf16"), ("rank", "pallas")],
+        )
+        entries.append(_grid_net_entry(A_f, M_f, dt, tag=f"{A_f}x{M_f}"))
+        return entries
+    if profile == "golden":
+        dt = np.dtype(dtype or np.float64)
+        A, M = 20, 60  # the 20-ticker demo universe, ~5y of months
+        entries = _monthly_entries(A, M, dt, tag=f"{A}x{M}")
+        entries.append(_histrank_entry(4096, 120, np.float32, tag="4096x120"))
+        entries.append(_online_ridge_entry(64, 8, 4, dt, tag="64x8x4"))
+        return entries
+    if profile == "smoke":
+        dt = np.dtype(dtype or np.float64)
+        entries = _grid_entries(
+            16, 48, dt, tag="16x48", donated=True,
+            modes_impls=[("rank", "xla")],
+        )
+        entries += _monthly_entries(8, 24, dt, tag="8x24")
+        entries.append(_grid_net_entry(16, 48, dt, tag="16x48"))
+        entries += _event_entries(4, 32, dt, tag="4x32")
+        entries.append(_histrank_entry(32, 6, np.float32, tag="32x6"))
+        entries.append(_online_ridge_entry(12, 3, 2, dt, tag="12x3x2"))
+        return entries
+    raise ValueError(f"unknown warmup profile {profile!r}: use one of {PROFILES}")
+
+
+def golden_event_entries(dtype, batch: int | None = None) -> list[ManifestEntry]:
+    """Event-engine entries at the ACTUAL golden workload shapes.
+
+    The golden minute-panel length depends on the data (reference mount
+    present or the synthetic fallback), so these shapes are resolved by
+    building the golden inputs through the same
+    :func:`csmom_tpu.compile.workloads.golden_event_inputs` path bench
+    uses — which also warms every upstream pipeline kernel as a side
+    effect.  Separated from :func:`build_manifest` because resolving them
+    runs the pipeline (seconds), which tests and shape listings should
+    not pay.
+
+    ``batch``: when given, also include the ``batch``-wide vmapped event
+    entry (bench's TPU RTT-amortizing leg, skipped on CPU).
+    """
+    from csmom_tpu.compile.entries import batched_event_fn
+
+    price, valid, score, adv, vol, _ = wl.golden_event_inputs(np.dtype(dtype))
+    A, T = price.shape
+    dt = np.dtype(dtype)
+    entries = _event_entries(A, T, dt, tag=f"golden{A}x{T}")
+    if batch:
+        p = _sds((A, T), dt)
+        v = _sds((A, T), bool)
+        entries.append(ManifestEntry(
+            name=f"event.batched{batch}@golden{A}x{T}",
+            fn=batched_event_fn(batch),
+            args=(p, v, _sds((batch, A, T), dt), _sds((A,), dt),
+                  _sds((A,), dt)),
+        ))
+    return entries

@@ -1,0 +1,177 @@
+"""Canonical hot-path workloads shared by ``bench.py`` and the AOT warmup.
+
+Single source of the argument SHAPES the warm-start pipeline promises to
+have compiled before a tunnel window opens.  bench and ``csmom warmup``
+build their inputs through these same functions, so the
+serialized-executable cache one of them writes is hit by the other by
+construction — the shapes cannot drift apart because there is only one
+definition of each workload:
+
+- the **golden event workload**: the reference's own 20-ticker x ~2,728
+  minute panel (or the synthesized same-shape fallback when the
+  reference mount is absent) — bench's headline metric;
+- the **reduced CPU grid**: 512 stocks x 3,780 days, the CPU fallback's
+  16-cell J x K grid;
+- the **north-star grid**: 3,000 stocks x 15,120 days (720 months), the
+  on-chip record workload.
+
+Everything here is host-side input building (CSV/pack ingest, synthetic
+generation, month-end aggregation); the jitted entry points these feed
+live in :mod:`csmom_tpu.compile.entries` and the engine modules.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+REFERENCE_DATA = "/root/reference/data"
+DEMO_TICKERS = [
+    "AAPL", "MSFT", "AMZN", "GOOGL", "NVDA", "TSLA", "META", "JPM", "BAC", "WMT",
+    "PG", "KO", "DIS", "CSCO", "ORCL", "INTC", "AMD", "NFLX", "C", "GS",
+]
+
+# grid parameter canon (BASELINE.json): 16 cells, J/K in {3, 6, 9, 12}
+GRID_JS = (3, 6, 9, 12)
+GRID_KS = (3, 6, 9, 12)
+GRID_SKIP = 1
+
+# panel sizes (assets, days): the CPU fallback's reduced grid and the
+# north-star on-chip workload
+REDUCED_GRID = (512, 3780)
+NORTH_STAR_GRID = (3000, 15120)
+
+
+def bench_platform(jax_mod=None):
+    """``(platform, on_cpu, dtype)`` under bench's platform policy: f64 on
+    CPU (x64 enabled, oracle-tight), f32 on accelerators.  Shared so a
+    warmup process resolves the exact dtypes a bench child will compile."""
+    import jax
+
+    jax = jax_mod or jax
+    platform = jax.devices()[0].platform
+    on_cpu = platform == "cpu"
+    if on_cpu:
+        jax.config.update("jax_enable_x64", True)
+    return platform, on_cpu, (np.float64 if on_cpu else np.float32)
+
+
+def golden_event_inputs(dtype):
+    """Dense minute panels for the event engine, from the shipped caches (or
+    a synthesized same-shape workload when the reference data is absent).
+
+    Returns ``(price, valid, score, adv, vol, n_trades)`` — the exact
+    argument set (and shapes) of bench's headline ``event_backtest`` call.
+    Building these runs the full intraday pipeline, which warms every
+    upstream kernel (features, model CV, the event engine itself) through
+    the persistent cache as a side effect — deliberate: a warmup that
+    skipped the pipeline would leave those compiles to the bench window.
+    """
+    import jax.numpy as jnp
+
+    from csmom_tpu.api import daily_risk_maps, intraday_pipeline, synthetic_minute_frame
+    from csmom_tpu.panel.ingest import load_daily, load_intraday
+
+    if os.path.isdir(REFERENCE_DATA):
+        minute_df = load_intraday(REFERENCE_DATA, DEMO_TICKERS)
+        daily_df = load_daily(REFERENCE_DATA, [t for t in DEMO_TICKERS if t != "AAPL"])
+    else:  # pragma: no cover
+        from csmom_tpu.panel.synthetic import synthetic_daily_panel
+
+        daily = synthetic_daily_panel(20, 7, seed=0)
+        daily_df = None
+        minute_df = synthetic_minute_frame(
+            __import__("pandas").DataFrame(
+                {
+                    "date": np.repeat(daily.times, 20),
+                    "ticker": np.tile(daily.tickers, 7),
+                    "open": daily.values.T.ravel(),
+                    "close": daily.values.T.ravel(),
+                    "volume": 1e6,
+                }
+            )
+        )
+    res, fit, compact, dense_score, dense_price, dense_valid = intraday_pipeline(
+        minute_df, daily_df, dtype=dtype
+    )
+    adv, vol = daily_risk_maps(daily_df, compact.tickers)
+    return (
+        jnp.asarray(dense_price, dtype),
+        jnp.asarray(dense_valid),
+        jnp.nan_to_num(jnp.asarray(dense_score, dtype)),
+        jnp.asarray(adv, dtype),
+        jnp.asarray(vol, dtype),
+        int(res.n_trades),
+    )
+
+
+def ensure_pack(A: int, T: int) -> str:
+    """Create-if-missing the synthetic daily pack, atomically; returns its dir.
+
+    Keyed by SYNTH_VERSION so a generator edit can never serve stale
+    panels; built in a pid-suffixed temp dir and os.rename'd into place so
+    concurrent runs cannot read a half-written pack (rename is atomic; the
+    loser just removes its own temp copy).
+    """
+    import shutil
+    import tempfile
+
+    from csmom_tpu.panel.pack import save_packed
+    from csmom_tpu.panel.synthetic import SYNTH_VERSION, synthetic_daily_panel
+
+    d = os.path.join(
+        tempfile.gettempdir(),
+        f"csmom_pack_s{SYNTH_VERSION}_{A}x{T}_seed7",
+    )
+    if not os.path.exists(os.path.join(d, "meta.json")):
+        tmp = f"{d}.build{os.getpid()}"
+        save_packed(
+            synthetic_daily_panel(A, T, seed=7, listing_gaps=True), tmp
+        )
+        try:
+            os.rename(tmp, d)
+        except OSError:  # lost the race: someone else's pack is in place
+            shutil.rmtree(tmp, ignore_errors=True)
+    return d
+
+
+def grid_month_inputs(A: int, T: int, dtype):
+    """Month-end grid panels from the packed binary cache.
+
+    Returns ``(pm, mm, M, pack_ingest_s)`` — device month-end price/mask
+    panels, the month count, and the measured disk -> host wall of the
+    memmapped pack read (the number that replaces a CSV parse at scale).
+    The pack build (if cold) happens OUTSIDE the timed region.
+    """
+    import jax.numpy as jnp
+
+    from csmom_tpu.panel.calendar import month_end_aggregate, month_end_segments
+    from csmom_tpu.panel.pack import load_packed
+
+    pack_dir = ensure_pack(A, T)
+    t0 = time.perf_counter()
+    panel = load_packed(pack_dir)  # memmap: pages fault in on first touch
+    # copy=True forces the full read inside the timed window — with a
+    # matching dtype, ascontiguousarray on a memmap is a zero-copy view and
+    # the pages would otherwise fault in later, under someone else's timer
+    host_values = np.array(panel.values, dtype=dtype, copy=True)
+    host_mask = np.array(panel.mask, copy=True)
+    pack_ingest_s = time.perf_counter() - t0
+    seg, ends = month_end_segments(panel.times)
+    v, m = jnp.asarray(host_values), jnp.asarray(host_mask)
+    pm, mm = month_end_aggregate(v, m, seg, len(ends))
+    return pm, mm, len(ends), pack_ingest_s
+
+
+def months_in_days(T: int) -> int:
+    """Month count of the synthetic pack calendar for ``T`` business days —
+    the grid panels' time axis, derived from the SAME calendar generator the
+    pack uses (no hardcoded month constants to drift)."""
+    from csmom_tpu.panel.calendar import month_end_segments
+    from csmom_tpu.panel.synthetic import synthetic_daily_panel
+
+    times = synthetic_daily_panel(1, T, seed=7).times
+    _, ends = month_end_segments(times)
+    return len(ends)

@@ -120,7 +120,10 @@ def _build(cls, table: dict, where: str):
 
 def load_config(path: str) -> RunConfig:
     """Load a RunConfig from a TOML file; absent sections keep defaults."""
-    import tomllib
+    try:
+        import tomllib  # 3.11+ stdlib
+    except ImportError:  # 3.10: the API-identical backport this image ships
+        import tomli as tomllib
 
     with open(path, "rb") as f:
         raw = tomllib.load(f)

@@ -20,7 +20,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from csmom_tpu.parallel.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from csmom_tpu.analytics.bootstrap import BootstrapResult, circular_block_indices

@@ -1,0 +1,29 @@
+"""jax API compatibility shims for the parallel layer.
+
+One symbol today: ``shard_map``.  Newer jax exports it at the top level
+with a ``check_vma`` kwarg; the 0.4.x line this image ships keeps it in
+``jax.experimental.shard_map`` under the older ``check_rep`` name for the
+same replication/varying-manual-axes check.  Every sharded engine in this
+package imports from here so the version split lives in exactly one
+place (and so the AOT shape manifest can import the sharded entry points
+on either jax line).
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma)
+
+except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+
+__all__ = ["shard_map"]

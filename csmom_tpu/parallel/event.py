@@ -13,7 +13,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
-from jax import shard_map
+from csmom_tpu.parallel.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from csmom_tpu.backtest.event import EventResult, event_backtest

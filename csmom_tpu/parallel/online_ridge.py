@@ -49,7 +49,9 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from csmom_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from csmom_tpu.models.online_ridge import (

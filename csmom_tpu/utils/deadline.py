@@ -23,11 +23,52 @@ plumbing for the TPU rebuild's evidence discipline.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 from typing import Callable, Optional
 
 __all__ = ["deadline_guard"]
+
+
+def _emit(line: str, *, flush_first: bool) -> None:
+    """Write the summary as ONE ``os.write`` syscall, preceded by a newline.
+
+    The driver parses the process's TRAILING JSON line, and callers print
+    per-row progress concurrently with the watchdog thread — two buffered
+    ``print``s can interleave at the stream-buffer level and corrupt that
+    line.  A single ``os.write`` to fd 1 is one syscall (atomic for pipe
+    writes up to PIPE_BUF-sized chunks and never interleaved mid-call by
+    the kernel for regular files), and the leading newline terminates any
+    half-flushed progress row so the JSON always starts at column 0.
+
+    ``flush_first`` orders any buffered progress output BEFORE the summary
+    — safe only on the caller's own thread.  The watchdog must NOT flush:
+    the main thread may be blocked mid-write holding the stream's internal
+    lock (a full pipe on a hung tunnel), and the watchdog taking that lock
+    would deadlock the very dump that exists to beat the SIGKILL.  Its
+    half-buffered rows die with ``os._exit``, which is the safe outcome.
+
+    On the watchdog path there is one more race: between this write and
+    the ``os._exit`` that follows it, the main thread can fill its stream
+    buffer and flush a progress fragment AFTER the summary, displacing the
+    trailing line.  So the watchdog first points fd 1 at ``/dev/null``
+    (late flushes vanish) and emits on a private dup of the real stream.
+    """
+    fd = 1
+    if flush_first:
+        try:
+            sys.stdout.flush()
+        except Exception:
+            pass
+    else:
+        try:
+            fd = os.dup(1)
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            os.dup2(devnull, 1)
+        except OSError:
+            fd = 1  # quarantine unavailable: emit on the raw fd anyway
+    os.write(fd, ("\n" + line + "\n").encode())
 
 
 def deadline_guard(
@@ -59,7 +100,7 @@ def deadline_guard(
             line = partial_line()
             if line is None:
                 os._exit(3)  # nothing measured: no artifact-worthy line
-            print(line, flush=True)
+            _emit(line, flush_first=False)  # no flush: see _emit
             os._exit(0)
 
     timer = None
@@ -77,6 +118,8 @@ def deadline_guard(
             done.set()
             if timer is not None:
                 timer.cancel()
-            print(line, flush=True)
+            # caller's thread: progress rows it printed flush first, then
+            # the summary lands as one uninterleavable write
+            _emit(line, flush_first=True)
 
     return finish

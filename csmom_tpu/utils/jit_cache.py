@@ -24,10 +24,32 @@ from __future__ import annotations
 import os
 import tempfile
 
-__all__ = ["enable_persistent_cache"]
+__all__ = ["cache_dir", "enable_persistent_cache"]
 
 
-def enable_persistent_cache(subdir: str = "cli") -> str | None:
+def cache_dir(subdir: str = "cli") -> str | None:
+    """The cache directory ``enable_persistent_cache(subdir)`` would use,
+    without touching jax (pure path derivation — safe from any process).
+
+    ``CSMOM_JIT_CACHE=0`` -> None (disabled); any other non-empty value
+    overrides the directory.  Single source of the path scheme: readers
+    like the warmup report loader resolve through here so a scheme change
+    cannot strand them looking in the wrong directory.
+    """
+    configured = os.environ.get("CSMOM_JIT_CACHE", "")
+    if configured == "0":
+        return None
+    if configured:
+        return configured
+    # uid-suffixed: a fixed path in world-writable /tmp would collide
+    # across users (and let one user feed another serialized executables)
+    return os.path.join(
+        tempfile.gettempdir(), f"csmom_{subdir}_cache-{os.getuid()}"
+    )
+
+
+def enable_persistent_cache(subdir: str = "cli",
+                            min_compile_s: float = 0.5) -> str | None:
     """Point jax at a uid-suffixed on-disk compile cache; returns its path.
 
     ``CSMOM_JIT_CACHE=0`` disables (same contract as the test tier's
@@ -35,23 +57,23 @@ def enable_persistent_cache(subdir: str = "cli") -> str | None:
     called after ``import jax`` and before the first compilation; calling
     it later is harmless (already-live executables just aren't cached).
     Never raises — the cache is an optimization, not a dependency.
+
+    ``min_compile_s`` is the persistence floor: compiles faster than this
+    are not written (the steady-state default keeps sub-second noise out
+    of the cache).  The AOT warmup passes 0.0 — its contract is that EVERY
+    manifest shape lands on disk, so a later process can assert
+    hit-count == manifest size instead of "most shapes were slow enough".
     """
-    configured = os.environ.get("CSMOM_JIT_CACHE", "")
-    if configured == "0":
+    path = cache_dir(subdir)
+    if path is None:
         return None
-    if configured:
-        path = configured
-    else:
-        # uid-suffixed: a fixed path in world-writable /tmp would collide
-        # across users (and let one user feed another serialized executables)
-        path = os.path.join(
-            tempfile.gettempdir(), f"csmom_{subdir}_cache-{os.getuid()}"
-        )
     try:
         import jax
 
         jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_s
+        )
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         return path
     except Exception:

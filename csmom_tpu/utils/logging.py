@@ -19,7 +19,11 @@ def _configure_root() -> None:
     if _CONFIGURED:
         return
     level = os.environ.get("CSMOM_LOG_LEVEL", "INFO").upper()
-    if not isinstance(logging.getLevelNamesMapping().get(level), int):
+    # logging.getLevelNamesMapping() is 3.11+; this must import on 3.10.
+    # getLevelName(name) round-trips a KNOWN level name to its int and
+    # returns the "Level %s" string for anything else, on every supported
+    # interpreter — so "is it an int" is the version-portable validity test.
+    if not isinstance(logging.getLevelName(level), int):
         level = "INFO"
     handler = logging.StreamHandler()
     handler.setFormatter(

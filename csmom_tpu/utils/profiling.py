@@ -17,11 +17,17 @@ Two layers, both zero-cost when unused:
   ``jax.profiler.trace`` (Perfetto/XPlane dump viewable in Perfetto or
   TensorBoard) when given a directory, and always logs the wall time of the
   block under its label.
+- ``compile_stats()`` / ``count_dispatches()`` — the AOT warm-start
+  pipeline's accounting: persistent-compile-cache hit/miss counters (the
+  number bench records so "0 in-window compiles" is a measured claim, not
+  a hope) and a per-call dispatch counter that pins the one-dispatch
+  property of the grid/event hot paths.
 """
 
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import time
 
 import jax
@@ -76,6 +82,142 @@ def measure_rtt(dtype=None, reps: int = 10) -> float:
     for _ in range(reps):
         fetch(tiny(s))
     return (time.perf_counter() - t0) / reps
+
+
+# -- compile-cache / dispatch accounting -------------------------------------
+#
+# jax.monitoring is the only stable-ish signal for what the runtime compiled
+# vs served from the serialized-executable cache.  Verified semantics on the
+# 0.4.x line this image ships (jax._src.{compiler,compilation_cache}):
+#
+#   compile_requests_use_cache  one per top-level compile request, only when
+#                               a cache dir is configured;
+#   cache_hits                  serialized executable successfully READ from
+#                               the cache (no backend compile happened);
+#   cache_misses                executable compiled AND WRITTEN to the cache
+#                               — a compile under the persistence thresholds
+#                               (min compile time / entry size) records
+#                               NEITHER hit nor miss, which is why the
+#                               warmup path zeroes those thresholds;
+#   backend_compile_duration    wraps compile_or_get_cached, so it fires on
+#                               every top-level compile request, cache hit,
+#                               write, or cache-disabled alike;
+#   jaxpr_trace_duration        one per traced computation, including inner
+#                               jits traced during an outer trace that never
+#                               dispatch on their own.
+#
+# Counters are process-global and monotone; callers diff snapshots.
+
+_COUNTERS = {
+    "cache_hits": 0,        # persistent-cache reads (serialized executable load)
+    "cache_misses": 0,      # persistent-cache writes (fresh compile, persisted)
+    "cache_requests": 0,    # compile requests that consulted the cache
+    "traces": 0,            # computations traced+lowered this process
+    "backend_compiles": 0,  # top-level compile requests (cache load OR compile)
+}
+_LISTENING = False
+
+
+def _install_listeners() -> None:
+    global _LISTENING
+    if _LISTENING:
+        return
+    from jax._src import monitoring
+
+    def _on_event(event, **kw):
+        if event == "/jax/compilation_cache/cache_hits":
+            _COUNTERS["cache_hits"] += 1
+        elif event == "/jax/compilation_cache/cache_misses":
+            _COUNTERS["cache_misses"] += 1
+        elif event == "/jax/compilation_cache/compile_requests_use_cache":
+            _COUNTERS["cache_requests"] += 1
+
+    def _on_duration(event, duration, **kw):
+        if event == "/jax/core/compile/jaxpr_trace_duration":
+            _COUNTERS["traces"] += 1
+        elif event == "/jax/core/compile/backend_compile_duration":
+            _COUNTERS["backend_compiles"] += 1
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _LISTENING = True
+
+
+@dataclasses.dataclass(frozen=True)
+class CompileStats:
+    """Snapshot of the process-global compile counters (monotone)."""
+
+    cache_hits: int
+    cache_misses: int
+    cache_requests: int
+    traces: int
+    backend_compiles: int
+
+    def delta(self, since: "CompileStats") -> "CompileStats":
+        return CompileStats(*(getattr(self, f.name) - getattr(since, f.name)
+                              for f in dataclasses.fields(self)))
+
+    @property
+    def hit_rate(self) -> float | None:
+        """Fraction of cache-consulting compile requests served from the
+        serialized-executable cache; None when the cache saw no traffic
+        (disabled, or nothing compiled since the snapshot base)."""
+        if not self.cache_requests:
+            return None
+        return self.cache_hits / self.cache_requests
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        hr = self.hit_rate
+        d["cache_hit_rate"] = (round(hr, 4) if hr is not None else
+                               "not applicable: no cache-consulting compiles "
+                               "in this window (cache disabled or all shapes "
+                               "already live in-process)")
+        return d
+
+
+def compile_stats() -> CompileStats:
+    """Current counters.  The persistent-cache fields only move when a
+    compilation cache directory is configured (utils.jit_cache)."""
+    _install_listeners()
+    return CompileStats(**_COUNTERS)
+
+
+@contextlib.contextmanager
+def count_dispatches(clear_caches: bool = True):
+    """Count distinct TOP-LEVEL XLA computations dispatched in the block.
+
+    Every computation a block launches must first be compiled in-process,
+    so with the in-process executable caches cleared on entry, the number
+    of top-level compile requests during the block equals the number of
+    DISTINCT computations it dispatched — one jit call that stays
+    on-device scores exactly 1, and any host round-trip between stages
+    (an eager op, a second jit, an implicit recommit) scores >= 2.  This
+    is the test hook behind the grid hot path's one-dispatch-per-call pin.
+
+    The counted signal is the top-level backend-compile counter, which on
+    this jax line wraps ``compile_or_get_cached`` and therefore fires once
+    per top-level computation whether the executable was compiled fresh or
+    loaded from the persistent cache, cache configured or not.  NOT the
+    jaxpr-trace counter: nested inner jits trace during outer tracing
+    without ever dispatching.
+
+    Yields a dict whose ``"dispatches"`` key is filled on exit.  Repeat
+    launches of one already-counted computation are not re-counted — so
+    ``== 1`` is a sound single-dispatch pin, while larger values are a
+    lower bound on launches.
+    """
+    _install_listeners()
+    if clear_caches:
+        jax.clear_caches()
+    before = dict(_COUNTERS)
+    box: dict = {}
+    try:
+        yield box
+    finally:
+        box["dispatches"] = (
+            _COUNTERS["backend_compiles"] - before["backend_compiles"]
+        )
 
 
 @contextlib.contextmanager

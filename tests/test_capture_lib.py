@@ -62,10 +62,10 @@ def test_land_artifact_upgrades_partial_with_full(tmp_path):
     assert "partial" not in got and got["rows"] == [1, 2, 3]
 
 
-def test_land_artifact_partial_does_not_refresh_partial(tmp_path):
-    """Unlike bench's in-file cache (where newer partial beats older
-    partial), a committed artifact stays as first landed: the watcher
-    retries via the absent done-marker, not by churning the artifact."""
+def test_land_artifact_partial_does_not_refresh_equal_partial(tmp_path):
+    """A newer partial with NO MORE measured rows never churns the
+    committed artifact: the watcher retries via the absent done-marker.
+    (A strictly richer partial is the exception — next test.)"""
     art = tmp_path / "art.json"
     art.write_text(json.dumps(json.loads(PARTIAL), indent=1))
     raw = tmp_path / "raw.log"
@@ -74,6 +74,33 @@ def test_land_artifact_partial_does_not_refresh_partial(tmp_path):
     _write(raw, newer_partial)
     _sh(tmp_path, f'land_artifact "{raw}" "{art}"')
     assert json.loads(art.read_text())["rows"] == [1]
+
+
+def test_land_artifact_richer_partial_upgrades_thinner_partial(tmp_path):
+    """ADVICE r5 #3: a deadline-hit capture that measured strictly MORE
+    rows than the committed partial is an upgrade, not churn — a later
+    window that got further must not be discarded for having also hit
+    its deadline."""
+    art = tmp_path / "art.json"
+    art.write_text(json.dumps(json.loads(PARTIAL), indent=1))  # 1 row
+    raw = tmp_path / "raw.log"
+    richer = json.dumps({"metric": "grid16_scaling", "rows": [9, 10],
+                         "partial": "deadline hit"})
+    _write(raw, richer)
+    _sh(tmp_path, f'land_artifact "{raw}" "{art}"')
+    assert json.loads(art.read_text())["rows"] == [9, 10]
+    # and the reverse direction (thinner over richer) still refuses
+    _write(raw, PARTIAL)
+    _sh(tmp_path, f'land_artifact "{raw}" "{art}"')
+    assert json.loads(art.read_text())["rows"] == [9, 10]
+    # "phases" records (grid_phases.py) count the same way
+    art2 = tmp_path / "art2.json"
+    art2.write_text(json.dumps({"metric": "grid_phases", "phases": [1],
+                                "partial": "deadline hit"}))
+    _write(raw, json.dumps({"metric": "grid_phases", "phases": [1, 2, 3],
+                            "partial": "deadline hit"}))
+    _sh(tmp_path, f'land_artifact "{raw}" "{art2}"')
+    assert json.loads(art2.read_text())["phases"] == [1, 2, 3]
 
 
 def test_promote_capture_full_claims_done_marker(tmp_path):

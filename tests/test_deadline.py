@@ -71,6 +71,47 @@ def test_unset_budget_arms_nothing():
     assert p.stdout.strip() == '{"full": true}'
 
 
+def test_summary_line_survives_interleaved_progress_prints():
+    """ADVICE r5 #2: the watchdog fires while the caller is mid-way
+    through a progress print — the driver-parsed TRAILING JSON line must
+    still be intact.  The summary is one os.write preceded by a newline,
+    so a half-written progress row can never splice into it."""
+    p = _run_guard_script("""
+            import json
+            finish = deadline_guard("GUARD_TEST_BUDGET",
+                                    lambda: json.dumps({"partial": True,
+                                                        "rows": 3}),
+                                    t0=t0, margin_s=0.0, min_delay_s=0.3)
+            # hammer stdout with unterminated progress fragments until the
+            # guard fires (os._exit) — worst-case interleaving pressure
+            while True:
+                sys.stdout.write("row 1234 wall 0.123")   # no newline
+                sys.stdout.write(" ...still going")
+                time.sleep(0.001)
+    """)
+    assert p.returncode == 0
+    lines = [ln for ln in p.stdout.splitlines() if ln.strip()]
+    # trailing line parses clean and starts at column 0
+    import json
+
+    assert json.loads(lines[-1]) == {"partial": True, "rows": 3}
+
+
+def test_finish_flushes_progress_before_summary():
+    """finish() on the caller's thread: buffered progress rows land BEFORE
+    the summary, which stays the trailing (parsed) line."""
+    p = _run_guard_script("""
+            finish = deadline_guard("GUARD_TEST_BUDGET", lambda: None,
+                                    t0=t0, margin_s=0.0, min_delay_s=30)
+            sys.stdout.write("progress row without newline")
+            finish('{"full": true}')
+    """)
+    assert p.returncode == 0
+    lines = p.stdout.splitlines()
+    assert lines[-1] == '{"full": true}'
+    assert any("progress row" in ln for ln in lines[:-1])
+
+
 def test_late_armed_guard_still_fires_before_external_budget():
     """The t0 anchor: a guard armed 0.8s after 'process start' with a 1s
     budget must compute a near-zero fuse (floored by min_delay_s), not a

@@ -87,6 +87,36 @@ def test_double_sort_table(rng):
     np.testing.assert_allclose(df.loc["V3-V1"].mean_ret, md)
 
 
+def test_double_sort_turnover_counts_unwind_months(rng):
+    """ADVICE r5 #1: a full-book unwind lands its |dw| on the first month
+    the book goes INVALID; the turnover average must include every month
+    with activity (valid OR turn > 0), or net_mean/be_bps are overstated."""
+    V, M = 3, 10
+
+    class DS:
+        spreads = rng.normal(0.005, 0.02, size=(V, M))
+        spread_valid = np.ones((V, M), bool)
+        book_turnover = np.full((V, M), 0.5)
+
+    # tercile 0: the book dies at month 6 — invalid from there on, but the
+    # unwind itself (2.0 = full both-legs exit) is charged at month 6
+    DS.spread_valid[0, 6:] = False
+    DS.book_turnover[0, 6:] = 0.0
+    DS.book_turnover[0, 6] = 2.0
+
+    df = double_sort_table(DS, half_spread_bps=10.0)
+    # 6 valid months at 0.5 plus the unwind month at 2.0, over 7 active
+    expected = (6 * 0.5 + 2.0) / 7
+    np.testing.assert_allclose(df.loc["V1 (low)"].mean_turnover, expected)
+    # and the net mean is charged at that heavier turnover
+    np.testing.assert_allclose(
+        df.loc["V1 (low)"].net_mean,
+        df.loc["V1 (low)"].mean_ret - 10.0 / 1e4 * expected,
+    )
+    # terciles with no invalid-month activity are unchanged by the fix
+    np.testing.assert_allclose(df.loc["V2"].mean_turnover, 0.5)
+
+
 @pytest.mark.reference_data
 @pytest.mark.slow
 def test_cli_doublesort_and_tables_run():
