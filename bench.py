@@ -78,8 +78,35 @@ CPU_RESERVE_S = 570   # observed CPU child wall: ~130s cold.  Sized so that
                       # reserve) still clears that wall with its deadline
                       # watchdog margin to spare — the fallback must produce a
                       # FULL record, not a watchdog partial
+if os.environ.get("CSMOM_BENCH_SMOKE"):
+    # a smoke child still compiles the headline pipeline and the reduced
+    # grid (~60-90 s measured warm-ish, worse on a cold machine), so the
+    # reserve shrinks to a cold-smoke-child size, not to nothing — the
+    # full-size reserve would starve every attempt out of a
+    # rehearsal-sized budget
+    CPU_RESERVE_S = 240
 _DEADLINE = time.monotonic() + TOTAL_BUDGET_S
 _CHILD_T0 = time.monotonic()  # child-process start, for its own sub-budget
+
+# Smoke mode (CSMOM_BENCH_SMOKE=1): the full pipeline shape — probe,
+# child, headline, grid leg, deadline guard, record split — with every
+# optional heavyweight leg skipped (with a reason, never silently).  This
+# is what `csmom rehearse` drives so a CPU-only machine can rehearse every
+# fault in minutes, and it is honest about itself in the record.
+SMOKE = bool(os.environ.get("CSMOM_BENCH_SMOKE"))
+SMOKE_REASON = "skipped: smoke mode (CSMOM_BENCH_SMOKE=1 — rehearsal runs " \
+               "the pipeline, not the workload)"
+
+
+def _chaos(point: str, **ctx):
+    """Chaos checkpoint (csmom_tpu.chaos): a no-op — one environ lookup,
+    no imports — unless a fault plan is armed, so the supervisor stays
+    jax-import-free and the measurement path stays unperturbed."""
+    if "CSMOM_FAULT_PLAN" not in os.environ:
+        return None
+    from csmom_tpu.chaos.inject import checkpoint
+
+    return checkpoint(point, **ctx)
 
 
 def _remaining() -> float:
@@ -131,6 +158,7 @@ def child_main():
     _LEGS: dict = {}
 
     def _compiled_leg(name: str, first_call):
+        _chaos("bench.compile", leg=name)
         b = compile_stats()
         t0 = time.perf_counter()
         first_call()
@@ -230,6 +258,11 @@ def child_main():
     # live reference: legs recorded after this point (and the final compile
     # totals) show up in a watchdog partial dump too
     _PROG["extra"]["compile_legs"] = _LEGS
+    # measured-row boundary: the headline is in _PROG, the grid legs are
+    # not — the r5 chaos plans (hang / expired deadline / SIGKILL between
+    # rows) all fire here, and the invariant is that the headline above
+    # still lands in a partial record
+    _chaos("bench.row", row="headline")
     _stall = float(os.environ.get("CSMOM_BENCH_STALL_S", "0") or 0)
     if _stall:  # test hook: a tunnel that hangs right after the headline —
         time.sleep(_stall)  # the watchdog must turn this into a partial dump
@@ -267,6 +300,8 @@ def child_main():
 
     def timed_or_reason(mode, impl="xla", floor_s=120.0):
         """Run a grid leg if the child budget allows, else a reason string."""
+        if SMOKE:
+            return SMOKE_REASON
         left = _child_left()
         if left < floor_s:
             return (f"skipped: child budget too small for this leg "
@@ -280,6 +315,7 @@ def child_main():
     # the child exists, and the supervisor only launches a child when at
     # least the child minimum is left
     grid_rank_s = timed("rank")
+    _chaos("bench.row", row="grid16.rank")
     _PROG["extra"].update({
         "grid16_rank_s": round(grid_rank_s, 4),
         "grid_workload": f"16 cells, {A} stocks x {T} days ({M} months)",
@@ -357,7 +393,9 @@ def child_main():
     # and bounds the TPU expectation (VERDICT r2 item 3)
     full_rank_s = full_matmul_s = None
     child_left = _child_left()  # inf when unbudgeted (standalone child runs)
-    if on_cpu and child_left > 360:  # observed: ~23x the reduced data; compile ~1 min
+    if SMOKE:
+        full_rank_s = full_matmul_s = SMOKE_REASON
+    elif on_cpu and child_left > 360:  # observed: ~23x the reduced data; compile ~1 min
         try:
             A_f, T_f = wl.NORTH_STAR_GRID
             fpm, fmm, M_f, _ = wl.grid_month_inputs(A_f, T_f, dtype)
@@ -491,6 +529,9 @@ def child_main():
                 "trace-vs-compile split (inner jits trace during an outer "
                 "trace without dispatching)",
     }
+    if SMOKE:
+        extra["smoke"] = ("smoke-mode record: pipeline-shaped, workload "
+                          "reduced — NOT a performance capture")
     line = json.dumps(
         {
             "metric": "intraday_event_backtest_bar_groups_per_sec",
@@ -500,6 +541,7 @@ def child_main():
             "extra": extra,
         }
     )
+    _chaos("bench.finish")
     _finish(line)
 
 
@@ -625,6 +667,8 @@ def _probe_default_backend(reserve_s: float):
     """True iff the default jax backend initializes in a subprocess within
     the probe timeout (the axon TPU plugin can hang, not just raise).
     ``reserve_s`` is budget that must stay untouched for later stages."""
+    if _chaos("bench.probe") == "fail":
+        return False, "chaos-injected probe failure (CSMOM_FAULT_PLAN)"
     code = "import jax; d = jax.devices(); print(d[0].platform)"
     timeout = min(PROBE_TIMEOUT_S, _remaining() - reserve_s)
     if timeout < 10:
@@ -727,6 +771,8 @@ def _run_histrank_child():
     """Run the distributed-rank comparison in its own process (needs the
     8-virtual-device CPU mesh flag set before jax init, which must not leak
     into the main children's timings)."""
+    if SMOKE:
+        return SMOKE_REASON
     env = dict(os.environ)
     env["CSMOM_BENCH_HISTRANK"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
@@ -820,6 +866,7 @@ def _write_full_record(record: dict) -> str:
     path = os.path.join(out_dir, name)
     tmp = f"{path}.tmp{os.getpid()}"
     try:
+        _chaos("bench.land", path=name)  # ENOSPC fault lands in the handler
         with open(tmp, "w") as f:
             json.dump(record, f, indent=1)
             f.write("\n")
@@ -1009,6 +1056,17 @@ def main():
             default_is_cpu = True  # env pins cpu; nothing to wait for
             break
         if okp:
+            # a window is open: stop the background warmup child first —
+            # it compiles north-star-size f64 shapes on every host core,
+            # and the TPU child's host-side walls (dispatch, pack ingest)
+            # must not be measured under that load.  Per-entry cache
+            # writes are atomic, so whatever it warmed stays warmed.
+            if warmup_proc is not None and not isinstance(warmup_proc, str):
+                if warmup_proc.poll() is None:
+                    warmup_proc.terminate()
+                    warmup_proc = ("terminated when a tunnel window opened "
+                                   "(its partial warm-start is kept: cache "
+                                   "writes are atomic per entry)")
             # cap this attempt so a tunnel that dies mid-child costs at
             # most ~20 min of the loop, not the entire remaining budget
             # (the child's own deadline watchdog turns a mid-window death

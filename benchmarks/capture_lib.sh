@@ -12,23 +12,36 @@
 #    summary claims RAW (the done-marker the watcher loop checks); a
 #    PARTIAL one is kept aside as RAW.partial and landed provisionally,
 #    so the loop retries that capture on the next window.
+#  - A landed artifact is re-validated as parseable JSON after the write
+#    and before the rename: a short write (ENOSPC, dying disk) between
+#    the formatter and the mv must never replace a good artifact with a
+#    truncated one.  The chaos harness (`csmom rehearse`) pins this via
+#    CSMOM_FAULT_LAND_TRUNCATE_BYTES, which simulates exactly that short
+#    write; the CSMOM_FAULT_* env names are the shell side of the
+#    csmom_tpu.chaos fault-plan contract.
 #
 # Callers define log() (tunnel_watch.sh logs to its file; tests stub it).
 
 _measured_rows() {  # stdin: one JSON record -> its measured-row count
   # a capture's substance is its measurement list ("rows" for the scaling
-  # sweep, "phases" for the phase profile); unparseable or listless -> 0
+  # sweep, "phases" for the phase profile — top-level or nested under
+  # "extra", where bench-child and minibench partials carry theirs);
+  # unparseable or listless -> 0.  Mirror of chaos.invariants.measured_rows
+  # (pinned by tests/test_capture_lib.py): the two sides of the landing
+  # contract must size a partial identically or a strictly-richer partial
+  # could be refused its upgrade.
   python -c '
 import json, sys
 try:
     d = json.load(sys.stdin)
 except Exception:
     print(0); raise SystemExit
+extra = d.get("extra") if isinstance(d.get("extra"), dict) else {}
 for k in ("rows", "phases"):
-    if isinstance(d.get(k), list):
-        print(len(d[k])); break
-else:
-    print(0)' 2>/dev/null || echo 0
+    for holder in (d, extra):
+        if isinstance(holder.get(k), list):
+            print(len(holder[k])); raise SystemExit
+print(0)' 2>/dev/null || echo 0
 }
 
 land_artifact() {  # $1 raw log, $2 committed artifact path
@@ -54,7 +67,20 @@ land_artifact() {  # $1 raw log, $2 committed artifact path
   fi
   if printf '%s\n' "$new_line" | python -m json.tool > "$2".tmp 2>/dev/null \
       && [ -s "$2".tmp ]; then
-    mv "$2".tmp "$2"
+    if [ -n "${CSMOM_FAULT_LAND_TRUNCATE_BYTES:-}" ]; then
+      # chaos fault: an ENOSPC/short write hitting between the formatter
+      # and the rename (csmom rehearse land-short-write scenario)
+      head -c "$CSMOM_FAULT_LAND_TRUNCATE_BYTES" "$2".tmp > "$2".tmp.chaos \
+        && mv "$2".tmp.chaos "$2".tmp
+      log "chaos: truncated $2.tmp to ${CSMOM_FAULT_LAND_TRUNCATE_BYTES} bytes"
+    fi
+    if python -c 'import json,sys; json.load(open(sys.argv[1]))' "$2".tmp \
+        2>/dev/null; then
+      mv "$2".tmp "$2"
+    else
+      rm -f "$2".tmp
+      log "artifact $2 failed post-write JSON validation (short write/ENOSPC?) — not landed, existing artifact untouched"
+    fi
   else
     rm -f "$2".tmp
     log "summary extraction FAILED for $2 (artifact not written)"

@@ -37,7 +37,11 @@ from jax import lax
 
 from csmom_tpu.analytics.stats import masked_mean, nw_t_stat, sharpe, t_stat
 from csmom_tpu.ops.ranking import decile_assign_panel
-from csmom_tpu.signals.momentum import momentum, monthly_returns
+from csmom_tpu.signals.momentum import (
+    formation_listed_mask,
+    momentum,
+    monthly_returns,
+)
 
 __all__ = ["BandedResult", "banded_from_labels", "banded_monthly_backtest",
            "banded_books", "book_partials", "finalize_book_spread",
@@ -128,6 +132,9 @@ def banded_monthly_backtest(
     """
     ret, ret_valid = monthly_returns(prices, mask)
     mom, mom_valid = momentum(prices, mask, lookback=lookback, skip=skip)
+    # same delisting rule as the plain engine (band=0 must stay identical)
+    mom_valid = mom_valid & formation_listed_mask(mask, skip)
+    mom = jnp.where(mom_valid, mom, jnp.nan)
     labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
     return banded_from_labels(labels, ret, ret_valid, n_bins=n_bins,
                               band=band, freq=freq)

@@ -21,7 +21,11 @@ import jax
 import jax.numpy as jnp
 
 from csmom_tpu.ops.ranking import decile_assign_panel
-from csmom_tpu.signals.momentum import momentum_dynamic, monthly_returns
+from csmom_tpu.signals.momentum import (
+    formation_listed_mask,
+    momentum_dynamic,
+    monthly_returns,
+)
 from csmom_tpu.signals.turnover import volume_tercile_labels
 from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat, nw_t_stat
 from csmom_tpu.costs.impact import long_short_weights, turnover_cost
@@ -67,6 +71,8 @@ def volume_double_sort(
     """
     ret, ret_valid = monthly_returns(prices, mask)
     mom, mom_valid = momentum_dynamic(prices, mask, lookback, skip)
+    mom_valid = mom_valid & formation_listed_mask(mask, skip)
+    mom = jnp.where(mom_valid, mom, jnp.nan)
     mom_labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
     # independent sort: momentum decile edges use every mom-valid asset
     # (turnover-less names still shape the breakpoints); the volume tercile

@@ -32,7 +32,11 @@ import jax
 import jax.numpy as jnp
 
 from csmom_tpu.ops.ranking import decile_assign_panel
-from csmom_tpu.signals.momentum import momentum_dynamic, monthly_returns
+from csmom_tpu.signals.momentum import (
+    formation_listed_mask,
+    momentum_dynamic,
+    monthly_returns,
+)
 from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat, nw_t_stat
 
 
@@ -310,8 +314,12 @@ def _jk_grid_backtest_impl(
     Ks = jnp.asarray(Ks)
     ret, ret_valid = monthly_returns(prices, mask)
 
+    listed = formation_listed_mask(mask, skip)
+
     def per_J(J):
         mom, mom_valid = momentum_dynamic(prices, mask, J, skip)
+        mom_valid = mom_valid & listed
+        mom = jnp.where(mom_valid, mom, jnp.nan)
         labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
         return _cohort_spreads(labels, ret, ret_valid, n_bins, max_hold, impl=impl)
 

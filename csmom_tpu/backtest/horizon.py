@@ -28,7 +28,11 @@ import jax.numpy as jnp
 from csmom_tpu.analytics.stats import masked_mean, nw_t_stat, t_stat
 from csmom_tpu.backtest.grid import _cohort_spreads  # shared cohort kernel
 from csmom_tpu.ops.ranking import decile_assign_panel
-from csmom_tpu.signals.momentum import momentum_dynamic, monthly_returns
+from csmom_tpu.signals.momentum import (
+    formation_listed_mask,
+    momentum_dynamic,
+    monthly_returns,
+)
 
 
 @jax.tree_util.register_dataclass
@@ -69,6 +73,10 @@ def horizon_profile(
     """
     ret, ret_valid = monthly_returns(prices, mask)
     mom, mom_valid = momentum_dynamic(prices, mask, lookback, skip)
+    # same delisting rule as every ranking engine: pad semantics carry a
+    # delisted asset's signal, the listed mask drops it from new cohorts
+    mom_valid = mom_valid & formation_listed_mask(mask, skip)
+    mom = jnp.where(mom_valid, mom, jnp.nan)
     labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
     R, R_valid = _cohort_spreads(labels, ret, ret_valid, n_bins, max_h)  # [M, H]
 
@@ -126,6 +134,8 @@ def volume_horizon_profile(
 
     ret, ret_valid = monthly_returns(prices, mask)
     mom, mom_valid = momentum_dynamic(prices, mask, lookback, skip)
+    mom_valid = mom_valid & formation_listed_mask(mask, skip)
+    mom = jnp.where(mom_valid, mom, jnp.nan)
     mom_labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
     both = mom_valid & turnover_valid
     vol_labels, _ = volume_tercile_labels(

@@ -32,7 +32,11 @@ import jax
 import jax.numpy as jnp
 
 from csmom_tpu.ops.ranking import decile_assign_panel, sector_decile_assign_panel
-from csmom_tpu.signals.momentum import momentum, monthly_returns
+from csmom_tpu.signals.momentum import (
+    formation_listed_mask,
+    momentum,
+    monthly_returns,
+)
 from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat, nw_t_stat
 from csmom_tpu.costs.impact import long_short_weights, turnover_cost
 
@@ -154,6 +158,11 @@ def monthly_spread_backtest(
     """
     ret, ret_valid = monthly_returns(prices, mask)
     mom, mom_valid = momentum(prices, mask, lookback=lookback, skip=skip)
+    # run_demo forms the signal from raw shifted prices: an asset drops out
+    # of ranking once delisted at the window-end month (pad semantics still
+    # carry it through interior gaps)
+    mom_valid = mom_valid & formation_listed_mask(mask, skip)
+    mom = jnp.where(mom_valid, mom, jnp.nan)
     labels, _ = decile_assign_panel(mom, mom_valid, n_bins=n_bins, mode=mode)
     return _assemble_result(ret, ret_valid, labels, n_bins, freq, impl=impl)
 
@@ -186,6 +195,8 @@ def sector_neutral_backtest(
     """
     ret, ret_valid = monthly_returns(prices, mask)
     mom, mom_valid = momentum(prices, mask, lookback=lookback, skip=skip)
+    mom_valid = mom_valid & formation_listed_mask(mask, skip)
+    mom = jnp.where(mom_valid, mom, jnp.nan)
     labels, _ = sector_decile_assign_panel(
         mom, mom_valid, sector_ids, n_sectors, n_bins=n_bins, mode=mode
     )

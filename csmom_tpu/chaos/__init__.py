@@ -1,0 +1,40 @@
+"""Deterministic fault injection + capture-pipeline rehearsal.
+
+Two consecutive TPU tunnel windows were lost to builder-controllable
+failures (r4: a SIGKILL at the external timeout discarded a fully measured
+headline; r5: the supervisor's own attempt cap killed the bench child
+mid-compile with every measurement unprinted — ``benchmarks/
+CAPTURES_r05.md``).  The fix set (``utils/deadline.py``, ``utils/
+jit_cache.py``, ``compile/aot.py``, ``benchmarks/capture_lib.sh``) is only
+trustworthy if it can be *proven* offline: this package injects those
+failures deterministically and rehearses the full supervisor → warmup →
+bench → deadline → land pipeline under each one, on a CPU-only machine,
+before a scarce tunnel window opens.
+
+Layout:
+
+- :mod:`~csmom_tpu.chaos.plan` — seeded, serializable fault plans
+  (``CSMOM_FAULT_PLAN`` env var pointing at a TOML file, or inline TOML).
+- :mod:`~csmom_tpu.chaos.inject` — the ``checkpoint("name")`` hooks
+  threaded through bench.py, compile/aot.py, and utils/deadline.py.
+  No-ops unless a plan is armed: the unarmed fast path is one dict lookup
+  in ``os.environ``, no imports, no allocation.
+- :mod:`~csmom_tpu.chaos.invariants` — schema validation for every landed
+  artifact (headline lines, full records, driver captures, multichip
+  summaries, partials and their monotone-upgrade rule).
+- :mod:`~csmom_tpu.chaos.minibench` — a jax-free miniature capture child
+  (measured rows + deadline guard + trailing JSON) for sub-second
+  rehearsal of the capture *path* without the bench *workload*.
+
+The operator entry point is ``csmom rehearse`` (:mod:`csmom_tpu.cli.
+rehearse`): the built-in fault matrix, a pass/fail table, and a nonzero
+exit on any invariant violation so watcher scripts can gate on it.
+
+The reference has no analogue (single process, no measurement harness);
+this is the evidence-discipline layer of the TPU rebuild, and its shape —
+chaos testing for a distributed measurement/serving pipeline — transfers
+directly to training/inference stacks.
+"""
+
+from csmom_tpu.chaos.inject import checkpoint  # noqa: F401
+from csmom_tpu.chaos.plan import Fault, FaultPlan  # noqa: F401

@@ -1,0 +1,321 @@
+"""Artifact invariants: what every landed capture must look like.
+
+The driver, the watcher loop, and the humans reading a round's evidence
+all parse the same small family of JSON artifacts.  This module is the
+single written-down contract for them, used three ways:
+
+- ``csmom rehearse`` validates every artifact a faulted pipeline lands;
+- the test tier validates every committed ``BENCH_*.json`` /
+  ``MULTICHIP_*.json`` at the repo root, so historical records can never
+  silently drift from the parser contract;
+- capture scripts may call :func:`validate` before landing.
+
+Validators return a list of violation strings (empty = valid) instead of
+raising: a rehearsal reports ALL breakage of a landed artifact, not the
+first.
+
+Artifact kinds (detected from keys, see :func:`detect_kind`):
+
+``record``
+    A bench-style summary: ``metric``/``value``/``unit``/``vs_baseline``
+    (+ optional ``extra`` dict).  Full bench records, MULTIHOST/HISTRANK
+    captures, and the stdout headline all have this shape.
+``driver_capture``
+    The round driver's wrapper: ``rc``/``tail`` (+ ``cmd``/``n``/
+    ``parsed``).  ``parsed`` may be null only for a nonzero ``rc`` — a
+    successful run whose tail did not parse is exactly the r4 failure.
+``multichip``
+    ``n_devices``/``rc``/``ok``/``skipped``/``tail``.
+``phases``
+    A phase profile: ``metric`` + ``phases`` list.
+``tpu_cache``
+    ``BENCH_TPU_LAST.json``: ``captured_utc``/``provenance``/``record``.
+
+Partial rules: a partial artifact carries ``extra.partial`` (non-empty
+string saying *what* is missing); a partial with a measurement list
+(``rows``/``phases``) is sized by it, and upgrades must be monotone —
+full beats partial, a partial only replaces a partial with strictly more
+measured rows (:func:`upgrade_ok`, the same rule
+``benchmarks/capture_lib.sh`` enforces shell-side).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = [
+    "detect_kind",
+    "measured_rows",
+    "trailing_json",
+    "upgrade_ok",
+    "validate",
+    "validate_file",
+    "validate_headline_text",
+    "validate_tree",
+]
+
+# the round driver's stdout capture window; a headline longer than this is
+# truncated and its JSON lost (the r4 failure)
+DRIVER_TAIL_CHARS = 2000
+
+_NUM = (int, float)
+
+
+def trailing_json(text: str):
+    """The last parseable JSON-object line of ``text``, or None — the same
+    extraction rule as bench's supervisor and capture_lib.sh."""
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
+def detect_kind(obj: dict) -> str | None:
+    if not isinstance(obj, dict):
+        return None
+    if {"captured_utc", "record"} <= set(obj):
+        return "tpu_cache"
+    if {"n_devices", "ok"} <= set(obj):
+        return "multichip"
+    if "phases" in obj and "metric" in obj:
+        return "phases"
+    if {"metric", "value"} <= set(obj):
+        return "record"
+    if {"rc", "tail"} <= set(obj):
+        return "driver_capture"
+    return None
+
+
+def measured_rows(obj: dict) -> int:
+    """A capture's substance: the length of its measurement list (mirrors
+    ``_measured_rows`` in capture_lib.sh; listless records count 0)."""
+    if not isinstance(obj, dict):
+        return 0
+    for k in ("rows", "phases"):
+        v = obj.get(k)
+        if isinstance(v, list):
+            return len(v)
+        extra = obj.get("extra")
+        if isinstance(extra, dict) and isinstance(extra.get(k), list):
+            return len(extra[k])
+    return 0
+
+
+def is_partial(obj: dict) -> bool:
+    if not isinstance(obj, dict):
+        return False
+    extra = obj.get("extra")
+    return bool(obj.get("partial")
+                or (isinstance(extra, dict) and extra.get("partial")))
+
+
+def _require(obj, key, types, kind, out, type_name=None):
+    if key not in obj:
+        out.append(f"{kind}: missing required key {key!r}")
+        return None
+    v = obj[key]
+    if not isinstance(v, types) or isinstance(v, bool) and bool not in (
+            types if isinstance(types, tuple) else (types,)):
+        out.append(
+            f"{kind}: {key!r} must be {type_name or types}, got "
+            f"{type(v).__name__}"
+        )
+        return None
+    return v
+
+
+def _validate_record(obj: dict, kind: str = "record") -> list:
+    out: list = []
+    _require(obj, "metric", str, kind, out)
+    _require(obj, "value", _NUM, kind, out, "a number")
+    _require(obj, "unit", str, kind, out)
+    _require(obj, "vs_baseline", _NUM, kind, out, "a number")
+    extra = obj.get("extra")
+    if extra is not None and not isinstance(extra, dict):
+        out.append(f"{kind}: extra must be a dict when present")
+        extra = None
+    if isinstance(extra, dict):
+        p = extra.get("partial")
+        if p is not None and (not isinstance(p, str) or not p.strip()):
+            out.append(
+                f"{kind}: extra.partial must be a non-empty string saying "
+                "what is missing"
+            )
+        for k in ("rows", "phases"):
+            if k in extra and not isinstance(extra[k], list):
+                out.append(f"{kind}: extra.{k} must be a list")
+    for k in ("rows", "phases"):
+        if k in obj and not isinstance(obj[k], list):
+            out.append(f"{kind}: {k} must be a list")
+    p = obj.get("partial")
+    if p is not None and (not isinstance(p, str) or not p.strip()):
+        out.append(f"{kind}: partial must be a non-empty string")
+    return out
+
+
+def _validate_driver_capture(obj: dict) -> list:
+    out: list = []
+    rc = _require(obj, "rc", int, "driver_capture", out)
+    _require(obj, "tail", str, "driver_capture", out)
+    parsed = obj.get("parsed")
+    if parsed is None:
+        if rc == 0:
+            out.append(
+                "driver_capture: rc == 0 but parsed is null — the tail's "
+                "trailing JSON was lost (the r4 failure mode)"
+            )
+    elif not isinstance(parsed, dict):
+        out.append("driver_capture: parsed must be an object or null")
+    else:
+        out += [f"parsed.{v}" for v in _validate_record(parsed)]
+        tail_obj = trailing_json(obj.get("tail", ""))
+        if tail_obj is not None and tail_obj.get("value") != parsed.get("value"):
+            out.append(
+                "driver_capture: parsed.value disagrees with the tail's "
+                "trailing JSON line"
+            )
+    return out
+
+
+def _validate_multichip(obj: dict) -> list:
+    out: list = []
+    _require(obj, "n_devices", int, "multichip", out)
+    _require(obj, "rc", int, "multichip", out)
+    _require(obj, "tail", str, "multichip", out)
+    for k in ("ok", "skipped"):
+        if k in obj and not isinstance(obj[k], bool):
+            out.append(f"multichip: {k!r} must be a bool")
+        elif k not in obj:
+            out.append(f"multichip: missing required key {k!r}")
+    if obj.get("ok") and obj.get("rc") != 0:
+        out.append("multichip: ok is true but rc != 0")
+    return out
+
+
+def _validate_phases(obj: dict) -> list:
+    out: list = []
+    _require(obj, "metric", str, "phases", out)
+    phases = _require(obj, "phases", list, "phases", out)
+    if phases is not None:
+        for i, ph in enumerate(phases):
+            if not isinstance(ph, dict):
+                out.append(f"phases: phases[{i}] must be an object")
+    return out
+
+
+def _validate_tpu_cache(obj: dict) -> list:
+    out: list = []
+    _require(obj, "captured_utc", str, "tpu_cache", out)
+    _require(obj, "provenance", str, "tpu_cache", out)
+    rec = _require(obj, "record", dict, "tpu_cache", out)
+    if rec is not None:
+        out += [f"record.{v}" for v in _validate_record(rec)]
+    return out
+
+
+_VALIDATORS = {
+    "record": _validate_record,
+    "driver_capture": _validate_driver_capture,
+    "multichip": _validate_multichip,
+    "phases": _validate_phases,
+    "tpu_cache": _validate_tpu_cache,
+}
+
+
+def validate(obj, kind: str | None = None) -> list:
+    """All contract violations of one artifact object (empty = valid)."""
+    if not isinstance(obj, dict):
+        return [f"artifact must be a JSON object, got {type(obj).__name__}"]
+    kind = kind or detect_kind(obj)
+    if kind is None:
+        return ["unrecognized artifact shape: none of the known key "
+                "signatures (record / driver_capture / multichip / phases "
+                "/ tpu_cache) match"]
+    if kind not in _VALIDATORS:
+        return [f"unknown artifact kind {kind!r}"]
+    return _VALIDATORS[kind](obj)
+
+
+def validate_headline_text(stdout_text: str) -> list:
+    """The stdout contract of a capture process: a trailing JSON line that
+    parses, validates as a record, and fits the driver's tail window."""
+    out: list = []
+    obj = trailing_json(stdout_text)
+    if obj is None:
+        return ["no parseable trailing JSON line on stdout (the r5 "
+                "failure mode: measurements existed but no line landed)"]
+    line = next(
+        ln for ln in reversed(stdout_text.strip().splitlines())
+        if ln.strip().startswith("{")
+        and _parses(ln.strip())
+    )
+    if len(line.strip()) > DRIVER_TAIL_CHARS:
+        out.append(
+            f"headline line is {len(line.strip())} chars — longer than the "
+            f"driver's {DRIVER_TAIL_CHARS}-char tail window (the r4 "
+            "failure mode)"
+        )
+    out += validate(obj, "record")
+    return out
+
+
+def _parses(line: str) -> bool:
+    try:
+        return isinstance(json.loads(line), dict)
+    except json.JSONDecodeError:
+        return False
+
+
+def upgrade_ok(old, new) -> list:
+    """Monotone-upgrade rule for re-landing an artifact name (the
+    capture_lib.sh contract): full beats partial; a partial only replaces
+    a partial with STRICTLY more measured rows; nothing replaces a full.
+    Returns violations of ``new`` landing over ``old``."""
+    if old is None:
+        return []
+    if not is_partial(old):
+        return ["landing over a FULL artifact: a full capture is never "
+                "overwritten"]
+    if is_partial(new) and measured_rows(new) <= measured_rows(old):
+        return [
+            f"partial-over-partial downgrade: new has {measured_rows(new)} "
+            f"measured rows, existing partial has {measured_rows(old)}"
+        ]
+    return []
+
+
+def validate_file(path: str) -> list:
+    """Violations of one artifact file (unreadable/unparseable included)."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except OSError as e:
+        return [f"unreadable: {e}"]
+    except json.JSONDecodeError as e:
+        return [f"not valid JSON: {e}"]
+    return validate(obj)
+
+
+def validate_tree(root: str, patterns=("BENCH_*.json", "MULTICHIP_*.json",
+                                       "MULTIHOST_*.json", "HISTRANK_*.json",
+                                       "PHASES_*.json")) -> dict:
+    """``{relative_path: violations}`` for every committed artifact under
+    ``root`` matching ``patterns`` (non-recursive: round artifacts land at
+    the repo root by contract).  Paths with no violations are included
+    with an empty list, so callers can report coverage, not just failures.
+    """
+    import glob as _glob
+
+    out = {}
+    for pat in patterns:
+        for path in sorted(_glob.glob(os.path.join(root, pat))):
+            out[os.path.basename(path)] = validate_file(path)
+    return out

@@ -1,0 +1,223 @@
+"""Fault plans: seeded, serializable descriptions of what to break where.
+
+A plan is a list of :class:`Fault`\\ s, each bound to a named
+instrumentation point (:func:`csmom_tpu.chaos.inject.checkpoint` call
+sites).  Plans serialize to TOML and arm through the ``CSMOM_FAULT_PLAN``
+environment variable — either a path to a ``.toml`` file or the TOML text
+itself (anything containing a newline or ``[[fault]]`` is treated as
+inline).  The env-var transport is deliberate: the capture pipeline is a
+process *tree* (supervisor → probe subprocesses → bench children →
+warmup child), and environment inheritance arms every process in it with
+one assignment, no plumbing.
+
+Determinism: ``seed`` drives every randomized choice a fault makes
+(corruption byte offsets, noise payloads) through ``random.Random`` — the
+same plan byte-for-byte reproduces the same damage.  Hit counting is
+per-process (each process in the tree counts its own checkpoint visits),
+which is what makes "kill the FIRST bench child at its first compile, let
+the fallback child live" expressible: the fallback is a new process whose
+counters start at zero, so a fault with ``max_fires = 1`` consumed by the
+first child never fires again *in that process* — cross-process scoping
+uses ``role`` instead (supervisor / child / warmup / any, derived from
+the ``CSMOM_BENCH_*`` env contract the pipeline already carries).
+
+TOML shape::
+
+    name = "kill-child-mid-compile"
+    seed = 7
+
+    [[fault]]
+    point = "bench.compile"     # checkpoint name (fnmatch pattern ok)
+    action = "kill"             # see Fault.ACTIONS
+    role = "child"              # supervisor | child | warmup | any
+    after = 0                   # skip this many matching hits first
+    max_fires = 1               # fire at most this many times (0 = every)
+    # action-specific keys: seconds, path, bytes, code, errno, text
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from fnmatch import fnmatch
+
+__all__ = ["Fault", "FaultPlan", "load_active_plan", "PLAN_ENV"]
+
+PLAN_ENV = "CSMOM_FAULT_PLAN"
+
+_ROLES = ("any", "supervisor", "child", "warmup")
+
+
+def _toml_module():
+    try:
+        import tomllib  # 3.11+ stdlib
+    except ModuleNotFoundError:  # pragma: no cover - 3.10 image
+        import tomli as tomllib
+    return tomllib
+
+
+def _toml_value(v) -> str:
+    """One scalar as TOML source (bools are lowercase; strings escape via
+    the JSON rules, which TOML basic strings share)."""
+    import json
+
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        return json.dumps(v)
+    return repr(v)
+
+
+def current_role() -> str:
+    """Which pipeline process this is, from the env contract bench already
+    sets on its children (``CSMOM_BENCH_CHILD`` / ``CSMOM_BENCH_WARMUP``).
+    A process that is neither is the supervisor (or a standalone CLI run,
+    which rehearses as one)."""
+    if os.environ.get("CSMOM_BENCH_WARMUP"):
+        return "warmup"
+    if os.environ.get("CSMOM_BENCH_CHILD"):
+        return "child"
+    return "supervisor"
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One fault: fire ``action`` at the ``after+1``-th .. hit of ``point``.
+
+    ``point`` is matched with :func:`fnmatch.fnmatch`, so
+    ``point = "bench.*"`` hits every bench checkpoint.  ``max_fires = 0``
+    means "every matching hit".
+    """
+
+    point: str
+    action: str
+    role: str = "any"
+    after: int = 0
+    max_fires: int = 1
+    global_once: bool = False  # fire once across the whole PROCESS TREE
+                               # (file-marker claim in CSMOM_FAULT_STATE):
+                               # "kill the first bench child, spare the
+                               # fallback" — per-process counters cannot
+                               # express that, a new process starts at 0
+    # action parameters (unused ones stay at their defaults)
+    seconds: float = 0.0     # sleep
+    path: str = ""           # corrupt_file / truncate_file glob (env-expanded)
+    bytes: int = 64          # truncate_file: size to keep
+    code: int = 1            # exit: status
+    errno_: int = 28         # raise_oserror: errno (default ENOSPC)
+    text: str = "chaos"      # stdout_noise payload / fail reason
+
+    ACTIONS = (
+        "kill",           # SIGKILL this process, right now (external cap)
+        "exit",           # os._exit(code) — a crash that skips cleanup
+        "sleep",          # hang for `seconds` (tunnel stall)
+        "trip_deadline",  # fire the armed deadline guard immediately
+        "clock_skew",     # jump time.time() by `seconds`; monotonic clocks
+                          # must shield every deadline from this
+        "corrupt_file",   # seeded byte-flips over files matching `path`
+        "truncate_file",  # cut files matching `path` to `bytes` bytes
+        "raise_oserror",  # raise OSError(errno_) at the checkpoint (ENOSPC)
+        "stdout_noise",   # concurrent writer racing the trailing JSON line
+        "fail",           # return "fail" for the caller to interpret
+    )
+
+    def validate(self) -> None:
+        if self.action not in self.ACTIONS:
+            raise ValueError(
+                f"unknown fault action {self.action!r} (expected one of "
+                f"{', '.join(self.ACTIONS)})"
+            )
+        if self.role not in _ROLES:
+            raise ValueError(
+                f"unknown fault role {self.role!r} (expected one of "
+                f"{', '.join(_ROLES)})"
+            )
+        if self.after < 0 or self.max_fires < 0:
+            raise ValueError("after/max_fires must be >= 0")
+
+    def matches(self, point: str, hit_index: int, role: str) -> bool:
+        """Does this fault fire for the ``hit_index``-th (0-based) matching
+        visit of ``point`` in a process with ``role``?"""
+        if self.role not in ("any", role):
+            return False
+        if not fnmatch(point, self.point):
+            return False
+        if hit_index < self.after:
+            return False
+        if self.max_fires and hit_index >= self.after + self.max_fires:
+            return False
+        return True
+
+    def to_toml(self) -> str:
+        lines = ["[[fault]]",
+                 f"point = {_toml_value(self.point)}",
+                 f"action = {_toml_value(self.action)}"]
+        defaults = Fault(point="", action="kill")
+        for f in dataclasses.fields(self):
+            if f.name in ("point", "action"):
+                continue
+            v = getattr(self, f.name)
+            if v != getattr(defaults, f.name):
+                key = "errno" if f.name == "errno_" else f.name
+                lines.append(f"{key} = {_toml_value(v)}")
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A named, seeded set of faults (the unit ``csmom rehearse`` runs)."""
+
+    name: str
+    faults: tuple
+    seed: int = 0
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValueError("fault plan needs a name")
+        for f in self.faults:
+            f.validate()
+
+    def to_toml(self) -> str:
+        head = f'name = "{self.name}"\nseed = {self.seed}\n'
+        return head + "\n" + "\n\n".join(f.to_toml() for f in self.faults) + "\n"
+
+    @classmethod
+    def from_toml(cls, text: str) -> "FaultPlan":
+        raw = _toml_module().loads(text)
+        known = {f.name for f in dataclasses.fields(Fault)} | {"errno"}
+        faults = []
+        for i, entry in enumerate(raw.get("fault", [])):
+            bad = set(entry) - known
+            if bad:
+                raise ValueError(
+                    f"fault #{i}: unknown keys {sorted(bad)} (a typo'd "
+                    "fault key must not silently become a no-op)"
+                )
+            if "errno" in entry:
+                entry = dict(entry, errno_=entry.pop("errno"))
+            faults.append(Fault(**entry))
+        plan = cls(
+            name=str(raw.get("name", "")),
+            seed=int(raw.get("seed", 0)),
+            faults=tuple(faults),
+        )
+        plan.validate()
+        return plan
+
+    @classmethod
+    def from_env_value(cls, value: str) -> "FaultPlan":
+        """Resolve the ``CSMOM_FAULT_PLAN`` value: a path unless it looks
+        like inline TOML (contains a newline or a ``[[fault]]`` table)."""
+        if "\n" in value or "[[fault]]" in value:
+            return cls.from_toml(value)
+        with open(value) as f:
+            return cls.from_toml(f.read())
+
+
+def load_active_plan() -> "FaultPlan | None":
+    """The armed plan, or None.  Raises loudly on an unparseable plan — a
+    rehearsal that silently ran fault-free would certify nothing."""
+    value = os.environ.get(PLAN_ENV, "")
+    if not value:
+        return None
+    return FaultPlan.from_env_value(value)

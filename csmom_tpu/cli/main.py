@@ -1432,7 +1432,8 @@ def build_parser() -> argparse.ArgumentParser:
         ("pack-info", cmd_packinfo, ()),
         ("bench", cmd_bench, ()),
         ("warmup", cmd_warmup, ()),
-    ):
+    ):  # rehearse lives in cli/rehearse.py (the main.py split: new
+        # subcommands register themselves instead of growing this module)
         sp = sub.add_parser(name, help=(fn.__doc__ or "").splitlines()[0])
         if name == "pack-info":
             sp.add_argument("pack_dir", help="packed panel directory")
@@ -1641,12 +1642,18 @@ def build_parser() -> argparse.ArgumentParser:
                             action="append", metavar="K=V",
                             help="strategy parameter, repeatable")
         sp.set_defaults(fn=fn)
+
+    from csmom_tpu.cli.rehearse import register as register_rehearse
+
+    register_rehearse(sub)
     return p
 
 
-# commands that never touch a device (pure pandas/numpy, or — bench — a
-# supervisor that does its own subprocess probing): no init probe for these
-_DEVICE_FREE_COMMANDS = {"fetch", "strategies", "bench", "pack-info"}
+# commands that never touch a device (pure pandas/numpy, or — bench and
+# rehearse — supervisors that do their own subprocess probing): no init
+# probe for these
+_DEVICE_FREE_COMMANDS = {"fetch", "strategies", "bench", "pack-info",
+                         "rehearse"}
 
 
 def _apply_platform(args) -> int:

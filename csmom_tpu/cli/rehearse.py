@@ -1,0 +1,731 @@
+"""csmom rehearse — prove the capture pipeline survives its fault matrix.
+
+Runs the supervisor → warmup → bench → deadline → land pipeline inside a
+sandbox tmpdir under every fault in the built-in matrix (plus ``--plan``
+for custom ones) and prints a per-fault pass/fail table.  Exit status is
+nonzero on ANY invariant violation, so watcher scripts can gate a tunnel
+window on a green rehearsal.  Everything runs on a CPU-only machine: the
+point is to rehearse BEFORE a window opens, not during one.
+
+Two pipeline tiers, because the invariants are properties of the capture
+*plumbing*, not the workload:
+
+- ``mini`` / ``shell`` scenarios drive :mod:`csmom_tpu.chaos.minibench`
+  and ``benchmarks/capture_lib.sh`` — sub-second per fault, no jax.
+  ``csmom rehearse --fast`` runs only these (the tier-1 subset).
+- ``bench`` scenarios drive the real ``bench.py`` supervisor or child in
+  smoke mode (``CSMOM_BENCH_SMOKE=1``: full pipeline shape, reduced
+  workload) — the r5 failure mode reproduced and shown fixed against the
+  actual code that will hold a window's measurements.
+
+This module is also the first move of the cli/main.py split (VERDICT:
+1,701 lines and growing): new subcommands land as their own module with a
+``register(sub)`` hook instead of growing the monolith.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+from csmom_tpu.chaos import invariants as inv
+from csmom_tpu.chaos.plan import Fault, FaultPlan
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_CAPTURE_LIB = os.path.join(_REPO, "benchmarks", "capture_lib.sh")
+
+
+# ------------------------------------------------------------ scenarios ----
+
+class Scenario:
+    """One rehearsal: a fault plan, a pipeline to drive, and the checks
+    the landed evidence must pass."""
+
+    def __init__(self, name, pipeline, plan, check, fast=False, notes="",
+                 env=None, rows=6, budget_s=None):
+        self.name = name
+        self.pipeline = pipeline  # mini | shell | bench-child | bench
+        self.plan = plan
+        self.check = check        # fn(result dict) -> list of violations
+        self.fast = fast
+        self.notes = notes
+        self.env = env or {}
+        self.rows = rows
+        self.budget_s = budget_s
+
+
+def _rows_of(obj) -> int:
+    return inv.measured_rows(obj or {})
+
+
+def _check_partial_no_lost_rows(r):
+    """A deadline-hit run must land a partial carrying EVERY measured row."""
+    out = list(r["headline_violations"])
+    obj = r["trailing"]
+    if obj is None:
+        return out + ["no trailing JSON line — measurements lost"]
+    if not inv.is_partial(obj):
+        out.append("expected an explicitly-partial record")
+    if r["sidecar_rows"] and _rows_of(obj) != r["sidecar_rows"]:
+        out.append(
+            f"lost measured rows: sidecar has {r['sidecar_rows']}, landed "
+            f"artifact has {_rows_of(obj)}"
+        )
+    if r.get("artifact") is not None:
+        out += [f"artifact: {v}" for v in inv.validate(r["artifact"])]
+        if _rows_of(r["artifact"]) != r["sidecar_rows"]:
+            out.append("landed artifact dropped measured rows")
+    elif r["sidecar_rows"]:
+        out.append("partial line printed but no artifact landed")
+    if r["rc"] != 0:
+        out.append(f"deadline dump must exit 0, got rc={r['rc']}")
+    return out
+
+
+def _check_full_all_rows(r):
+    """An unfaulted-outcome run: full record, all rows, schema-valid."""
+    out = list(r["headline_violations"])
+    obj = r["trailing"]
+    if obj is None:
+        return out + ["no trailing JSON line"]
+    if inv.is_partial(obj):
+        out.append("expected a FULL record, got a partial")
+    if r["sidecar_rows"] and _rows_of(obj) != r["sidecar_rows"]:
+        out.append(
+            f"row count mismatch: sidecar {r['sidecar_rows']} vs landed "
+            f"{_rows_of(obj)}"
+        )
+    if r.get("artifact") is not None:
+        out += [f"artifact: {v}" for v in inv.validate(r["artifact"])]
+    if r["rc"] != 0:
+        out.append(f"rc={r['rc']}")
+    return out
+
+
+def _check_killed_nothing_fabricated(r):
+    """A SIGKILLed process prints nothing; the landing layer must not
+    fabricate an artifact from the corpse (and must keep any prior one)."""
+    out = []
+    if r["rc"] >= 0:
+        out.append(f"expected SIGKILL (negative rc), got rc={r['rc']}")
+    if r["trailing"] is not None:
+        out.append("a SIGKILLed process somehow printed a summary line")
+    if r.get("artifact") is not None:
+        out.append("landing fabricated an artifact from a dead process")
+    return out
+
+
+def _mini_scenarios():
+    sleep_long = 600.0
+    return [
+        Scenario(
+            "expire-deadline-between-rows", "mini",
+            FaultPlan("expire-deadline-between-rows", seed=1, faults=(
+                Fault(point="mini.row", action="trip_deadline", after=3),
+            )),
+            _check_partial_no_lost_rows, fast=True,
+            notes="deadline expires between measured rows -> partial dump "
+                  "carries every measured row (r4/r5 fix, fast form)",
+        ),
+        Scenario(
+            "hang-mid-row", "mini",
+            FaultPlan("hang-mid-row", seed=2, faults=(
+                Fault(point="mini.row", action="sleep", after=2,
+                      seconds=sleep_long),
+            )),
+            _check_partial_no_lost_rows,
+            notes="tunnel-style hang mid-row -> watchdog beats the stall "
+                  "and dumps the measured rows",
+            env={"CSMOM_MINIBENCH_BUDGET": "2",
+                 "CSMOM_MINIBENCH_MIN_DELAY": "1"},
+            budget_s=None,
+        ),
+        Scenario(
+            "stdout-interleave", "mini",
+            FaultPlan("stdout-interleave", seed=3, faults=(
+                Fault(point="mini.finish", action="stdout_noise",
+                      seconds=1.0),
+            )),
+            _check_full_all_rows, fast=True,
+            notes="concurrent stdout writer racing the trailing JSON -> "
+                  "the quarantined single-write emit keeps it parseable",
+        ),
+        Scenario(
+            "clock-skew", "mini",
+            FaultPlan("clock-skew", seed=4, faults=(
+                Fault(point="mini.start", action="clock_skew",
+                      seconds=3600.0),
+            )),
+            _check_full_all_rows,
+            notes="wall clock jumps +1h mid-capture -> monotonic-anchored "
+                  "deadline keeps its true fuse, run completes in full",
+            env={"CSMOM_MINIBENCH_BUDGET": "30"},
+        ),
+        Scenario(
+            "sigkill-mid-row", "mini",
+            FaultPlan("sigkill-mid-row", seed=5, faults=(
+                Fault(point="mini.row", action="kill", after=2),
+            )),
+            _check_killed_nothing_fabricated,
+            notes="SIGKILL between rows: unpreventable loss, but the "
+                  "landing layer must not fabricate or clobber artifacts",
+        ),
+    ]
+
+
+def _check_short_write(r):
+    out = []
+    if r.get("artifact") is not None:
+        out.append("a truncated (ENOSPC) write LANDED as the artifact")
+    if not r.get("prior_intact", True):
+        out.append("the faulted landing damaged the pre-existing artifact")
+    if r.get("retry_artifact") is None:
+        out.append("the fault-free retry failed to land the artifact")
+    else:
+        out += [f"retry artifact: {v}"
+                for v in inv.validate(r["retry_artifact"])]
+    return out
+
+
+def _shell_scenarios():
+    return [
+        Scenario(
+            "land-short-write", "shell", None, _check_short_write, fast=True,
+            notes="ENOSPC/short write between formatter and rename -> "
+                  "post-write JSON validation refuses to land garbage; "
+                  "the fault-free retry lands cleanly",
+        ),
+    ]
+
+
+def _check_bench_partial(r):
+    """r5 reproduced and shown fixed: the child lost its window mid-run but
+    the already-measured headline landed in an explicitly-partial line."""
+    out = list(r["headline_violations"])
+    obj = r["trailing"]
+    if obj is None:
+        return out + ["no trailing JSON line — the r5 empty-record failure"]
+    extra = obj.get("extra") or {}
+    if not str(extra.get("partial", "")).startswith("child deadline hit"):
+        out.append("expected the child deadline watchdog's partial marker")
+    if not isinstance(obj.get("value"), (int, float)) or obj["value"] <= 0:
+        out.append("the measured headline value was lost")
+    if extra.get("platform") != "cpu":
+        out.append("partial record lost its platform attribution")
+    if r["rc"] != 0:
+        out.append(f"watchdog dump must exit 0, got rc={r['rc']}")
+    return out
+
+
+def _check_bench_supervisor_landed(r):
+    """Supervisor-level faults: whatever broke, ONE schema-valid headline
+    lands on stdout and points at (or explains) the full record."""
+    out = list(r["headline_violations"])
+    obj = r["trailing"]
+    if obj is None:
+        return out + ["supervisor printed no parseable headline"]
+    extra = obj.get("extra") or {}
+    if "full_record" not in extra:
+        out.append("headline does not reference the full record")
+    full = r.get("full_record")
+    if full is not None:
+        out += [f"full record: {v}" for v in inv.validate(full)]
+    return out
+
+
+def _check_bench_fallback_measured(r):
+    out = _check_bench_supervisor_landed(r)
+    obj = r["trailing"] or {}
+    if not isinstance(obj.get("value"), (int, float)) or obj.get("value", 0) <= 0:
+        out.append("no measured value — the fallback child did not secure "
+                   "the record")
+    return out
+
+
+def _check_kill_fallback(r):
+    out = _check_bench_fallback_measured(r)
+    full = r.get("full_record") or {}
+    errs = (full.get("extra") or {}).get("attempt_errors") or []
+    if not any("child" in str(e) for e in errs):
+        out.append("the SIGKILLed first child left no trace in "
+                   "attempt_errors — a lost attempt must be recorded, "
+                   "not hidden")
+    return out
+
+
+def _check_warmup_healed(r):
+    out = []
+    rep = r.get("trailing")
+    if rep is None:
+        return ["warmup printed no summary line"]
+    if rep.get("n_errors", 1) != 0:
+        out.append(
+            f"warmup reported {rep.get('n_errors')} errors over a corrupt "
+            "cache — self-heal (evict + recompile) did not hold"
+        )
+    if rep.get("value", 0) <= 0:
+        out.append("warmup compiled no manifest entries")
+    return out
+
+
+def _bench_scenarios():
+    return [
+        Scenario(
+            "r5-hang-mid-compile-window", "bench-child",
+            FaultPlan("r5-hang", seed=10, faults=(
+                # the r5 wound: the window dies right after the headline,
+                # mid "compile the next leg"
+                Fault(point="bench.row", action="sleep", seconds=600.0,
+                      role="child"),
+            )),
+            _check_bench_partial,
+            notes="THE r5 reproduction: child loses the window after the "
+                  "headline; the deadline guard lands a partial with the "
+                  "measured headline instead of an empty record",
+            env={"CSMOM_BENCH_CHILD_BUDGET": "150"},
+        ),
+        Scenario(
+            "expire-deadline-mid-row", "bench-child",
+            FaultPlan("expire-deadline-mid-row", seed=11, faults=(
+                Fault(point="bench.row", action="trip_deadline",
+                      role="child"),
+            )),
+            _check_bench_partial,
+            notes="deadline expiry between measured rows on the real "
+                  "child — instant form of the r5 rehearsal",
+            env={"CSMOM_BENCH_CHILD_BUDGET": "600"},
+        ),
+        Scenario(
+            "kill-child-mid-compile", "bench",
+            FaultPlan("kill-child-mid-compile", seed=12, faults=(
+                Fault(point="bench.compile", action="kill", role="child",
+                      global_once=True),
+            )),
+            _check_kill_fallback,
+            notes="supervisor's cap SIGKILLs the first child mid-compile; "
+                  "the fallback child still secures a measured record",
+        ),
+        Scenario(
+            "probe-outage", "bench",
+            FaultPlan("probe-outage", seed=13, faults=(
+                Fault(point="bench.probe", action="fail",
+                      role="supervisor", max_fires=0),
+            )),
+            _check_bench_fallback_measured,
+            notes="every tunnel probe fails; the CPU fallback secures the "
+                  "record and the probes are recorded, not hidden",
+            budget_s=480,  # small enough that the probe/sleep loop yields
+                           # to the reporting reserve right after fallback
+        ),
+        Scenario(
+            "enospc-on-land", "bench",
+            FaultPlan("enospc-on-land", seed=14, faults=(
+                Fault(point="bench.land", action="raise_oserror",
+                      role="supervisor", errno_=28),
+            )),
+            _check_bench_supervisor_landed,
+            notes="full-record write hits ENOSPC; the headline still "
+                  "prints, carrying the write failure as a reason",
+        ),
+        Scenario(
+            "corrupt-aot-cache", "warmup",
+            FaultPlan("corrupt-aot-cache", seed=15, faults=(
+                Fault(point="warmup.entry", action="corrupt_file",
+                      path="$CSMOM_JIT_CACHE/*", max_fires=1),
+            )),
+            _check_warmup_healed,
+            notes="serialized-executable cache corrupted on disk; warmup "
+                  "evicts + recompiles (self-heal) instead of crashing",
+        ),
+        Scenario(
+            "clock-skew-mid-child", "bench-child",
+            FaultPlan("clock-skew-mid-child", seed=16, faults=(
+                Fault(point="bench.compile", action="clock_skew",
+                      seconds=3600.0, role="child"),
+            )),
+            _check_bench_child_full,
+            notes="NTP-step wall-clock jump inside the child; the "
+                  "monotonic deadline holds and the full record lands",
+            env={"CSMOM_BENCH_CHILD_BUDGET": "600"},
+        ),
+    ]
+
+
+def _check_bench_child_full(r):
+    out = list(r["headline_violations"])
+    obj = r["trailing"]
+    if obj is None:
+        return out + ["no trailing JSON line"]
+    if inv.is_partial(obj):
+        out.append("clock skew shortened the monotonic deadline — the "
+                   "run was cut into a partial")
+    if r["rc"] != 0:
+        out.append(f"rc={r['rc']}")
+    return out
+
+
+def builtin_matrix(fast: bool = False):
+    mats = _mini_scenarios() + _shell_scenarios()
+    if not fast:
+        mats += _bench_scenarios()
+    else:
+        mats = [s for s in mats if s.fast]
+    return mats
+
+
+# -------------------------------------------------------------- runners ----
+
+def _land_with_capture_lib(raw_path: str, art_path: str, env=None) -> None:
+    script = (
+        "log() { echo \"[capture_lib] $*\" >&2; }; "
+        f"source '{_CAPTURE_LIB}'; "
+        f"land_artifact '{raw_path}' '{art_path}'"
+    )
+    subprocess.run(["bash", "-c", script], check=False,
+                   env={**os.environ, **(env or {})},
+                   capture_output=True, text=True)
+
+
+def _read_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _base_env(scenario, box: str) -> dict:
+    env = dict(os.environ)
+    env.pop("CSMOM_FAULT_STATE", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "CSMOM_FAULT_STATE": os.path.join(box, "chaos-state"),
+        "PYTHONPATH": _REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    if scenario.plan is not None:
+        plan_path = os.path.join(box, "plan.toml")
+        with open(plan_path, "w") as f:
+            f.write(scenario.plan.to_toml())
+        env["CSMOM_FAULT_PLAN"] = plan_path
+    env.update(scenario.env)
+    return env
+
+
+def _run_mini(scenario, box: str) -> dict:
+    sidecar = os.path.join(box, "sidecar.jsonl")
+    env = _base_env(scenario, box)
+    env.setdefault("CSMOM_MINIBENCH_BUDGET", "60")
+    env.update({
+        "CSMOM_MINIBENCH_ROWS": str(scenario.rows),
+        "CSMOM_MINIBENCH_SIDECAR": sidecar,
+    })
+    p = subprocess.run(
+        [sys.executable, "-m", "csmom_tpu.chaos.minibench"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=box,
+    )
+    raw = os.path.join(box, "raw.out")
+    with open(raw, "w") as f:
+        f.write(p.stdout)
+    art = os.path.join(box, "ARTIFACT.json")
+    _land_with_capture_lib(raw, art)
+    sidecar_rows = 0
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            sidecar_rows = sum(1 for ln in f if ln.strip())
+    trailing = inv.trailing_json(p.stdout)
+    return {
+        "rc": p.returncode,
+        "stdout": p.stdout,
+        "stderr": p.stderr,
+        "trailing": trailing,
+        "headline_violations": (
+            inv.validate_headline_text(p.stdout) if trailing else []
+        ),
+        "sidecar_rows": sidecar_rows,
+        "artifact": _read_json(art),
+    }
+
+
+def _run_shell(scenario, box: str) -> dict:
+    # a known-good raw capture, landed twice: once under the short-write
+    # fault (must refuse), once clean (must land)
+    full = {"metric": "m", "value": 3.0, "unit": "u", "vs_baseline": 1.0,
+            "extra": {"rows": [{"r": 0}, {"r": 1}]}}
+    prior = {"metric": "m", "value": 2.0, "unit": "u", "vs_baseline": 1.0,
+             "extra": {"partial": "one row measured", "rows": [{"r": 0}]}}
+    raw = os.path.join(box, "raw.out")
+    with open(raw, "w") as f:
+        f.write("progress line\n" + json.dumps(full) + "\n")
+    art = os.path.join(box, "ARTIFACT.json")
+    prior_path = os.path.join(box, "PRIOR.json")
+    with open(prior_path, "w") as f:
+        json.dump(prior, f)
+    # faulted landing over an empty slot must not land garbage
+    _land_with_capture_lib(raw, art,
+                           env={"CSMOM_FAULT_LAND_TRUNCATE_BYTES": "20"})
+    landed_faulted = _read_json(art)
+    # faulted landing over an existing partial must leave it intact
+    _land_with_capture_lib(raw, prior_path,
+                           env={"CSMOM_FAULT_LAND_TRUNCATE_BYTES": "20"})
+    prior_after = _read_json(prior_path)
+    # clean retry lands
+    _land_with_capture_lib(raw, art)
+    return {
+        "rc": 0,
+        "stdout": "",
+        "stderr": "",
+        "trailing": full,
+        "headline_violations": [],
+        "sidecar_rows": 0,
+        "artifact": landed_faulted,
+        "prior_intact": prior_after == prior,
+        "retry_artifact": _read_json(art),
+    }
+
+
+def _run_bench_child(scenario, box: str) -> dict:
+    env = _base_env(scenario, box)
+    env.update({
+        "CSMOM_BENCH_CHILD": "1",
+        "CSMOM_BENCH_FORCE_CPU": "1",
+        "CSMOM_BENCH_SMOKE": "1",
+    })
+    env.setdefault("CSMOM_BENCH_CHILD_BUDGET", "300")
+    p = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=box,
+        timeout=float(env["CSMOM_BENCH_CHILD_BUDGET"]) + 60,
+    )
+    trailing = inv.trailing_json(p.stdout)
+    return {
+        "rc": p.returncode,
+        "stdout": p.stdout,
+        "stderr": p.stderr,
+        "trailing": trailing,
+        # the SUPERVISOR parses a child's line (no driver tail window —
+        # it builds the bounded headline itself), so a direct child run
+        # validates record schema only, not the 2,000-char cap
+        "headline_violations": (
+            inv.validate(trailing, "record") if trailing else []
+        ),
+        "sidecar_rows": 0,
+    }
+
+
+def _run_bench_supervisor(scenario, box: str) -> dict:
+    env = _base_env(scenario, box)
+    env.update({
+        "CSMOM_BENCH_SMOKE": "1",
+        "CSMOM_BENCH_FULL_DIR": box,
+        "CSMOM_ROUND": "rehearse",
+        "CSMOM_BENCH_BUDGET": str(scenario.budget_s or 600),
+    })
+    p = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=box,
+        timeout=(scenario.budget_s or 600) + 120,
+    )
+    trailing = inv.trailing_json(p.stdout)
+    return {
+        "rc": p.returncode,
+        "stdout": p.stdout,
+        "stderr": p.stderr,
+        "trailing": trailing,
+        "headline_violations": (
+            inv.validate_headline_text(p.stdout) if trailing else []
+        ),
+        "sidecar_rows": 0,
+        "full_record": _read_json(
+            os.path.join(box, "BENCH_FULL_rehearse.json")
+        ),
+    }
+
+
+def _run_warmup(scenario, box: str) -> dict:
+    env = _base_env(scenario, box)
+    cache = os.path.join(box, "jit-cache")
+    env["CSMOM_JIT_CACHE"] = cache
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "import json;"
+        "from csmom_tpu.compile.aot import warmup;"
+        "rep = warmup(profiles=('smoke',), subdir='rehearse',"
+        "             include_golden_event=False, write_report=False);"
+        "print(json.dumps({'metric': 'aot_warmup', 'value': rep['n_entries'],"
+        "                  'unit': 'entries', 'vs_baseline': 1.0,"
+        "                  'n_errors': rep['n_errors'],"
+        "                  'n_cache_hits': rep['n_cache_hits']}))"
+    )
+    # pass 1: populate the cache, fault-free
+    clean = {k: v for k, v in env.items() if k != "CSMOM_FAULT_PLAN"}
+    p0 = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                        text=True, env=clean, cwd=box, timeout=600)
+    # pass 2: the armed fault corrupts every cached executable before the
+    # first entry compiles; self-heal must evict + recompile
+    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, cwd=box, timeout=600)
+    trailing = inv.trailing_json(p.stdout)
+    out = {
+        "rc": p.returncode,
+        "stdout": p.stdout,
+        "stderr": p.stderr,
+        "trailing": trailing,
+        "headline_violations": [],
+        "sidecar_rows": 0,
+    }
+    if p0.returncode != 0:
+        out["headline_violations"] = [
+            f"fault-free warmup pass failed rc={p0.returncode}: "
+            f"{p0.stderr[-300:]}"
+        ]
+    return out
+
+
+_RUNNERS = {
+    "mini": _run_mini,
+    "shell": _run_shell,
+    "bench-child": _run_bench_child,
+    "bench": _run_bench_supervisor,
+    "warmup": _run_warmup,
+}
+
+
+# ------------------------------------------------------------------ cmd ----
+
+def _run_scenario(scenario, sandbox_root: str) -> tuple:
+    box = os.path.join(sandbox_root, scenario.name)
+    os.makedirs(box, exist_ok=True)
+    t0 = time.monotonic()
+    try:
+        result = _RUNNERS[scenario.pipeline](scenario, box)
+        violations = scenario.check(result)
+    except subprocess.TimeoutExpired as e:
+        violations = [f"scenario runner timed out after {e.timeout:.0f}s"]
+        result = {}
+    wall = time.monotonic() - t0
+    return result, violations, wall
+
+
+# the generic invariant of a custom plan on each pipeline: the outcome may
+# be full OR partial, but a schema-valid line must land with zero lost rows
+def _check_custom_generic(r):
+    out = list(r["headline_violations"])
+    obj = r["trailing"]
+    if obj is None:
+        return out + ["no parseable trailing JSON line — the fault lost "
+                      "the measurements"]
+    if r["sidecar_rows"] and _rows_of(obj) != r["sidecar_rows"]:
+        out.append(
+            f"lost measured rows: sidecar has {r['sidecar_rows']}, landed "
+            f"line has {_rows_of(obj)}"
+        )
+    return out
+
+
+_CUSTOM_CHECKS = {
+    "mini": _check_custom_generic,
+    "bench-child": _check_custom_generic,
+    "bench": _check_bench_supervisor_landed,
+    "warmup": _check_warmup_healed,
+}
+
+
+def cmd_rehearse(args) -> int:
+    """Rehearse the capture pipeline under deterministic fault injection."""
+    if getattr(args, "plan", None):
+        if args.pipeline not in _CUSTOM_CHECKS:
+            print(
+                f"--pipeline {args.pipeline} does not take a custom plan "
+                "(its faults are CSMOM_FAULT_* env-var driven, not "
+                "checkpoint-based); use one of "
+                f"{', '.join(sorted(_CUSTOM_CHECKS))}",
+                file=sys.stderr,
+            )
+            return 2
+        plan = FaultPlan.from_env_value(args.plan)
+        matrix = [Scenario(
+            plan.name or "custom-plan", args.pipeline, plan,
+            _CUSTOM_CHECKS[args.pipeline],
+            notes="custom plan (generic invariants: a schema-valid line "
+                  "lands, full or explicitly partial, zero lost rows)",
+        )]
+    else:
+        matrix = builtin_matrix(fast=args.fast)
+    if getattr(args, "only", None):
+        matrix = [s for s in matrix if args.only in s.name]
+        if not matrix:
+            print(f"no scenario matches --only {args.only!r}",
+                  file=sys.stderr)
+            return 2
+    if getattr(args, "list", False):
+        for s in matrix:
+            tier = "fast" if s.fast else "full"
+            print(f"{s.name:32s} {s.pipeline:12s} [{tier}] {s.notes}")
+        return 0
+
+    sandbox_root = args.sandbox or tempfile.mkdtemp(prefix="csmom-rehearse-")
+    os.makedirs(sandbox_root, exist_ok=True)
+    print(f"rehearsing {len(matrix)} fault scenario(s) in {sandbox_root} "
+          f"({'fast tier' if args.fast else 'full matrix'})\n")
+
+    failures = 0
+    rows = []
+    for scenario in matrix:
+        result, violations, wall = _run_scenario(scenario, sandbox_root)
+        ok = not violations
+        failures += 0 if ok else 1
+        rows.append((scenario, ok, wall, violations))
+        status = "PASS" if ok else "FAIL"
+        print(f"  [{status}] {scenario.name:32s} ({scenario.pipeline}, "
+              f"{wall:5.1f}s)")
+        for v in violations:
+            print(f"         - {v}")
+        if not ok and args.verbose and result.get("stderr"):
+            print("         stderr tail:",
+                  result["stderr"][-400:].replace("\n", "\n           "))
+
+    print(f"\n{len(matrix) - failures}/{len(matrix)} scenarios green")
+    if failures:
+        print("rehearsal FAILED: the capture pipeline would lose evidence "
+              "under at least one rehearsed fault — fix before a window",
+              file=sys.stderr)
+    if not args.keep and not args.sandbox and not failures:
+        shutil.rmtree(sandbox_root, ignore_errors=True)
+    elif failures:
+        print(f"sandbox kept for inspection: {sandbox_root}")
+    return 1 if failures else 0
+
+
+def register(sub) -> None:
+    """Attach the ``rehearse`` subparser (called from cli.main)."""
+    sp = sub.add_parser(
+        "rehearse",
+        help="rehearse the capture pipeline under deterministic fault "
+             "injection (run before every tunnel window)",
+    )
+    sp.add_argument("--fast", action="store_true",
+                    help="tier-1 subset: capture-path faults only (<30 s, "
+                         "no jax) — what the watcher gates on")
+    sp.add_argument("--plan", metavar="TOML",
+                    help="run a custom fault plan (path or inline TOML) "
+                         "instead of the built-in matrix")
+    sp.add_argument("--pipeline", default="mini",
+                    choices=sorted(_RUNNERS),
+                    help="pipeline a custom --plan drives (default mini)")
+    sp.add_argument("--only", metavar="SUBSTR",
+                    help="run only matrix scenarios whose name contains "
+                         "SUBSTR")
+    sp.add_argument("--list", action="store_true",
+                    help="print the scenario matrix without running it")
+    sp.add_argument("--sandbox", metavar="DIR",
+                    help="run in DIR instead of a fresh tmpdir (kept)")
+    sp.add_argument("--keep", action="store_true",
+                    help="keep the sandbox even when green")
+    sp.add_argument("--verbose", action="store_true",
+                    help="print stderr tails of failing scenarios")
+    sp.set_defaults(fn=cmd_rehearse)

@@ -30,6 +30,78 @@ log = get_logger("compile.aot")
 REPORT_NAME = "warmup_report.json"
 
 
+def _evict_cache_entries() -> int:
+    """Remove every serialized-executable file from the live cache dir.
+
+    The cache keys are opaque (HLO hash + backend), so a corrupt entry
+    cannot be mapped back to the computation that tripped over it — and a
+    cache that has already served one poisoned entry is not worth
+    trusting for the rest of a scarce window.  Eviction costs only
+    recompiles; keeping a poisoned entry costs the window.  Returns the
+    number of files removed.
+    """
+    import glob
+
+    import jax
+
+    d = jax.config.jax_compilation_cache_dir
+    if not d or not os.path.isdir(d):
+        return 0
+    n = 0
+    for p in glob.glob(os.path.join(d, "*")):
+        if os.path.basename(p) == REPORT_NAME or not os.path.isfile(p):
+            continue
+        try:
+            os.remove(p)
+            n += 1
+        except OSError:
+            pass  # a file we cannot remove we also cannot make worse
+    return n
+
+
+# error-text shapes a poisoned serialized executable surfaces as (jax
+# soft-fails zlib header damage with a warning, but truncation/bit-flips
+# can raise from the decompressor or the XLA deserializer instead)
+_CORRUPTION_MARKERS = (
+    "deserial", "decompress", "corrupt", "truncat", "incorrect header",
+    "invalid compressed data", "compilation cache",
+)
+
+
+def _looks_like_cache_corruption(e: Exception) -> bool:
+    msg = f"{type(e).__name__}: {e}".lower()
+    return any(m in msg for m in _CORRUPTION_MARKERS)
+
+
+def _compile_with_self_heal(lowered, name: str):
+    """``lowered.compile()`` that survives a corrupt cache entry.
+
+    jax soft-fails on some damage (a zlib header error logs a warning and
+    recompiles) but a truncated or bit-flipped serialized executable can
+    surface as a raising deserialization error instead — and before this
+    guard, that single poisoned file crashed the warmup/bench child and
+    cost the window (the chaos ``corrupt-aot-cache`` fault pins this
+    path).  On a corruption-shaped exception: log, evict the cache, retry
+    once cold.  Any other exception (OOM, unsupported op, a backend that
+    died) propagates untouched — evicting the cache for those would
+    destroy every already-warmed shape over an error eviction cannot fix.
+    A second failure after eviction is a real compile problem and
+    propagates too.
+    """
+    try:
+        return lowered.compile(), False
+    except Exception as e:
+        if not _looks_like_cache_corruption(e):
+            raise
+        evicted = _evict_cache_entries()
+        log.warning(
+            "compile of %s raised %s: %s — evicted %d cache entries, "
+            "recompiling cold (corrupt serialized-executable self-heal)",
+            name, type(e).__name__, str(e)[:200], evicted,
+        )
+        return lowered.compile(), True
+
+
 def aot_compile(entry) -> dict:
     """Lower + compile one :class:`ManifestEntry`; return its record.
 
@@ -38,7 +110,12 @@ def aot_compile(entry) -> dict:
     (``cache_hit``) — the per-shape evidence the bench record embeds.
     The compiled executable object itself is discarded: the product is
     the on-disk cache entry, not the in-process handle.
+
+    A corrupt cache entry is detected, logged, evicted, and recompiled
+    (``self_healed`` in the record) instead of raising — a poisoned cache
+    must cost recompiles, never a window.
     """
+    from csmom_tpu.chaos.inject import checkpoint
     from csmom_tpu.utils.profiling import compile_stats
 
     entry.validate()
@@ -46,11 +123,12 @@ def aot_compile(entry) -> dict:
     t0 = time.perf_counter()
     lowered = entry.fn.lower(*entry.args, **dict(entry.kwargs))
     trace_s = time.perf_counter() - t0
+    checkpoint("aot.compile", entry=entry.name)
     t1 = time.perf_counter()
-    lowered.compile()
+    _, healed = _compile_with_self_heal(lowered, entry.name)
     compile_s = time.perf_counter() - t1
     d = compile_stats().delta(before)
-    return {
+    rec = {
         "name": entry.name,
         "shapes": entry.shape_summary(),
         "trace_s": round(trace_s, 4),
@@ -62,6 +140,11 @@ def aot_compile(entry) -> dict:
         # records neither, which warmup() rules out by zeroing the floor
         "cache_hit": bool(d.cache_hits and d.cache_misses == 0),
     }
+    if healed:
+        rec["self_healed"] = ("corrupt cache entry evicted and recompiled "
+                              "cold")
+        rec["cache_hit"] = False
+    return rec
 
 
 def warmup(profiles=("bench-cpu", "golden"), *, subdir: str = "bench",
@@ -103,8 +186,11 @@ def warmup(profiles=("bench-cpu", "golden"), *, subdir: str = "bench",
     for profile in profiles:
         entries += [(profile, e) for e in build_manifest(profile)]
 
+    from csmom_tpu.chaos.inject import checkpoint
+
     rows = []
     for profile, entry in entries:
+        checkpoint("warmup.entry", entry=entry.name)
         try:
             rec = aot_compile(entry)
         except Exception as e:  # record, keep warming the rest

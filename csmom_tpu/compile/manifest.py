@@ -104,7 +104,7 @@ def _grid_entries(A: int, M: int, dtype, *, modes_impls, tag: str,
             name=f"grid.jk16.rank.xla.donated@{tag}",
             fn=_jk_grid_backtest_donated,
             args=(p, m, _sds((len(wl.GRID_JS),), idx),
-                  _sds((len(wl.GRID_KS),), idx), 1),
+                  _sds((len(wl.GRID_KS),), idx), wl.GRID_SKIP),
             kwargs=dict(n_bins=10, mode="rank", max_hold=max(wl.GRID_KS),
                         freq=12, impl="xla"),
         ))

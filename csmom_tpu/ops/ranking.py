@@ -120,13 +120,16 @@ def _qcut_edges(x, valid, n_bins: int):
     A = x.shape[0]
     v_sorted = jnp.sort(jnp.where(valid, x, _BIG))
     n = jnp.sum(valid)
-    # pandas.qcut nudges each probability up one ulp when n_bins*p is not
-    # exactly the integer it "should" be (tile.py: np.putmask(quantiles,
-    # q*quantiles != arange, nextafter)); bit-exact edges need the same nudge.
-    # Static given n_bins, so computed host-side at trace time.
+    # pandas >= 2.0 passes the raw linspace probabilities to Series.quantile
+    # (the pre-2.0 one-ulp nextafter nudge in tile.py is gone), which routes
+    # them through np.percentile: q -> q*100 -> /100.  That percent roundtrip
+    # is lossy — (1/3)*100/100 lands one ulp BELOW 1/3 — so an edge that
+    # "should" fall on an exact order statistic interpolates a hair below the
+    # data value, and searchsorted(side='left') sends a tied value to the
+    # UPPER bin.  Bit-exact parity requires the same roundtripped
+    # probabilities.  Static given n_bins, so computed host-side.
     q = np.linspace(0.0, 1.0, n_bins + 1)
-    q = np.where(n_bins * q != np.arange(n_bins + 1), np.nextafter(q, 1), q)
-    q = jnp.asarray(q, dtype=x.dtype)
+    q = jnp.asarray((q * 100.0) / 100.0, dtype=x.dtype)
     pos = q * jnp.maximum(n - 1, 0).astype(x.dtype)
     lo = jnp.floor(pos).astype(jnp.int32)
     hi = jnp.minimum(lo + 1, jnp.maximum(n - 1, 0)).astype(jnp.int32)

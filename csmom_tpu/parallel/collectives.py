@@ -37,7 +37,11 @@ from csmom_tpu.backtest.grid import (
 )
 from csmom_tpu.backtest.monthly import decile_partial_sums, decile_means
 from csmom_tpu.ops.ranking import decile_assign_panel
-from csmom_tpu.signals.momentum import momentum_dynamic, monthly_returns
+from csmom_tpu.signals.momentum import (
+    formation_listed_mask,
+    momentum_dynamic,
+    monthly_returns,
+)
 from csmom_tpu.analytics.stats import sharpe, masked_mean, t_stat, nw_t_stat
 
 
@@ -88,6 +92,10 @@ def sharded_monthly_spread_backtest(
     def local_fn(pv, mv):
         ret_l, retv_l = monthly_returns(pv, mv)
         mom_l, momv_l = momentum_dynamic(pv, mv, lookback, skip)
+        # same delisting rule as the single-device engine (shard-local:
+        # the time axis is unsharded, so the per-asset last print is exact)
+        momv_l = momv_l & formation_listed_mask(mv, skip)
+        mom_l = jnp.where(momv_l, mom_l, jnp.nan)
         labels_l, _ = _ranked_labels_local(mom_l, momv_l, n_bins, mode)
 
         next_ret = jnp.roll(ret_l, -1, axis=1)
@@ -158,6 +166,10 @@ def sharded_banded_backtest(
     def local_fn(pv, mv):
         ret_l, retv_l = monthly_returns(pv, mv)
         mom_l, momv_l = momentum_dynamic(pv, mv, lookback, skip)
+        # same delisting rule as the single-device engine (shard-local:
+        # the time axis is unsharded, so the per-asset last print is exact)
+        momv_l = momv_l & formation_listed_mask(mv, skip)
+        mom_l = jnp.where(momv_l, mom_l, jnp.nan)
         labels_l, _ = _ranked_labels_local(mom_l, momv_l, n_bins, mode)
         long_l, short_l = banded_books(labels_l, n_bins, band)
         # the single-device aggregation, distributed by exactly one psum
@@ -216,9 +228,12 @@ def sharded_jk_grid_backtest(
 
     def local_fn(pv, mv, Js_l, Ks_all):
         ret_l, retv_l = monthly_returns(pv, mv)
+        listed_l = formation_listed_mask(mv, skip)
 
         def per_J(J):
             mom_l, momv_l = momentum_dynamic(pv, mv, J, skip)
+            momv_l = momv_l & listed_l
+            mom_l = jnp.where(momv_l, mom_l, jnp.nan)
             labels_l, _ = _ranked_labels_local(mom_l, momv_l, n_bins, mode)
             return _cohort_partial_sums(labels_l, ret_l, retv_l, n_bins, H,
                                         impl=impl)

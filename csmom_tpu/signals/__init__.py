@@ -1,6 +1,13 @@
 """Signal engineering over masked panels: momentum, turnover, intraday."""
 
-from csmom_tpu.signals.momentum import monthly_returns, momentum, momentum_dynamic
+from csmom_tpu.signals.momentum import (
+    formation_listed_mask,
+    monthly_returns,
+    momentum,
+    momentum_dynamic,
+    padded_prices,
+    raw_monthly_returns,
+)
 from csmom_tpu.signals.residual import (
     residual_momentum,
     residual_momentum_sweep,
@@ -13,7 +20,10 @@ from csmom_tpu.signals.turnover import (
 )
 
 __all__ = [
+    "formation_listed_mask",
     "monthly_returns",
+    "padded_prices",
+    "raw_monthly_returns",
     "momentum",
     "momentum_dynamic",
     "residual_momentum",

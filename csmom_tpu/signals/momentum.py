@@ -6,18 +6,32 @@ prices, ``ret_1m = pct_change`` per asset, then ``mom_J`` = shift by
 ``prod(1+r) - 1`` evaluated with a Python lambda per window — the hottest
 signal loop in the reference (SURVEY §3.2).
 
-Panel form: the window product telescopes, so the compounded (J, skip)
-momentum is a single gather-and-divide::
+The reference's ``pct_change()`` runs with pandas' default
+``fill_method='pad'``: prices are forward-filled before differencing, so an
+interior missing month carries the last observed price (its return is 0.0,
+not NaN) and a delisted asset keeps a 0-return tail.  Only the months
+*before* an asset's first observation stay NaN.  The panel kernels reproduce
+that exactly by forward-filling along the time axis (:func:`padded_prices`)
+and keying validity off "has the asset been observed yet", not off the raw
+observation mask.
 
-    mom[a, t] = price[a, t-skip] / price[a, t-skip-J] - 1
+Panel form: the window product telescopes on the filled prices, so the
+compounded (J, skip) momentum is a single gather-and-divide::
 
-valid iff every monthly return inside the window exists.  That validity rule
-reproduces the reference's NaN semantics exactly on per-asset contiguous
-histories: pandas' ``min_periods=1`` never actually emits an early value
-because the leading ``pct_change`` NaN poisons every truncated window
-(measured in SURVEY §2.1.2: first valid ``mom_J`` lands at month
-J+skip+1), and an interior missing month poisons the windows covering it
-just like NaN propagates through ``np.prod``.
+    mom[a, t] = filled[a, t-skip] / filled[a, t-skip-J] - 1
+
+valid iff every (padded) monthly return inside the window exists — i.e. the
+window opens at or after the asset's first observation.  That reproduces the
+reference's NaN semantics: pandas' ``min_periods=1`` never actually emits an
+early value because the leading ``pct_change`` NaN poisons every truncated
+window (measured in SURVEY §2.1.2: first valid ``mom_J`` lands at month
+J+skip+1 after the asset's first observation).
+
+The grid/backtest drivers (``run_demo.py``) instead build the signal from
+*raw* shifted prices (``prices.shift(skip)/prices.shift(skip+J) - 1``), which
+additionally drops an asset from every formation date after its delisting;
+:func:`formation_listed_mask` expresses that extra requirement for the
+engines without changing this module's rolling-product parity.
 
 No Python per-window work, no scan: O(A*T) elementwise ops + one prefix
 sum for the validity count — embarrassingly parallel along assets, which is
@@ -33,8 +47,37 @@ import jax.numpy as jnp
 
 
 @jax.jit
+def padded_prices(prices, mask):
+    """Forward-filled price panel (pandas ``fill_method='pad'`` parity).
+
+    Args:
+      prices: f[A, M] month-end price panel (NaN at masked slots).
+      mask:   bool[A, M] raw observation mask.
+
+    Returns:
+      (filled f[A, M], seen bool[A, M]) — ``filled[a, t]`` is the last
+      observed price at or before t (NaN before the asset's first
+      observation); ``seen[a, t]`` marks slots with at least one observation
+      at or before t.
+    """
+    M = prices.shape[1]
+    idx = jnp.arange(M)
+    last = jax.lax.cummax(jnp.where(mask, idx, -1), axis=1)
+    seen = last >= 0
+    filled = jnp.take_along_axis(
+        jnp.where(mask, prices, jnp.nan), jnp.clip(last, 0, M - 1), axis=1
+    )
+    return jnp.where(seen, filled, jnp.nan), seen
+
+
+@jax.jit
 def monthly_returns(prices, mask):
     """1-month simple returns per asset (``features.py:44``).
+
+    Pandas-pad parity: returns are differences of the forward-filled panel,
+    so a gap month yields 0.0 (price carried) and a delisted asset a
+    0-return tail; only slots before the asset's first observation (plus the
+    first month) are invalid.
 
     Args:
       prices: f[A, M] month-end price panel (NaN at masked slots).
@@ -42,7 +85,27 @@ def monthly_returns(prices, mask):
 
     Returns:
       (ret f[A, M], ret_valid bool[A, M]) — slot t holds
-      ``prices[t]/prices[t-1] - 1``; the first month of each asset is invalid.
+      ``filled[t]/filled[t-1] - 1``.
+    """
+    filled, seen = padded_prices(prices, mask)
+    prev = jnp.roll(filled, 1, axis=1)
+    prev_seen = jnp.roll(seen, 1, axis=1).at[:, 0].set(False)
+    # seen is monotone along time, so prev_seen alone implies seen
+    valid = prev_seen & (prev != 0.0)
+    ret = jnp.where(valid, filled / jnp.where(valid, prev, 1.0) - 1.0, jnp.nan)
+    return ret, valid
+
+
+@jax.jit
+def raw_monthly_returns(prices, mask):
+    """Adjacent-months returns on the *raw* (un-padded) panel.
+
+    ``ret[t] = prices[t]/prices[t-1] - 1`` with both month-ends observed,
+    NaN otherwise — a missing month drops out of that asset's windows
+    instead of carrying the last price forward.  This is the contract the
+    residual-momentum OLS windows and the low-volatility rolling std build
+    on (full masked windows, pandas ``rolling`` NaN-skipping); portfolio
+    next-month returns use :func:`monthly_returns` (pad parity) instead.
     """
     prev = jnp.roll(prices, 1, axis=1)
     prev_mask = jnp.roll(mask, 1, axis=1).at[:, 0].set(False)
@@ -78,6 +141,7 @@ def momentum_dynamic(prices, mask, lookback, skip):
     instead of one compilation per cell.
     """
     _, ret_valid = monthly_returns(prices, mask)
+    filled, _ = padded_prices(prices, mask)
     A, M = prices.shape
     t = jnp.arange(M)
 
@@ -86,7 +150,8 @@ def momentum_dynamic(prices, mask, lookback, skip):
     lo = t - skip - lookback
     in_range = lo >= 0
 
-    # all J returns in the window must exist (NaN poisoning parity)
+    # all J (padded) returns in the window must exist — equivalently the
+    # window opens at or after the asset's first observation
     bad = (~ret_valid).astype(jnp.int32)
     badc = jnp.concatenate(
         [jnp.zeros((A, 1), jnp.int32), jnp.cumsum(bad, axis=1)], axis=1
@@ -95,8 +160,32 @@ def momentum_dynamic(prices, mask, lookback, skip):
     lo_c = jnp.clip(lo + 1, 0, M - 1)
     window_bad = badc[:, hi_c + 1] - badc[:, lo_c]
 
-    p_hi = prices[:, hi_c]
-    p_lo = prices[:, jnp.clip(lo, 0, M - 1)]
+    p_hi = filled[:, hi_c]
+    p_lo = filled[:, jnp.clip(lo, 0, M - 1)]
     valid = in_range[None, :] & (window_bad == 0) & (p_lo != 0.0)
     mom = jnp.where(valid, p_hi / jnp.where(valid, p_lo, 1.0) - 1.0, jnp.nan)
     return mom, valid
+
+
+def formation_listed_mask(mask, skip):
+    """bool[A, M]: the asset is still listed at the formation window's end.
+
+    The reference's backtest drivers form the signal as
+    ``prices.shift(skip) / prices.shift(skip+J) - 1`` on the *raw* panel
+    (``run_demo.py:31-45``): once an asset's history ends (delisting), the
+    shifted raw price is NaN and the asset drops out of every later
+    formation date — even though the padded rolling-product signal would
+    carry a value through.  The engines AND this mask into the padded
+    momentum validity to reproduce that: an asset is ranked only while an
+    observation exists at or after the window-end month ``t - skip`` (its
+    last formation date is the month after its final print).  An *interior*
+    gap does not un-list an asset — pad semantics carry it — which is what
+    keeps scattered-hole panels identical to the plain padded signal.
+
+    ``skip`` may be traced (the engines run under jit).
+    """
+    M = mask.shape[1]
+    idx = jnp.arange(M)
+    last = jnp.max(jnp.where(mask, idx, -1), axis=1)  # [A] final print
+    hi = idx - skip  # unclipped: hi < 0 is pre-history, V_pad already bars it
+    return last[:, None] >= hi[None, :]

@@ -46,7 +46,7 @@ import jax
 import jax.numpy as jnp
 
 from csmom_tpu.ops.rolling import _windowed_prefix_diff
-from csmom_tpu.signals.momentum import monthly_returns
+from csmom_tpu.signals.momentum import monthly_returns, raw_monthly_returns
 
 
 def _residual_score(prices, mask, lookback, skip: int, est_window,
@@ -59,7 +59,7 @@ def _residual_score(prices, mask, lookback, skip: int, est_window,
     lookback or < 3) comes back all-invalid rather than raising."""
     dt = prices.dtype
     A, M = prices.shape
-    r, r_valid = monthly_returns(prices, mask)
+    r, r_valid = raw_monthly_returns(prices, mask)
     rf = jnp.where(r_valid, jnp.nan_to_num(r), 0.0)
     v = r_valid.astype(dt)
 

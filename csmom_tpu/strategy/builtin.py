@@ -39,7 +39,15 @@ class Momentum(Strategy):
     skip: int = 1
 
     def signal(self, prices, mask, **panels):
-        return momentum(prices, mask, lookback=self.lookback, skip=self.skip)
+        from csmom_tpu.signals.momentum import formation_listed_mask
+
+        mom, valid = momentum(prices, mask, lookback=self.lookback,
+                              skip=self.skip)
+        # the dedicated monthly engine's delisting rule, so
+        # strategy_backtest(Momentum) stays bit-identical to it on panels
+        # with delistings, not only late entrants
+        valid = valid & formation_listed_mask(mask, self.skip)
+        return jnp.where(valid, mom, jnp.nan), valid
 
 
 @register_strategy("intermediate_momentum")
@@ -78,9 +86,9 @@ class LowVolatility(Strategy):
 
     def signal(self, prices, mask, **panels):
         from csmom_tpu.ops.rolling import rolling_std
-        from csmom_tpu.signals.momentum import monthly_returns
+        from csmom_tpu.signals.momentum import raw_monthly_returns
 
-        ret, rvalid = monthly_returns(prices, mask)
+        ret, rvalid = raw_monthly_returns(prices, mask)
         vol, vvalid = rolling_std(
             ret, rvalid, self.window, min_periods=self.min_obs, ddof=1
         )
@@ -97,7 +105,10 @@ class Reversal(Strategy):
     skip: int = 0
 
     def signal(self, prices, mask, **panels):
+        from csmom_tpu.signals.momentum import formation_listed_mask
+
         mom, valid = momentum(prices, mask, lookback=self.lookback, skip=self.skip)
+        valid = valid & formation_listed_mask(mask, self.skip)
         return jnp.where(valid, -mom, jnp.nan), valid
 
 
@@ -146,7 +157,11 @@ class VolumeZMomentum(Strategy):
     def signal(self, prices, mask, *, volumes=None, volumes_mask=None, **panels):
         if volumes is None:
             raise ValueError("VolumeZMomentum needs a volumes= panel")
+        from csmom_tpu.signals.momentum import formation_listed_mask
+
         mom, mom_valid = momentum(prices, mask, lookback=self.lookback, skip=self.skip)
+        mom_valid = mom_valid & formation_listed_mask(mask, self.skip)
+        mom = jnp.where(mom_valid, mom, jnp.nan)
         # fallback mask excludes zeros: segment-summed volume panels store
         # 0.0 (not NaN) at never-observed slots (see monthly_price_panel's
         # phantom-zero note), and a pre-listing zero must not enter the

@@ -15,6 +15,16 @@ probe) can eat 60-120 s before the guard is armed, and an unanchored
 timer would fire after the external SIGKILL, which is the bug this
 module exists to prevent.
 
+Clock discipline: every anchor and every elapsed computation here is
+``time.monotonic()``.  The wall clock (``time.time``) is NEVER consulted
+— an NTP step or a deliberate skew (the chaos ``clock_skew`` fault,
+:mod:`csmom_tpu.chaos`) during a capture would otherwise shorten or
+stretch the fuse and either lose the window to the external SIGKILL or
+dump a partial while time remained.  ``t0`` MUST therefore come from
+``time.monotonic()``; a wall-clock anchor (epoch seconds) is detected at
+arm time and re-anchored to "now" with a stderr note, because a silently
+never-firing guard is the precise failure this module exists to prevent.
+
 The reference has no analogue (no benchmarks, no timeouts —
 ``/root/reference/README.md`` is a bare title); this is capture-harness
 plumbing for the TPU rebuild's evidence discipline.
@@ -28,7 +38,27 @@ import threading
 import time
 from typing import Callable, Optional
 
-__all__ = ["deadline_guard"]
+__all__ = ["deadline_guard", "trip_active_guard"]
+
+# the most recently armed guard's fire callable, for the chaos
+# ``trip_deadline`` fault (one guard per capture process by construction)
+_ACTIVE_FIRE: Optional[Callable[[], None]] = None
+
+
+def trip_active_guard() -> bool:
+    """Fire the armed deadline guard NOW (chaos hook).
+
+    Behaves exactly as if the budget expired at this instant: the partial
+    line (if any) is emitted through the quarantined path and the process
+    exits.  Returns False when no guard is armed in this process (the
+    caller logs; a rehearsal asserting on guard behavior treats that as a
+    wiring failure, not a pass).
+    """
+    fire = _ACTIVE_FIRE
+    if fire is None:
+        return False
+    fire()
+    return True  # pragma: no cover - fire() exits the process
 
 
 def _emit(line: str, *, flush_first: bool) -> None:
@@ -89,15 +119,41 @@ def deadline_guard(
     lock, cancels the timer, and prints — whichever of the two prints
     first is the process's single stdout summary line.
     """
+    global _ACTIVE_FIRE
     budget = float(os.environ.get(env_var, "0") or 0)
     lock = threading.Lock()
     done = threading.Event()
+
+    # a wall-clock anchor (epoch seconds from time.time, ~1.7e9) instead of
+    # a monotonic one would push the fuse past any real budget and the
+    # guard would silently never fire — re-anchor and say so, loudly
+    if abs(time.monotonic() - t0) > 2 * 86400:
+        print(
+            "deadline_guard: t0 does not look like a time.monotonic() "
+            "anchor (wall-clock seconds?); re-anchoring to now — pass "
+            "t0=time.monotonic() captured at process start",
+            file=sys.stderr, flush=True,
+        )
+        t0 = time.monotonic()
 
     def _fire():
         with lock:
             if done.is_set():
                 return  # full line already printed (or printing won race)
-            line = partial_line()
+            # partial_line() serializes live progress state the main thread
+            # is still mutating (bench's _PROG/_LEGS dicts); a mid-mutation
+            # snapshot can raise ("dictionary changed size during
+            # iteration") and an unguarded raise here would kill the timer
+            # thread with NO line and NO exit — the exact lost-window
+            # failure this guard exists to prevent.  Retry a few times
+            # (each attempt re-snapshots), then fall through to exit 3.
+            line = None
+            for _ in range(5):
+                try:
+                    line = partial_line()
+                    break
+                except Exception:
+                    time.sleep(0.02)
             if line is None:
                 os._exit(3)  # nothing measured: no artifact-worthy line
             _emit(line, flush_first=False)  # no flush: see _emit
@@ -112,10 +168,13 @@ def deadline_guard(
         timer = threading.Timer(delay, _fire)
         timer.daemon = True
         timer.start()
+        _ACTIVE_FIRE = _fire
 
     def finish(line: str) -> None:
+        global _ACTIVE_FIRE
         with lock:
             done.set()
+            _ACTIVE_FIRE = None
             if timer is not None:
                 timer.cancel()
             # caller's thread: progress rows it printed flush first, then

@@ -54,6 +54,24 @@ def test_band_zero_equals_plain_engine(rng):
                                float(plain.ann_sharpe), rtol=1e-12)
 
 
+def test_band_zero_equals_plain_engine_with_delistings(rng):
+    """Same invariant on a panel with delistings: both engines must apply
+    the same formation_listed_mask drop rule, not just agree on the
+    late-entrant fixtures."""
+    prices, mask = _panel(rng)
+    prices = np.asarray(prices).copy()
+    prices[-3:, 28:] = np.nan
+    mask = np.isfinite(prices)
+    plain = monthly_spread_backtest(prices, mask, lookback=6, skip=1, n_bins=5)
+    banded = banded_monthly_backtest(prices, mask, lookback=6, skip=1,
+                                     n_bins=5, band=0)
+    np.testing.assert_array_equal(np.asarray(banded.spread_valid),
+                                  np.asarray(plain.spread_valid))
+    np.testing.assert_allclose(np.asarray(banded.spread),
+                               np.asarray(plain.spread),
+                               rtol=1e-12, equal_nan=True)
+
+
 def test_books_match_loop_oracle(rng):
     prices, mask = _panel(rng)
     mom, momv = momentum(np.asarray(prices), np.asarray(mask), lookback=6, skip=1)

@@ -103,6 +103,51 @@ def test_land_artifact_richer_partial_upgrades_thinner_partial(tmp_path):
     assert json.loads(art2.read_text())["phases"] == [1, 2, 3]
 
 
+def test_land_artifact_counts_rows_nested_under_extra(tmp_path):
+    """bench-child and minibench partials carry their measurement list
+    under extra.rows; the shell row counter must size them exactly like
+    chaos.invariants.measured_rows or a richer partial is refused its
+    upgrade."""
+    art = tmp_path / "art.json"
+    art.write_text(json.dumps({
+        "metric": "m", "value": 1.0,
+        "extra": {"rows": [{"r": 0}], "partial": "deadline hit"},
+    }))
+    raw = tmp_path / "raw.log"
+    richer = json.dumps({
+        "metric": "m", "value": 2.0,
+        "extra": {"rows": [{"r": 0}, {"r": 1}], "partial": "deadline hit"},
+    })
+    _write(raw, richer)
+    _sh(tmp_path, f'land_artifact "{raw}" "{art}"')
+    assert len(json.loads(art.read_text())["extra"]["rows"]) == 2
+    # thinner-over-richer still refuses through the extra-nested path
+    _write(raw, json.dumps({
+        "metric": "m", "value": 1.0,
+        "extra": {"rows": [{"r": 9}], "partial": "deadline hit"},
+    }))
+    _sh(tmp_path, f'land_artifact "{raw}" "{art}"')
+    assert len(json.loads(art.read_text())["extra"]["rows"]) == 2
+
+
+def test_land_artifact_refuses_truncated_post_write(tmp_path):
+    """The chaos land-short-write contract: a tmp file truncated between
+    the formatter and the rename (ENOSPC) must never land, and an
+    existing artifact stays untouched."""
+    art = tmp_path / "art.json"
+    art.write_text(json.dumps(json.loads(PARTIAL), indent=1))
+    raw = tmp_path / "raw.log"
+    _write(raw, FULL)
+    r = _sh(tmp_path,
+            f'CSMOM_FAULT_LAND_TRUNCATE_BYTES=15 land_artifact "{raw}" "{art}"')
+    assert r.returncode == 0
+    assert json.loads(art.read_text())["rows"] == [1]  # prior intact
+    assert not (tmp_path / "art.json.tmp").exists()
+    # fault cleared: the upgrade lands
+    _sh(tmp_path, f'land_artifact "{raw}" "{art}"')
+    assert json.loads(art.read_text())["rows"] == [1, 2, 3]
+
+
 def test_promote_capture_full_claims_done_marker(tmp_path):
     raw = tmp_path / "scaling_raw.log"
     _write(tmp_path / "scaling_raw.log.tmp", '{"point": 1}', FULL)

@@ -1,0 +1,81 @@
+"""Rehearsal driver: the fast tier is a tier-1 gate, the full matrix slow.
+
+``csmom rehearse --fast`` is what the watcher scripts gate on before a
+tunnel window: <=3 capture-path faults, no jax in the rehearsed
+processes, well under 30 s.  The slow test runs the complete built-in
+matrix — the real bench.py supervisor/child in smoke mode — which is the
+acceptance bar: every fault lands a schema-valid (possibly partial)
+artifact with zero lost measured rows, including the r5 failure mode
+reproduced and shown fixed.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from csmom_tpu.cli.rehearse import builtin_matrix
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(args, timeout):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("CSMOM_FAULT_PLAN", None)
+    return subprocess.run(
+        [sys.executable, "-m", "csmom_tpu.cli.main", "rehearse", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=_REPO,
+    )
+
+
+def test_fast_tier_is_small_and_capture_path_only():
+    fast = builtin_matrix(fast=True)
+    assert 1 <= len(fast) <= 3, "the fast tier must stay <= 3 faults"
+    assert all(s.pipeline in ("mini", "shell") for s in fast), (
+        "fast-tier scenarios must not need jax-importing pipelines"
+    )
+    # the r4/r5 family (deadline loses measured rows) must be represented
+    assert any("deadline" in s.name for s in fast)
+
+
+def test_rehearse_fast_runs_green_and_quick():
+    t0 = time.monotonic()
+    p = _run_cli(["--fast"], timeout=120)
+    wall = time.monotonic() - t0
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "scenarios green" in p.stdout
+    assert wall < 30, f"--fast took {wall:.1f}s; the watcher gate needs <30s"
+
+
+def test_rehearse_exits_nonzero_on_violation(tmp_path):
+    """A plan that kills the mini pipeline outright cannot satisfy the
+    full-record invariants — rehearse must fail loudly, not shrug."""
+    plan = tmp_path / "kill.toml"
+    plan.write_text(
+        'name = "kill-now"\n\n[[fault]]\npoint = "mini.start"\n'
+        'action = "kill"\n'
+    )
+    p = _run_cli(["--plan", str(plan), "--pipeline", "mini"], timeout=120)
+    assert p.returncode == 1
+    assert "FAIL" in p.stdout
+
+
+def test_rehearse_list_names_whole_matrix():
+    p = _run_cli(["--list"], timeout=60)
+    assert p.returncode == 0
+    for scenario in builtin_matrix():
+        assert scenario.name in p.stdout
+
+
+@pytest.mark.slow
+def test_rehearse_full_matrix_green():
+    """Acceptance: the complete built-in fault matrix — including the r5
+    reproduction against the real bench child — runs green on a CPU-only
+    machine."""
+    p = _run_cli(["--verbose"], timeout=3000)
+    assert p.returncode == 0, p.stdout + p.stderr
+    n = len(builtin_matrix())
+    assert f"{n}/{n} scenarios green" in p.stdout

@@ -57,6 +57,23 @@ def test_momentum_strategy_matches_dedicated_engine(rng):
     assert float(ded.ann_sharpe) == float(via.ann_sharpe)
 
 
+def test_momentum_strategy_matches_engine_with_delistings(rng):
+    """The parity contract must hold on DELISTING panels too: the pad
+    semantics carry a delisted asset's signal forward, and both paths must
+    apply the same formation_listed_mask drop rule (a latent divergence
+    here survived every late-entrant-only fixture)."""
+    prices, mask = _toy(rng)
+    prices[-4:, 30:] = np.nan  # four delistings mid-sample
+    mask = np.isfinite(prices)
+    ded = monthly_spread_backtest(prices, mask, lookback=6, skip=1, n_bins=5)
+    via = strategy_backtest(prices, mask, Momentum(lookback=6, skip=1), n_bins=5)
+    np.testing.assert_array_equal(np.asarray(ded.labels), np.asarray(via.labels))
+    np.testing.assert_allclose(
+        np.asarray(ded.spread), np.asarray(via.spread), equal_nan=True
+    )
+    assert float(ded.ann_sharpe) == float(via.ann_sharpe)
+
+
 def test_reversal_is_negated_momentum_ranks(rng):
     prices, mask = _toy(rng)
     res = strategy_backtest(prices, mask, Reversal(lookback=1, skip=0), n_bins=5)

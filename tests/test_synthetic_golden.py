@@ -31,13 +31,16 @@ from csmom_tpu.panel.synthetic import synthetic_daily_panel
 
 # -- pinned fingerprints (computed 2026-07-30, f64, xla cpu) -----------------
 # monthly leg: synthetic_daily_panel(40, 1260, seed=123, listing_gaps=True)
+# (re-pinned 2026-08-02 after the pandas-parity semantics fix: pct_change
+# pad/forward-fill returns, delisting-aware formation mask, pandas>=2.0
+# percent-roundtrip qcut edges — the oracle-suite fix set)
 MONTHLY = {
     "n_months": 58,
     "n_valid_spreads": 44,
-    "mean_spread": -0.024960908018,
-    "ann_sharpe": -0.847140334855,
-    "nw_t": -2.046468172081,
-    "cum_return": 0.258753707035,
+    "mean_spread": -0.024151046163,
+    "ann_sharpe": -0.838545964552,
+    "nw_t": -2.001284759867,
+    "cum_return": 0.271094424165,
 }
 # event leg: synthetic_daily_panel(8, 10, seed=77) -> synthetic_minute_frame
 # (seed=5, 31,200 rows) -> ridge CV -> event backtest (reference constants)
