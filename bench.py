@@ -100,13 +100,107 @@ SMOKE_REASON = "skipped: smoke mode (CSMOM_BENCH_SMOKE=1 — rehearsal runs " \
 
 def _chaos(point: str, **ctx):
     """Chaos checkpoint (csmom_tpu.chaos): a no-op — one environ lookup,
-    no imports — unless a fault plan is armed, so the supervisor stays
-    jax-import-free and the measurement path stays unperturbed."""
-    if "CSMOM_FAULT_PLAN" not in os.environ:
+    no imports — unless a fault plan OR telemetry is armed, so a fully
+    disarmed supervisor stays package-import-free and the measurement
+    path stays unperturbed.  Armed telemetry routes through the real
+    checkpoint so every chaos site doubles as a timeline event."""
+    env = os.environ
+    if "CSMOM_FAULT_PLAN" not in env and env.get("CSMOM_TELEMETRY",
+                                                 "0") in ("", "0"):
         return None
     from csmom_tpu.chaos.inject import checkpoint
 
     return checkpoint(point, **ctx)
+
+
+# -- run telemetry (csmom_tpu.obs) -------------------------------------------
+#
+# Default ON: the TELEMETRY_<round>.json sidecar is part of a round's
+# evidence exactly like the FULL record — phases (warmup/probe/compile/
+# row/land), span walls, and the metrics snapshot, readable via `csmom
+# timeline <round>` instead of reconstructed from prints.  CSMOM_TELEMETRY=0
+# disarms the whole layer (span() collapses to a shared no-op; the
+# supervisor then never imports the package), which is the knob the
+# <1%-overhead acceptance check flips.  The event stream is a scratch
+# JSONL in tmp that supervisor and children (env inheritance) append to;
+# the committed artifact is the assembled sidecar.
+
+# (obs module, root span, owned scratch-stream path or None) once armed
+_TEL = None
+
+
+def _tel_start():
+    """Arm supervisor telemetry (unless CSMOM_TELEMETRY=0) and open the
+    run's root span.  The arming decision is the shared
+    obs.spans.arm_policy: an operator-provided env contract is honored,
+    not clobbered; only a blank env gets the default tmp scratch stream
+    (which _tel_finish deletes once the sidecar has landed)."""
+    global _TEL
+    if os.environ.get("CSMOM_TELEMETRY", "") == "0":
+        return  # before the package import: a disarmed supervisor stays light
+    import tempfile
+
+    from csmom_tpu import obs
+
+    default = os.path.join(
+        tempfile.gettempdir(),
+        f"csmom_telemetry_{ROUND}_{os.getpid()}.jsonl",
+    )
+    col = (obs.spans.current_collector() if obs.armed() else
+           obs.arm_policy("bench-supervisor", default_path=default,
+                          run_id=ROUND))
+    if col is None:
+        return
+    root = obs.span("bench.supervisor", root=True)
+    root.__enter__()
+    _TEL = (obs, root, default if col.path == default else None)
+
+
+def _tel_span(name: str, **attrs):
+    """A supervisor-side span; a no-op context manager when disarmed."""
+    if _TEL is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    return _TEL[0].span(name, **attrs)
+
+
+def _tel_finish(out_dir: str):
+    """Close the root span and land the TELEMETRY sidecar (the shared
+    obs.timeline finish sequence: full stream file, child metrics
+    outrank ours, disarm, never raise).  Returns the sidecar name or a
+    reason string — telemetry failure must never cost the headline."""
+    global _TEL
+    if _TEL is None:
+        return "not captured: telemetry disarmed (CSMOM_TELEMETRY=0)"
+    obs, root, owned_stream = _TEL
+    _TEL = None
+    # the landing step is about to run with the collector closed, so its
+    # breadcrumb goes in NOW — "reached the land step" must be readable
+    # off the timeline even when the record write itself dies (the chaos
+    # bench.land faults)
+    obs.point("bench.land", record=FULL_RECORD_NAME)
+    root.__exit__(None, None, None)
+    from csmom_tpu.obs import metrics as obs_metrics
+    from csmom_tpu.obs import timeline as obs_tl
+
+    try:
+        fallback = obs_metrics.snapshot()
+    except Exception:
+        fallback = None
+    # our own default arming (owned scratch stream, run id = ROUND) may
+    # overwrite the round's sidecar across reruns; an operator-armed run
+    # carries a foreign run id and must not clobber committed evidence
+    name = obs_tl.finish_and_write(out_dir, fallback_metrics=fallback,
+                                   overwrite=owned_stream is not None)
+    if owned_stream and name.startswith("TELEMETRY_"):
+        # our scratch stream is fully represented by the landed sidecar;
+        # an operator-provided stream (or a failed landing) is kept
+        try:
+            os.remove(owned_stream)
+        except OSError:
+            pass
+    return name
 
 
 def _remaining() -> float:
@@ -148,6 +242,18 @@ def child_main():
     from csmom_tpu.compile.entries import batched_event_fn, grid_scalar_fn
     from csmom_tpu.utils.profiling import compile_stats
 
+    # telemetry: join the supervisor's event stream (env contract) — or
+    # stay disarmed, in which case every span below is the shared no-op
+    from csmom_tpu import obs
+    from csmom_tpu.obs import metrics as obs_metrics
+
+    obs.arm_from_env("bench-child")
+    # registered before any leg runs: a record showing rows_landed=0 must
+    # mean "no leg completed", never "counting not wired"
+    obs_metrics.counter("bench.rows_landed")
+    _root_sp = obs.span("bench.child", root=True)
+    _root_sp.__enter__()
+
     platform, on_cpu, dtype = wl.bench_platform(jax)
     _stats0 = compile_stats()  # child-lifetime base for the compile totals
 
@@ -161,7 +267,8 @@ def child_main():
         _chaos("bench.compile", leg=name)
         b = compile_stats()
         t0 = time.perf_counter()
-        first_call()
+        with obs.span("bench.compile", leg=name):
+            first_call()
         d = compile_stats().delta(b)
         rec = {"compile_wall_s": round(time.perf_counter() - t0, 4)}
         if _cache_dir is not None:
@@ -235,10 +342,12 @@ def child_main():
     run = lambda: fetch(event_backtest(price, valid, score, adv, vol).total_pnl)
     _compiled_leg("event.golden", run)  # compile (or cache load)
     reps = 20
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        run()
-    dt = (time.perf_counter() - t0) / reps
+    with obs.span("bench.row", row="event.golden", reps=reps):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            run()
+        dt = (time.perf_counter() - t0) / reps
+    obs_metrics.counter("bench.rows_landed").inc()
     groups_per_sec = n_bars / dt
     _PROG.update({
         "value": round(groups_per_sec, 1),
@@ -293,10 +402,14 @@ def child_main():
         gfn = grid_scalar_fn(wl.GRID_JS, wl.GRID_KS, wl.GRID_SKIP, mode, impl)
         _compiled_leg(f"grid16.{mode}.{impl}@{A}x{M}",
                       lambda: fetch(gfn(pm, mm)))  # compile + warm the tunnel
-        t0 = time.perf_counter()
-        for _ in range(grid_reps):
-            fetch(gfn(pm, mm))
-        return (time.perf_counter() - t0) / grid_reps
+        with obs.span("bench.row", row=f"grid16.{mode}.{impl}",
+                      reps=grid_reps):
+            t0 = time.perf_counter()
+            for _ in range(grid_reps):
+                fetch(gfn(pm, mm))
+            dt = (time.perf_counter() - t0) / grid_reps
+        obs_metrics.counter("bench.rows_landed").inc()
+        return dt
 
     def timed_or_reason(mode, impl="xla", floor_s=120.0):
         """Run a grid leg if the child budget allows, else a reason string."""
@@ -376,11 +489,13 @@ def child_main():
         try:
             _compiled_leg(f"event.batched{B}",
                           lambda: fetch(bat(price, valid, bscore, adv, vol)))
-            t0 = time.perf_counter()
-            breps = 5
-            for _ in range(breps):
-                fetch(bat(price, valid, bscore, adv, vol))
-            batched_per_run_s = (time.perf_counter() - t0) / breps / B
+            with obs.span("bench.row", row=f"event.batched{B}"):
+                t0 = time.perf_counter()
+                breps = 5
+                for _ in range(breps):
+                    fetch(bat(price, valid, bscore, adv, vol))
+                batched_per_run_s = (time.perf_counter() - t0) / breps / B
+            obs_metrics.counter("bench.rows_landed").inc()
         except Exception as e:  # record the why, keep the headline metric
             batched_skip_reason = (
                 f"failed: {type(e).__name__}: {e}"[:200]
@@ -407,9 +522,11 @@ def child_main():
                 fetch(gfn(fpm, fmm))
 
             _compiled_leg(f"grid16.rank.xla@{A_f}x{M_f}", gf)  # compile
-            t0 = time.perf_counter()
-            gf()
-            full_rank_s = time.perf_counter() - t0
+            with obs.span("bench.row", row="grid16.full.xla"):
+                t0 = time.perf_counter()
+                gf()
+                full_rank_s = time.perf_counter() - t0
+            obs_metrics.counter("bench.rows_landed").inc()
         except Exception as e:  # record, never lose the JSON line
             full_rank_s = f"failed: {type(e).__name__}: {e}"[:200]
         # the matmul leg doubles the full-size work: re-check the budget and
@@ -420,9 +537,11 @@ def child_main():
             try:
                 _compiled_leg(f"grid16.rank.matmul@{A_f}x{M_f}",
                               lambda: gf("matmul"))  # compile
-                t0 = time.perf_counter()
-                gf("matmul")
-                full_matmul_s = time.perf_counter() - t0
+                with obs.span("bench.row", row="grid16.full.matmul"):
+                    t0 = time.perf_counter()
+                    gf("matmul")
+                    full_matmul_s = time.perf_counter() - t0
+                obs_metrics.counter("bench.rows_landed").inc()
             except Exception as e:
                 full_matmul_s = f"failed: {type(e).__name__}: {e}"[:200]
         else:
@@ -532,6 +651,16 @@ def child_main():
     if SMOKE:
         extra["smoke"] = ("smoke-mode record: pipeline-shaped, workload "
                           "reduced — NOT a performance capture")
+    # telemetry registry snapshot into the record (rows landed, deadline
+    # margin, compile counters + listener state folded in) — the "where
+    # did the dispatches go" companion to the walls above
+    _margin = _child_left()
+    obs_metrics.gauge("bench.deadline_margin_s").set(
+        None if _margin == float("inf") else round(_margin, 3))
+    extra["metrics"] = (
+        obs_metrics.snapshot() if obs.armed() else
+        "not captured: telemetry disarmed (CSMOM_TELEMETRY=0)"
+    )
     line = json.dumps(
         {
             "metric": "intraday_event_backtest_bar_groups_per_sec",
@@ -542,6 +671,15 @@ def child_main():
         }
     )
     _chaos("bench.finish")
+    # close the child's root span and mirror the final snapshot into the
+    # event stream before the summary lands (a supervisor assembling the
+    # sidecar reads it from there)
+    _root_sp.set(platform=platform)
+    _root_sp.__exit__(None, None, None)
+    _col = obs.spans.current_collector()
+    if _col is not None:
+        _col.emit({"kind": "metrics", "t_s": round(time.monotonic(), 6),
+                   "data": obs_metrics.snapshot()})
     _finish(line)
 
 
@@ -647,9 +785,12 @@ def warmup_child_main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    from csmom_tpu import obs
     from csmom_tpu.compile.aot import warmup
 
-    rep = warmup(profiles=("bench-cpu", "golden"), subdir="bench")
+    obs.arm_from_env("bench-warmup")
+    with obs.span("bench.warmup.child"):
+        rep = warmup(profiles=("bench-cpu", "golden"), subdir="bench")
     print(json.dumps({
         "metric": "aot_warmup",
         "value": rep["n_entries"],
@@ -674,10 +815,11 @@ def _probe_default_backend(reserve_s: float):
     if timeout < 10:
         return False, "no budget left for a probe"
     try:
-        p = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=timeout,
-        )
+        with _tel_span("bench.probe", timeout_s=int(timeout)):
+            p = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout,
+            )
     except subprocess.TimeoutExpired:
         return False, f"probe timeout after {int(timeout)}s (backend hung at init)"
     if p.returncode == 0:
@@ -714,10 +856,11 @@ def _run_child(force_cpu: bool, reserve_s: float | None = None):
         return None, "no budget left for this attempt"
     env["CSMOM_BENCH_CHILD_BUDGET"] = str(int(timeout))
     try:
-        p = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, capture_output=True, text=True, timeout=timeout,
-        )
+        with _tel_span("bench.child.attempt", cpu=force_cpu):
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=timeout,
+            )
     except subprocess.TimeoutExpired as e:
         out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
         return _parse_json_line(out), f"child timeout after {int(timeout)}s"
@@ -1003,6 +1146,7 @@ def main():
             timespec="seconds"
         )
 
+    _tel_start()  # root span + shared event stream for every child
     probes, errors = [], []
     result = None       # CPU fallback (or a default platform that IS cpu)
     tpu_result = None
@@ -1165,8 +1309,13 @@ def main():
             "extra": {"error": "all benchmark attempts failed",
                       "attempts": errors, "tpu_probes": probes},
         }
-    # split the output: full record to the committed per-round file, one
-    # compact headline line (bounded length) to stdout for the driver
+    # split the output: the TELEMETRY sidecar lands FIRST so the FULL
+    # record can point at what actually landed (name or failure reason,
+    # never a prediction); then the full record, then the bounded
+    # headline line to stdout for the driver
+    result.setdefault("extra", {})["telemetry_sidecar"] = _tel_finish(
+        os.environ.get("CSMOM_BENCH_FULL_DIR", _REPO)
+    )
     ref = _write_full_record(result)
     print(_headline(result, ref))
 

@@ -1,11 +1,18 @@
 """Checkpoint runtime: where armed fault plans actually fire.
 
 Instrumentation points call ``checkpoint("bench.compile", leg=name)``.
-Unarmed (no ``CSMOM_FAULT_PLAN`` in the environment) the call is one
-``os.environ`` membership test — no imports, no allocation — so the hot
-measurement path pays nothing.  Armed, the active plan is parsed once per
-process and each visit is counted per checkpoint name; faults whose
-(point pattern, role, hit window) match execute their action.
+Unarmed (no ``CSMOM_FAULT_PLAN`` and no ``CSMOM_TELEMETRY`` in the
+environment) the call is two ``os.environ`` membership tests — no
+imports, no allocation — so the hot measurement path pays nothing.
+Armed, the active plan is parsed once per process and each visit is
+counted per checkpoint name; faults whose (point pattern, role, hit
+window) match execute their action.
+
+Every checkpoint site doubles as a telemetry event: when run telemetry
+is armed (:mod:`csmom_tpu.obs`), the visit is recorded as a durationless
+point in the run's event stream BEFORE any fault fires — so a fault that
+kills the process still leaves "we reached bench.row" in the timeline,
+which is exactly the post-mortem breadcrumb the r4/r5 forensics lacked.
 
 Self-executing actions (kill / exit / sleep / trip_deadline / clock_skew /
 corrupt_file / truncate_file / stdout_noise) happen inside the call;
@@ -44,6 +51,25 @@ from csmom_tpu.chaos.plan import PLAN_ENV, current_role, load_active_plan
 
 __all__ = ["checkpoint", "reset"]
 
+# csmom_tpu.obs.spans.ENV_STREAM, spelled out so the unarmed fast path
+# never imports the obs package just to read one constant
+_OBS_ENV = "CSMOM_TELEMETRY"
+
+
+def _obs_point(point: str, ctx: dict) -> None:
+    """Mirror a checkpoint visit into the armed telemetry stream.
+
+    No-op (after the lazy import) in processes that inherited the env
+    var but never armed a collector; never raises — observability must
+    not become a new fault injector."""
+    try:
+        from csmom_tpu.obs import spans as _spans
+
+        if _spans._COLLECTOR is not None:
+            _spans.point(f"chaos.{point}", **ctx)
+    except Exception:
+        pass
+
 _STATE_LOCK = threading.Lock()
 _PLAN = None
 _PLAN_LOADED = False
@@ -73,9 +99,13 @@ def checkpoint(point: str, **ctx) -> str | None:
     """Visit an instrumentation point; fire any matching armed faults.
 
     Returns the last fired action name (``"fail"`` is the one callers
-    branch on), or None when nothing fired.  Unarmed cost: one environ
-    lookup.
+    branch on), or None when nothing fired.  Unarmed cost: two environ
+    lookups (fault plan + telemetry).
     """
+    if os.environ.get(_OBS_ENV, "0") not in ("", "0"):
+        # telemetry first, fault second: a kill/exit fault must not erase
+        # the evidence that its checkpoint was reached
+        _obs_point(point, ctx)
     if PLAN_ENV not in os.environ:
         return None
     plan = _plan()

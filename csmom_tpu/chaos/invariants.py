@@ -30,6 +30,12 @@ Artifact kinds (detected from keys, see :func:`detect_kind`):
     A phase profile: ``metric`` + ``phases`` list.
 ``tpu_cache``
     ``BENCH_TPU_LAST.json``: ``captured_utc``/``provenance``/``record``.
+``telemetry``
+    A run-telemetry sidecar (``TELEMETRY_*.json``, :mod:`csmom_tpu.obs.
+    timeline`): ``run_id``/``schema_version``/``wall_s``/``phases``,
+    where the phase durations PARTITION the wall (their sum must land
+    within 5% of ``wall_s`` — the whole point of the artifact is that
+    the time is accounted for, not vibes).
 
 Partial rules: a partial artifact carries ``extra.partial`` (non-empty
 string saying *what* is missing); a partial with a measurement list
@@ -81,6 +87,9 @@ def trailing_json(text: str):
 def detect_kind(obj: dict) -> str | None:
     if not isinstance(obj, dict):
         return None
+    if obj.get("kind") == "telemetry" or {"run_id", "wall_s",
+                                          "phases"} <= set(obj):
+        return "telemetry"
     if {"captured_utc", "record"} <= set(obj):
         return "tpu_cache"
     if {"n_devices", "ok"} <= set(obj):
@@ -221,8 +230,48 @@ def _validate_tpu_cache(obj: dict) -> list:
     return out
 
 
+def _validate_telemetry(obj: dict) -> list:
+    out: list = []
+    _require(obj, "run_id", str, "telemetry", out)
+    _require(obj, "schema_version", int, "telemetry", out)
+    wall = _require(obj, "wall_s", _NUM, "telemetry", out, "a number")
+    phases = _require(obj, "phases", list, "telemetry", out)
+    if phases is not None:
+        names = []
+        total = 0.0
+        for i, ph in enumerate(phases):
+            if not isinstance(ph, dict):
+                out.append(f"telemetry: phases[{i}] must be an object")
+                continue
+            if not isinstance(ph.get("name"), str):
+                out.append(f"telemetry: phases[{i}].name must be a string")
+            else:
+                names.append(ph["name"])
+            if not isinstance(ph.get("dur_s"), _NUM):
+                out.append(f"telemetry: phases[{i}].dur_s must be a number")
+            else:
+                total += ph["dur_s"]
+        if len(names) != len(set(names)):
+            out.append("telemetry: duplicate phase names")
+        # the artifact's core claim: the phases ACCOUNT for the wall.
+        # Tolerance 5% (rounding, torn tail events); floored so a
+        # sub-second smoke run is not failed over microseconds.
+        if isinstance(wall, _NUM) and not out:
+            tol = max(0.05 * wall, 0.02)
+            if abs(total - wall) > tol:
+                out.append(
+                    f"telemetry: phase durations sum to {total:.4f}s but "
+                    f"wall_s is {wall:.4f}s (off by more than 5% — the "
+                    "timeline lost track of where the time went)"
+                )
+    if "spans" in obj and not isinstance(obj["spans"], list):
+        out.append("telemetry: spans must be a list")
+    return out
+
+
 _VALIDATORS = {
     "record": _validate_record,
+    "telemetry": _validate_telemetry,
     "driver_capture": _validate_driver_capture,
     "multichip": _validate_multichip,
     "phases": _validate_phases,
@@ -238,7 +287,7 @@ def validate(obj, kind: str | None = None) -> list:
     if kind is None:
         return ["unrecognized artifact shape: none of the known key "
                 "signatures (record / driver_capture / multichip / phases "
-                "/ tpu_cache) match"]
+                "/ tpu_cache / telemetry) match"]
     if kind not in _VALIDATORS:
         return [f"unknown artifact kind {kind!r}"]
     return _VALIDATORS[kind](obj)
@@ -306,7 +355,8 @@ def validate_file(path: str) -> list:
 
 def validate_tree(root: str, patterns=("BENCH_*.json", "MULTICHIP_*.json",
                                        "MULTIHOST_*.json", "HISTRANK_*.json",
-                                       "PHASES_*.json")) -> dict:
+                                       "PHASES_*.json",
+                                       "TELEMETRY_*.json")) -> dict:
     """``{relative_path: violations}`` for every committed artifact under
     ``root`` matching ``patterns`` (non-recursive: round artifacts land at
     the repo root by contract).  Paths with no violations are included

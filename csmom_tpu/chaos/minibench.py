@@ -36,7 +36,12 @@ _T0 = time.monotonic()
 
 def main() -> int:
     from csmom_tpu.chaos.inject import checkpoint
+    from csmom_tpu.obs import arm_from_env
     from csmom_tpu.utils.deadline import deadline_guard
+
+    # join an armed telemetry stream (CSMOM_TELEMETRY): every checkpoint
+    # below then doubles as a timeline point, mirroring bench's contract
+    arm_from_env("minibench")
 
     n_rows = int(os.environ.get("CSMOM_MINIBENCH_ROWS", "5"))
     row_s = float(os.environ.get("CSMOM_MINIBENCH_ROW_S", "0.01"))

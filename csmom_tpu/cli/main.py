@@ -1217,11 +1217,30 @@ def cmd_warmup(args) -> int:
 
     from csmom_tpu.compile.aot import warmup
 
-    report = warmup(
-        profiles=tuple(profiles),
-        subdir=args.cache_subdir,
-        include_golden_event=not args.no_golden_event,
-    )
+    # honor an armed telemetry stream (CSMOM_TELEMETRY): the per-entry
+    # warmup/aot spans then land on the run's timeline and a sidecar is
+    # written.  Unlike bench/rehearse (default-ON runs), a standalone
+    # warmup arms ONLY via the env contract — arm_policy with no default
+    from csmom_tpu import obs
+
+    tel_col = obs.arm_policy("warmup-cli")
+    with obs.span("warmup.cli", root=True, profiles=",".join(profiles)):
+        report = warmup(
+            profiles=tuple(profiles),
+            subdir=args.cache_subdir,
+            include_golden_event=not args.no_golden_event,
+        )
+    if tel_col is not None:
+        from csmom_tpu.obs import metrics as obs_metrics
+        from csmom_tpu.obs import timeline as obs_tl
+
+        # warmup only ever runs env-armed, so its run id is the
+        # operator's: never overwrite an existing sidecar of that name
+        # (it could be a round's committed evidence)
+        sidecar = obs_tl.finish_and_write(
+            os.environ.get("CSMOM_TELEMETRY_DIR") or os.getcwd(),
+            fallback_metrics=obs_metrics.snapshot(), overwrite=False)
+        print(f"telemetry: {sidecar}")
     for r in report["entries"]:
         status = ("HIT" if r.get("cache_hit")
                   else ("ERROR " + r["error"] if "error" in r else "compiled"))
@@ -1644,8 +1663,10 @@ def build_parser() -> argparse.ArgumentParser:
         sp.set_defaults(fn=fn)
 
     from csmom_tpu.cli.rehearse import register as register_rehearse
+    from csmom_tpu.cli.timeline import register as register_timeline
 
     register_rehearse(sub)
+    register_timeline(sub)
     return p
 
 
@@ -1653,7 +1674,7 @@ def build_parser() -> argparse.ArgumentParser:
 # rehearse — supervisors that do their own subprocess probing): no init
 # probe for these
 _DEVICE_FREE_COMMANDS = {"fetch", "strategies", "bench", "pack-info",
-                         "rehearse"}
+                         "rehearse", "timeline"}
 
 
 def _apply_platform(args) -> int:
@@ -1692,7 +1713,6 @@ def _apply_platform(args) -> int:
                 and getattr(args, "command", None) not in _DEVICE_FREE_COMMANDS):
             import subprocess
             import tempfile
-            import time as _time
 
             # Default raised from 6 s (ADVICE r4): cold TPU runtime init can
             # legitimately take >6 s, and a false exit 3 on a healthy tunnel
@@ -1704,17 +1724,19 @@ def _apply_platform(args) -> int:
             # keyed by the platform string) so back-to-back CLI invocations
             # pay the subprocess init once, not per command.  TTL is short:
             # this image's tunnel flaps in ~25-min windows, so a stale "ok"
-            # must expire well inside one.
+            # must expire well inside one.  Freshness goes through the
+            # deadline module's skew-resistant marker_fresh (the chaos
+            # clock_skew fault monkeypatches time.time, which used to make
+            # this cache read "fresh" for an hour or "expired" instantly).
+            from csmom_tpu.utils.deadline import marker_fresh
+
             ttl_s = float(os.environ.get("CSMOM_PLATFORM_PROBE_TTL_S", "120"))
             mark = os.path.join(
                 tempfile.gettempdir(),
                 f"csmom_probe_ok_{''.join(c if c.isalnum() else '_' for c in envp)}",
             )
-            try:
-                if ttl_s > 0 and _time.time() - os.path.getmtime(mark) < ttl_s:
-                    return 0  # fresh success cached: skip the probe
-            except OSError:
-                pass  # no marker yet
+            if marker_fresh(mark, ttl_s):
+                return 0  # fresh success cached: skip the probe
             try:
                 subprocess.run(
                     [sys.executable, "-c",

@@ -402,6 +402,11 @@ def _read_json(path: str):
 def _base_env(scenario, box: str) -> dict:
     env = dict(os.environ)
     env.pop("CSMOM_FAULT_STATE", None)
+    # a rehearsed process must not append to the REHEARSAL's own telemetry
+    # stream (its run is the scenario's, not ours); bench-supervisor
+    # scenarios re-arm themselves with a fresh stream in their sandbox
+    env.pop("CSMOM_TELEMETRY", None)
+    env.pop("CSMOM_TELEMETRY_RUN", None)
     env.update({
         "JAX_PLATFORMS": "cpu",
         "CSMOM_FAULT_STATE": os.path.join(box, "chaos-state"),
@@ -648,6 +653,7 @@ def cmd_rehearse(args) -> int:
             )
             return 2
         plan = FaultPlan.from_env_value(args.plan)
+        custom_plan = True
         matrix = [Scenario(
             plan.name or "custom-plan", args.pipeline, plan,
             _CUSTOM_CHECKS[args.pipeline],
@@ -655,6 +661,7 @@ def cmd_rehearse(args) -> int:
                   "lands, full or explicitly partial, zero lost rows)",
         )]
     else:
+        custom_plan = False
         matrix = builtin_matrix(fast=args.fast)
     if getattr(args, "only", None):
         matrix = [s for s in matrix if args.only in s.name]
@@ -673,21 +680,72 @@ def cmd_rehearse(args) -> int:
     print(f"rehearsing {len(matrix)} fault scenario(s) in {sandbox_root} "
           f"({'fast tier' if args.fast else 'full matrix'})\n")
 
+    # run telemetry (csmom_tpu.obs): the rehearsal is itself a run — each
+    # scenario is a measured row, and the sidecar answers "which scenario
+    # ate the wall" the same way bench's answers "which leg did"
+    from csmom_tpu import obs
+    from csmom_tpu.obs import metrics as obs_metrics
+    from csmom_tpu.obs import timeline as obs_tl
+
+    # distinct run ids per flavor so a custom-plan rehearsal can never
+    # land over the built-in matrix's sidecar name; the arming decision
+    # itself is the shared obs.spans.arm_policy (operator env honored,
+    # sandbox stream as the default-ON fallback)
+    run_id = ("rehearse_custom" if custom_plan
+              else "rehearse_fast" if args.fast else "rehearse")
+    # operator-armed (env contract) runs carry a FOREIGN run id, so their
+    # sidecar must not overwrite an existing file of that name (e.g. a
+    # committed round sidecar); our own default names overwrite freely
+    operator_armed = os.environ.get(obs.spans.ENV_STREAM,
+                                    "") not in ("", "0")
+    col = obs.arm_policy(
+        "rehearse",
+        default_path=os.path.join(sandbox_root, "telemetry_events.jsonl"),
+        run_id=run_id,
+    )
+    telemetry_on = col is not None
+    if telemetry_on:
+        run_id = col.run_id
+
+    # register both counters up front so a green run snapshots an
+    # explicit failures=0 — "no failures" must be distinguishable from
+    # "failure counting not wired" (the counters-read-0 ambiguity this
+    # layer exists to remove)
+    obs_metrics.counter("rehearse.scenarios")
+    obs_metrics.counter("rehearse.failures")
     failures = 0
     rows = []
-    for scenario in matrix:
-        result, violations, wall = _run_scenario(scenario, sandbox_root)
-        ok = not violations
-        failures += 0 if ok else 1
-        rows.append((scenario, ok, wall, violations))
-        status = "PASS" if ok else "FAIL"
-        print(f"  [{status}] {scenario.name:32s} ({scenario.pipeline}, "
-              f"{wall:5.1f}s)")
-        for v in violations:
-            print(f"         - {v}")
-        if not ok and args.verbose and result.get("stderr"):
-            print("         stderr tail:",
-                  result["stderr"][-400:].replace("\n", "\n           "))
+    with obs.span("rehearse.run", root=True, scenarios=len(matrix)):
+        for scenario in matrix:
+            with obs.span("rehearse.row", phase="row",
+                          scenario=scenario.name,
+                          pipeline=scenario.pipeline) as sp:
+                result, violations, wall = _run_scenario(
+                    scenario, sandbox_root)
+                sp.set(ok=not violations)  # before the span record emits
+            ok = not violations
+            obs_metrics.counter("rehearse.scenarios").inc()
+            if not ok:
+                obs_metrics.counter("rehearse.failures").inc()
+            failures += 0 if ok else 1
+            rows.append((scenario, ok, wall, violations))
+            status = "PASS" if ok else "FAIL"
+            print(f"  [{status}] {scenario.name:32s} ({scenario.pipeline}, "
+                  f"{wall:5.1f}s)")
+            for v in violations:
+                print(f"         - {v}")
+            if not ok and args.verbose and result.get("stderr"):
+                print("         stderr tail:",
+                      result["stderr"][-400:].replace("\n", "\n           "))
+
+    if telemetry_on:
+        sidecar = obs_tl.finish_and_write(
+            os.environ.get("CSMOM_TELEMETRY_DIR") or os.getcwd(),
+            fallback_metrics=obs_metrics.snapshot(),
+            overwrite=not operator_armed,
+        )
+        print(f"\ntelemetry: {sidecar} (render with `csmom timeline "
+              f"{run_id}`)")
 
     print(f"\n{len(matrix) - failures}/{len(matrix)} scenarios green")
     if failures:

@@ -1,0 +1,109 @@
+"""csmom timeline — render a run's telemetry sidecar as a text flame summary.
+
+``csmom timeline <run>`` takes a path to a ``TELEMETRY_*.json`` sidecar,
+a raw JSONL event stream (assembled on the fly), or a bare run id (the
+sidecar is located by glob in the current directory, then the repo
+root).  Output is the phase table (where the wall went:
+warmup/probe/compile/row/land/other), the top spans by total wall, and
+the run's final metrics snapshot — the "read the timeline instead of
+reconstructing it" half of the telemetry contract
+(:mod:`csmom_tpu.obs`).
+
+Device-free and jax-free, like ``rehearse``: rendering evidence must
+never depend on a backend being up.  Second module of the cli/main.py
+split — subcommands register themselves via ``register(sub)``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+from csmom_tpu.chaos import invariants as inv
+from csmom_tpu.obs import timeline as tl
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _locate(run: str) -> str | None:
+    """Resolve a run argument to a sidecar/event-stream path."""
+    if os.path.isfile(run):
+        return run
+    hits: list = []
+    for root in (os.getcwd(), _REPO):
+        hits += sorted(glob.glob(os.path.join(root, f"TELEMETRY_*{run}*.json")))
+        hits += sorted(glob.glob(os.path.join(root, f"TELEMETRY_{run}")))
+    return hits[0] if hits else None
+
+
+def cmd_timeline(args) -> int:
+    """Render a run's TELEMETRY sidecar (or raw event stream) as a text
+    flame summary."""
+    path = _locate(args.run)
+    if path is None:
+        print(
+            f"error: no TELEMETRY sidecar matches {args.run!r} (looked for "
+            "a file path, then TELEMETRY_*<run>*.json in . and the repo "
+            "root).  Runs emit one when telemetry is armed "
+            "(CSMOM_TELEMETRY; bench and rehearse arm it by default).",
+            file=sys.stderr,
+        )
+        return 2
+    if path.endswith((".jsonl", ".events")):
+        events = tl.read_events(path)
+        # a reused (append-mode) stream can carry several runs; render
+        # the most recent one rather than a blended timeline that
+        # corresponds to none of them
+        runs = [e.get("run") for e in events if e.get("run")]
+        latest = runs[-1] if runs else None
+        if len(set(runs)) > 1:
+            print(
+                f"note: stream carries {len(set(runs))} runs; rendering "
+                f"the most recent ({latest!r})", file=sys.stderr,
+            )
+        obj = tl.assemble(events, run_id=latest)
+    else:
+        try:
+            obj = tl.load_sidecar(path)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: unreadable sidecar {path}: {e}", file=sys.stderr)
+            return 2
+    violations = inv.validate(obj, "telemetry")
+    if args.json:
+        json.dump(obj, sys.stdout, indent=1)
+        print()
+    else:
+        print(f"[{os.path.relpath(path)}]")
+        try:
+            print(tl.render(obj, top=args.top))
+        except Exception as e:  # a damaged sidecar must still get its
+            print(f"(render failed: {type(e).__name__}: {e} — "  # diagnosis
+                  "schema report below)")
+    if violations:
+        print("\nschema violations (the sidecar is damaged or stale-format):",
+              file=sys.stderr)
+        for v in violations:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def register(sub) -> None:
+    """Attach the ``timeline`` subparser (called from cli.main)."""
+    sp = sub.add_parser(
+        "timeline",
+        help="render a run's TELEMETRY_*.json sidecar (phases, top spans, "
+             "metrics) as a text flame summary",
+    )
+    sp.add_argument("run",
+                    help="sidecar path, raw .jsonl event stream, or run id "
+                         "(globbed as TELEMETRY_*<run>*.json)")
+    sp.add_argument("--top", type=int, default=12,
+                    help="span aggregates to show (default 12)")
+    sp.add_argument("--json", action="store_true",
+                    help="dump the assembled sidecar object instead of "
+                         "rendering")
+    sp.set_defaults(fn=cmd_timeline)

@@ -116,17 +116,20 @@ def aot_compile(entry) -> dict:
     must cost recompiles, never a window.
     """
     from csmom_tpu.chaos.inject import checkpoint
+    from csmom_tpu.obs import span
     from csmom_tpu.utils.profiling import compile_stats
 
     entry.validate()
     before = compile_stats()
-    t0 = time.perf_counter()
-    lowered = entry.fn.lower(*entry.args, **dict(entry.kwargs))
-    trace_s = time.perf_counter() - t0
-    checkpoint("aot.compile", entry=entry.name)
-    t1 = time.perf_counter()
-    _, healed = _compile_with_self_heal(lowered, entry.name)
-    compile_s = time.perf_counter() - t1
+    with span("aot.compile", entry=entry.name) as sp:
+        t0 = time.perf_counter()
+        lowered = entry.fn.lower(*entry.args, **dict(entry.kwargs))
+        trace_s = time.perf_counter() - t0
+        checkpoint("aot.compile", entry=entry.name)
+        t1 = time.perf_counter()
+        _, healed = _compile_with_self_heal(lowered, entry.name)
+        compile_s = time.perf_counter() - t1
+        sp.set(trace_s=round(trace_s, 4), compile_s=round(compile_s, 4))
     d = compile_stats().delta(before)
     rec = {
         "name": entry.name,
@@ -187,15 +190,17 @@ def warmup(profiles=("bench-cpu", "golden"), *, subdir: str = "bench",
         entries += [(profile, e) for e in build_manifest(profile)]
 
     from csmom_tpu.chaos.inject import checkpoint
+    from csmom_tpu.obs import span
 
     rows = []
     for profile, entry in entries:
         checkpoint("warmup.entry", entry=entry.name)
-        try:
-            rec = aot_compile(entry)
-        except Exception as e:  # record, keep warming the rest
-            rec = {"name": entry.name,
-                   "error": f"{type(e).__name__}: {e}"[:200]}
+        with span("warmup.entry", entry=entry.name, profile=profile):
+            try:
+                rec = aot_compile(entry)
+            except Exception as e:  # record, keep warming the rest
+                rec = {"name": entry.name,
+                       "error": f"{type(e).__name__}: {e}"[:200]}
         rec["profile"] = profile
         rows.append(rec)
         log.info("warmup %-40s trace %.2fs compile %.2fs %s",

@@ -1,0 +1,64 @@
+"""Run telemetry: structured spans, a metrics registry, a phase timeline.
+
+The last two tunnel windows were diagnosed after the fact from scattered
+prints, bench JSON fragments, and the chaos rehearsal log.  This package
+is the single answer to "where did the time and the dispatches go in this
+run?" — span-based tracing in the Dapper spirit, sized for a capture
+pipeline instead of an RPC fleet:
+
+- :mod:`~csmom_tpu.obs.spans` — nestable, thread-safe spans
+  (``span("bench.row", shape=...)``) recording monotonic wall time (plus
+  device time via the ``profiling.fetch`` device_get pattern), emitted as
+  a JSON-lines event stream keyed by a run id.  Cross-process: children
+  append to the same stream (CLOCK_MONOTONIC is system-wide on Linux, so
+  their timestamps compose on one timeline).
+- :mod:`~csmom_tpu.obs.metrics` — a process-wide registry of counters /
+  gauges / histograms (rows landed, deadline margin, dispatch counts; the
+  AOT cache hit/miss counters fold in from ``profiling.compile_stats``),
+  snapshotted into every BENCH record.
+- :mod:`~csmom_tpu.obs.timeline` — assembles the event stream into a
+  per-run ``TELEMETRY_<run>.json`` sidecar (phases: warmup, probe,
+  compile, row, land, other) that ``chaos.invariants`` schema-validates
+  like every other committed artifact, and renders it as a text flame
+  summary (``csmom timeline <run>``).
+
+Like the chaos harness, the whole layer is ZERO-COST when disarmed: with
+no collector armed, ``span()`` returns a shared no-op singleton and
+``metric.inc()`` is one global load + compare — no allocation, no I/O
+(tested in tests/test_obs.py, mirroring the chaos unarmed contract).
+Arming is explicit (:func:`~csmom_tpu.obs.spans.arm`) or env-driven
+(``CSMOM_TELEMETRY`` = an event-stream path, ``1`` for in-memory, ``0``
+to force off), so the measurement path never pays for observability it
+did not ask for.
+
+Nothing in these modules imports jax (or numpy) — but reaching them runs
+the ``csmom_tpu`` package ``__init__`` (which does).  The bench
+supervisor therefore imports this package LAZILY and only when armed: a
+disarmed supervisor (``CSMOM_TELEMETRY=0``) stays package-import-free,
+and an armed one pays the ~1 s package import once, before its first
+probe — never inside a measured interval.
+"""
+
+from csmom_tpu.obs import metrics, spans, timeline
+from csmom_tpu.obs.spans import (
+    arm,
+    arm_from_env,
+    arm_policy,
+    armed,
+    disarm,
+    point,
+    span,
+)
+
+__all__ = [
+    "arm",
+    "arm_from_env",
+    "arm_policy",
+    "armed",
+    "disarm",
+    "metrics",
+    "point",
+    "span",
+    "spans",
+    "timeline",
+]

@@ -1,0 +1,157 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+One flat registry per process, keyed by dotted metric name.  Handles are
+cheap and cached (``counter("bench.rows_landed")`` twice returns the
+same object), and every mutator checks the telemetry arming flag FIRST:
+disarmed, ``inc()``/``set()``/``observe()`` are one global load + one
+compare — no lock, no allocation — the same zero-cost-unarmed contract
+as ``chaos.checkpoint`` and ``obs.span`` (pinned by tests).  The
+registry therefore only accumulates while a collector is armed, which is
+exactly when a snapshot can land anywhere.
+
+``snapshot()`` is what bench embeds in every BENCH record (and emits
+into the event stream): all registered values, plus the AOT
+compile-cache accounting folded in from ``profiling.compile_stats`` —
+cache hits/misses, trace vs backend-compile counts — and the
+jax.monitoring listener state, both read lazily so a jax-free process
+(the bench supervisor) can snapshot without importing jax.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+from csmom_tpu.obs import spans as _spans
+
+__all__ = ["counter", "gauge", "histogram", "snapshot", "reset"]
+
+_LOCK = threading.Lock()
+_REGISTRY: dict = {}  # name -> metric handle
+
+
+class Counter:
+    """Monotone event count.  ``inc(n)`` is a no-op while disarmed."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if _spans._COLLECTOR is None:
+            return
+        with _LOCK:
+            self.value += n
+
+
+class Gauge:
+    """Last-written value (deadline margin, queue depth, a flag)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = None
+
+    def set(self, v) -> None:
+        if _spans._COLLECTOR is None:
+            return
+        with _LOCK:
+            self.value = v
+
+
+class Histogram:
+    """Streaming summary of observations: count / sum / min / max."""
+
+    __slots__ = ("name", "count", "total", "min", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, v: float) -> None:
+        if _spans._COLLECTOR is None:
+            return
+        with _LOCK:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": round(self.total, 6),
+            "min": self.min,
+            "max": self.max,
+            "mean": round(self.total / self.count, 6) if self.count else None,
+        }
+
+
+def _get(name: str, cls):
+    with _LOCK:
+        m = _REGISTRY.get(name)
+        if m is None:
+            m = _REGISTRY[name] = cls(name)
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(m).__name__}, not {cls.__name__}"
+            )
+        return m
+
+
+def counter(name: str) -> Counter:
+    return _get(name, Counter)
+
+
+def gauge(name: str) -> Gauge:
+    return _get(name, Gauge)
+
+
+def histogram(name: str) -> Histogram:
+    return _get(name, Histogram)
+
+
+def reset() -> None:
+    """Drop every registered metric (tests re-register per case)."""
+    with _LOCK:
+        _REGISTRY.clear()
+
+
+def snapshot(include_compile: bool = True) -> dict:
+    """All registered metrics as one JSON-ready dict.
+
+    ``compile`` folds in the process-global AOT cache / dispatch counters
+    from :func:`csmom_tpu.utils.profiling.compile_stats`, read lazily and
+    only when jax is already imported — a jax-free supervisor snapshots
+    its own registry and records WHY the compile block is absent instead
+    of importing a backend to fill it.
+    """
+    with _LOCK:
+        out: dict = {
+            "counters": {m.name: m.value for m in _REGISTRY.values()
+                         if isinstance(m, Counter)},
+            "gauges": {m.name: m.value for m in _REGISTRY.values()
+                       if isinstance(m, Gauge)},
+            "histograms": {m.name: m.summary() for m in _REGISTRY.values()
+                           if isinstance(m, Histogram)},
+        }
+    if include_compile:
+        if "jax" in sys.modules:
+            from csmom_tpu.utils.profiling import (
+                compile_stats,
+                listeners_installed,
+            )
+
+            out["compile"] = compile_stats().as_dict()
+            out["profiling_listeners_installed"] = listeners_installed()
+        else:
+            out["compile"] = ("not applicable: jax not imported in this "
+                              "process (supervisor-side snapshot)")
+    return out

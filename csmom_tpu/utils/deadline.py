@@ -25,6 +25,12 @@ dump a partial while time remained.  ``t0`` MUST therefore come from
 arm time and re-anchored to "now" with a stderr note, because a silently
 never-firing guard is the precise failure this module exists to prevent.
 
+Code that legitimately needs the wall clock (file-mtime TTLs, identity
+stamps) must go through :func:`wall_now_s` / :func:`file_age_s` /
+:func:`marker_fresh` below — the skew-resistant CLOCK_REALTIME readers —
+rather than ``time.time``; the tier-1 time-discipline lint
+(tests/test_time_discipline.py) enforces exactly that.
+
 The reference has no analogue (no benchmarks, no timeouts —
 ``/root/reference/README.md`` is a bare title); this is capture-harness
 plumbing for the TPU rebuild's evidence discipline.
@@ -38,7 +44,50 @@ import threading
 import time
 from typing import Callable, Optional
 
-__all__ = ["deadline_guard", "trip_active_guard"]
+__all__ = ["deadline_guard", "file_age_s", "marker_fresh",
+           "trip_active_guard", "wall_now_s"]
+
+
+# -- skew-resistant wall-clock helpers ---------------------------------------
+#
+# Some checks genuinely need the wall clock: a file-mtime TTL ("is this
+# probe-success marker recent?") compares against st_mtime, which IS wall
+# time — no monotonic clock can age a file written by another process.
+# But ``time.time`` is exactly what the chaos ``clock_skew`` fault (and a
+# real NTP step, partially) perturbs, and the r7 skew-proofing banned it
+# from this module.  These helpers are the one documented home for such
+# checks: they read CLOCK_REALTIME through ``time.clock_gettime``, which
+# the skew fault's monkeypatch cannot touch, and they clamp the
+# pathological cases (negative ages from a backwards step) toward the
+# SAFE side — "stale", never "fresh forever".
+
+def wall_now_s() -> float:
+    """Current wall-clock seconds (CLOCK_REALTIME), immune to the chaos
+    ``clock_skew`` monkeypatch of ``time.time``.  For identity stamps and
+    file-age comparisons only — NEVER for durations (use monotonic)."""
+    return time.clock_gettime(time.CLOCK_REALTIME)
+
+
+def file_age_s(path: str) -> float:
+    """Age of ``path`` in seconds (>= 0) per its mtime.  A negative raw
+    age (mtime in the future: a backwards clock step, a copied file)
+    clamps to +inf — an unknowable age must read as stale, not fresh.
+    Raises ``OSError`` when the file is absent/unstatable."""
+    age = wall_now_s() - os.path.getmtime(path)
+    return age if age >= 0 else float("inf")
+
+
+def marker_fresh(path: str, ttl_s: float) -> bool:
+    """True iff ``path`` exists and is younger than ``ttl_s`` — the
+    skew-safe form of the wall-clock-minus-getmtime TTL idiom.
+    ``ttl_s <= 0`` means "never fresh" (TTL disabled); a missing or
+    unstatable marker is simply not fresh."""
+    if ttl_s <= 0:
+        return False
+    try:
+        return file_age_s(path) < ttl_s
+    except OSError:
+        return False
 
 # the most recently armed guard's fire callable, for the chaos
 # ``trip_deadline`` fault (one guard per capture process by construction)

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import sys
 import time
 
 import jax
@@ -115,32 +116,65 @@ _COUNTERS = {
     "traces": 0,            # computations traced+lowered this process
     "backend_compiles": 0,  # top-level compile requests (cache load OR compile)
 }
-_LISTENING = False
+
+# Idempotency across RE-IMPORT, not just re-call: a module-level boolean
+# resets when this module is reloaded (importlib.reload, a second import
+# path, an embedder re-exec'ing site code) while the listeners registered
+# with jax.monitoring live on — the old closures keep counting into the
+# old dict and a fresh registration double-counts every event.  So the
+# installed marker AND the live counter dict are stashed on jax's own
+# monitoring module (one per process, reload-proof); a reloaded copy of
+# this module ADOPTS the existing dict instead of re-registering.  Fork
+# needs nothing: the child inherits both the registered listeners and the
+# counter values, which stay correct (they are process-global monotone
+# counts, and the fork point is their shared baseline).
+_LISTENER_TAG = "_csmom_profiling_counters"
 
 
 def _install_listeners() -> None:
-    global _LISTENING
-    if _LISTENING:
-        return
+    global _COUNTERS
     from jax._src import monitoring
+
+    existing = getattr(monitoring, _LISTENER_TAG, None)
+    if existing is not None:
+        _COUNTERS = existing  # adopt, never re-register (see _LISTENER_TAG)
+        return
+    c = _COUNTERS  # bind the dict, not the module global: reload-proof
 
     def _on_event(event, **kw):
         if event == "/jax/compilation_cache/cache_hits":
-            _COUNTERS["cache_hits"] += 1
+            c["cache_hits"] += 1
         elif event == "/jax/compilation_cache/cache_misses":
-            _COUNTERS["cache_misses"] += 1
+            c["cache_misses"] += 1
         elif event == "/jax/compilation_cache/compile_requests_use_cache":
-            _COUNTERS["cache_requests"] += 1
+            c["cache_requests"] += 1
 
     def _on_duration(event, duration, **kw):
         if event == "/jax/core/compile/jaxpr_trace_duration":
-            _COUNTERS["traces"] += 1
+            c["traces"] += 1
         elif event == "/jax/core/compile/backend_compile_duration":
-            _COUNTERS["backend_compiles"] += 1
+            c["backend_compiles"] += 1
 
     monitoring.register_event_listener(_on_event)
     monitoring.register_event_duration_secs_listener(_on_duration)
-    _LISTENING = True
+    setattr(monitoring, _LISTENER_TAG, c)
+
+
+def listeners_installed() -> bool:
+    """Whether this process's compile/dispatch listeners are registered.
+
+    Read from the reload-proof marker on jax's monitoring module (NOT a
+    module global here, which a reload would zero); surfaced in every
+    ``obs.metrics.snapshot()`` so a record whose compile counters read 0
+    shows whether that means "nothing compiled" or "nobody was counting".
+    """
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import monitoring
+    except ImportError:  # pragma: no cover - jax layout drift
+        return False
+    return getattr(monitoring, _LISTENER_TAG, None) is not None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,434 @@
+"""Run-telemetry subsystem: spans, metrics registry, timeline assembly.
+
+The zero-cost-unarmed contract mirrors the chaos checkpoint's: with no
+collector armed, ``span()`` / ``point()`` / ``metric.inc()`` must do no
+allocation-visible work per call — the measurement path never pays for
+observability it did not ask for.  Armed behavior: spans nest per thread,
+record monotonic walls, and land in the event stream; the timeline
+assembler partitions the run's wall into phases that sum to the wall by
+construction (the invariant the ``telemetry`` schema validator pins).
+"""
+
+import gc
+import json
+import os
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+from csmom_tpu import obs
+from csmom_tpu.chaos import invariants as inv
+from csmom_tpu.obs import metrics, timeline as tl
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs(monkeypatch):
+    """Every case starts and ends disarmed with an empty registry, and the
+    env contract never leaks into other tests' subprocesses."""
+    monkeypatch.delenv("CSMOM_TELEMETRY", raising=False)
+    monkeypatch.delenv("CSMOM_TELEMETRY_RUN", raising=False)
+    obs.disarm()
+    metrics.reset()
+    yield
+    obs.disarm()
+    metrics.reset()
+
+
+# ------------------------------------------------- disarmed = zero cost ----
+
+def test_disarmed_span_is_a_shared_noop_singleton():
+    s1, s2 = obs.span("a"), obs.span("b")
+    assert s1 is s2  # no per-call object
+    with obs.span("c") as sp:
+        assert sp is s1
+        sp.set(x=1).event("e", y=2)  # all no-ops, all chainable
+    assert obs.point("d") is None
+    assert not obs.armed()
+
+
+def test_disarmed_calls_do_no_allocation_visible_work():
+    c = metrics.counter("overhead.count")   # registration allocates, once
+    g = metrics.gauge("overhead.gauge")
+    h = metrics.histogram("overhead.hist")
+    for _ in range(2000):  # warm every code path / cache first
+        obs.span("x")
+        obs.point("x")
+        c.inc()
+        g.set(1.0)
+        h.observe(1.0)
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(5000):
+        obs.span("x")
+        obs.point("x")
+        c.inc()
+        g.set(1.0)
+        h.observe(1.0)
+    gc.collect()
+    grown = sys.getallocatedblocks() - before
+    assert grown < 50, (
+        f"disarmed telemetry calls allocated {grown} blocks over 5000 "
+        "iterations — the unarmed fast path must be allocation-free"
+    )
+    # and nothing was recorded: the registry only accumulates while armed
+    assert c.value == 0
+    assert g.value is None
+    assert h.count == 0
+
+
+# --------------------------------------------------------- armed spans ----
+
+def test_armed_spans_record_nesting_attrs_and_device_time():
+    col = obs.arm(None, run_id="unit", proc="t")
+    with obs.span("outer", kind="root") as so:
+        with obs.span("inner", leg="x") as si:
+            time.sleep(0.01)
+            si.set(extra_attr=3)
+        so.event("mark", at="after-inner")
+    by_name = {e["name"]: e for e in col.events}
+    inner, outer = by_name["inner"], by_name["outer"]
+    assert inner["parent"] == outer["seq"]
+    assert outer["parent"] is None
+    assert inner["dur_s"] >= 0.01
+    assert inner["attrs"] == {"leg": "x", "extra_attr": 3}
+    assert by_name["mark"]["kind"] == "point"
+    assert by_name["mark"]["parent"] == outer["seq"]
+    assert all(e["run"] == "unit" and e["proc"] == "t" for e in col.events)
+
+
+def test_armed_span_records_exceptions_and_unwinds_stack():
+    col = obs.arm(None, run_id="unit", proc="t")
+    with pytest.raises(ValueError, match="boom"):
+        with obs.span("failing"):
+            raise ValueError("boom")
+    (ev,) = col.events
+    assert ev["error"].startswith("ValueError")
+    # the stack unwound: a new span parents to nothing, not to the corpse
+    with obs.span("after"):
+        pass
+    assert col.events[-1]["parent"] is None
+
+
+def test_spans_nest_independently_across_threads():
+    col = obs.arm(None, run_id="unit", proc="t")
+    with obs.span("main.root"):
+        def worker(n):
+            with obs.span(f"w{n}.outer"):
+                with obs.span(f"w{n}.inner"):
+                    time.sleep(0.005)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    by_name = {e["name"]: e for e in col.events}
+    for n in range(3):
+        # each thread's outer span has NO parent: thread-local stacks mean
+        # a worker never parents into main's (or a sibling's) open span
+        assert by_name[f"w{n}.outer"]["parent"] is None
+        assert (by_name[f"w{n}.inner"]["parent"]
+                == by_name[f"w{n}.outer"]["seq"])
+
+
+def test_arm_with_unwritable_stream_degrades_to_memory(tmp_path, capsys):
+    """An unopenable stream path must not cost the run: the collector
+    drops to in-memory with a stderr note instead of raising."""
+    col = obs.arm(str(tmp_path / "no-such-dir" / "events.jsonl"),
+                  run_id="u", proc="t")
+    assert col.path is None
+    with obs.span("bench.row"):
+        pass
+    assert [e["name"] for e in col.events] == ["bench.row"]
+    assert "continuing in-memory" in capsys.readouterr().err
+
+
+def test_finish_and_write_lands_disarms_and_reports_failures(tmp_path):
+    obs.arm(None, run_id="fw", proc="t")
+    with obs.span("run.root", root=True):
+        pass
+    name = tl.finish_and_write(str(tmp_path))
+    assert name == "TELEMETRY_fw.json"
+    assert not obs.armed()
+    assert inv.validate_file(str(tmp_path / name)) == []
+    # disarmed: a reason, not a crash
+    assert "disarmed" in tl.finish_and_write(str(tmp_path))
+    # unwritable out_dir: the REASON comes back (for the record to carry)
+    # and the collector still disarms
+    obs.arm(None, run_id="fw2", proc="t")
+    with obs.span("x"):
+        pass
+    reason = tl.finish_and_write(str(tmp_path / "missing" / "dir"))
+    assert "unwritable" in reason
+    assert not obs.armed()
+
+
+def test_write_sidecar_no_overwrite_protects_existing_name(tmp_path):
+    """An operator-supplied run id (e.g. a round id like r05) must not
+    replace an existing sidecar of that name — the new run lands
+    pid-suffixed instead."""
+    existing = tmp_path / "TELEMETRY_r99.json"
+    existing.write_text("{}")
+    name = tl.write_sidecar(str(tmp_path), "r99", events=[],
+                            overwrite=False)
+    assert name == f"TELEMETRY_r99-{os.getpid()}.json"
+    assert existing.read_text() == "{}"  # untouched
+    # default (our own name): overwrite freely
+    assert tl.write_sidecar(str(tmp_path), "r99", events=[]) == \
+        "TELEMETRY_r99.json"
+    assert existing.read_text() != "{}"
+
+
+def test_arm_exports_the_actual_stream_not_the_requested_one(tmp_path):
+    """If the stream open fails and the collector degrades to in-memory,
+    children must not be pointed at a path the assembler never reads."""
+    obs.arm(str(tmp_path / "gone" / "e.jsonl"), run_id="u", proc="t")
+    assert os.environ["CSMOM_TELEMETRY"] == "1"  # degraded: in-memory
+
+
+def test_finish_and_write_run_scopes_the_stream_metrics_check(tmp_path):
+    """A stale metrics event from an older run in a reused (append-mode)
+    stream must not suppress the live fallback snapshot."""
+    stream = tmp_path / "s.jsonl"
+    stream.write_text(json.dumps(
+        {"kind": "metrics", "run": "old-run", "t_s": 0.0,
+         "data": {"counters": {"stale": 9}}}) + "\n")
+    obs.arm(str(stream), run_id="new-run", proc="t")
+    with obs.span("run.root", root=True):
+        pass
+    name = tl.finish_and_write(str(tmp_path),
+                               fallback_metrics={"counters": {"live": 1}})
+    obj = tl.load_sidecar(str(tmp_path / name))
+    assert obj["metrics"] == {"counters": {"live": 1}}
+
+
+def test_event_stream_file_appends_parseable_lines(tmp_path):
+    stream = tmp_path / "events.jsonl"
+    obs.arm(str(stream), run_id="filerun", proc="t")
+    with obs.span("bench.row", row=1):
+        pass
+    obs.point("chaos.bench.finish")
+    events = tl.read_events(str(stream))
+    assert [e["name"] for e in events] == ["bench.row", "chaos.bench.finish"]
+    assert os.environ["CSMOM_TELEMETRY"] == str(stream)  # exported for kids
+    obs.disarm()
+    assert "CSMOM_TELEMETRY" not in os.environ  # and retracted
+
+
+# ------------------------------------------------------------- metrics ----
+
+def test_metrics_accumulate_only_while_armed():
+    obs.arm(None, run_id="unit", proc="t")
+    c = metrics.counter("bench.rows_landed")
+    c.inc()
+    c.inc(2)
+    metrics.gauge("bench.deadline_margin_s").set(17.5)
+    metrics.histogram("row.wall_s").observe(0.5)
+    metrics.histogram("row.wall_s").observe(1.5)
+    snap = metrics.snapshot(include_compile=False)
+    assert snap["counters"]["bench.rows_landed"] == 3
+    assert snap["gauges"]["bench.deadline_margin_s"] == 17.5
+    h = snap["histograms"]["row.wall_s"]
+    assert (h["count"], h["min"], h["max"], h["mean"]) == (2, 0.5, 1.5, 1.0)
+    with pytest.raises(TypeError, match="already registered"):
+        metrics.gauge("bench.rows_landed")
+
+
+def test_metrics_snapshot_folds_compile_stats_and_listener_state():
+    obs.arm(None, run_id="unit", proc="t")
+    snap = metrics.snapshot()  # jax is imported in the test process
+    assert isinstance(snap["compile"], dict)
+    assert {"cache_hits", "cache_misses", "traces",
+            "backend_compiles"} <= set(snap["compile"])
+    assert snap["profiling_listeners_installed"] in (True, False)
+
+
+# -------------------------------------------- checkpoints double as events --
+
+def test_chaos_checkpoint_doubles_as_telemetry_point(monkeypatch):
+    monkeypatch.delenv("CSMOM_FAULT_PLAN", raising=False)
+    from csmom_tpu.chaos import inject
+
+    inject.reset()
+    col = obs.arm(None, run_id="unit", proc="t")
+    assert inject.checkpoint("bench.row", row=3) is None  # no fault fired
+    (ev,) = col.events
+    assert ev["name"] == "chaos.bench.row"
+    assert ev["kind"] == "point"
+    assert ev["attrs"] == {"row": 3}
+
+
+def test_chaos_checkpoint_stays_silent_disarmed(monkeypatch):
+    monkeypatch.delenv("CSMOM_FAULT_PLAN", raising=False)
+    from csmom_tpu.chaos import inject
+
+    inject.reset()
+    assert inject.checkpoint("bench.row") is None  # and no collector to hit
+
+
+# ------------------------------------------------------------ timeline ----
+
+def _span_ev(name, t0, t1, seq, parent=None, attrs=None):
+    return {"kind": "span", "name": name, "seq": seq, "parent": parent,
+            "thread": 1, "t0_s": t0, "t1_s": t1, "dur_s": t1 - t0,
+            "attrs": attrs or {}, "run": "synt", "proc": "t", "pid": 1}
+
+
+def test_timeline_phase_partition_priority_and_exact_sum():
+    events = [
+        _span_ev("root", 0.0, 5.0, 1, attrs={"root": True}),
+        _span_ev("bench.probe", 0.0, 2.0, 2),
+        _span_ev("bench.compile", 1.0, 3.0, 3),   # overlaps probe: wins 1..2
+        _span_ev("bench.row", 2.5, 4.0, 4),       # overlaps compile: wins
+    ]
+    obj = tl.assemble(events, run_id="synt")
+    durs = {p["name"]: p["dur_s"] for p in obj["phases"]}
+    assert durs == pytest.approx({
+        "warmup": 0.0, "probe": 1.0, "compile": 1.5, "row": 1.5,
+        "land": 0.0, "other": 1.0,
+    })
+    assert sum(durs.values()) == pytest.approx(obj["wall_s"])
+    assert obj["wall_s"] == pytest.approx(5.0)
+    assert inv.detect_kind(obj) == "telemetry"
+    assert inv.validate(obj) == []
+
+
+def test_timeline_envelope_fallback_without_root_span():
+    events = [_span_ev("bench.row", 1.0, 2.0, 1)]
+    obj = tl.assemble(events, run_id="synt")
+    assert obj["wall_s"] == pytest.approx(1.0)
+    assert "no root span" in obj["root"]
+    assert inv.validate(obj) == []
+
+
+def test_assemble_filters_foreign_run_events():
+    """An env-armed stream file opens append, so a reused path can carry
+    an older run; with an explicit run_id those events must be dropped,
+    not blended into a timeline that corresponds to no single run."""
+    events = [
+        _span_ev("root", 0.0, 1.0, 1, attrs={"root": True}),
+        dict(_span_ev("bench.row", 0.0, 0.5, 2), run="yesterdays-run"),
+    ]
+    obj = tl.assemble(events, run_id="synt")
+    assert obj["n_spans"] == 1
+    durs = {p["name"]: p["dur_s"] for p in obj["phases"]}
+    assert durs["row"] == 0.0
+
+
+def test_cli_timeline_damaged_sidecar_still_reports_violations(tmp_path,
+                                                               capsys):
+    bad = {"kind": "telemetry", "schema_version": 1, "run_id": "x",
+           "wall_s": 1.0,
+           "phases": [{"dur_s": 1.0}, "not-a-dict"], "spans": ["junk"]}
+    p = tmp_path / "TELEMETRY_bad.json"
+    p.write_text(json.dumps(bad))
+    from csmom_tpu.cli.timeline import cmd_timeline
+
+    args = types.SimpleNamespace(run=str(p), top=5, json=False)
+    assert cmd_timeline(args) == 1  # render survives, violations reported
+    assert "schema violations" in capsys.readouterr().err
+
+
+def test_telemetry_validator_rejects_unaccounted_wall():
+    obj = {"kind": "telemetry", "schema_version": 1, "run_id": "x",
+           "wall_s": 10.0,
+           "phases": [{"name": "row", "dur_s": 1.0}]}
+    assert any("5%" in v for v in inv.validate(obj))
+    obj["phases"].append({"name": "other", "dur_s": 9.0})
+    assert inv.validate(obj) == []
+    obj["phases"].append({"name": "other", "dur_s": 0.0})
+    assert any("duplicate" in v for v in inv.validate(obj))
+
+
+def test_sidecar_write_validate_render_and_cli(tmp_path, capsys):
+    col = obs.arm(None, run_id="unit-run", proc="t")
+    with obs.span("run.root", root=True):
+        with obs.span("bench.row", row="leg0"):
+            time.sleep(0.005)
+        metrics.counter("bench.rows_landed").inc()
+    name = tl.write_sidecar(str(tmp_path), "unit-run",
+                            events=list(col.events),
+                            metrics=metrics.snapshot(include_compile=False))
+    path = tmp_path / name
+    assert name == "TELEMETRY_unit-run.json"
+    assert inv.validate_file(str(path)) == []
+
+    rendered = tl.render(tl.load_sidecar(str(path)))
+    assert "unit-run" in rendered and "row" in rendered
+
+    from csmom_tpu.cli.timeline import cmd_timeline
+
+    args = types.SimpleNamespace(run=str(path), top=5, json=False)
+    assert cmd_timeline(args) == 0
+    out = capsys.readouterr().out
+    assert "phase" in out and "bench.rows_landed=1" in out
+    # --json dumps the assembled object verbatim
+    args = types.SimpleNamespace(run=str(path), top=5, json=True)
+    assert cmd_timeline(args) == 0
+    assert json.loads(capsys.readouterr().out)["run_id"] == "unit-run"
+
+
+def test_cli_timeline_missing_run_fails_cleanly(tmp_path, capsys,
+                                                monkeypatch):
+    from csmom_tpu.cli.timeline import cmd_timeline
+
+    monkeypatch.chdir(tmp_path)
+    args = types.SimpleNamespace(run="no-such-run-id", top=5, json=False)
+    assert cmd_timeline(args) == 2
+    assert "no TELEMETRY sidecar" in capsys.readouterr().err
+
+
+# --------------------------------------- profiling listener idempotency ----
+
+def test_install_listeners_idempotent_under_recall_and_reimport():
+    import importlib
+
+    from jax._src import monitoring
+
+    from csmom_tpu.utils import profiling
+
+    profiling._install_listeners()
+    n_ev = len(monitoring._event_listeners)
+    n_dur = len(monitoring._event_duration_secs_listeners)
+    profiling._install_listeners()  # re-call: no growth
+    reloaded = importlib.reload(profiling)  # re-import: the r7 hazard
+    reloaded._install_listeners()
+    assert len(monitoring._event_listeners) == n_ev
+    assert len(monitoring._event_duration_secs_listeners) == n_dur
+    assert reloaded.listeners_installed() is True
+    # the reloaded module ADOPTED the live counter dict instead of
+    # registering fresh closures over a zeroed one (no double counting,
+    # no dead counters)
+    assert reloaded._COUNTERS is getattr(monitoring,
+                                         reloaded._LISTENER_TAG)
+
+
+# ------------------------------------------ skew-safe wall-clock helpers ----
+
+def test_marker_fresh_is_skew_resistant(tmp_path, monkeypatch):
+    from csmom_tpu.utils import deadline as dl
+
+    p = tmp_path / "marker"
+    p.write_text("ok")
+    assert dl.marker_fresh(str(p), 60) is True
+    assert dl.marker_fresh(str(p), 0) is False       # TTL disabled
+    assert dl.marker_fresh(str(tmp_path / "absent"), 60) is False
+
+    # the chaos clock_skew fault monkeypatches time.time (+1h); the
+    # helpers read CLOCK_REALTIME and must not flinch
+    real = time.clock_gettime(time.CLOCK_REALTIME)
+    monkeypatch.setattr(time, "time", lambda: real + 3600.0)
+    assert dl.marker_fresh(str(p), 60) is True
+
+    # an mtime in the future (backwards wall step, copied file) must read
+    # STALE — an unknowable age can never be "fresh forever"
+    future = time.clock_gettime(time.CLOCK_REALTIME) + 3600
+    os.utime(p, (future, future))
+    assert dl.file_age_s(str(p)) == float("inf")
+    assert dl.marker_fresh(str(p), 1e9) is False

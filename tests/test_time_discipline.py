@@ -1,0 +1,107 @@
+"""Tier-1 time-discipline lint + telemetry artifact validation.
+
+The r7 skew-proofing made ``utils.deadline`` monotonic-only, and the
+chaos ``clock_skew`` fault exists to catch wall-clock timing sneaking
+back in — but the ban was enforced by review, not by a test, and one
+call site (the CLI probe-marker TTL) survived it until this round.  This
+lint makes the discipline mechanical: no bare ``time.time()`` and no
+argless ``datetime.now()`` anywhere in the package, the bench harness,
+or the capture scripts, outside a documented allowlist.
+
+Legitimate wall-clock needs go through the skew-resistant helpers in
+``utils.deadline`` (``wall_now_s`` / ``file_age_s`` / ``marker_fresh``)
+or take an explicit timezone (identity stamps:
+``datetime.now(timezone.utc)`` — argful, so not matched here).
+"""
+
+import glob
+import os
+import re
+
+from csmom_tpu.chaos import invariants as inv
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a bare wall-clock read; the suffix form also catches aliased imports
+# like ``_time.time()``
+_WALL_CLOCK = re.compile(r"time\.time\(\)")
+_ARGLESS_NOW = re.compile(r"datetime(?:\.datetime)?\.now\(\s*\)")
+
+# path (repo-relative) -> max allowed matches, each one justified.  These
+# are MENTIONS in prose, not executed timing calls; anything new must
+# either use the deadline helpers or argue its way in here.
+_ALLOWLIST = {
+    # module docstring explaining why naive wall-clock pairs mis-measure
+    # async dispatch — the warning against the pattern, not a use of it
+    "csmom_tpu/utils/profiling.py": 1,
+    # comment documenting what the clock_skew fault perturbs
+    "csmom_tpu/chaos/plan.py": 1,
+}
+
+
+def _timing_sources():
+    files = [os.path.join(_REPO, "bench.py")]
+    for root in ("csmom_tpu", "benchmarks"):
+        for dirpath, _, names in os.walk(os.path.join(_REPO, root)):
+            files += [os.path.join(dirpath, n) for n in names
+                      if n.endswith(".py")]
+    return sorted(files)
+
+
+def test_no_bare_wall_clock_in_timing_paths():
+    offenders = {}
+    for path in _timing_sources():
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        n = len(_WALL_CLOCK.findall(src)) + len(_ARGLESS_NOW.findall(src))
+        rel = os.path.relpath(path, _REPO)
+        if n > _ALLOWLIST.get(rel, 0):
+            offenders[rel] = n
+    assert offenders == {}, (
+        f"bare time.time()/argless datetime.now() in timing paths: "
+        f"{offenders} — use utils.deadline.wall_now_s/file_age_s/"
+        "marker_fresh (or datetime.now(timezone.utc) for identity "
+        "stamps), or extend the documented allowlist"
+    )
+
+
+def test_allowlist_entries_are_not_stale():
+    """An allowlisted file that no longer contains its mention must lose
+    the entry — a stale allowlist is a hole the next regression walks
+    through."""
+    for rel, allowed in _ALLOWLIST.items():
+        path = os.path.join(_REPO, rel)
+        assert os.path.exists(path), f"allowlisted file {rel} is gone"
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        n = len(_WALL_CLOCK.findall(src)) + len(_ARGLESS_NOW.findall(src))
+        assert 0 < n <= allowed, (
+            f"{rel}: {n} matches vs allowlisted {allowed} — update or "
+            "drop the entry"
+        )
+
+
+def test_deadline_helpers_are_the_documented_wall_clock_home():
+    from csmom_tpu.utils import deadline
+
+    for helper in ("wall_now_s", "file_age_s", "marker_fresh"):
+        assert hasattr(deadline, helper)
+
+
+# --------------------------- committed telemetry sidecars (same tier) ----
+
+def test_telemetry_pattern_is_in_the_tier1_artifact_sweep():
+    """TELEMETRY_*.json validates in the SAME tree sweep as BENCH_*/
+    MULTICHIP_* (test_chaos.test_every_committed_artifact_validates runs
+    it); pin that the pattern stays in the default sweep."""
+    import inspect
+
+    sig = inspect.signature(inv.validate_tree)
+    assert "TELEMETRY_*.json" in sig.parameters["patterns"].default
+
+
+def test_committed_telemetry_sidecars_validate():
+    paths = sorted(glob.glob(os.path.join(_REPO, "TELEMETRY_*.json")))
+    for p in paths:
+        assert inv.validate_file(p) == [], (os.path.basename(p),
+                                            inv.validate_file(p))
