@@ -339,14 +339,32 @@ def child_main():
     price, valid, score, adv, vol, n_trades = wl.golden_event_inputs(dtype)
     n_bars = int(np.asarray(valid).any(axis=0).sum())
 
+    # Raw repeat samples (perf-ledger contract): every timed leg records
+    # its PER-REP walls, not only the mean — `csmom ledger diff/gate`
+    # needs the sample distribution to put a bootstrap CI behind a
+    # regression verdict instead of a bare delta.  Keyed by the same
+    # extra field name as the leg's aggregate, so the ledger joins them
+    # without a mapping table.  Lives in the FULL record only (the
+    # headline digest has a fixed key set and never carries lists).
+    _SAMPLES: dict = {}
+
+    def _timed_reps(n: int, one_rep):
+        """``(mean_wall, per_rep_walls)`` of n reps, each individually
+        timed — the tuple keeps a leg's samples structurally tied to its
+        mean, so a failed leg can never leave stale samples behind for
+        the next key to pick up."""
+        walls = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            one_rep()
+            walls.append(time.perf_counter() - t0)
+        return sum(walls) / n, [round(w, 6) for w in walls]
+
     run = lambda: fetch(event_backtest(price, valid, score, adv, vol).total_pnl)
     _compiled_leg("event.golden", run)  # compile (or cache load)
     reps = 20
     with obs.span("bench.row", row="event.golden", reps=reps):
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            run()
-        dt = (time.perf_counter() - t0) / reps
+        dt, _SAMPLES["event_backtest_wall_s"] = _timed_reps(reps, run)
     obs_metrics.counter("bench.rows_landed").inc()
     groups_per_sec = n_bars / dt
     _PROG.update({
@@ -367,6 +385,11 @@ def child_main():
     # live reference: legs recorded after this point (and the final compile
     # totals) show up in a watchdog partial dump too
     _PROG["extra"]["compile_legs"] = _LEGS
+    _PROG["extra"]["samples"] = _SAMPLES  # live dict: grid legs append
+    _PROG["extra"]["samples_note"] = (
+        "per-rep raw walls (s) keyed by the matching aggregate field — "
+        "the ledger's bootstrap-CI regression input (obs.regress)"
+    )
     # measured-row boundary: the headline is in _PROG, the grid legs are
     # not — the r5 chaos plans (hang / expired deadline / SIGKILL between
     # rows) all fire here, and the invariant is that the headline above
@@ -380,7 +403,10 @@ def child_main():
     #    reduced (recorded) on the CPU fallback so the fallback still
     #    completes inside the driver timeout --------------------------------
     if on_cpu:
-        (A, T), grid_reps = wl.REDUCED_GRID, 2    # 512 stocks x 15 yr
+        # 512 stocks x 15 yr; 5 reps (was 2): the ledger's bootstrap CI
+        # needs >= 5 raw samples to back a verdict, and the reduced grid
+        # is cheap enough that 3 extra reps cost ~1 s
+        (A, T), grid_reps = wl.REDUCED_GRID, 5
     else:
         (A, T), grid_reps = wl.NORTH_STAR_GRID, 5  # the north-star workload
     # At-scale data path: the panel is fed from the packed binary cache
@@ -398,20 +424,22 @@ def child_main():
     # timed rep is one dispatch + one 4-byte fetch) are the shared
     # compile.entries callables — the exact functions the AOT manifest
     # compiles, hence identical HLO and guaranteed cache connection
-    def timed(mode, impl="xla"):
+    def timed(mode, impl="xla", sample_key=None):
+        """One timed grid leg; ``sample_key`` is the extra field its
+        aggregate lands in, so the per-rep samples are recorded under
+        the SAME name at the same call site — no side table to desync."""
         gfn = grid_scalar_fn(wl.GRID_JS, wl.GRID_KS, wl.GRID_SKIP, mode, impl)
         _compiled_leg(f"grid16.{mode}.{impl}@{A}x{M}",
                       lambda: fetch(gfn(pm, mm)))  # compile + warm the tunnel
         with obs.span("bench.row", row=f"grid16.{mode}.{impl}",
                       reps=grid_reps):
-            t0 = time.perf_counter()
-            for _ in range(grid_reps):
-                fetch(gfn(pm, mm))
-            dt = (time.perf_counter() - t0) / grid_reps
+            dt, walls = _timed_reps(grid_reps, lambda: fetch(gfn(pm, mm)))
+        if sample_key is not None:
+            _SAMPLES[sample_key] = walls
         obs_metrics.counter("bench.rows_landed").inc()
         return dt
 
-    def timed_or_reason(mode, impl="xla", floor_s=120.0):
+    def timed_or_reason(mode, impl="xla", floor_s=120.0, sample_key=None):
         """Run a grid leg if the child budget allows, else a reason string."""
         if SMOKE:
             return SMOKE_REASON
@@ -420,14 +448,14 @@ def child_main():
             return (f"skipped: child budget too small for this leg "
                     f"({int(left)}s left < {int(floor_s)}s floor)")
         try:
-            return timed(mode, impl)
+            return timed(mode, impl, sample_key=sample_key)
         except Exception as e:
             return f"failed: {type(e).__name__}: {e}"[:200]
 
     # the north-star number itself is never budget-gated: it is the reason
     # the child exists, and the supervisor only launches a child when at
     # least the child minimum is left
-    grid_rank_s = timed("rank")
+    grid_rank_s = timed("rank", sample_key="grid16_rank_s")
     _chaos("bench.row", row="grid16.rank")
     _PROG["extra"].update({
         "grid16_rank_s": round(grid_rank_s, 4),
@@ -438,10 +466,11 @@ def child_main():
         ),
         "pack_ingest_s": round(pack_ingest_s, 4),
     })
-    grid_qcut_s = timed_or_reason("qcut")
+    grid_qcut_s = timed_or_reason("qcut", sample_key="grid16_qcut_s")
     _PROG["extra"]["grid16_qcut_s"] = _r4(grid_qcut_s)
     # MXU-form cohort aggregation (membership^T @ returns cross table)
-    grid_matmul_s = timed_or_reason("rank", "matmul")
+    grid_matmul_s = timed_or_reason("rank", "matmul",
+                                    sample_key="grid16_rank_matmul_s")
     _PROG["extra"]["grid16_rank_matmul_s"] = _r4(grid_matmul_s)
     # the fused Pallas cohort kernel only makes sense compiled on the TPU;
     # off-TPU it runs in interpreter mode (correctness tests), far too slow
@@ -449,13 +478,16 @@ def child_main():
     grid_pallas_s = (
         "skipped: cpu platform (pallas kernel compiles only on tpu; "
         "interpreter mode is a correctness harness, not timeable at scale)"
-        if on_cpu else timed_or_reason("rank", "pallas")
+        if on_cpu else timed_or_reason(
+            "rank", "pallas", sample_key="grid16_rank_pallas_s")
     )
     # bf16-operand MXU form: reduced-precision throughput mode, only
     # meaningful on the accelerator
     grid_bf16_s = (
         "skipped: cpu platform (bf16 MXU operands are a tpu fast path)"
-        if on_cpu else timed_or_reason("rank", "matmul_bf16")
+        if on_cpu else timed_or_reason(
+            "rank", "matmul_bf16",
+            sample_key="grid16_rank_matmul_bf16_s")
     )
     _PROG["extra"]["grid16_rank_pallas_s"] = _r4(grid_pallas_s)
     _PROG["extra"]["grid16_rank_matmul_bf16_s"] = _r4(grid_bf16_s)
@@ -490,11 +522,14 @@ def child_main():
             _compiled_leg(f"event.batched{B}",
                           lambda: fetch(bat(price, valid, bscore, adv, vol)))
             with obs.span("bench.row", row=f"event.batched{B}"):
-                t0 = time.perf_counter()
                 breps = 5
-                for _ in range(breps):
-                    fetch(bat(price, valid, bscore, adv, vol))
-                batched_per_run_s = (time.perf_counter() - t0) / breps / B
+                dt_b, bwalls = _timed_reps(
+                    breps, lambda: fetch(bat(price, valid, bscore, adv, vol))
+                )
+                batched_per_run_s = dt_b / B
+            _SAMPLES["event_batched_per_run_s"] = [
+                round(w / B, 8) for w in bwalls
+            ]
             obs_metrics.counter("bench.rows_landed").inc()
         except Exception as e:  # record the why, keep the headline metric
             batched_skip_reason = (
@@ -523,9 +558,11 @@ def child_main():
 
             _compiled_leg(f"grid16.rank.xla@{A_f}x{M_f}", gf)  # compile
             with obs.span("bench.row", row="grid16.full.xla"):
-                t0 = time.perf_counter()
-                gf()
-                full_rank_s = time.perf_counter() - t0
+                # one rep by design (the full-size leg exists to prove
+                # the compile+memory, not to distribute): a single raw
+                # sample — the ledger reports point deltas, never a CI
+                full_rank_s, _SAMPLES["grid16_rank_full_s"] = \
+                    _timed_reps(1, gf)
             obs_metrics.counter("bench.rows_landed").inc()
         except Exception as e:  # record, never lose the JSON line
             full_rank_s = f"failed: {type(e).__name__}: {e}"[:200]
@@ -538,9 +575,8 @@ def child_main():
                 _compiled_leg(f"grid16.rank.matmul@{A_f}x{M_f}",
                               lambda: gf("matmul"))  # compile
                 with obs.span("bench.row", row="grid16.full.matmul"):
-                    t0 = time.perf_counter()
-                    gf("matmul")
-                    full_matmul_s = time.perf_counter() - t0
+                    full_matmul_s, _SAMPLES["grid16_rank_matmul_full_s"] = \
+                        _timed_reps(1, lambda: gf("matmul"))
                 obs_metrics.counter("bench.rows_landed").inc()
             except Exception as e:
                 full_matmul_s = f"failed: {type(e).__name__}: {e}"[:200]
@@ -1034,11 +1070,30 @@ def _headline(record: dict, full_record_ref: str) -> str:
     def _s(v, n=120):  # bound any free-text value
         return v if not isinstance(v, str) else (v if len(v) <= n else v[:n - 1] + "…")
 
+    def _short_provenance(p):
+        """The provenance CLASS, complete — never a lossy cut.
+
+        r5's committed headline carried 'session-cached (originally:
+        live (r3; block_until_re…' — a provenance string truncated
+        mid-parenthesis is not machine-readable provenance at all.  The
+        headline keeps only the leading class token ('live' /
+        'session-cached'), which is complete and parseable by
+        construction; the full composed string stays in the FULL record
+        the headline points at (pinned by a round-trip test)."""
+        if not isinstance(p, str):
+            return p
+        head = p.split(" (", 1)[0].strip()
+        return head or "unknown"
+
     probes = ex.get("tpu_probes") or []
     digest = {
         "platform": ex.get("platform"),
         "device_kind": ex.get("device_kind"),
         "north_star_met": ex.get("north_star_met"),
+        # the headline metric's workload fingerprint: the perf ledger
+        # keys its rows on it, so a round whose FULL record is lost must
+        # still land a headline comparable with other rounds' records
+        "workload": _s(ex.get("workload")),
         "grid16_rank_s": ex.get("grid16_rank_s"),
         "grid_workload": _s(ex.get("grid_workload")),
         "golden_ok": ex.get("golden_ok"),
@@ -1064,7 +1119,7 @@ def _headline(record: dict, full_record_ref: str) -> str:
             "captured_utc": _s(cached.get("captured_utc"), 60),
             "value": cached.get("value"),
             "unit": _s(cached.get("unit"), 40),
-            "provenance": _s(cached.get("provenance"), 80),
+            "provenance": _short_provenance(cached.get("provenance")),
         }
     digest = {k: v for k, v in digest.items() if v is not None}
     head = {

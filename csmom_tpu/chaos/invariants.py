@@ -49,8 +49,10 @@ from __future__ import annotations
 
 import json
 import os
+import re
 
 __all__ = [
+    "committable_sidecar",
     "detect_kind",
     "measured_rows",
     "trailing_json",
@@ -65,7 +67,27 @@ __all__ = [
 # truncated and its JSON lost (the r4 failure)
 DRIVER_TAIL_CHARS = 2000
 
+# telemetry sidecar schema versions this checker (and the ledger/timeline
+# readers) understand; a sidecar stamped with anything else is from a
+# different era of the code and must fail loudly, not half-parse
+KNOWN_TELEMETRY_SCHEMA_VERSIONS = (1,)
+
+# only ROUND sidecars are committed evidence: TELEMETRY_r<NN>.json.
+# Rehearse/scratch sidecars (TELEMETRY_rehearse_*.json, pid-suffixed
+# operator reruns) are regenerated per run and gitignored — one slipped
+# into the tree once, which is why this is now a named rule with a
+# tier-1 test behind it instead of a .gitignore comment.
+_COMMITTED_SIDECAR_RE = re.compile(r"^TELEMETRY_r\d+\.json$")
+
 _NUM = (int, float)
+
+
+def committable_sidecar(basename: str) -> bool:
+    """True iff this TELEMETRY file name may be committed (round
+    sidecars only); non-TELEMETRY names are not this rule's business."""
+    if not basename.startswith("TELEMETRY_"):
+        return True
+    return bool(_COMMITTED_SIDECAR_RE.match(basename))
 
 
 def trailing_json(text: str):
@@ -161,6 +183,22 @@ def _validate_record(obj: dict, kind: str = "record") -> list:
         for k in ("rows", "phases"):
             if k in extra and not isinstance(extra[k], list):
                 out.append(f"{kind}: extra.{k} must be a list")
+        samples = extra.get("samples")
+        if samples is not None:
+            # the perf-ledger contract: raw per-rep walls, keyed by the
+            # matching aggregate field, every sample a number — a string
+            # smuggled into a sample list would poison the bootstrap
+            if not isinstance(samples, dict):
+                out.append(f"{kind}: extra.samples must be a dict of "
+                           "leg -> list of raw per-rep numbers")
+            else:
+                for leg, vals in samples.items():
+                    if (not isinstance(vals, list)
+                            or not all(isinstance(v, _NUM)
+                                       and not isinstance(v, bool)
+                                       for v in vals)):
+                        out.append(f"{kind}: extra.samples[{leg!r}] must "
+                                   "be a list of numbers")
     for k in ("rows", "phases"):
         if k in obj and not isinstance(obj[k], list):
             out.append(f"{kind}: {k} must be a list")
@@ -233,7 +271,14 @@ def _validate_tpu_cache(obj: dict) -> list:
 def _validate_telemetry(obj: dict) -> list:
     out: list = []
     _require(obj, "run_id", str, "telemetry", out)
-    _require(obj, "schema_version", int, "telemetry", out)
+    ver = _require(obj, "schema_version", int, "telemetry", out)
+    if ver is not None and ver not in KNOWN_TELEMETRY_SCHEMA_VERSIONS:
+        out.append(
+            f"telemetry: unknown schema_version {ver} (this checker "
+            f"understands {list(KNOWN_TELEMETRY_SCHEMA_VERSIONS)}) — the "
+            "sidecar is from a different era of the code; do not "
+            "half-parse it"
+        )
     wall = _require(obj, "wall_s", _NUM, "telemetry", out, "a number")
     phases = _require(obj, "phases", list, "telemetry", out)
     if phases is not None:
@@ -266,6 +311,39 @@ def _validate_telemetry(obj: dict) -> list:
                 )
     if "spans" in obj and not isinstance(obj["spans"], list):
         out.append("telemetry: spans must be a list")
+    # device-memory axis (obs.memstats through the metrics snapshot):
+    # per-shape byte fields must be ints and carry the comparable peak —
+    # the ledger's memory gate diffs exactly these numbers, so a
+    # mistyped field here would corrupt a cross-run verdict silently
+    metrics = obj.get("metrics")
+    mem = metrics.get("memory") if isinstance(metrics, dict) else None
+    if mem is not None:
+        if not isinstance(mem, dict):
+            out.append("telemetry: metrics.memory must be a dict of "
+                       "shape -> byte stats")
+        else:
+            for shape, stats in mem.items():
+                if isinstance(stats, str):
+                    continue  # a capture-failure reason is a valid value
+                if not isinstance(stats, dict):
+                    out.append(f"telemetry: metrics.memory[{shape!r}] must "
+                               "be a byte-stats dict or a reason string")
+                    continue
+                pk = stats.get("peak_bytes")
+                if not isinstance(pk, int) or isinstance(pk, bool):
+                    out.append(f"telemetry: metrics.memory[{shape!r}] "
+                               "missing int peak_bytes (the ledger's "
+                               "comparable scalar)")
+                if not isinstance(stats.get("platform"), str):
+                    out.append(f"telemetry: metrics.memory[{shape!r}] "
+                               "missing str platform — compiled bytes "
+                               "are per-backend and must say whose they "
+                               "are")
+                for k, v in stats.items():
+                    if k.endswith("_in_bytes") and (
+                            not isinstance(v, int) or isinstance(v, bool)):
+                        out.append(f"telemetry: metrics.memory[{shape!r}]."
+                                   f"{k} must be an int byte count")
     return out
 
 

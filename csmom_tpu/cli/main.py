@@ -1662,19 +1662,22 @@ def build_parser() -> argparse.ArgumentParser:
                             help="strategy parameter, repeatable")
         sp.set_defaults(fn=fn)
 
+    from csmom_tpu.cli.ledger import register as register_ledger
     from csmom_tpu.cli.rehearse import register as register_rehearse
     from csmom_tpu.cli.timeline import register as register_timeline
 
     register_rehearse(sub)
     register_timeline(sub)
+    register_ledger(sub)
     return p
 
 
 # commands that never touch a device (pure pandas/numpy, or — bench and
 # rehearse — supervisors that do their own subprocess probing): no init
-# probe for these
+# probe for these.  ledger pins cpu itself before its bootstrap math, so
+# the probe would only add a failure mode to an offline evidence reader.
 _DEVICE_FREE_COMMANDS = {"fetch", "strategies", "bench", "pack-info",
-                         "rehearse", "timeline"}
+                         "rehearse", "timeline", "ledger"}
 
 
 def _apply_platform(args) -> int:

@@ -105,18 +105,21 @@ def _compile_with_self_heal(lowered, name: str):
 def aot_compile(entry) -> dict:
     """Lower + compile one :class:`ManifestEntry`; return its record.
 
-    The record carries the trace-vs-compile wall split and whether the
+    The record carries the trace-vs-compile wall split, whether the
     backend compile was served from the serialized-executable cache
-    (``cache_hit``) — the per-shape evidence the bench record embeds.
-    The compiled executable object itself is discarded: the product is
-    the on-disk cache entry, not the in-process handle.
+    (``cache_hit``), and the shape's device-memory analysis (``memory``:
+    argument/output/temp/peak bytes via ``compiled.memory_analysis()``,
+    registered with :mod:`csmom_tpu.obs.memstats`) — the per-shape
+    evidence the bench record and the perf ledger embed.  The compiled
+    executable object itself is then discarded: the persistent product
+    is the on-disk cache entry, not the in-process handle.
 
     A corrupt cache entry is detected, logged, evicted, and recompiled
     (``self_healed`` in the record) instead of raising — a poisoned cache
     must cost recompiles, never a window.
     """
     from csmom_tpu.chaos.inject import checkpoint
-    from csmom_tpu.obs import span
+    from csmom_tpu.obs import memstats, span
     from csmom_tpu.utils.profiling import compile_stats
 
     entry.validate()
@@ -127,15 +130,24 @@ def aot_compile(entry) -> dict:
         trace_s = time.perf_counter() - t0
         checkpoint("aot.compile", entry=entry.name)
         t1 = time.perf_counter()
-        _, healed = _compile_with_self_heal(lowered, entry.name)
+        compiled, healed = _compile_with_self_heal(lowered, entry.name)
         compile_s = time.perf_counter() - t1
         sp.set(trace_s=round(trace_s, 4), compile_s=round(compile_s, 4))
     d = compile_stats().delta(before)
+    # the AOT pass is the one place a Compiled handle exists for every
+    # hot shape, so the device-memory axis is read here (HBM peak /
+    # argument / temp / output bytes) and registered with obs.memstats —
+    # metrics snapshots and the TELEMETRY sidecar fold it in from there
+    import jax as _jax
+
+    memory = memstats.capture(entry.name, compiled,
+                              platform=_jax.default_backend())
     rec = {
         "name": entry.name,
         "shapes": entry.shape_summary(),
         "trace_s": round(trace_s, 4),
         "compile_s": round(compile_s, 4),
+        "memory": memory,
         "cache_hits": d.cache_hits,
         "cache_writes": d.cache_misses,  # jax's "miss" event fires on WRITE
         # hit iff at least one serialized executable was READ and none had
@@ -252,6 +264,10 @@ def warmup(profiles=("bench-cpu", "golden"), *, subdir: str = "bench",
             golden_note = f"failed: {type(e).__name__}: {e}"[:200]
 
     total = compile_stats().delta(base)
+    from csmom_tpu.obs import memstats
+
+    peaks = {r["name"]: memstats.peak_bytes(r.get("memory")) for r in rows}
+    measured = {k: v for k, v in peaks.items() if v is not None}
     report = {
         "metric": "aot_warmup",
         "utc": datetime.datetime.now(datetime.timezone.utc).isoformat(
@@ -266,6 +282,18 @@ def warmup(profiles=("bench-cpu", "golden"), *, subdir: str = "bench",
         "golden_event": golden_note,
         "wall_s": round(time.perf_counter() - t_start, 2),
         "totals": total.as_dict(),
+        # manifest-level memory digest (per-shape detail rides in each
+        # entry's "memory" dict): which shape claims the most device
+        # memory, so a report reader sees the binding shape first
+        "memory": (
+            {
+                "n_shapes_measured": len(measured),
+                "max_peak_bytes": max(measured.values()),
+                "max_peak_entry": max(measured, key=measured.get),
+            }
+            if measured else
+            "not measured: no entry produced a memory analysis"
+        ),
         "entries": rows,
     }
     if write_report and cache_dir:

@@ -21,6 +21,16 @@ pipeline instead of an RPC fleet:
   compile, row, land, other) that ``chaos.invariants`` schema-validates
   like every other committed artifact, and renders it as a text flame
   summary (``csmom timeline <run>``).
+- :mod:`~csmom_tpu.obs.memstats` — the device-memory axis: per-shape
+  ``compiled.memory_analysis()`` bytes captured during the AOT pass,
+  folded into metrics snapshots (hence the sidecar) and the warmup
+  report.
+- :mod:`~csmom_tpu.obs.ledger` / :mod:`~csmom_tpu.obs.regress` — the
+  CROSS-run half: ingest every committed artifact into a normalized,
+  provenance-aware per-metric trajectory, and turn raw repeat samples
+  into block-bootstrap CI regression verdicts (``csmom ledger
+  show/diff/gate``).  Single-run telemetry says where this run's time
+  went; the ledger says whether this run moved the trajectory.
 
 Like the chaos harness, the whole layer is ZERO-COST when disarmed: with
 no collector armed, ``span()`` returns a shared no-op singleton and
@@ -39,7 +49,7 @@ and an armed one pays the ~1 s package import once, before its first
 probe — never inside a measured interval.
 """
 
-from csmom_tpu.obs import metrics, spans, timeline
+from csmom_tpu.obs import ledger, memstats, metrics, regress, spans, timeline
 from csmom_tpu.obs.spans import (
     arm,
     arm_from_env,
@@ -56,8 +66,11 @@ __all__ = [
     "arm_policy",
     "armed",
     "disarm",
+    "ledger",
+    "memstats",
     "metrics",
     "point",
+    "regress",
     "span",
     "spans",
     "timeline",

@@ -1,0 +1,436 @@
+"""Cross-run perf ledger: the committed artifacts as ONE trajectory.
+
+Every round lands evidence at the repo root — ``BENCH_rNN.json`` driver
+captures, ``BENCH_FULL_rNN.json`` full records, ``MULTICHIP_*`` /
+``MULTIHOST_*`` / ``HISTRANK_*`` / ``PHASES_*`` captures,
+``TELEMETRY_rNN.json`` sidecars — and until now the *trajectory* across
+them lived only as hand-written ROADMAP prose.  This module ingests the
+whole heterogeneous family (schema contract:
+:mod:`csmom_tpu.chaos.invariants` — the same ``detect_kind``/``validate``
+the rehearsal and the tier-1 sweep use) into normalized per-metric
+:class:`Row`\\ s that a regression gate can diff mechanically.
+
+Provenance discipline is the point.  Every row carries its platform,
+device kind, and workload fingerprint, and two rows are only comparable
+when all three match (:meth:`Row.key`): a CPU-fallback wall never
+silently compares against a TPU wall, a reduced-grid number never
+against the north-star grid.  Provenance and flags ride separately and
+control PAIRING, not the key: rows flagged ``partial`` / ``smoke`` / a
+named variant (watcher re-runs, session captures) stay VISIBLE in the
+trajectory but are excluded from gating (:meth:`Row.gate_eligible`),
+and diff refuses to pair rows of differing flag provenance — the
+ledger shows everything and only compares like-for-like.
+
+Raw repeat samples (``extra.samples`` in new FULL records, recorded
+per-rep by ``bench.py``) ride along on their rows so
+:mod:`csmom_tpu.obs.regress` can put a bootstrap CI behind every
+verdict instead of a bare delta.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import json
+import os
+import re
+
+from csmom_tpu.chaos import invariants as inv
+
+__all__ = [
+    "DEFAULT_PATTERNS",
+    "Ledger",
+    "Row",
+    "load",
+    "run_of",
+]
+
+DEFAULT_PATTERNS = (
+    "BENCH_*.json",
+    "MULTICHIP_*.json",
+    "MULTIHOST_*.json",
+    "HISTRANK_*.json",
+    "PHASES_*.json",
+    "TELEMETRY_*.json",
+)
+
+_RUN_RE = re.compile(r"_r(\d+)")
+
+
+def _scratch_note(basename: str) -> str | None:
+    """A precise skip-reason for known scratch/per-machine files, or
+    None to ingest.  Single-sourced on the same rules the hygiene tests
+    enforce: ``BENCH_TPU_LAST.json`` is the per-machine session cache,
+    and a TELEMETRY name that is both uncommittable
+    (:func:`invariants.committable_sidecar`) and un-attributable (no
+    round id) is a rehearse/scratch sidecar.  An uncommittable-but-
+    attributable name (``TELEMETRY_rNN-<pid>.json`` operator reruns)
+    still ingests — flagged as a variant, never gate-eligible."""
+    if basename == "BENCH_TPU_LAST.json":
+        return "per-machine TPU session cache, not round evidence: skipped"
+    if (basename.startswith("TELEMETRY_")
+            and not inv.committable_sidecar(basename)
+            and run_of(basename)[0] is None):
+        return ("scratch sidecar (uncommittable name, no round id), not "
+                "round evidence: skipped")
+    return None
+
+# bench-extra wall metrics: field name -> the extra field holding that
+# leg's workload fingerprint.  All are walls (lower is better, seconds).
+_WALL_METRICS = {
+    "event_backtest_wall_s": "workload",
+    "event_batched_per_run_s": "workload",
+    "grid16_rank_s": "grid_workload",
+    "grid16_qcut_s": "grid_workload",
+    "grid16_rank_matmul_s": "grid_workload",
+    "grid16_rank_pallas_s": "grid_workload",
+    "grid16_rank_matmul_bf16_s": "grid_workload",
+    "pack_ingest_s": "grid_workload",
+    "grid16_rank_full_s": "grid_full_workload",
+    "grid16_rank_matmul_full_s": "grid_full_workload",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Row:
+    """One (run, metric) observation with full provenance."""
+
+    run: str                 # normalized round id, e.g. "r05"
+    run_num: int
+    metric: str
+    value: float
+    unit: str
+    direction: str           # "lower" | "higher" (which way is better)
+    platform: str | None     # "cpu" / "tpu" / None (unrecorded)
+    device_kind: str | None
+    workload: str | None     # fingerprint two runs must share to compare
+    source: str              # artifact file the row came from
+    samples: tuple = ()      # raw per-rep measurements, () when absent
+    flags: tuple = ()        # "partial", "smoke", "info", "variant:<v>"
+
+    def key(self):
+        """Comparability key: rows only diff/gate within the same key."""
+        return (self.metric, self.platform, self.device_kind, self.workload)
+
+    def gate_eligible(self) -> bool:
+        # flags ARE the provenance mechanism: any flag (partial, smoke,
+        # info, variant) marks evidence the gate must not regress against
+        return not self.flags
+
+
+@dataclasses.dataclass
+class Ledger:
+    rows: list
+    problems: list           # [{"source": ..., "note": ...}, ...]
+    root: str
+
+    def runs(self) -> list:
+        return sorted({r.run for r in self.rows},
+                      key=lambda s: int(s.lstrip("r")))
+
+    def by_key(self) -> dict:
+        out: dict = {}
+        for r in self.rows:
+            out.setdefault(r.key(), []).append(r)
+        for rows in out.values():
+            rows.sort(key=lambda r: (r.run_num, r.source))
+        return out
+
+    def rows_for_run(self, run: str) -> list:
+        want = _norm_run(run)
+        return [r for r in self.rows if r.run == want]
+
+
+def _norm_run(run: str) -> str:
+    m = re.fullmatch(r"r?(\d+)", str(run).strip())
+    if not m:
+        return str(run)
+    return f"r{int(m.group(1)):02d}"
+
+
+def run_of(basename: str):
+    """(run_id, run_num, variant) parsed from an artifact file name;
+    ``(None, None, None)`` when the name carries no round id.
+
+    ANY residue between the round id and ``.json`` names a variant —
+    including the ``-<pid>`` suffix ``timeline.write_sidecar``'s
+    no-clobber path gives operator reruns (``TELEMETRY_r05-1234.json``):
+    only the bare canonical name is the round's evidence; everything
+    else stays visible but flagged, hence never gate-eligible."""
+    m = _RUN_RE.search(basename)
+    if not m:
+        return None, None, None
+    num = int(m.group(1))
+    stem = basename[m.end():]
+    if stem.endswith(".json"):
+        stem = stem[:-len(".json")]
+    variant = stem.lstrip("_-") or None
+    return f"r{num:02d}", num, variant
+
+
+def _num(v):
+    """A measured number, or None — reason strings ('skipped: ...') and
+    booleans are not measurements."""
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def _flags(obj: dict, variant: str | None, info: bool = False) -> tuple:
+    """Provenance flags of one record ``obj`` (the FULL artifact object,
+    so the top-level ``partial`` marker is honored via the same
+    :func:`invariants.is_partial` rule the schema family defines)."""
+    flags = []
+    if inv.is_partial(obj):
+        flags.append("partial")
+    if "smoke" in (obj.get("extra") or {}):
+        flags.append("smoke")
+    if info:
+        flags.append("info")
+    if variant:
+        flags.append(f"variant:{variant}")
+    return tuple(flags)
+
+
+def _bench_rows(obj: dict, run: str, num: int, variant, source: str) -> list:
+    """Rows from one bench-style record (FULL record, parsed headline, or
+    a session capture)."""
+    extra = obj.get("extra") or {}
+    platform = extra.get("platform")
+    # old records (r02/r03) predate device_kind; within one platform the
+    # platform string is the honest default, not a fabricated kind
+    device_kind = extra.get("device_kind") or platform
+    flags = _flags(obj, variant)
+    samples = extra.get("samples") if isinstance(extra.get("samples"),
+                                                 dict) else {}
+    rows = []
+
+    def add(metric, value, unit, direction, workload_field):
+        v = _num(value)
+        if v is None:
+            return  # unmeasured legs carry reason strings, not numbers
+        raw = samples.get(metric)
+        # numeric entries only (same defense as _num): a damaged record
+        # smuggling null/strings into a sample list must degrade to
+        # fewer samples, never take ingest_file's no-raise contract down
+        clean = tuple(
+            float(s) for s in raw
+            if isinstance(s, (int, float)) and not isinstance(s, bool)
+        ) if isinstance(raw, list) else ()
+        rows.append(Row(
+            run=run, run_num=num, metric=metric, value=v, unit=unit,
+            direction=direction, platform=platform,
+            device_kind=device_kind,
+            workload=extra.get(workload_field), source=source,
+            samples=clean,
+            flags=flags,
+        ))
+
+    add(obj.get("metric", "headline"), obj.get("value"),
+        obj.get("unit", "?"), "higher", "workload")
+    for metric, workload_field in _WALL_METRICS.items():
+        add(metric, extra.get(metric), "s", "lower", workload_field)
+    ct = extra.get("compile_totals")
+    if isinstance(ct, dict):
+        add("in_window_fresh_compiles", ct.get("in_window_fresh_compiles"),
+            "compiles", "lower", "workload")
+    return rows
+
+
+def _telemetry_rows(obj: dict, run: str, num: int, variant,
+                    source: str) -> list:
+    """Rows from a TELEMETRY sidecar: phase walls (informational — phase
+    mix shifts with the tunnel's mood, not with code quality) and the
+    per-shape memory bytes from the metrics snapshot (gate-relevant:
+    compiled memory is deterministic per shape+backend)."""
+    rows = []
+    base = dict(run=run, run_num=num, source=source)
+    for ph in obj.get("phases") or []:
+        if not isinstance(ph, dict):
+            continue
+        v = _num(ph.get("dur_s"))
+        if v is None or not isinstance(ph.get("name"), str):
+            continue
+        rows.append(Row(
+            metric=f"phase.{ph['name']}_s", value=v, unit="s",
+            direction="lower", platform=None, device_kind=None,
+            workload=obj.get("root") if isinstance(obj.get("root"), str)
+            else None,
+            flags=_flags({}, variant, info=True), **base,
+        ))
+    metrics = obj.get("metrics")
+    mem = metrics.get("memory") if isinstance(metrics, dict) else None
+    if isinstance(mem, dict):
+        for shape_name, stats in sorted(mem.items()):
+            if not isinstance(stats, dict):
+                continue  # capture-failure reason string: nothing to diff
+            peak = stats.get("peak_bytes")
+            if not isinstance(peak, int) or isinstance(peak, bool):
+                continue
+            platform = stats.get("platform")
+            if not isinstance(platform, str):
+                # compiled bytes are per-backend; an unstamped entry
+                # could pair a CPU model against a TPU measurement under
+                # key (None, None) — refuse rather than mis-attribute
+                continue
+            # the measurement basis is part of the comparability key: a
+            # backend-reported peak covers intermediates a modeled
+            # argument+output+temp sum cannot, so a jax upgrade that
+            # starts reporting real peaks must open a NEW trajectory,
+            # not diff measured-vs-modeled on the old one
+            src = stats.get("peak_source", "")
+            basis = ("modeled" if isinstance(src, str)
+                     and src.startswith("model") else "measured")
+            rows.append(Row(
+                metric="mem_peak_bytes", value=float(peak), unit="bytes",
+                direction="lower", platform=platform,
+                device_kind=platform, workload=f"{shape_name} [{basis}]",
+                flags=_flags({}, variant), **base,
+            ))
+    return rows
+
+
+def _generic_rows(obj: dict, kind: str, run: str, num: int, variant,
+                  source: str) -> list:
+    """Info rows for the remaining artifact kinds (multichip equality,
+    phases profiles, histrank/multihost records reached without a bench
+    wrapper): shown in the trajectory, never gated — their value axes
+    are equality/topology claims, not regression-testable walls."""
+    extra = obj.get("extra") or {}
+    if kind == "multichip":
+        return [Row(
+            run=run, run_num=num, metric="multichip_ok",
+            value=1.0 if obj.get("ok") else 0.0, unit="bool",
+            direction="higher", platform=None, device_kind=None,
+            workload=f"n_devices={obj.get('n_devices')}",
+            source=source, flags=_flags(obj, variant, info=True),
+        )]
+    v = _num(obj.get("value"))
+    if v is None:
+        return []
+    unit = str(obj.get("unit", "?"))
+    return [Row(
+        run=run, run_num=num, metric=str(obj.get("metric", "?")), value=v,
+        unit=unit,
+        # best-effort direction for a foreign value axis: walls read as
+        # lower-is-better, anything else as higher.  Info rows never
+        # gate, so a mislabel costs a display hint, not a verdict
+        direction="lower" if unit.rstrip("s").endswith("_") or unit == "s"
+        else "higher",
+        platform=extra.get("platform"),
+        device_kind=extra.get("device_kind") or extra.get("platform"),
+        workload=extra.get("workload"), source=source,
+        flags=_flags(obj, variant, info=True),
+    )]
+
+
+def ingest_file(path: str, have_full_runs=frozenset()) -> tuple:
+    """``(rows, problems)`` for one artifact file.  Never raises on a
+    damaged file: the damage IS the finding, reported as a problem."""
+    source = os.path.basename(path)
+    run, num, variant = run_of(source)
+    if run is None:
+        return [], [{"source": source,
+                     "note": "no round id (rNN) in the file name: not "
+                             "attributable to a run, skipped"}]
+    try:
+        with open(path, encoding="utf-8") as f:
+            obj = json.load(f)
+    except OSError as e:
+        return [], [{"source": source, "note": f"unreadable: {e}"}]
+    except json.JSONDecodeError as e:
+        return [], [{"source": source, "note": f"not valid JSON: {e}"}]
+    kind = inv.detect_kind(obj)
+    if kind == "driver_capture":
+        parsed = obj.get("parsed")
+        if not isinstance(parsed, dict):
+            return [], [{"source": source,
+                         "note": "driver capture with parsed: null — the "
+                                 "run's headline was lost (the r4 failure "
+                                 "mode); no trajectory rows"}]
+        if run in have_full_runs and variant is None:
+            # the canonical FULL record is a superset of the CANONICAL
+            # headline only — a variant capture (watcher rerun) is
+            # distinct evidence and stays visible, flagged
+            return [], []
+        return _bench_rows(parsed, run, num, variant, source), []
+    if kind == "record":
+        # only BENCH-family records carry the gate-relevant wall metrics
+        # with known directions; HISTRANK/MULTIHOST captures are record-
+        # SHAPED but their value axes (comm ratios, equality claims) are
+        # trajectory information, never regression-gated
+        if source.startswith("BENCH"):
+            return _bench_rows(obj, run, num, variant, source), []
+        rows = _generic_rows(obj, kind, run, num, variant, source)
+        if rows:
+            return rows, []
+        return [], [{"source": source,
+                     "note": "record artifact with no numeric value axis: "
+                             "present but contributes no trajectory rows"}]
+    if kind == "telemetry":
+        # closed-world schema: a sidecar from a different era of the
+        # code must not be half-parsed into gate-eligible rows (its
+        # byte semantics may have changed) — same rule `csmom timeline`
+        # enforces, via the same invariants constant
+        ver = obj.get("schema_version")
+        if ver not in inv.KNOWN_TELEMETRY_SCHEMA_VERSIONS:
+            return [], [{"source": source,
+                         "note": f"unknown telemetry schema_version "
+                                 f"{ver!r} (reader understands "
+                                 f"{list(inv.KNOWN_TELEMETRY_SCHEMA_VERSIONS)}"
+                                 "): not half-parsed into rows"}]
+        return _telemetry_rows(obj, run, num, variant, source), []
+    if kind in ("multichip", "phases"):
+        rows = _generic_rows(obj, kind, run, num, variant, source)
+        if rows:
+            return rows, []
+        return [], [{"source": source,
+                     "note": f"{kind} artifact with no numeric value axis: "
+                             "present but contributes no trajectory rows"}]
+    if kind == "tpu_cache":
+        return [], [{"source": source,
+                     "note": "session cache file: provenance belongs to the "
+                             "run that captured it, skipped"}]
+    return [], [{"source": source,
+                 "note": "unrecognized artifact shape: no known key "
+                         "signature matches"}]
+
+
+def load(root: str, patterns=DEFAULT_PATTERNS) -> Ledger:
+    """Ingest every committed artifact under ``root`` (non-recursive:
+    round artifacts land at the repo root by contract)."""
+    paths = []
+    for pat in patterns:
+        paths += _glob.glob(os.path.join(root, pat))
+    paths = sorted(set(paths))
+    # FULL records ingest first: a run's driver capture only defers to
+    # its FULL record when that record ACTUALLY yielded rows — a
+    # truncated/damaged FULL file (the ENOSPC case) must not suppress a
+    # healthy headline that did land
+    def _is_canonical_full(base: str) -> bool:
+        if not base.startswith("BENCH_FULL_"):
+            return False
+        run, _, variant = run_of(base)
+        return run is not None and variant is None
+
+    rows, problems, have_full = [], [], set()
+    deferred = []
+    for p in paths:
+        base = os.path.basename(p)
+        note = _scratch_note(base)
+        if note is not None:
+            problems.append({"source": base, "note": note})
+            continue
+        if not _is_canonical_full(base):
+            deferred.append(p)
+            continue
+        r, pr = ingest_file(p)
+        rows += r
+        problems += pr
+        if r:
+            have_full.add(run_of(base)[0])
+    for p in deferred:
+        r, pr = ingest_file(p, have_full_runs=have_full)
+        rows += r
+        problems += pr
+    rows.sort(key=lambda r: (r.metric, r.run_num, r.source))
+    return Ledger(rows=rows, problems=problems, root=root)

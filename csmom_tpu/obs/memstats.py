@@ -1,0 +1,148 @@
+"""Per-shape device-memory accounting from XLA's compiled executables.
+
+The telemetry layer answers "where did the time go"; this module answers
+the companion question the r6-r8 records never could: **how much device
+memory does each compiled shape claim?**  On the north-star workload the
+binding resource is HBM, not FLOPs — a shape that compiles fine on the
+CPU fallback can OOM a v5e core — so memory has to be an observable axis
+of the perf ledger, with per-shape evidence a regression gate can diff
+across rounds, not a vibe ("it fit last time").
+
+The capture site is the AOT pass (:mod:`csmom_tpu.compile.aot`): the one
+place the repo holds a ``Compiled`` handle for every hot shape, so
+``compiled.memory_analysis()`` (XLA's ``CompiledMemoryStats``: argument /
+output / temp / generated-code bytes, peak where the backend reports it)
+is free to read there — no extra compile, no extra dispatch.  The same
+code runs on CPU and TPU; the byte numbers are per-backend, which is why
+every ledger row carries its platform and the gate never diffs a cpu row
+against a tpu row.
+
+Captured stats land three ways (the ledger reads the third):
+
+- the per-entry AOT record (``aot_compile``) and the warmup report;
+- the process-wide registry here, folded into every
+  :func:`csmom_tpu.obs.metrics.snapshot` under ``"memory"``;
+- through the snapshot, the ``TELEMETRY_<run>.json`` sidecar —
+  schema-validated by :mod:`csmom_tpu.chaos.invariants` like the rest of
+  the artifact family.
+
+jax-free at import (the chaos/obs contract): the module only touches a
+``Compiled`` object the caller already holds.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = [
+    "BYTE_FIELDS",
+    "capture",
+    "memory_analysis_bytes",
+    "peak_bytes",
+    "record",
+    "reset",
+    "snapshot",
+]
+
+# CompiledMemoryStats fields we persist, in report order.  All ints
+# (bytes); absent attributes are simply not reported rather than zeroed,
+# so a backend that cannot account a field never fakes a 0 measurement.
+BYTE_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+# backends that report a true HBM peak expose it under one of these
+_PEAK_ATTRS = ("peak_memory_in_bytes", "peak_memory_usage_in_bytes")
+
+_LOCK = threading.Lock()
+_REGISTRY: dict = {}  # entry name -> bytes dict (or capture-failure reason)
+
+
+def memory_analysis_bytes(compiled) -> dict | str:
+    """``compiled.memory_analysis()`` as a JSON-ready bytes dict.
+
+    Returns a reason string instead of raising when the backend has no
+    memory analysis (some plugins stub it out) — memory observability
+    must never cost the compile that produced the handle.
+    """
+    try:
+        stats = compiled.memory_analysis()
+    except Exception as e:  # plugin-dependent surface: record why
+        return f"not available: {type(e).__name__}: {e}"[:160]
+    if stats is None:
+        return "not available: backend returned no memory analysis"
+    out: dict = {}
+    for field in BYTE_FIELDS:
+        v = getattr(stats, field, None)
+        if isinstance(v, int):
+            out[field] = v
+    for attr in _PEAK_ATTRS:
+        v = getattr(stats, attr, None)
+        if isinstance(v, int) and v > 0:
+            out["peak_bytes"] = v
+            out["peak_source"] = attr
+            break
+    if "peak_bytes" not in out:
+        # CPU (and some plugin) stats carry no peak; the live-buffer sum
+        # over the MEASURED components is the defensible lower bound —
+        # labeled as a model naming exactly what was summed, so a TPU
+        # row never silently compares against a modeled CPU row as if
+        # both were measured peaks.  Components that were not reported
+        # contribute nothing and are not named: a backend reporting
+        # neither a peak nor any component gets a reason string, never
+        # a fabricated 0 that a later real measurement would read as
+        # infinite memory growth.
+        comps = [f for f in ("argument_size_in_bytes",
+                             "output_size_in_bytes",
+                             "temp_size_in_bytes") if f in out]
+        if not comps:
+            return ("not available: backend reports neither a peak nor "
+                    "argument/output/temp byte components")
+        out["peak_bytes"] = sum(out[f] for f in comps)
+        out["peak_source"] = ("model: "
+                              + "+".join(c.split("_")[0] for c in comps)
+                              + " (backend reports no peak)")
+    return out
+
+
+def record(name: str, stats: dict | str) -> None:
+    """Register one shape's stats in the process-wide table (last write
+    wins: recompiling a shape re-measures it)."""
+    with _LOCK:
+        _REGISTRY[name] = stats
+
+
+def capture(name: str, compiled, platform: str | None = None) -> dict | str:
+    """Measure + register in one step; returns what was recorded.
+
+    ``platform`` stamps the backend the bytes belong to — compiled
+    memory is per-backend, and the ledger refuses to diff rows whose
+    platforms differ, so an unstamped row can never masquerade as a
+    TPU measurement."""
+    stats = memory_analysis_bytes(compiled)
+    if isinstance(stats, dict) and platform:
+        stats["platform"] = platform
+    record(name, stats)
+    return stats
+
+
+def peak_bytes(stats) -> int | None:
+    """The comparable scalar of one entry (None for failure reasons)."""
+    if isinstance(stats, dict) and isinstance(stats.get("peak_bytes"), int):
+        return stats["peak_bytes"]
+    return None
+
+
+def snapshot() -> dict:
+    """All captured shapes: ``{entry_name: bytes_dict_or_reason}``."""
+    with _LOCK:
+        return dict(_REGISTRY)
+
+
+def reset() -> None:
+    with _LOCK:
+        _REGISTRY.clear()

@@ -142,6 +142,15 @@ def snapshot(include_compile: bool = True) -> dict:
             "histograms": {m.name: m.summary() for m in _REGISTRY.values()
                            if isinstance(m, Histogram)},
         }
+    # device-memory axis: per-shape memory_analysis bytes captured by the
+    # AOT pass (obs.memstats).  Included only when something was captured
+    # — a process that never held a Compiled handle has nothing to claim,
+    # and an empty block would read as "measured: zero shapes use memory"
+    from csmom_tpu.obs import memstats as _memstats
+
+    mem = _memstats.snapshot()
+    if mem:
+        out["memory"] = mem
     if include_compile:
         if "jax" in sys.modules:
             from csmom_tpu.utils.profiling import (

@@ -218,3 +218,70 @@ def test_event_donated_variant_matches_and_consumes_a_panel(rng):
     assert float(keep.total_pnl) == float(gave.total_pnl)
     declined = any("donated" in str(w.message).lower() for w in caught)
     assert p1.is_deleted() or v1.is_deleted() or s1.is_deleted() or declined
+
+
+# ------------------------------------------- device-memory observability ----
+# (the perf ledger's memory axis: aot_compile reads compiled.memory_
+# analysis() — the one place a Compiled handle exists per hot shape —
+# and the bytes flow into the entry record, the metrics snapshot, and a
+# schema-valid TELEMETRY sidecar.  Same code path on TPU; pinned on CPU.)
+
+def test_aot_compile_record_carries_memory_bytes(tmp_path, monkeypatch):
+    from csmom_tpu import obs
+    from csmom_tpu.chaos import invariants as inv
+    from csmom_tpu.compile.aot import aot_compile
+    from csmom_tpu.obs import memstats
+    from csmom_tpu.obs import metrics as obs_metrics
+    from csmom_tpu.obs import timeline as tl
+
+    monkeypatch.delenv("CSMOM_TELEMETRY", raising=False)
+    memstats.reset()
+    entry = ManifestEntry(
+        name="memtest.tiny@8x8",
+        fn=jax.jit(lambda x: x.sum()),
+        args=(jax.ShapeDtypeStruct((8, 8), np.float32),),
+    )
+    rec = aot_compile(entry)
+    mem = rec["memory"]
+    assert isinstance(mem, dict), mem
+    # the comparable scalar + at least one measured byte field, all ints
+    assert isinstance(mem["peak_bytes"], int)
+    assert mem["platform"] == "cpu"
+    assert any(k.endswith("_in_bytes") and isinstance(v, int)
+               for k, v in mem.items())
+    assert mem["argument_size_in_bytes"] == 8 * 8 * 4
+
+    # registry -> metrics snapshot -> sidecar, schema-validated like any
+    # committed artifact (the acceptance path for the TPU round too)
+    assert memstats.snapshot()["memtest.tiny@8x8"] == mem
+    obs.arm(run_id="memtest")
+    try:
+        snap = obs_metrics.snapshot()
+        assert snap["memory"]["memtest.tiny@8x8"]["peak_bytes"] == \
+            mem["peak_bytes"]
+        name = tl.finish_and_write(str(tmp_path), fallback_metrics=snap)
+    finally:
+        obs.disarm()
+    assert name == "TELEMETRY_memtest.json"
+    assert inv.validate_file(os.path.join(str(tmp_path), name)) == []
+    memstats.reset()
+
+
+def test_warmup_report_carries_per_shape_memory(tmp_path, monkeypatch):
+    """The manifest report's memory digest: every smoke entry measured,
+    the binding (max-peak) shape named."""
+    from csmom_tpu.compile.aot import warmup
+    from csmom_tpu.obs import memstats
+
+    monkeypatch.setenv("CSMOM_JIT_CACHE", "0")
+    memstats.reset()
+    rep = warmup(profiles=("smoke",), write_report=False,
+                 include_golden_event=False)
+    assert rep["n_errors"] == 0
+    assert rep["memory"]["n_shapes_measured"] == rep["n_entries"]
+    assert rep["memory"]["max_peak_bytes"] > 0
+    assert rep["memory"]["max_peak_entry"]
+    for row in rep["entries"]:
+        assert isinstance(row["memory"], dict), row
+        assert isinstance(row["memory"]["peak_bytes"], int)
+    memstats.reset()

@@ -222,3 +222,31 @@ def test_exhausted_budget_still_prints_valid_headline(tmp_path):
     # the full record keeps what the headline digests away
     assert full["metric"] == obj["metric"]
     assert "tpu_probes" in full["extra"]
+
+
+def test_headline_provenance_round_trips_complete(bench):
+    """ISSUE 4 satellite: BENCH_r05.json landed with tpu_last_verified.
+    provenance lossily cut mid-parenthesis ('…').  The headline now
+    carries the complete provenance CLASS (the leading token), never a
+    truncation; the full composed string stays only in the FULL record.
+    Round-trip: headline -> parse -> provenance must be a complete
+    prefix of the record's, with no loss marker."""
+    rec = _bloated_record()
+    long_prov = ("session-cached (originally: live (r3; "
+                 "block_until_ready-timed — treat walls as "
+                 "dispatch-inclusive upper bounds))")
+    rec["extra"]["tpu_last_verified"]["provenance"] = long_prov
+    line = bench._headline(rec, "BENCH_FULL_r05.json")
+    assert len(line) <= bench.HEADLINE_MAX_CHARS
+    got = json.loads(line)["extra"]["tpu_last_verified"]["provenance"]
+    assert got == "session-cached"
+    assert "…" not in got
+    # complete-prefix property: nothing was cut mid-word — the headline
+    # value plus the full-record pointer reconstructs the whole string
+    assert long_prov.startswith(got)
+    # the FULL record (what the headline points at) keeps it verbatim
+    assert rec["extra"]["tpu_last_verified"]["provenance"] == long_prov
+    # a short provenance ('live') survives whole too
+    rec["extra"]["tpu_last_verified"]["provenance"] = "live"
+    got = json.loads(bench._headline(rec, "BENCH_FULL_r05.json"))
+    assert got["extra"]["tpu_last_verified"]["provenance"] == "live"

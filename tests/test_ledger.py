@@ -1,0 +1,581 @@
+"""Perf ledger: artifact ingestion, CI-backed verdicts, the gate CLI.
+
+Three layers under test:
+
+- :mod:`csmom_tpu.obs.ledger` — committed artifacts normalize into
+  provenance-aware rows, and rows only compare within an identical
+  (metric, platform, device, workload) key: the cpu-fallback-vs-tpu
+  confusion the ledger exists to prevent is pinned here, not prose;
+- :mod:`csmom_tpu.obs.regress` — raw repeat samples become block-
+  bootstrap CIs (reusing analytics/bootstrap) and a regression is only
+  CONFIRMED on disjoint intervals + a practically-significant delta;
+- ``csmom ledger`` CLI — `gate` exits nonzero on a synthetic injected
+  regression and on unexplained memory growth, zero on the committed
+  artifact history (with ``BENCH_r04.json`` surfaced as the known r4
+  gap, never excused into a row); `diff` prints bootstrap CIs, not bare
+  deltas, for every sampled metric; malformed artifacts degrade to
+  pointed messages, never tracebacks (same contract as `csmom
+  timeline`, whose malformed-sidecar behavior is pinned here too).
+"""
+
+import json
+import os
+
+import pytest
+
+from csmom_tpu.chaos import invariants as inv
+from csmom_tpu.cli.main import main as cli_main
+from csmom_tpu.obs import ledger as ld
+from csmom_tpu.obs import regress
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# tight/loose sample sets around two well-separated means: the injected
+# regression (REF -> 3x) must CONFIRM, and REF-vs-REF_B must not
+REF_SAMPLES = [0.100, 0.101, 0.099, 0.1002, 0.0998, 0.1005, 0.0995, 0.1001]
+REF_SAMPLES_B = [0.1003, 0.0997, 0.1004, 0.0999, 0.1, 0.1002, 0.0996, 0.1]
+BAD_SAMPLES = [3 * s for s in REF_SAMPLES_B]
+
+_WORKLOADS = {
+    "workload": "golden 20x2728 minute panel, 28020 trades (float64)",
+    "grid_workload": "16 cells, 512 stocks x 3780 days (174 months)",
+}
+
+
+def _full_record(rank_samples, value=1.5e6, platform="cpu", **extra_over):
+    mean = sum(rank_samples) / len(rank_samples)
+    extra = {
+        "platform": platform,
+        "device_kind": platform,
+        **_WORKLOADS,
+        "grid16_rank_s": round(mean, 6),
+        "samples": {"grid16_rank_s": list(rank_samples)},
+        **extra_over,
+    }
+    return {
+        "metric": "intraday_event_backtest_bar_groups_per_sec",
+        "value": value, "unit": "bar_groups/s", "vs_baseline": 1.0,
+        "extra": extra,
+    }
+
+
+def _telemetry(run, peak_bytes):
+    return {
+        "kind": "telemetry", "schema_version": 1, "run_id": run,
+        "wall_s": 1.0, "t0_s": 0.0, "t1_s": 1.0,
+        "phases": [{"name": "row", "dur_s": 1.0}],
+        "metrics": {"memory": {"grid.jk16.rank.xla@512x3780": {
+            "argument_size_in_bytes": 100, "temp_size_in_bytes": 50,
+            "peak_bytes": int(peak_bytes), "platform": "cpu",
+        }}},
+    }
+
+
+def _write(root, name, obj):
+    with open(os.path.join(root, name), "w") as f:
+        json.dump(obj, f)
+
+
+@pytest.fixture
+def clean_pair(tmp_path):
+    """Two runs, statistically identical grid samples."""
+    _write(tmp_path, "BENCH_FULL_r01.json", _full_record(REF_SAMPLES))
+    _write(tmp_path, "BENCH_FULL_r02.json", _full_record(REF_SAMPLES_B))
+    return tmp_path
+
+
+@pytest.fixture
+def regressed_pair(tmp_path):
+    """Candidate run r02 with grid samples degraded 3x over r01."""
+    _write(tmp_path, "BENCH_FULL_r01.json", _full_record(REF_SAMPLES))
+    _write(tmp_path, "BENCH_FULL_r02.json", _full_record(BAD_SAMPLES))
+    return tmp_path
+
+
+# ------------------------------------------------------------ regress ----
+
+def test_bootstrap_ci_brackets_the_mean():
+    ci = regress.bootstrap_mean_ci(REF_SAMPLES, n_resamples=500)
+    assert ci["lo"] <= ci["point"] <= ci["hi"]
+    assert abs(ci["point"] - 0.1) < 0.001
+    assert ci["n"] == len(REF_SAMPLES)
+
+
+def test_confirmed_regression_needs_disjoint_cis_and_material_delta():
+    v = regress.compare_samples(BAD_SAMPLES, REF_SAMPLES, direction="lower")
+    assert v["verdict"] == "regression" and v["worse"]
+    # same distribution: never confirmed, whatever the noise says
+    v2 = regress.compare_samples(REF_SAMPLES_B, REF_SAMPLES,
+                                 direction="lower")
+    assert v2["verdict"] == "no-change"
+    # higher-is-better mirror: 3x more throughput is an improvement
+    v3 = regress.compare_samples(BAD_SAMPLES, REF_SAMPLES,
+                                 direction="higher")
+    assert v3["verdict"] == "improvement"
+
+
+def test_point_comparison_is_never_a_confirmed_regression():
+    v = regress.compare(30.0, 10.0, direction="lower")
+    assert v["verdict"] == "suspect"          # flagged...
+    assert v["verdict"] not in regress.GATE_FAILING  # ...but never gating
+    assert "point-delta" in v["basis"]
+    # too few samples on one side degrades to point-delta too
+    v2 = regress.compare(30.0, 10.0, cand_samples=[30.0] * 2,
+                         ref_samples=REF_SAMPLES, direction="lower")
+    assert "point-delta" in v2["basis"]
+
+
+def test_memory_compare_is_deterministic():
+    assert regress.compare_memory(220, 100)["verdict"] == "memory-growth"
+    assert regress.compare_memory(105, 100)["verdict"] == "no-change"
+    assert regress.compare_memory(50, 100)["verdict"] == "memory-shrink"
+
+
+# ------------------------------------------------------------- ledger ----
+
+def test_ingest_separates_platforms_and_provenance(tmp_path):
+    _write(tmp_path, "BENCH_FULL_r01.json", _full_record(REF_SAMPLES))
+    _write(tmp_path, "BENCH_FULL_r02.json",
+           _full_record(REF_SAMPLES_B, platform="tpu"))
+    L = ld.load(str(tmp_path))
+    rows = [r for r in L.rows if r.metric == "grid16_rank_s"]
+    assert {r.platform for r in rows} == {"cpu", "tpu"}
+    keys = {r.key() for r in rows}
+    assert len(keys) == 2, "cpu and tpu rows must never share a ledger key"
+    assert all(r.samples == tuple(r_s) for r, r_s in
+               zip(sorted(rows, key=lambda r: r.run),
+                   (REF_SAMPLES, REF_SAMPLES_B)))
+
+
+def test_partial_smoke_and_variant_rows_are_not_gate_eligible(tmp_path):
+    _write(tmp_path, "BENCH_FULL_r01.json",
+           _full_record(REF_SAMPLES, partial="deadline hit"))
+    _write(tmp_path, "BENCH_FULL_r02_watcher.json",
+           _full_record(REF_SAMPLES_B))
+    L = ld.load(str(tmp_path))
+    assert L.rows and not any(r.gate_eligible() for r in L.rows)
+    flags = {f for r in L.rows for f in r.flags}
+    assert "partial" in flags and "variant:watcher" in flags
+
+
+def test_parsed_null_driver_capture_is_a_gap_not_a_row(tmp_path):
+    _write(tmp_path, "BENCH_r04.json", {"rc": 0, "tail": "truncated…",
+                                        "parsed": None})
+    L = ld.load(str(tmp_path))
+    assert L.rows == []
+    assert any("r4 failure" in p["note"] for p in L.problems)
+
+
+def test_damaged_artifact_is_a_problem_never_a_raise(tmp_path):
+    (tmp_path / "BENCH_FULL_r01.json").write_text('{"metric": "x", "val')
+    L = ld.load(str(tmp_path))
+    assert L.rows == []
+    assert any("not valid JSON" in p["note"] for p in L.problems)
+
+
+def test_committed_history_ingests_with_known_gaps_only():
+    L = ld.load(_REPO)
+    assert len(L.rows) >= 20, "committed artifacts should yield a trajectory"
+    gap_sources = {p["source"] for p in L.problems}
+    # the two known headline losses stay visible as gaps
+    assert {"BENCH_r01.json", "BENCH_r04.json"} <= gap_sources
+    # every row's provenance fields are populated enough to key on
+    for r in L.rows:
+        assert r.run.startswith("r") and r.metric and r.source
+
+
+# ----------------------------------------------------------- gate CLI ----
+
+def test_gate_fails_on_injected_regression(regressed_pair, capsys):
+    rc = cli_main(["ledger", "gate", "--offline",
+                   "--root", str(regressed_pair)])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "regression" in out.out and "GATE FAILED" in out.err
+
+
+def test_gate_passes_on_statistically_identical_runs(clean_pair, capsys):
+    rc = cli_main(["ledger", "gate", "--offline", "--root", str(clean_pair)])
+    assert rc == 0
+    assert "gate PASSED" in capsys.readouterr().out
+
+
+def test_gate_fails_on_unexplained_memory_growth(clean_pair, capsys):
+    _write(clean_pair, "TELEMETRY_r01.json", _telemetry("r01", 1_000_000))
+    _write(clean_pair, "TELEMETRY_r02.json", _telemetry("r02", 2_000_000))
+    rc = cli_main(["ledger", "gate", "--offline", "--root", str(clean_pair)])
+    assert rc == 1
+    assert "memory-growth" in capsys.readouterr().out
+
+
+def test_gate_tolerates_in_band_memory_drift(clean_pair, capsys):
+    _write(clean_pair, "TELEMETRY_r01.json", _telemetry("r01", 1_000_000))
+    _write(clean_pair, "TELEMETRY_r02.json", _telemetry("r02", 1_050_000))
+    rc = cli_main(["ledger", "gate", "--offline", "--root", str(clean_pair)])
+    assert rc == 0
+
+
+def test_gate_passes_on_the_committed_artifact_history(capsys):
+    """The tier-1 wiring (ISSUE satellite): the ledger gate runs offline
+    over the repo's committed artifacts in every PR.  It must pass —
+    point-delta drifts may be suspect but are never confirmed without
+    samples — while BENCH_r04.json stays pinned as the visible known-bad
+    gap (not excused, not a row)."""
+    rc = cli_main(["ledger", "gate", "--offline", "--root", _REPO])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "gate PASSED" in out
+    assert "BENCH_r04.json" in out  # the known gap stays surfaced
+
+
+def test_diff_reports_bootstrap_cis_not_bare_deltas(regressed_pair, capsys):
+    rc = cli_main(["ledger", "diff", "r01", "r02",
+                   "--root", str(regressed_pair)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # every sampled metric shows an interval and the sample count
+    line = next(ln for ln in out.splitlines() if "grid16_rank_s" in ln
+                and "regression" in ln)
+    assert line
+    assert "[0." in out and "(n=8)" in out
+    assert "bootstrap-ci" in out
+
+
+def test_diff_unknown_run_is_a_pointed_error(clean_pair, capsys):
+    rc = cli_main(["ledger", "diff", "r01", "r99",
+                   "--root", str(clean_pair)])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "r99" in err and "known runs" in err
+
+
+def test_show_markdown_emits_tables(capsys):
+    rc = cli_main(["ledger", "show", "--markdown", "--root", _REPO,
+                   "--metric", "grid16_rank_s"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "| run | value | platform |" in out
+    assert "`BENCH_FULL_r05.json`" in out
+    assert "csmom ledger show --markdown" in out  # provenance comment
+
+
+def test_show_empty_root_is_a_pointed_error(tmp_path, capsys):
+    rc = cli_main(["ledger", "show", "--root", str(tmp_path)])
+    assert rc == 2
+    assert "no round artifacts" in capsys.readouterr().err
+
+
+def test_bare_ledger_prints_usage(capsys):
+    assert cli_main(["ledger"]) == 2
+    assert "csmom ledger {show,diff,gate}" in capsys.readouterr().err
+
+
+# ------------------------------------------- timeline CLI robustness ----
+# (same graceful-degradation contract as the ledger: a damaged sidecar
+# gets a pointed nonzero exit, never a traceback)
+
+def test_timeline_truncated_json_sidecar(tmp_path, capsys):
+    p = tmp_path / "TELEMETRY_broken.json"
+    p.write_text('{"kind": "telemetry", "run_id": "x", "wall_')
+    rc = cli_main(["timeline", str(p)])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "unreadable sidecar" in err
+
+
+def test_timeline_missing_phases_flagged_not_crashed(tmp_path, capsys):
+    p = tmp_path / "TELEMETRY_nophases.json"
+    p.write_text(json.dumps({"kind": "telemetry", "schema_version": 1,
+                             "run_id": "x", "wall_s": 1.0}))
+    rc = cli_main(["timeline", str(p)])
+    cap = capsys.readouterr()
+    assert rc == 1
+    assert "schema violations" in cap.err
+    assert "phases" in cap.err
+
+
+def test_timeline_unknown_schema_version_rejected(tmp_path, capsys):
+    obj = _telemetry("x", 100)
+    obj["schema_version"] = 99
+    p = tmp_path / "TELEMETRY_future.json"
+    p.write_text(json.dumps(obj))
+    rc = cli_main(["timeline", str(p)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "unknown schema_version 99" in err
+
+
+def test_ledger_refuses_unknown_schema_telemetry(tmp_path):
+    """Closed-world schema, ledger side: a future-era sidecar must not
+    be half-parsed into gate-eligible rows (its byte semantics may have
+    changed) — it becomes a named problem, and other artifacts still
+    ingest."""
+    obj = _telemetry("r01", 100)
+    obj["schema_version"] = 99
+    _write(tmp_path, "TELEMETRY_r01.json", obj)
+    _write(tmp_path, "BENCH_FULL_r01.json", _full_record(REF_SAMPLES))
+    L = ld.load(str(tmp_path))  # must not raise
+    assert not any(r.metric == "mem_peak_bytes" for r in L.rows)
+    assert any("unknown telemetry schema_version 99" in p["note"]
+               for p in L.problems)
+    assert any(r.metric == "grid16_rank_s" for r in L.rows)
+
+
+# -------------------------------------------------- schema round-trips ----
+
+def test_samples_schema_validated_in_records():
+    good = _full_record(REF_SAMPLES)
+    assert inv.validate(good, "record") == []
+    bad = _full_record(REF_SAMPLES)
+    bad["extra"]["samples"]["grid16_rank_s"] = ["0.1", 0.2]
+    assert any("samples" in v for v in inv.validate(bad, "record"))
+    bad2 = _full_record(REF_SAMPLES)
+    bad2["extra"]["samples"] = [0.1, 0.2]
+    assert any("samples" in v for v in inv.validate(bad2, "record"))
+
+
+def test_telemetry_memory_block_schema():
+    good = _telemetry("r01", 1000)
+    assert inv.validate(good, "telemetry") == []
+    bad = _telemetry("r01", 1000)
+    bad["metrics"]["memory"]["grid.jk16.rank.xla@512x3780"].pop("peak_bytes")
+    assert any("peak_bytes" in v for v in inv.validate(bad, "telemetry"))
+    bad2 = _telemetry("r01", 1000)
+    bad2["metrics"]["memory"]["x"] = {"peak_bytes": 1,
+                                      "argument_size_in_bytes": "lots"}
+    assert any("argument_size_in_bytes" in v
+               for v in inv.validate(bad2, "telemetry"))
+    # a capture-failure reason string is a legitimate per-shape value
+    ok = _telemetry("r01", 1000)
+    ok["metrics"]["memory"]["y"] = "not available: backend stub"
+    assert inv.validate(ok, "telemetry") == []
+
+
+# -------------------------------------------- review-hardening pins ----
+
+def test_null_in_sample_list_degrades_never_raises(tmp_path):
+    """ingest_file's no-raise contract holds for damaged sample lists:
+    non-numeric entries are dropped (fewer samples), the file still
+    contributes rows."""
+    rec = _full_record(REF_SAMPLES)
+    rec["extra"]["samples"]["grid16_rank_s"] = [0.1, None, "x", 0.2, True]
+    _write(tmp_path, "BENCH_FULL_r01.json", rec)
+    L = ld.load(str(tmp_path))
+    row = next(r for r in L.rows if r.metric == "grid16_rank_s")
+    assert row.samples == (0.1, 0.2)  # null/str/bool dropped, no raise
+
+
+def test_pid_suffixed_sidecar_is_a_variant_not_round_evidence(tmp_path):
+    """timeline.write_sidecar's no-clobber path lands operator reruns as
+    TELEMETRY_rNN-<pid>.json; those must ingest flagged (never
+    gate-eligible), so a gitignored local rerun cannot inject or mask a
+    memory verdict for the round."""
+    assert ld.run_of("TELEMETRY_r05-1234.json") == ("r05", 5, "1234")
+    assert ld.run_of("TELEMETRY_r05.json") == ("r05", 5, None)
+    _write(tmp_path, "TELEMETRY_r01.json", _telemetry("r01", 1_000_000))
+    _write(tmp_path, "TELEMETRY_r01-999.json", _telemetry("r01", 9_999_999))
+    L = ld.load(str(tmp_path))
+    mem = [r for r in L.rows if r.metric == "mem_peak_bytes"]
+    eligible = [r for r in mem if r.gate_eligible()]
+    assert len(eligible) == 1 and eligible[0].value == 1_000_000
+    rerun = next(r for r in mem if r.value == 9_999_999)
+    assert "variant:999" in rerun.flags
+
+
+def test_gate_bad_candidate_id_is_a_pointed_error(clean_pair, capsys):
+    rc = cli_main(["ledger", "gate", "--root", str(clean_pair),
+                   "--candidate", "rx1"])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "not a run id" in err
+
+
+def test_diff_refuses_cross_provenance_pairing(tmp_path, capsys):
+    """A session/variant row never diffs against a live row of another
+    run — the weaker timing discipline makes the verdict meaningless."""
+    _write(tmp_path, "BENCH_TPU_r01_session.json",
+           _full_record(REF_SAMPLES, platform="tpu"))
+    _write(tmp_path, "BENCH_FULL_r02.json",
+           _full_record(REF_SAMPLES_B, platform="tpu"))
+    rc = cli_main(["ledger", "diff", "r01", "r02", "--root", str(tmp_path)])
+    cap = capsys.readouterr()
+    assert "[skip]" in cap.out and "incomparable provenance" in cap.out
+    assert "regression" not in cap.out and "improvement" not in cap.out
+    assert rc == 1  # nothing comparable survived
+
+
+def test_memstats_never_fabricates_a_zero_peak():
+    from csmom_tpu.obs import memstats
+
+    class OddFields:  # plugin stubbing everything but generated-code
+        generated_code_size_in_bytes = 512
+
+    class Holder:
+        def memory_analysis(self):
+            return OddFields()
+
+    got = memstats.memory_analysis_bytes(Holder())
+    assert isinstance(got, str) and "not available" in got  # no fake 0
+
+
+def test_diff_pairs_like_for_like_when_both_sides_share_a_flagset(tmp_path,
+                                                                  capsys):
+    """Cross-provenance is refused, but an identical flag-set on both
+    sides IS comparable: watcher-vs-watcher diffs even when one run also
+    has a live row the other lacks."""
+    _write(tmp_path, "BENCH_FULL_r01.json", _full_record(REF_SAMPLES))
+    _write(tmp_path, "BENCH_FULL_r01_watcher.json",
+           _full_record(REF_SAMPLES))
+    _write(tmp_path, "BENCH_FULL_r02_watcher.json",
+           _full_record(BAD_SAMPLES))
+    rc = cli_main(["ledger", "diff", "r01", "r02", "--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    line = next(ln for ln in out.splitlines()
+                if "grid16_rank_s" in ln and "regression" in ln)
+    assert line  # the watcher-vs-watcher 3x regression was examined
+
+
+def test_gate_reports_vanished_metrics(tmp_path, capsys):
+    """A leg measured in the reference but absent from the candidate
+    (budget skip — or a leg that now fails, which bench records as a
+    reason string and therefore no row) must be surfaced, never silently
+    dropped from the gate report."""
+    r1 = _full_record(REF_SAMPLES)
+    r1["extra"]["grid16_qcut_s"] = 0.25
+    _write(tmp_path, "BENCH_FULL_r01.json", r1)
+    r2 = _full_record(REF_SAMPLES_B)
+    r2["extra"]["grid16_qcut_s"] = "failed: XlaRuntimeError: boom"
+    _write(tmp_path, "BENCH_FULL_r02.json", r2)
+    rc = cli_main(["ledger", "gate", "--offline", "--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0  # not confirmable without a number...
+    assert "1 vanished" in out  # ...but loudly visible
+    assert "grid16_qcut_s" in out and "last measured r01" in out
+
+
+def test_bool_peak_bytes_rejected_everywhere():
+    """isinstance(True, int) is True: a bool smuggled into a byte field
+    must fail schema validation AND never become a ledger row."""
+    bad = _telemetry("r01", 1000)
+    bad["metrics"]["memory"]["grid.jk16.rank.xla@512x3780"]["peak_bytes"] \
+        = True
+    assert any("peak_bytes" in v for v in inv.validate(bad, "telemetry"))
+    rows = ld._telemetry_rows(bad, "r01", 1, None, "TELEMETRY_r01.json")
+    assert not any(r.metric == "mem_peak_bytes" for r in rows)
+
+
+def test_histrank_multihost_records_are_info_never_gated():
+    """Record-SHAPED captures outside the BENCH family (comm ratios,
+    equality claims) ride as info rows: visible, never gate-eligible,
+    never the gate's default candidate."""
+    L = ld.load(_REPO)
+    other = [r for r in L.rows
+             if not r.source.startswith(("BENCH", "TELEMETRY"))]
+    assert other, "committed HISTRANK/MULTIHOST should yield info rows"
+    assert all("info" in r.flags and not r.gate_eligible() for r in other)
+
+
+def test_top_level_partial_marker_flags_rows(tmp_path):
+    """invariants.is_partial honors a TOP-level partial marker; the
+    ledger must use the same rule, not a private extra-only variant."""
+    rec = _full_record(REF_SAMPLES)
+    rec["partial"] = "deadline hit before the grid legs"
+    _write(tmp_path, "BENCH_FULL_r01.json", rec)
+    L = ld.load(str(tmp_path))
+    assert L.rows and all("partial" in r.flags for r in L.rows)
+    assert not any(r.gate_eligible() for r in L.rows)
+
+
+def test_modeled_and_measured_peaks_never_share_a_key(tmp_path):
+    """A jax upgrade that starts reporting true peaks must open a new
+    memory trajectory (first-seen), not diff measured-vs-modeled."""
+    t1 = _telemetry("r01", 150)
+    t1["metrics"]["memory"]["grid.jk16.rank.xla@512x3780"]["peak_source"] \
+        = "model: argument+output+temp (backend reports no peak)"
+    t2 = _telemetry("r02", 220)
+    t2["metrics"]["memory"]["grid.jk16.rank.xla@512x3780"]["peak_source"] \
+        = "peak_memory_in_bytes"
+    _write(tmp_path, "TELEMETRY_r01.json", t1)
+    _write(tmp_path, "TELEMETRY_r02.json", t2)
+    L = ld.load(str(tmp_path))
+    mem = [r for r in L.rows if r.metric == "mem_peak_bytes"]
+    assert len({r.key() for r in mem}) == 2
+    rc = cli_main(["ledger", "gate", "--offline", "--root", str(tmp_path)])
+    assert rc == 0  # first-seen on the measured key, no spurious growth
+
+
+def test_valueless_phases_artifact_is_a_named_problem():
+    """Every committed file either contributes rows or a named problem —
+    PHASES_CPU_r04.json (no top-level value) must not vanish silently."""
+    L = ld.load(_REPO)
+    assert any(p["source"] == "PHASES_CPU_r04.json"
+               and "no numeric value axis" in p["note"]
+               for p in L.problems)
+
+
+def test_damaged_full_record_does_not_suppress_healthy_headline(tmp_path):
+    """A truncated FULL record (short write / ENOSPC) must not make the
+    run's intact driver-capture headline defer to it: deferral is earned
+    by rows actually ingesting, not by a file name existing."""
+    (tmp_path / "BENCH_FULL_r01.json").write_text('{"metric": "x", "val')
+    _write(tmp_path, "BENCH_r01.json", {
+        "n": 1, "cmd": "bench", "rc": 0, "tail": "{}",
+        "parsed": _full_record(REF_SAMPLES),
+    })
+    L = ld.load(str(tmp_path))
+    assert any(r.metric == "grid16_rank_s" and r.source == "BENCH_r01.json"
+               for r in L.rows)
+    assert any("not valid JSON" in p["note"] for p in L.problems)
+
+
+def test_variant_driver_capture_survives_canonical_full_dedup(tmp_path):
+    """Dedup covers the CANONICAL headline only: a watcher/rerun driver
+    capture for a run that also has a canonical FULL record is distinct
+    evidence and stays visible (flagged), per the module contract."""
+    _write(tmp_path, "BENCH_FULL_r05.json", _full_record(REF_SAMPLES))
+    _write(tmp_path, "BENCH_r05_watcher.json", {
+        "n": 5, "cmd": "bench", "rc": 0, "tail": "{}",
+        "parsed": _full_record(BAD_SAMPLES),
+    })
+    L = ld.load(str(tmp_path))
+    watcher = [r for r in L.rows if r.source == "BENCH_r05_watcher.json"]
+    assert watcher and all("variant:watcher" in r.flags for r in watcher)
+    # the canonical headline (same run, no variant) still defers to FULL
+    _write(tmp_path, "BENCH_r05.json", {
+        "n": 5, "cmd": "bench", "rc": 0, "tail": "{}",
+        "parsed": _full_record(REF_SAMPLES_B),
+    })
+    L2 = ld.load(str(tmp_path))
+    assert not any(r.source == "BENCH_r05.json" for r in L2.rows)
+
+
+def test_unstamped_memory_stats_never_become_rows(tmp_path):
+    """Compiled bytes are per-backend: a stats dict without a platform
+    stamp must be schema-flagged and never pair under a (None, None)
+    key."""
+    bad = _telemetry("r01", 1000)
+    bad["metrics"]["memory"]["grid.jk16.rank.xla@512x3780"].pop("platform")
+    assert any("platform" in v for v in inv.validate(bad, "telemetry"))
+    rows = ld._telemetry_rows(bad, "r01", 1, None, "TELEMETRY_r01.json")
+    assert not any(r.metric == "mem_peak_bytes" for r in rows)
+
+
+def test_point_verdict_reports_true_sample_counts():
+    v = regress.compare(0.24, 0.10, cand_samples=[0.24, 0.25, 0.23],
+                        ref_samples=None, direction="lower")
+    assert v["candidate"]["n"] == 3 and v["reference"]["n"] == 1
+
+
+def test_gate_surfaces_compounding_subtolerance_drift(tmp_path, capsys):
+    """Per-PR gating against the previous run lets sub-tolerance drift
+    compound invisibly (memory: +9% per round under a 10% band); the
+    ratchet guard reports cumulative drift vs the oldest reference as a
+    suspect (visible, non-gating)."""
+    for i, peak in enumerate((1_000_000, 1_090_000, 1_190_000), start=1):
+        _write(tmp_path, f"TELEMETRY_r{i:02d}.json",
+               _telemetry(f"r{i:02d}", peak))
+    rc = cli_main(["ledger", "gate", "--offline", "--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0  # each step is inside --mem-tol: accepted per round
+    assert "cumulative drift since r01" in out  # ...but never hidden

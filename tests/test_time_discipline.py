@@ -105,3 +105,63 @@ def test_committed_telemetry_sidecars_validate():
     for p in paths:
         assert inv.validate_file(p) == [], (os.path.basename(p),
                                             inv.validate_file(p))
+
+
+def test_only_round_sidecars_are_committed():
+    """ISSUE 4 satellite: TELEMETRY_rehearse_*.json once sat at the repo
+    root despite the gitignore declaring rehearse sidecars scratch.  The
+    rule is now code (invariants.committable_sidecar): only round
+    sidecars (TELEMETRY_rNN.json) may be tracked.  Checked against git's
+    own index so an ignored-but-present scratch file (tier-1 rehearse
+    runs regenerate them in cwd) never false-positives."""
+    import subprocess
+    import sys
+
+    try:
+        p = subprocess.run(
+            ["git", "ls-files", "TELEMETRY_*.json"], cwd=_REPO,
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:  # no git in image
+        import pytest
+
+        pytest.skip(f"git unavailable: {e}")
+    if p.returncode != 0:
+        import pytest
+
+        pytest.skip(f"not a git checkout: {p.stderr.strip()[:100]}")
+    tracked = [ln.strip() for ln in p.stdout.splitlines() if ln.strip()]
+    offenders = [t for t in tracked if not inv.committable_sidecar(t)]
+    assert offenders == [], (
+        f"non-round telemetry sidecars committed: {offenders} — rehearse/"
+        "scratch sidecars are regenerated per run and must stay out of "
+        "the tree (round evidence is TELEMETRY_rNN.json only)"
+    )
+    # the rule itself stays strict
+    assert inv.committable_sidecar("TELEMETRY_r06.json")
+    assert not inv.committable_sidecar("TELEMETRY_rehearse_fast.json")
+    assert not inv.committable_sidecar("TELEMETRY_r06-1234.json")
+
+
+def test_perf_ledger_modules_stay_wall_clock_free():
+    """The ledger/regress/memstats layer reads evidence and must never
+    read the wall clock (its verdicts have to be reproducible from the
+    committed artifacts alone): zero bare wall-clock matches AND no
+    allowlist entry pleading one in."""
+    new_modules = (
+        "csmom_tpu/obs/ledger.py",
+        "csmom_tpu/obs/regress.py",
+        "csmom_tpu/obs/memstats.py",
+        "csmom_tpu/cli/ledger.py",
+    )
+    for rel in new_modules:
+        path = os.path.join(_REPO, rel)
+        assert os.path.exists(path), rel
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        n = len(_WALL_CLOCK.findall(src)) + len(_ARGLESS_NOW.findall(src))
+        assert n == 0, f"{rel}: {n} bare wall-clock call(s) in the ledger"
+        assert rel not in _ALLOWLIST, (
+            f"{rel} must not be allowlisted: ledger verdicts are "
+            "reproducible-from-artifacts by contract"
+        )
