@@ -21,7 +21,10 @@ strategy logic as pure, jit-compiled functions over those panels:
 - ``parallel``  device-mesh sharding (shard_map), distributed rank, collectives
 - ``backends``  one API over the 'tpu' (JAX) and 'pandas' engines
 - ``native``    C++ runtime components (fast CSV parser via ctypes)
-- ``cli``       run / replicate / grid / sweep / intraday / bench commands
+- ``serve``     online workload: micro-batching signal service (bounded
+                admission, shape-bucket coalescing, seeded load generator)
+- ``cli``       the ``csmom`` entry points (the subcommand table is
+                generated into ``csmom --help``'s epilog from the registry)
 - ``utils``     structured logging, profiling, error guards
 
 The parameter grid (J x K lookback/holding) is a ``vmap`` axis; the asset axis

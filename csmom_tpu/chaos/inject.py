@@ -35,7 +35,17 @@ warmup.entry         aot warmup, before each manifest entry      corrupt_file
 aot.compile          aot_compile, between lower and compile      corrupt_file, truncate_file
 mini.row             chaos.minibench, before each measured row   any (fast tier)
 mini.finish          chaos.minibench, before the trailing JSON   stdout_noise
+serve.admit          serve queue, before admission               sleep
+serve.coalesce       serve batcher, after gathering a batch      sleep
+serve.dispatch       serve worker, before the engine call        fail, sleep
 ===================  =========================================  ==========
+
+The ``serve.*`` points run in the signal service's own threads (the
+serve subsystem is in-process by design), so process-fatal actions
+(kill/exit) take the whole service down; the rehearsed worker-crash
+fault is the ``fail`` action at ``serve.dispatch``, which the worker
+loop treats as a crash of that dispatch — its batch terminates
+``rejected`` and the queue stays drainable.
 """
 
 from __future__ import annotations

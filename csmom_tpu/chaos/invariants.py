@@ -36,6 +36,15 @@ Artifact kinds (detected from keys, see :func:`detect_kind`):
     where the phase durations PARTITION the wall (their sum must land
     within 5% of ``wall_s`` — the whole point of the artifact is that
     the time is accounted for, not vibes).
+``serve``
+    A signal-service load-generation record (``SERVE_*.json``,
+    :mod:`csmom_tpu.serve.loadgen`): headline + ``requests`` accounting
+    + ``latency_ms`` percentiles + ``batches``.  Closed-world schema AND
+    closed books: ``served + rejected + expired == admitted`` and
+    ``expired_dispatched == 0`` are schema rules — an artifact whose
+    request ledger does not balance (a silently dropped request, an
+    expired request that was dispatched anyway) is invalid evidence,
+    full stop.
 
 Partial rules: a partial artifact carries ``extra.partial`` (non-empty
 string saying *what* is missing); a partial with a measurement list
@@ -72,20 +81,26 @@ DRIVER_TAIL_CHARS = 2000
 # different era of the code and must fail loudly, not half-parse
 KNOWN_TELEMETRY_SCHEMA_VERSIONS = (1,)
 
-# only ROUND sidecars are committed evidence: TELEMETRY_r<NN>.json.
-# Rehearse/scratch sidecars (TELEMETRY_rehearse_*.json, pid-suffixed
-# operator reruns) are regenerated per run and gitignored — one slipped
-# into the tree once, which is why this is now a named rule with a
-# tier-1 test behind it instead of a .gitignore comment.
-_COMMITTED_SIDECAR_RE = re.compile(r"^TELEMETRY_r\d+\.json$")
+# serve artifact schema versions this checker (and the ledger) understand
+# — the same closed-world rule as telemetry
+KNOWN_SERVE_SCHEMA_VERSIONS = (1,)
+
+# only ROUND sidecars are committed evidence: TELEMETRY_r<NN>.json and
+# SERVE_r<NN>.json.  Rehearse/smoke/scratch files (TELEMETRY_rehearse_*,
+# SERVE_smoke*, pid-suffixed operator reruns) are regenerated per run
+# and gitignored — one slipped into the tree once, which is why this is
+# a named rule with a tier-1 test behind it instead of a .gitignore
+# comment.
+_REGENERATED_PREFIXES = ("TELEMETRY_", "SERVE_")
+_COMMITTED_SIDECAR_RE = re.compile(r"^(?:TELEMETRY|SERVE)_r\d+\.json$")
 
 _NUM = (int, float)
 
 
 def committable_sidecar(basename: str) -> bool:
-    """True iff this TELEMETRY file name may be committed (round
-    sidecars only); non-TELEMETRY names are not this rule's business."""
-    if not basename.startswith("TELEMETRY_"):
+    """True iff this TELEMETRY/SERVE file name may be committed (round
+    artifacts only); other name families are not this rule's business."""
+    if not basename.startswith(_REGENERATED_PREFIXES):
         return True
     return bool(_COMMITTED_SIDECAR_RE.match(basename))
 
@@ -109,6 +124,10 @@ def trailing_json(text: str):
 def detect_kind(obj: dict) -> str | None:
     if not isinstance(obj, dict):
         return None
+    # serve before record: a SERVE artifact carries metric/value too
+    if obj.get("kind") == "serve" or {"requests", "latency_ms",
+                                      "batches"} <= set(obj):
+        return "serve"
     if obj.get("kind") == "telemetry" or {"run_id", "wall_s",
                                           "phases"} <= set(obj):
         return "telemetry"
@@ -347,8 +366,117 @@ def _validate_telemetry(obj: dict) -> list:
     return out
 
 
+def _validate_serve(obj: dict) -> list:
+    """The serve artifact contract: balanced request books, ordered
+    percentiles, consistent batch histogram, a known schema era."""
+    out: list = []
+    _require(obj, "run_id", str, "serve", out)
+    ver = _require(obj, "schema_version", int, "serve", out)
+    if ver is not None and ver not in KNOWN_SERVE_SCHEMA_VERSIONS:
+        out.append(
+            f"serve: unknown schema_version {ver} (this checker "
+            f"understands {list(KNOWN_SERVE_SCHEMA_VERSIONS)}) — the "
+            "artifact is from a different era of the code; do not "
+            "half-parse it"
+        )
+    _require(obj, "wall_s", _NUM, "serve", out, "a number")
+    # the headline is record-shaped (metric/value/unit/vs_baseline), so
+    # the record rules apply verbatim
+    out += _validate_record(obj, kind="serve")
+
+    req = _require(obj, "requests", dict, "serve", out)
+    served = 0
+    if req is not None:
+        for k in ("admitted", "served", "rejected", "expired",
+                  "expired_dispatched"):
+            v = req.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"serve: requests.{k} must be a non-negative "
+                           "int (the accounting is the contract)")
+                req = None
+                break
+        if req is not None:
+            served = req["served"]
+            total = req["served"] + req["rejected"] + req["expired"]
+            if total != req["admitted"]:
+                out.append(
+                    f"serve: request accounting broken — served "
+                    f"{req['served']} + rejected {req['rejected']} + "
+                    f"expired {req['expired']} = {total} != admitted "
+                    f"{req['admitted']} (a request was dropped or "
+                    "double-counted)"
+                )
+            if req["expired_dispatched"] != 0:
+                out.append(
+                    f"serve: expired_dispatched = "
+                    f"{req['expired_dispatched']} — a request that "
+                    "expired while queued must be cancelled, never "
+                    "dispatched"
+                )
+
+    lat = _require(obj, "latency_ms", dict, "serve", out)
+    if lat is not None:
+        for leg in ("queue", "service", "total"):
+            side = lat.get(leg)
+            if not isinstance(side, dict):
+                out.append(f"serve: latency_ms.{leg} must be a dict of "
+                           "p50/p95/p99")
+                continue
+            vals = []
+            for q in ("p50", "p95", "p99"):
+                v = side.get(q)
+                if v is None:
+                    # legal only when nothing was observed on that leg
+                    if leg != "queue" and served:
+                        out.append(f"serve: latency_ms.{leg}.{q} is null "
+                                   "but requests were served — the "
+                                   "latency was measured, record it")
+                    continue
+                if not isinstance(v, _NUM) or isinstance(v, bool):
+                    out.append(f"serve: latency_ms.{leg}.{q} must be a "
+                               "number (milliseconds) or null")
+                else:
+                    vals.append(v)
+            if vals != sorted(vals):
+                out.append(f"serve: latency_ms.{leg} percentiles must be "
+                           "non-decreasing (p50 <= p95 <= p99)")
+
+    batches = _require(obj, "batches", dict, "serve", out)
+    if batches is not None:
+        count = batches.get("count")
+        hist = batches.get("size_hist")
+        if not isinstance(count, int) or isinstance(count, bool):
+            out.append("serve: batches.count must be an int")
+        elif not isinstance(hist, dict):
+            out.append("serve: batches.size_hist must be a dict of "
+                       "batch-size -> count")
+        else:
+            bad = [k for k, v in hist.items()
+                   if not (isinstance(v, int) and not isinstance(v, bool))
+                   or not str(k).isdigit()]
+            if bad:
+                out.append(f"serve: batches.size_hist has non-int-keyed or "
+                           f"non-int-valued entries: {bad}")
+            elif sum(hist.values()) != count:
+                out.append(
+                    f"serve: batches.size_hist sums to "
+                    f"{sum(hist.values())} but batches.count is {count} — "
+                    "a dispatched batch is missing from the histogram"
+                )
+    comp = obj.get("compile")
+    if comp is not None and not isinstance(comp, dict):
+        out.append("serve: compile must be a dict when present")
+    elif isinstance(comp, dict):
+        fc = comp.get("in_window_fresh_compiles")
+        if fc is not None and not isinstance(fc, (int, str)):
+            out.append("serve: compile.in_window_fresh_compiles must be "
+                       "an int count or a reason string")
+    return out
+
+
 _VALIDATORS = {
     "record": _validate_record,
+    "serve": _validate_serve,
     "telemetry": _validate_telemetry,
     "driver_capture": _validate_driver_capture,
     "multichip": _validate_multichip,
@@ -365,7 +493,7 @@ def validate(obj, kind: str | None = None) -> list:
     if kind is None:
         return ["unrecognized artifact shape: none of the known key "
                 "signatures (record / driver_capture / multichip / phases "
-                "/ tpu_cache / telemetry) match"]
+                "/ tpu_cache / telemetry / serve) match"]
     if kind not in _VALIDATORS:
         return [f"unknown artifact kind {kind!r}"]
     return _VALIDATORS[kind](obj)
@@ -433,8 +561,8 @@ def validate_file(path: str) -> list:
 
 def validate_tree(root: str, patterns=("BENCH_*.json", "MULTICHIP_*.json",
                                        "MULTIHOST_*.json", "HISTRANK_*.json",
-                                       "PHASES_*.json",
-                                       "TELEMETRY_*.json")) -> dict:
+                                       "PHASES_*.json", "TELEMETRY_*.json",
+                                       "SERVE_*.json")) -> dict:
     """``{relative_path: violations}`` for every committed artifact under
     ``root`` matching ``patterns`` (non-recursive: round artifacts land at
     the repo root by contract).  Paths with no violations are included

@@ -1,4 +1,9 @@
-"""``csmom`` CLI: run / replicate / grid / sweep / intraday / bench.
+"""``csmom`` CLI — research, capture, and serving entry points.
+
+The subcommand table is GENERATED from the live registry into the
+``--help`` epilog (see :func:`_registry_epilog`): a hand-written list
+here drifted once (it named 6 of what were by then 16 subcommands), so
+no prose enumeration of subcommands is maintained anywhere anymore.
 
 The reference has no CLI at all — its driver hardcodes every parameter
 (``/root/reference/run_demo.py:193-207``).  Each subcommand here covers one
@@ -1461,9 +1466,9 @@ def build_parser() -> argparse.ArgumentParser:
         if name == "warmup":
             sp.add_argument("--profiles",
                             help="comma-separated warmup profiles "
-                                 "(bench-cpu, bench-tpu, golden, smoke; "
-                                 "default: platform-appropriate bench + "
-                                 "golden)")
+                                 "(bench-cpu, bench-tpu, golden, smoke, "
+                                 "serve, serve-smoke; default: platform-"
+                                 "appropriate bench + golden)")
             sp.add_argument("--platform", choices=["cpu", "tpu", "default"],
                             help="pin the jax platform before compiling "
                                  "(shapes are cached per backend: warm CPU "
@@ -1664,12 +1669,33 @@ def build_parser() -> argparse.ArgumentParser:
 
     from csmom_tpu.cli.ledger import register as register_ledger
     from csmom_tpu.cli.rehearse import register as register_rehearse
+    from csmom_tpu.cli.serve import register as register_serve
     from csmom_tpu.cli.timeline import register as register_timeline
 
     register_rehearse(sub)
     register_timeline(sub)
     register_ledger(sub)
+    register_serve(sub)
+    # the epilog is built AFTER every registration hook has run, from the
+    # registry itself — a subcommand cannot exist without appearing here
+    p.epilog = _registry_epilog(sub)
+    p.formatter_class = argparse.RawDescriptionHelpFormatter
     return p
+
+
+def _registry_epilog(sub) -> str:
+    """The ``--help`` subcommand table, generated from the live subparser
+    registry (names + their registered help lines).  This replaced a
+    hand-maintained docstring list that had drifted to a third of the
+    real registry — generation is the only form that cannot drift."""
+    helps = {a.dest: a.help or "" for a in
+             getattr(sub, "_choices_actions", [])}
+    names = sorted(sub.choices)
+    lines = [f"subcommands ({len(names)}):"]
+    for n in names:
+        first = helps.get(n, "").split("\n")[0]
+        lines.append(f"  {n:<12} {first}".rstrip())
+    return "\n".join(lines)
 
 
 # commands that never touch a device (pure pandas/numpy, or — bench and

@@ -34,7 +34,7 @@ import tempfile
 import time
 
 from csmom_tpu.chaos import invariants as inv
-from csmom_tpu.chaos.plan import Fault, FaultPlan
+from csmom_tpu.chaos.plan import PLAN_ENV, Fault, FaultPlan
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _CAPTURE_LIB = os.path.join(_REPO, "benchmarks", "capture_lib.sh")
@@ -199,6 +199,72 @@ def _shell_scenarios():
             notes="ENOSPC/short write between formatter and rename -> "
                   "post-write JSON validation refuses to land garbage; "
                   "the fault-free retry lands cleanly",
+        ),
+    ]
+
+
+def _check_serve_worker_crash(r):
+    """ISSUE 5: a worker crash mid-batch must terminate its batch as
+    rejected-with-reason (never a silent drop), leave the remaining
+    queue drainable, and keep the accounting equation closed — the
+    validator enforces served + rejected + expired == admitted."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve")
+    req = art.get("requests") or {}
+    if not req.get("rejected_worker_crash"):
+        out.append("the injected crash terminated no requests as "
+                   "rejected — the fault did not fire or the loss was "
+                   "hidden")
+    if not req.get("served"):
+        out.append("no request served after the crash — the queue did "
+                   "not stay drainable")
+    if (art.get("batches") or {}).get("count", 0) < 2:
+        out.append("fewer than 2 batches dispatched — nothing ran after "
+                   "the crashed batch")
+    return out
+
+
+def _check_serve_deadline_storm(r):
+    """Overload + tight deadlines: requests must expire WHILE QUEUED and
+    never be dispatched (expired_dispatched == 0 is a validator rule),
+    with the books still balanced on the drained queue."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve")
+    req = art.get("requests") or {}
+    if not req.get("expired"):
+        out.append("the deadline storm expired no requests — the storm "
+                   "did not overload the queue (tune the plan)")
+    return out
+
+
+def _serve_scenarios():
+    return [
+        Scenario(
+            "serve-worker-kill-mid-batch", "serve",
+            FaultPlan("serve-worker-kill", seed=20, faults=(
+                Fault(point="serve.dispatch", action="fail", after=1,
+                      max_fires=1),
+            )),
+            _check_serve_worker_crash, fast=True,
+            notes="worker crash mid-batch (chaos fail at serve.dispatch):"
+                  " the batch terminates rejected-with-reason, the queue "
+                  "drains on, served+rejected+expired == admitted",
+            env={"load": {"schedule": "0.5x80", "seed": 11,
+                          "deadline_s": 2.0}},
+        ),
+        Scenario(
+            "serve-deadline-storm", "serve",
+            FaultPlan("serve-deadline-storm", seed=21, faults=(
+                Fault(point="serve.dispatch", action="sleep",
+                      seconds=0.12, after=0, max_fires=3),
+            )),
+            _check_serve_deadline_storm, fast=True,
+            notes="slow dispatches pile the queue past tight deadlines: "
+                  "requests expire WHILE QUEUED (never dispatched), "
+                  "backpressure rejects at the bound, books balance",
+            env={"load": {"schedule": "0.4x150", "seed": 12,
+                          "deadline_s": 0.08},
+                 "serve": {"capacity": 24}},
         ),
     ]
 
@@ -370,7 +436,7 @@ def _check_bench_child_full(r):
 
 
 def builtin_matrix(fast: bool = False):
-    mats = _mini_scenarios() + _shell_scenarios()
+    mats = _mini_scenarios() + _shell_scenarios() + _serve_scenarios()
     if not fast:
         mats += _bench_scenarios()
     else:
@@ -591,12 +657,66 @@ def _run_warmup(scenario, box: str) -> dict:
     return out
 
 
+def _run_serve(scenario, box: str) -> dict:
+    """Drive the signal service IN-PROCESS (stub engine, smoke buckets).
+
+    The serve subsystem is thread-based by design and the rehearsed
+    faults are result faults (``fail``) and delays (``sleep``), not
+    process faults — so the scenario runs inside the rehearsal process:
+    no subprocess, no jax, which is what keeps the fast tier's wall
+    inside its 30 s budget with the two serve scenarios aboard.
+    ``scenario.env`` here carries runner kwargs (``serve`` -> ServeConfig
+    overrides, ``load`` -> LoadConfig overrides), not OS env vars.
+    """
+    from csmom_tpu.chaos import inject
+    from csmom_tpu.serve.loadgen import (
+        LoadConfig,
+        run_loadgen,
+        write_artifact,
+    )
+    from csmom_tpu.serve.service import ServeConfig, SignalService
+
+    saved = {k: os.environ.get(k) for k in (PLAN_ENV, "CSMOM_FAULT_STATE")}
+    try:
+        if scenario.plan is not None:
+            plan_path = os.path.join(box, "plan.toml")
+            with open(plan_path, "w") as f:
+                f.write(scenario.plan.to_toml())
+            os.environ[PLAN_ENV] = plan_path
+        os.environ["CSMOM_FAULT_STATE"] = os.path.join(box, "chaos-state")
+        inject.reset()  # re-read the scenario's plan, fresh hit counters
+        svc = SignalService(ServeConfig(
+            profile="serve-smoke", engine="stub",
+            **scenario.env.get("serve", {}))).start()
+        load = LoadConfig(run_id=f"rehearse_{scenario.name}",
+                          **scenario.env.get("load", {}))
+        art = run_loadgen(svc, load)
+        write_artifact(box, art)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        inject.reset()  # the next scenario must not inherit this plan
+    return {
+        "rc": 0,
+        "stdout": "",
+        "stderr": "",
+        "trailing": art,
+        "headline_violations": [],
+        "sidecar_rows": 0,
+        "artifact": art,
+    }
+
+
 _RUNNERS = {
     "mini": _run_mini,
     "shell": _run_shell,
     "bench-child": _run_bench_child,
     "bench": _run_bench_supervisor,
     "warmup": _run_warmup,
+    "serve": _run_serve,
 }
 
 
@@ -632,11 +752,19 @@ def _check_custom_generic(r):
     return out
 
 
+def _check_serve_generic(r):
+    # whatever the custom fault did, the landed SERVE artifact must be
+    # schema-valid — which INCLUDES balanced request books and zero
+    # expired-but-dispatched requests (the serve kind's core invariants)
+    return inv.validate(r.get("artifact") or {}, "serve")
+
+
 _CUSTOM_CHECKS = {
     "mini": _check_custom_generic,
     "bench-child": _check_custom_generic,
     "bench": _check_bench_supervisor_landed,
     "warmup": _check_warmup_healed,
+    "serve": _check_serve_generic,
 }
 
 

@@ -1,0 +1,251 @@
+"""csmom serve / csmom loadgen — the online workload's entry points.
+
+``csmom serve`` starts the in-process micro-batching signal service
+(:mod:`csmom_tpu.serve`): warms every bucket shape, prints the readiness
+report (bucket grid, warmup stats), runs a per-endpoint self-probe so
+"ready" is a demonstrated claim, then serves until ``--duration``
+elapses (0 = until Ctrl-C) and prints the request accounting on the way
+out.
+
+``csmom loadgen`` drives an in-process service with the seeded open-loop
+generator (:mod:`csmom_tpu.serve.loadgen`) and lands a schema-valid
+``SERVE_<run>.json``: throughput, p50/p95/p99 queue+service latency,
+batch-size distribution, request accounting, in-window compile count.
+``--smoke`` is the tier-1 preset: smoke buckets, a sub-second schedule,
+the whole admission→coalesce→dispatch pipeline on CPU.  Exit is nonzero
+when the artifact fails its own invariants (kind ``serve`` in
+:mod:`csmom_tpu.chaos.invariants`) — a loadgen whose books don't balance
+must fail loudly, not land evidence.
+
+Registered via ``register(sub)`` like rehearse/timeline/ledger (the
+cli/main.py split: new subcommands do not grow the monolith).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["cmd_loadgen", "cmd_serve", "register"]
+
+
+def _mk_service(args, engine_default: str = "jax"):
+    from csmom_tpu.serve.service import ServeConfig, SignalService
+
+    profile = args.profile or ("serve-smoke" if getattr(args, "smoke", False)
+                               else "serve")
+    cfg = ServeConfig(
+        profile=profile,
+        engine="stub" if args.stub else engine_default,
+        capacity=args.capacity,
+        max_wait_s=args.max_wait_ms / 1e3,
+        default_deadline_s=(None if args.deadline_ms in (None, 0)
+                            else args.deadline_ms / 1e3),
+    )
+    return SignalService(cfg)
+
+
+def _print_ready(svc) -> None:
+    from csmom_tpu.serve.buckets import ENDPOINTS
+
+    spec = svc.spec
+    print(f"signal service ready: engine {svc.engine.name}, bucket "
+          f"profile {spec.name}")
+    print(f"  endpoints: {', '.join(ENDPOINTS)}")
+    print(f"  buckets: B({','.join(map(str, spec.batch_buckets))}) x "
+          f"A({','.join(map(str, spec.asset_buckets))}) x {spec.months} "
+          f"months ({spec.dtype})")
+    print(f"  admission: capacity {svc.config.capacity}, coalesce window "
+          f"{svc.config.max_wait_s * 1e3:g} ms, default deadline "
+          f"{svc.config.default_deadline_s}")
+    print(f"  warmup: {svc.warm_report}")
+
+
+def cmd_serve(args) -> int:
+    """Run the micro-batching signal service (in-process, thread-based)."""
+    import numpy as np
+
+    from csmom_tpu.serve.buckets import ENDPOINTS
+
+    svc = _mk_service(args)
+    svc.start()
+    _print_ready(svc)
+
+    # a demonstrated "ready": one probe request per endpoint through the
+    # full admission -> coalesce -> dispatch path
+    spec = svc.spec
+    A = spec.asset_buckets[0]
+    rng = np.random.default_rng(0)
+    probes = []
+    for kind in ENDPOINTS:
+        v = 100.0 * np.exp(np.cumsum(
+            rng.normal(0, 0.03, (A, spec.months)), axis=1))
+        probes.append(svc.submit(kind, v.astype(np.float32),
+                                 np.ones((A, spec.months), bool),
+                                 deadline_s=5.0))
+    ok = all(p.wait(10.0) and p.state == "served" for p in probes)
+    print(f"  self-probe: {'all endpoints served' if ok else 'FAILED'}")
+    if not ok:
+        svc.stop()
+        for p in probes:
+            if p.state != "served":
+                print(f"    {p.kind}: state={p.state} error={p.error}",
+                      file=sys.stderr)
+        return 1
+
+    import time
+
+    from csmom_tpu.utils.deadline import mono_now_s
+
+    try:
+        if args.duration > 0:
+            end = mono_now_s() + args.duration
+            while mono_now_s() < end:
+                time.sleep(min(0.2, max(0.0, end - mono_now_s())))
+        else:
+            print("serving until interrupted (Ctrl-C) ...")
+            while True:
+                time.sleep(0.5)
+    except KeyboardInterrupt:
+        print("\ninterrupted — draining")
+    svc.stop(drain=True)
+    print(f"accounting: {svc.accounting()}")
+    print(f"batches: {svc.batch_stats()}")
+    print(f"in-window fresh compiles: {svc.fresh_compiles()}")
+    viols = svc.invariant_violations()
+    for v in viols:
+        print(f"INVARIANT VIOLATION: {v}", file=sys.stderr)
+    return 1 if viols else 0
+
+
+def cmd_loadgen(args) -> int:
+    """Open-loop load generation against an in-process service; lands
+    SERVE_<run>.json."""
+    from csmom_tpu.chaos import invariants as inv
+    from csmom_tpu.serve.loadgen import (
+        LoadConfig,
+        parse_schedule,
+        run_loadgen,
+        write_artifact,
+    )
+
+    if args.smoke:
+        schedule = args.schedule or "0.8x60"
+        run_id = args.run_id or "smoke"
+    else:
+        schedule = args.schedule or "2x40"
+        run_id = args.run_id or f"loadgen-{os.getpid()}"
+    try:
+        parse_schedule(schedule)
+    except ValueError as e:
+        print(f"--schedule: {e}", file=sys.stderr)
+        return 2
+    svc = _mk_service(args)
+    svc.start()
+    _print_ready(svc)
+    load = LoadConfig(
+        schedule=schedule,
+        seed=args.seed,
+        deadline_s=(None if args.deadline_ms in (None, 0)
+                    else args.deadline_ms / 1e3),
+        run_id=run_id,
+    )
+    print(f"offering: schedule {schedule} (seed {load.seed}, deadline "
+          f"{load.deadline_s}s) ...")
+    art = run_loadgen(svc, load)
+    out_dir = args.out or os.getcwd()
+    path = write_artifact(out_dir, art)
+
+    req = art["requests"]
+    lat = art["latency_ms"]["total"]
+    print(f"\nthroughput: {art['value']} req/s over {art['wall_s']}s wall")
+    print(f"requests: admitted {req['admitted']} -> served {req['served']}, "
+          f"rejected {req['rejected']} (queue-full "
+          f"{req['rejected_queue_full']}, crash "
+          f"{req['rejected_worker_crash']}), expired {req['expired']}")
+    print(f"latency total ms: p50 {lat['p50']}  p95 {lat['p95']}  "
+          f"p99 {lat['p99']}")
+    print(f"batches: {art['batches']}")
+    print(f"in-window fresh compiles: "
+          f"{art['compile']['in_window_fresh_compiles']}")
+    print(f"artifact: {path}")
+
+    viols = inv.validate_file(path)
+    if viols:
+        print("ARTIFACT INVALID:", file=sys.stderr)
+        for v in viols:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    fresh = art["compile"]["in_window_fresh_compiles"]
+    if isinstance(fresh, int) and fresh > 0 and not args.allow_fresh_compiles:
+        print(f"error: {fresh} fresh compile(s) inside the serving window "
+              "— a dispatch missed the warmed bucket grid (padding or "
+              "warmup bug); rerun with --allow-fresh-compiles to land "
+              "anyway", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _common_flags(sp) -> None:
+    sp.add_argument("--platform", choices=["cpu", "tpu", "default"],
+                    help="pin the jax platform before the engine warms "
+                         "(every subcommand supports this; use 'cpu' "
+                         "when the TPU tunnel is unavailable)")
+    sp.add_argument("--profile", choices=["serve", "serve-smoke"],
+                    help="bucket grid (default: serve; --smoke implies "
+                         "serve-smoke)")
+    sp.add_argument("--stub", action="store_true",
+                    help="numpy stub engine (no jax): plumbing/chaos runs")
+    sp.add_argument("--capacity", type=int, default=64,
+                    help="admission-queue bound (backpressure beyond it; "
+                         "default 64)")
+    sp.add_argument("--max-wait-ms", dest="max_wait_ms", type=float,
+                    default=10.0,
+                    help="micro-batch coalescing window (default 10 ms)")
+    sp.add_argument("--deadline-ms", dest="deadline_ms", type=float,
+                    default=500.0,
+                    help="default per-request deadline (0 = none; a "
+                         "request expiring while queued is cancelled, "
+                         "never dispatched)")
+
+
+def register(sub) -> None:
+    """Attach the ``serve`` and ``loadgen`` subparsers (from cli.main)."""
+    sp = sub.add_parser(
+        "serve",
+        help="run the in-process micro-batching signal service (warm "
+             "bucket shapes, self-probe every endpoint, serve)",
+    )
+    _common_flags(sp)
+    sp.add_argument("--duration", type=float, default=5.0,
+                    help="seconds to serve after the self-probe "
+                         "(0 = until Ctrl-C; default 5)")
+    sp.set_defaults(fn=cmd_serve)
+
+    lg = sub.add_parser(
+        "loadgen",
+        help="seeded open-loop load generator against an in-process "
+             "service; lands a SERVE_<run>.json latency/throughput "
+             "artifact",
+    )
+    _common_flags(lg)
+    lg.add_argument("--smoke", action="store_true",
+                    help="tier-1 preset: smoke buckets, sub-second "
+                         "schedule, SERVE_smoke.json (gitignored)")
+    lg.add_argument("--schedule", metavar="DURxRPS,...",
+                    help="arrival schedule segments, e.g. 2x25,3x60 "
+                         "(default: 2x40; smoke: 0.8x60)")
+    lg.add_argument("--seed", type=int, default=0,
+                    help="load stream seed (arrivals, mixes, panels; "
+                         "same seed = same request stream)")
+    lg.add_argument("--run-id", dest="run_id",
+                    help="artifact run id: SERVE_<run-id>.json (round "
+                         "evidence must be rNN; anything else is "
+                         "scratch and gitignored)")
+    lg.add_argument("--out", help="artifact directory (default: cwd)")
+    lg.add_argument("--allow-fresh-compiles", dest="allow_fresh_compiles",
+                    action="store_true",
+                    help="land the artifact even when the serving window "
+                         "compiled fresh shapes (default: exit 1 — the "
+                         "zero-compile property is the contract)")
+    lg.set_defaults(fn=cmd_loadgen)

@@ -227,7 +227,37 @@ def _months(T: int) -> int:
     return _MONTH_CACHE[T]
 
 
-PROFILES = ("bench-cpu", "bench-tpu", "golden", "smoke")
+def _serve_entries(profile: str, dtype=None) -> list[ManifestEntry]:
+    """The serve bucket grid: every (endpoint, batch, assets) shape the
+    signal service may dispatch (:mod:`csmom_tpu.serve.buckets`).
+
+    The entries wrap the SAME ``lru_cache``-shared jitted callables the
+    live service dispatches (``serve.engine.serve_entry_fn`` at the
+    ``ServeConfig`` defaults), so ``csmom warmup --profiles serve``
+    AOT-persists byte-identical HLO and a restarted service loads every
+    bucket executable from disk instead of compiling at startup."""
+    from csmom_tpu.serve.buckets import ENDPOINTS, bucket_spec
+    from csmom_tpu.serve.engine import serve_entry_fn
+    from csmom_tpu.serve.service import ServeConfig
+
+    spec = bucket_spec(profile)
+    dt = np.dtype(dtype or spec.dtype)
+    cfg = ServeConfig()  # the single source of the service's signal params
+    out = []
+    for kind in ENDPOINTS:
+        fn = serve_entry_fn(kind, cfg.lookback, cfg.skip, cfg.n_bins,
+                            cfg.mode)
+        for B, A, M in spec.shapes():
+            out.append(ManifestEntry(
+                name=f"serve.{kind}.b{B}@{A}x{M}",
+                fn=fn,
+                args=(_sds((B, A, M), dt), _sds((B, A, M), bool)),
+            ))
+    return out
+
+
+PROFILES = ("bench-cpu", "bench-tpu", "golden", "smoke", "serve",
+            "serve-smoke")
 
 
 def build_manifest(profile: str, dtype=None) -> list[ManifestEntry]:
@@ -248,6 +278,10 @@ def build_manifest(profile: str, dtype=None) -> list[ManifestEntry]:
       panel, histrank, online ridge.
     - ``"smoke"``: tiny shapes of every entry kind — the test tier's
       profile (fast to compile, exercises every manifest code path).
+    - ``"serve"`` / ``"serve-smoke"``: the signal service's bucket grids
+      (``csmom_tpu.serve.buckets``) — every (endpoint, batch, assets)
+      shape a micro-batch dispatch may take, at the service's own jitted
+      entries.  f32 (the serve compute dtype).
 
     ``dtype`` overrides the profile's default float dtype.
     """
@@ -296,6 +330,10 @@ def build_manifest(profile: str, dtype=None) -> list[ManifestEntry]:
         entries.append(_histrank_entry(32, 6, np.float32, tag="32x6"))
         entries.append(_online_ridge_entry(12, 3, 2, dt, tag="12x3x2"))
         return entries
+    if profile in ("serve", "serve-smoke"):
+        # the online workload's closed shape world: warm it before
+        # starting a service and the request path never compiles
+        return _serve_entries(profile, dtype)
     raise ValueError(f"unknown warmup profile {profile!r}: use one of {PROFILES}")
 
 

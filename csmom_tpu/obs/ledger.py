@@ -3,7 +3,8 @@
 Every round lands evidence at the repo root — ``BENCH_rNN.json`` driver
 captures, ``BENCH_FULL_rNN.json`` full records, ``MULTICHIP_*`` /
 ``MULTIHOST_*`` / ``HISTRANK_*`` / ``PHASES_*`` captures,
-``TELEMETRY_rNN.json`` sidecars — and until now the *trajectory* across
+``TELEMETRY_rNN.json`` sidecars, ``SERVE_rNN.json`` signal-service
+load records — and until now the *trajectory* across
 them lived only as hand-written ROADMAP prose.  This module ingests the
 whole heterogeneous family (schema contract:
 :mod:`csmom_tpu.chaos.invariants` — the same ``detect_kind``/``validate``
@@ -52,6 +53,7 @@ DEFAULT_PATTERNS = (
     "HISTRANK_*.json",
     "PHASES_*.json",
     "TELEMETRY_*.json",
+    "SERVE_*.json",
 )
 
 _RUN_RE = re.compile(r"_r(\d+)")
@@ -68,7 +70,7 @@ def _scratch_note(basename: str) -> str | None:
     still ingests — flagged as a variant, never gate-eligible."""
     if basename == "BENCH_TPU_LAST.json":
         return "per-machine TPU session cache, not round evidence: skipped"
-    if (basename.startswith("TELEMETRY_")
+    if (basename.startswith(("TELEMETRY_", "SERVE_"))
             and not inv.committable_sidecar(basename)
             and run_of(basename)[0] is None):
         return ("scratch sidecar (uncommittable name, no round id), not "
@@ -289,6 +291,43 @@ def _telemetry_rows(obj: dict, run: str, num: int, variant,
     return rows
 
 
+def _serve_rows(obj: dict, run: str, num: int, variant,
+                source: str) -> list:
+    """Rows from a SERVE artifact: the online workload's trajectory.
+
+    Throughput (higher is better) and the total-latency percentiles
+    (lower) are the gate-relevant axes; the in-window fresh-compile
+    count rides along because the zero-compile property is the serve
+    layer's structural claim and a regression there is a padding/warmup
+    bug, not noise.  Smoke-bucket runs arrive flagged (``extra.smoke``)
+    and therefore never gate — same provenance discipline as bench."""
+    extra = obj.get("extra") or {}
+    platform = extra.get("platform")
+    device_kind = extra.get("device_kind") or platform
+    workload = extra.get("workload")
+    flags = _flags(obj, variant)
+    base = dict(run=run, run_num=num, source=source, platform=platform,
+                device_kind=device_kind, workload=workload, flags=flags)
+    rows = []
+    v = _num(obj.get("value"))
+    if v is not None:
+        rows.append(Row(metric="serve_throughput_rps", value=v,
+                        unit=str(obj.get("unit", "req/s")),
+                        direction="higher", **base))
+    total = (obj.get("latency_ms") or {}).get("total")
+    if isinstance(total, dict):
+        for q in ("p50", "p95", "p99"):
+            pv = _num(total.get(q))
+            if pv is not None:
+                rows.append(Row(metric=f"serve_{q}_ms", value=pv, unit="ms",
+                                direction="lower", **base))
+    fc = _num((obj.get("compile") or {}).get("in_window_fresh_compiles"))
+    if fc is not None:
+        rows.append(Row(metric="serve_in_window_fresh_compiles", value=fc,
+                        unit="compiles", direction="lower", **base))
+    return rows
+
+
 def _generic_rows(obj: dict, kind: str, run: str, num: int, variant,
                   source: str) -> list:
     """Info rows for the remaining artifact kinds (multichip equality,
@@ -366,6 +405,17 @@ def ingest_file(path: str, have_full_runs=frozenset()) -> tuple:
         return [], [{"source": source,
                      "note": "record artifact with no numeric value axis: "
                              "present but contributes no trajectory rows"}]
+    if kind == "serve":
+        # closed-world schema, same rule as telemetry: a serve artifact
+        # from a different era must not half-parse into gate rows
+        ver = obj.get("schema_version")
+        if ver not in inv.KNOWN_SERVE_SCHEMA_VERSIONS:
+            return [], [{"source": source,
+                         "note": f"unknown serve schema_version {ver!r} "
+                                 f"(reader understands "
+                                 f"{list(inv.KNOWN_SERVE_SCHEMA_VERSIONS)})"
+                                 ": not half-parsed into rows"}]
+        return _serve_rows(obj, run, num, variant, source), []
     if kind == "telemetry":
         # closed-world schema: a sidecar from a different era of the
         # code must not be half-parsed into gate-eligible rows (its

@@ -1,0 +1,49 @@
+"""csmom_tpu.serve — the online workload: a micro-batching signal service.
+
+Every entry point before this package was a one-shot batch CLI; this is
+the request path the ROADMAP's "serve heavy traffic" north star needs,
+built the way inference servers batch (continuous/micro-batching in the
+Orca spirit, Yu et al. OSDI '22) and composed from subsystems earlier
+rounds landed:
+
+- :mod:`~csmom_tpu.serve.queue` — bounded admission queue: per-request
+  monotonic deadlines, two priority classes, and BACKPRESSURE — a full
+  queue rejects with a retry-after hint instead of buffering unboundedly.
+  Every request presented to the service terminates in exactly one of
+  ``served`` / ``rejected`` / ``expired`` (the accounting invariant the
+  chaos scenarios assert: served + rejected + expired == admitted).
+- :mod:`~csmom_tpu.serve.batcher` — micro-batch coalescer: waits up to a
+  max-latency window, then pads the gathered same-endpoint requests up to
+  the nearest :mod:`~csmom_tpu.serve.buckets` shape bucket, so every
+  dispatch hits a shape the engine already warmed — zero in-window fresh
+  compiles by construction, verified via ``profiling.compile_stats``.
+- :mod:`~csmom_tpu.serve.engine` — the scoring backends: ``JaxEngine``
+  (vmapped momentum / turnover / mini-backtest kernels, one dispatch per
+  micro-batch; shapes enumerable by the ``compile/manifest.py`` ``serve``
+  profile so ``csmom warmup --profiles serve`` AOT-persists them) and
+  ``StubEngine`` (pure numpy, jax-free — what the fast rehearse tier and
+  plumbing tests drive).
+- :mod:`~csmom_tpu.serve.service` — the worker loop: admission →
+  coalesce → dispatch, chaos checkpoints at each stage, queue-depth /
+  batch-size / latency metrics into :mod:`csmom_tpu.obs`, requests whose
+  deadline expired while queued cancelled before dispatch, and a worker
+  crash mid-batch terminating its in-flight requests (rejected, with the
+  crash as the reason) while the queue stays drainable.
+- :mod:`~csmom_tpu.serve.loadgen` — seeded OPEN-LOOP load generator
+  (arrivals fire on schedule whether or not the service keeps up — the
+  honest way to find the saturation knee) emitting a schema-valid
+  ``SERVE_<run>.json`` artifact: throughput, batch-size distribution,
+  p50/p95/p99 queue + service latency, request accounting, and the
+  in-window compile count.  :mod:`csmom_tpu.chaos.invariants` validates
+  it (kind ``serve``) and :mod:`csmom_tpu.obs.ledger` ingests it, so
+  serve latency/throughput join the cross-run regression gate.
+
+Everything is in-process and thread-based (no network dependency), so
+the full admission→coalesce→dispatch pipeline runs in tier-1 on CPU.
+Clock discipline: all timing goes through
+:func:`csmom_tpu.utils.deadline.mono_now_s` (monotonic, skew-proof).
+"""
+
+from csmom_tpu.serve.buckets import ENDPOINTS, BucketSpec, bucket_spec
+
+__all__ = ["ENDPOINTS", "BucketSpec", "bucket_spec"]

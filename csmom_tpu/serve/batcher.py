@@ -1,0 +1,124 @@
+"""Micro-batch coalescer: gathered requests -> one padded bucket dispatch.
+
+The batcher turns the queue's per-request panels into the CLOSED set of
+shapes the engine warmed (:mod:`csmom_tpu.serve.buckets`): it waits up
+to the coalescing window for same-endpoint company, then pads
+
+- each request's asset axis up to the smallest asset bucket that holds
+  it (padded lanes carry a False mask, so kernels ignore them exactly
+  like delisted names), and
+- the batch axis up to the smallest batch bucket (padding rows are
+  all-masked dummies),
+
+so every dispatch is one of ``len(batch_buckets) x len(asset_buckets)``
+shapes per endpoint — the zero-in-window-compiles property is a
+consequence of this padding, not of luck about what clients send.
+
+Why pad instead of compiling per request shape: a fresh XLA compile is
+seconds (CPU) to ~30 s (tunneled TPU) of request-path latency, paid by
+the first caller of every new universe size and again after every
+restart; padding costs masked FLOPs bounded by the bucket step (< 4x
+worst case, measured per run as ``pad_fraction`` in the SERVE artifact).
+For a service the trade is not close — see ARCHITECTURE "Serving".
+
+Numpy-only (the jax side lives in :mod:`csmom_tpu.serve.engine`), so the
+stub engine path and the fast rehearse tier stay jax-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import numpy as np
+
+from csmom_tpu.serve.buckets import BucketSpec
+from csmom_tpu.serve.queue import AdmissionQueue
+
+__all__ = ["Batcher", "Microbatch"]
+
+
+@dataclasses.dataclass
+class Microbatch:
+    """One coalesced, padded dispatch unit."""
+
+    kind: str
+    requests: list               # live (non-expired) requests, batch order
+    batch_bucket: int            # B: padded batch rows
+    asset_bucket: int            # A: padded asset lanes
+    values: np.ndarray           # f32[B, A, M]
+    mask: np.ndarray             # bool[B, A, M]
+
+    @property
+    def pad_fraction(self) -> float:
+        """Fraction of dispatched (batch, asset) lanes that are padding —
+        the honesty metric for the bucket grid."""
+        used = sum(r.n_assets for r in self.requests)
+        total = self.batch_bucket * self.asset_bucket
+        return round(1.0 - used / total, 4) if total else 0.0
+
+
+class Batcher:
+    """Coalesce queued requests into padded bucket-shaped micro-batches."""
+
+    def __init__(self, spec: BucketSpec, max_wait_s: float = 0.01):
+        self.spec = spec
+        self.max_wait_s = max_wait_s
+
+    def next_batch(self, queue: AdmissionQueue,
+                   stop: threading.Event) -> Microbatch | None:
+        """Block for the next micro-batch; None when ``stop`` is set (or
+        every gathered request had already expired, or padding failed).
+
+        Padding failure is CONTAINED here, not propagated: once requests
+        have been taken off the queue, an escaping exception would kill
+        the worker thread with those requests never reaching a terminal
+        state — exactly the silent drop the accounting invariant exists
+        to forbid.  A batch that cannot be padded terminates rejected
+        (with the reason) and the worker lives on.
+        """
+        from csmom_tpu.chaos.inject import checkpoint
+        from csmom_tpu.obs import metrics
+
+        reqs = queue.collect(self.spec.max_batch, self.max_wait_s, stop)
+        if not reqs:
+            return None
+        checkpoint("serve.coalesce", kind=reqs[0].kind, n=len(reqs))
+        try:
+            return self.pad(reqs)
+        except Exception as e:
+            metrics.counter("serve.pad_failures").inc()
+            reason = f"could not pad batch ({type(e).__name__}: {e})"[:200]
+            for r in reqs:
+                queue.finish_rejected(r, reason)
+            return None
+
+    def pad(self, reqs: list) -> Microbatch:
+        """Pad ``reqs`` (same endpoint, each ``values/mask`` = [A_i, M])
+        into one bucket-shaped array pair."""
+        kind = reqs[0].kind
+        B = self.spec.batch_bucket_for(len(reqs))
+        A = self.spec.asset_bucket_for(max(r.n_assets for r in reqs))
+        if A is None:  # service.submit rejects oversize at the door
+            raise ValueError(
+                f"request exceeds the largest asset bucket "
+                f"{self.spec.max_assets}")
+        M = self.spec.months
+        dtype = np.dtype(self.spec.dtype)
+        values = np.zeros((B, A, M), dtype=dtype)
+        mask = np.zeros((B, A, M), dtype=bool)
+        for b, r in enumerate(reqs):
+            v = np.asarray(r.values, dtype=dtype)
+            m = np.asarray(r.mask, dtype=bool)
+            if v.shape != (r.n_assets, M):
+                raise ValueError(
+                    f"request {r.req_id}: values shape {v.shape} does not "
+                    f"match (n_assets={r.n_assets}, months={M})")
+            if m.shape != v.shape:
+                raise ValueError(
+                    f"request {r.req_id}: mask shape {m.shape} does not "
+                    f"match the values panel {v.shape}")
+            values[b, :r.n_assets] = v
+            mask[b, :r.n_assets] = m
+        return Microbatch(kind=kind, requests=list(reqs), batch_bucket=B,
+                          asset_bucket=A, values=values, mask=mask)

@@ -1,0 +1,101 @@
+"""Serve shape buckets: the closed set of shapes a dispatch may take.
+
+A request arrives with its own universe size; XLA compiles per shape.
+Serving per-request shapes would therefore compile on the request path —
+~30 s/shape on the tunneled TPU backend, seconds on CPU, either way a
+latency cliff the first caller of every new size falls off.  The serve
+layer instead pads every micro-batch up to the nearest entry of a SMALL
+fixed grid of (batch, assets) buckets at one canonical month count, so
+the set of dispatchable shapes is closed and enumerable: the
+``compile/manifest.py`` ``serve`` profile lists exactly these shapes,
+``csmom warmup --profiles serve`` AOT-persists them, and the service
+warms them again (by execution) at startup — after which zero fresh
+compiles can occur in the serving window *by construction* (verified per
+run via ``profiling.compile_stats`` and recorded in the SERVE artifact).
+
+The cost is padded lanes (masked out, so results are exact); the
+``pad_fraction`` field of every SERVE artifact keeps that overhead
+honest.  Bucket sizes are powers-of-two-ish steps so the worst-case pad
+waste is bounded (< 4x on the asset axis, < 2x between batch steps).
+
+This module is stdlib-only: the queue/batcher/service plumbing and the
+fast rehearse tier import bucket geometry without touching jax.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+
+__all__ = ["ENDPOINTS", "BucketSpec", "PROFILES", "bucket_spec"]
+
+# the service's endpoint names (engine.py implements each; the Lee-
+# Swaminathan signal family: price momentum, turnover, and the
+# mini-backtest that scores a whole panel to (mean_spread, sharpe))
+ENDPOINTS = ("momentum", "turnover", "backtest")
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """One closed shape grid: (batch buckets) x (asset buckets) x months."""
+
+    name: str
+    months: int                 # canonical history length M (time axis)
+    asset_buckets: tuple        # ascending A buckets requests pad up to
+    batch_buckets: tuple        # ascending B buckets micro-batches pad up to
+    dtype: str = "float32"      # the serve compute dtype (TPU-native)
+
+    def asset_bucket_for(self, n_assets: int) -> int | None:
+        """Smallest asset bucket holding ``n_assets``; None = too large
+        (the service rejects at admission — an unserveable shape must
+        fail at the door, not compile on the dispatch path)."""
+        if n_assets <= 0:
+            return None
+        i = bisect.bisect_left(self.asset_buckets, n_assets)
+        return self.asset_buckets[i] if i < len(self.asset_buckets) else None
+
+    def batch_bucket_for(self, n_requests: int) -> int:
+        """Smallest batch bucket holding ``n_requests`` (the batcher never
+        gathers more than ``max_batch`` requests, so this always fits)."""
+        i = bisect.bisect_left(self.batch_buckets, n_requests)
+        return self.batch_buckets[min(i, len(self.batch_buckets) - 1)]
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_buckets[-1]
+
+    @property
+    def max_assets(self) -> int:
+        return self.asset_buckets[-1]
+
+    def shapes(self):
+        """Every dispatchable (B, A, months) — the closed world the serve
+        manifest profile enumerates and warmup compiles."""
+        return [(b, a, self.months)
+                for b in self.batch_buckets for a in self.asset_buckets]
+
+
+PROFILES = {
+    # the production grid: five years of months, universes to 128 names,
+    # batches to 8 requests — 6 shapes per endpoint
+    "serve": BucketSpec(
+        name="serve", months=60, asset_buckets=(32, 128),
+        batch_buckets=(1, 4, 8),
+    ),
+    # the tier-1/smoke grid: tiny shapes, every code path — 2 shapes per
+    # endpoint, compiles in seconds on CPU
+    "serve-smoke": BucketSpec(
+        name="serve-smoke", months=24, asset_buckets=(8,),
+        batch_buckets=(1, 4),
+    ),
+}
+
+
+def bucket_spec(profile: str) -> BucketSpec:
+    try:
+        return PROFILES[profile]
+    except KeyError:
+        raise ValueError(
+            f"unknown serve bucket profile {profile!r}: use one of "
+            f"{sorted(PROFILES)}"
+        ) from None

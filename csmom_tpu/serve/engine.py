@@ -1,0 +1,203 @@
+"""Serve scoring engines: vmapped jax kernels + a jax-free stub.
+
+``JaxEngine`` is the real thing: one jitted, vmapped entry per endpoint
+(momentum / turnover / mini-backtest), shared process-wide through
+``lru_cache`` exactly like :mod:`csmom_tpu.compile.entries` — the same
+callable the ``compile/manifest.py`` ``serve`` profile lowers, so an AOT
+``csmom warmup --profiles serve`` and a live service compile
+byte-identical HLO and the serialized-executable cache connects them.
+Each micro-batch is ONE dispatch returning a fixed-shape array; the
+engine never sees a shape outside the bucket grid.
+
+Freshness accounting: ``warm()`` executes every (endpoint, bucket) shape
+once and snapshots ``profiling.compile_stats``; ``fresh_compiles()`` is
+the ``backend_compiles`` delta since that snapshot — an EXACT in-process
+count of computations built during the serving window, which the SERVE
+artifact records as ``in_window_fresh_compiles`` (0 by construction when
+every dispatch stayed on the bucket grid).
+
+``StubEngine`` scores with plain numpy (deterministic, jax-free): the
+queue/batcher/chaos plumbing is engine-agnostic, so the fast rehearse
+tier and the plumbing tests drive the stub and stay off jax entirely —
+the same split the chaos harness makes between ``minibench`` and the
+real ``bench.py``.
+
+jax imports stay inside ``JaxEngine`` so importing this module costs
+nothing jax-side.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+from csmom_tpu.serve.buckets import ENDPOINTS, BucketSpec
+
+__all__ = ["ENDPOINTS", "JaxEngine", "StubEngine", "make_engine",
+           "serve_entry_fn"]
+
+# days constant the turnover stub shares with signals.turnover's ADV proxy
+_TRADING_DAYS_PER_MONTH = 21.0
+
+
+def _nanmean(a: np.ndarray, axis: int) -> np.ndarray:
+    """All-NaN-slice-safe nanmean (np.nanmean warns on empty slices; a
+    padded stub batch is full of them by design)."""
+    ok = np.isfinite(a)
+    c = ok.sum(axis=axis)
+    s = np.where(ok, a, 0.0).sum(axis=axis)
+    return np.where(c > 0, s / np.maximum(c, 1), np.nan)
+
+
+@lru_cache(maxsize=32)
+def serve_entry_fn(kind: str, lookback: int, skip: int, n_bins: int,
+                   mode: str):
+    """The jitted batch scorer for one endpoint (process-shared).
+
+    Signature (all endpoints): ``fn(values f[B, A, M], mask bool[B, A, M])``
+    — one array pair in, one fixed-shape array out, so a micro-batch is a
+    single dispatch:
+
+    - ``momentum``: ``f[B, A]`` — the (J, skip) compounded momentum at
+      the last formation date, NaN where invalid/padded.
+    - ``turnover``: ``f[B, A]`` — trailing-``lookback`` average turnover
+      proxy (values = monthly share volume; the offline shares-unknown
+      proxy, like ``csmom doublesort`` without ``--fetch-shares``).
+    - ``backtest``: ``f[B, 2]`` — (mean_spread, ann_sharpe) of the full
+      monthly decile backtest per request panel.
+    """
+    if kind not in ENDPOINTS:
+        raise ValueError(f"unknown endpoint {kind!r}: use one of {ENDPOINTS}")
+    import jax
+    import jax.numpy as jnp
+
+    if kind == "momentum":
+        from csmom_tpu.signals.momentum import momentum
+
+        def one(values, mask):
+            mom, ok = momentum(values, mask, lookback=lookback, skip=skip)
+            return jnp.where(ok[:, -1], mom[:, -1], jnp.nan)
+
+    elif kind == "turnover":
+        from csmom_tpu.signals.turnover import turnover_features
+
+        def one(values, mask):
+            shares = jnp.ones((values.shape[0],), values.dtype)
+            turn, ok = turnover_features(
+                values, mask, shares, lookback=lookback)["turn_avg"]
+            return jnp.where(ok[:, -1], turn[:, -1], jnp.nan)
+
+    else:  # backtest
+        from csmom_tpu.backtest.monthly import monthly_spread_backtest
+
+        def one(values, mask):
+            res = monthly_spread_backtest(
+                values, mask, lookback=lookback, skip=skip, n_bins=n_bins,
+                mode=mode)
+            return jnp.stack([res.mean_spread, res.ann_sharpe])
+
+    return jax.jit(jax.vmap(one))
+
+
+class JaxEngine:
+    """The compiled scoring backend (one dispatch per micro-batch)."""
+
+    name = "jax"
+
+    def __init__(self, lookback: int = 12, skip: int = 1, n_bins: int = 10,
+                 mode: str = "rank"):
+        self.lookback = lookback
+        self.skip = skip
+        self.n_bins = n_bins
+        self.mode = mode
+        self._stats0 = None
+
+    def _fn(self, kind: str):
+        return serve_entry_fn(kind, self.lookback, self.skip, self.n_bins,
+                              self.mode)
+
+    def warm(self, spec: BucketSpec) -> dict:
+        """Execute every (endpoint, bucket) shape once, then snapshot the
+        compile counters — everything after this snapshot is in-window."""
+        import jax
+
+        from csmom_tpu.obs import span
+        from csmom_tpu.utils.profiling import compile_stats
+
+        n = 0
+        with span("serve.warmup", phase="warmup", spec=spec.name):
+            for kind in ENDPOINTS:
+                fn = self._fn(kind)
+                for B, A, M in spec.shapes():
+                    v = np.zeros((B, A, M), np.dtype(spec.dtype))
+                    m = np.zeros((B, A, M), bool)
+                    jax.block_until_ready(fn(v, m))
+                    n += 1
+        self._stats0 = compile_stats()
+        return {"n_shapes_warmed": n, "endpoints": list(ENDPOINTS)}
+
+    def score(self, kind: str, values: np.ndarray,
+              mask: np.ndarray) -> np.ndarray:
+        return np.asarray(self._fn(kind)(values, mask))
+
+    def fresh_compiles(self):
+        """Distinct computations backend-compiled since warm() — the
+        in-window fresh-compile count (0 = every dispatch was warm)."""
+        from csmom_tpu.utils.profiling import compile_stats
+
+        if self._stats0 is None:
+            return ("not measurable: engine was never warmed "
+                    "(call warm() before serving)")
+        return compile_stats().delta(self._stats0).backend_compiles
+
+
+class StubEngine:
+    """Deterministic numpy scorer — the plumbing-test / rehearse engine.
+
+    Shapes and NaN semantics mirror the jax engine; the numbers are a
+    simplified model (no pad-parity forward fill), which is fine: every
+    consumer of the stub is testing the queue/batcher/chaos path, not
+    signal values.
+    """
+
+    name = "stub"
+
+    def __init__(self, lookback: int = 12, skip: int = 1, n_bins: int = 10,
+                 mode: str = "rank"):
+        self.lookback = lookback
+        self.skip = skip
+
+    def warm(self, spec: BucketSpec) -> dict:
+        return {"n_shapes_warmed": 0,
+                "note": "stub engine: nothing to compile"}
+
+    def score(self, kind: str, values: np.ndarray,
+              mask: np.ndarray) -> np.ndarray:
+        v = np.where(mask, values, np.nan)
+        if kind == "momentum":
+            end = v[:, :, -1 - self.skip]
+            start = v[:, :, -1 - self.skip - self.lookback]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                return end / start - 1.0
+        if kind == "turnover":
+            return (_nanmean(v[:, :, -self.lookback:], -1)
+                    / _TRADING_DAYS_PER_MONTH)
+        if kind == "backtest":
+            with np.errstate(divide="ignore", invalid="ignore"):
+                ret = v[:, :, 1:] / v[:, :, :-1] - 1.0
+            mean = _nanmean(_nanmean(ret, 1), -1)
+            return np.stack([np.nan_to_num(mean),
+                             np.zeros_like(mean)], axis=-1)
+        raise ValueError(f"unknown endpoint {kind!r}")
+
+    def fresh_compiles(self) -> int:
+        return 0  # nothing ever compiles: trivially warm
+
+
+def make_engine(name: str, **kwargs):
+    if name == "jax":
+        return JaxEngine(**kwargs)
+    if name == "stub":
+        return StubEngine(**kwargs)
+    raise ValueError(f"unknown engine {name!r}: use 'jax' or 'stub'")

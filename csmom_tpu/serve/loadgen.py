@@ -1,0 +1,261 @@
+"""Deterministic open-loop load generator + the SERVE artifact writer.
+
+Open-loop means arrivals fire on the SCHEDULE's clock, not the
+service's: a slow service does not throttle the generator, so overload
+shows up as real queue growth, expiry, and backpressure rejection —
+exactly the degradation surface the serve layer exists to manage.  (A
+closed-loop generator that waits for each response would hide the knee:
+coordinated omission.)
+
+Determinism: one seeded ``random.Random`` drives everything — arrival
+times (exponential inter-arrivals per schedule segment: Poisson
+traffic), endpoint mix, universe sizes, priorities, and the synthetic
+panels — so a rehearse scenario or a regression hunt replays the exact
+request stream from ``(schedule, seed)`` alone.
+
+The run lands as ``SERVE_<run>.json``: throughput headline, request
+accounting (the served + rejected + expired == admitted invariant is IN
+the schema — :mod:`csmom_tpu.chaos.invariants` kind ``serve`` refuses an
+artifact whose books do not balance), p50/p95/p99 queue / service /
+total latency, the batch-size histogram with the padding overhead, and
+the in-window fresh-compile count.  :mod:`csmom_tpu.obs.ledger` ingests
+these rows (``serve_throughput_rps``, ``serve_p99_ms``, ...), so serve
+performance joins the cross-run regression gate like every bench wall.
+
+Naming rule (the TELEMETRY rule, extended): only round artifacts
+(``SERVE_rNN.json``) are committable evidence; ``SERVE_smoke*.json`` /
+``SERVE_rehearse*.json`` are regenerated per run and gitignored.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import random
+import time
+
+import numpy as np
+
+from csmom_tpu.serve.buckets import ENDPOINTS
+from csmom_tpu.serve.service import ServeConfig, SignalService
+from csmom_tpu.utils.deadline import mono_now_s
+
+__all__ = ["LoadConfig", "arrival_offsets", "build_artifact",
+           "parse_schedule", "run_loadgen", "synth_panel", "write_artifact"]
+
+SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Segment:
+    duration_s: float
+    rps: float
+
+
+def parse_schedule(spec: str) -> tuple:
+    """``"2x25,3x60"`` -> (Segment(2, 25), Segment(3, 60)): run 2 s at
+    25 req/s, then 3 s at 60 req/s."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            dur, _, rate = part.partition("x")
+            out.append(Segment(float(dur), float(rate)))
+        except ValueError:
+            raise ValueError(
+                f"bad schedule segment {part!r}: use DURxRPS, e.g. 2x25"
+            ) from None
+    if not out:
+        raise ValueError(f"empty schedule {spec!r}")
+    return tuple(out)
+
+
+def arrival_offsets(segments: tuple, rng: random.Random) -> list:
+    """Seeded Poisson arrival offsets (seconds from start) covering every
+    segment — the deterministic request clock."""
+    out: list = []
+    t0 = 0.0
+    for seg in segments:
+        if seg.rps <= 0:
+            t0 += seg.duration_s
+            continue
+        t = t0 + rng.expovariate(seg.rps)
+        while t < t0 + seg.duration_s:
+            out.append(t)
+            t += rng.expovariate(seg.rps)
+        t0 += seg.duration_s
+    return out
+
+
+def synth_panel(rng: random.Random, n_assets: int, months: int,
+                kind: str) -> tuple:
+    """One deterministic request panel: a positive random walk (prices)
+    or positive level noise (volume), with a seeded sprinkle of masked
+    gaps so the mask path is always exercised."""
+    r = np.random.default_rng(rng.getrandbits(32))
+    if kind == "turnover":
+        values = r.lognormal(mean=12.0, sigma=0.5,
+                             size=(n_assets, months)).astype(np.float32)
+    else:
+        steps = r.normal(0.0, 0.04, size=(n_assets, months)).astype(np.float32)
+        values = 100.0 * np.exp(np.cumsum(steps, axis=1), dtype=np.float32)
+    mask = r.random((n_assets, months)) > 0.02
+    mask[:, 0] = True  # every asset observed at least once, from the start
+    values = np.where(mask, values, np.nan).astype(np.float32)
+    return values, mask
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadConfig:
+    """One load-generation run (everything the artifact must replay)."""
+
+    schedule: str = "2x40"
+    seed: int = 0
+    kinds: tuple = ENDPOINTS
+    deadline_s: float | None = 0.5
+    interactive_fraction: float = 0.7
+    max_assets: int | None = None     # default: the spec's largest bucket
+    run_id: str = "smoke"
+
+
+def _percentiles(samples: list) -> dict:
+    """Nearest-rank p50/p95/p99 in milliseconds (None when unobserved).
+
+    Nearest-rank is ``ceil(q*N) - 1`` (0-based): with N=2 the p50 is the
+    FIRST sample, with N=100 the p99 is the 99th — ``int(q*N)`` would be
+    one rank high exactly when q*N is integral, a bias that shifts with
+    sample count and would feed the regression gate noise."""
+    if not samples:
+        return {"p50": None, "p95": None, "p99": None}
+    s = sorted(samples)
+
+    def pick(q):
+        return round(1e3 * s[max(0, math.ceil(q * len(s)) - 1)], 3)
+
+    return {"p50": pick(0.50), "p95": pick(0.95), "p99": pick(0.99)}
+
+
+def run_loadgen(service: SignalService, load: LoadConfig) -> dict:
+    """Drive ``service`` with the seeded open-loop schedule; returns the
+    artifact object (not yet written).
+
+    The service must be started; it is drained and stopped before the
+    books are closed, so the accounting invariant is evaluated on a
+    quiet queue.
+    """
+    rng = random.Random(load.seed)
+    segments = parse_schedule(load.schedule)
+    offsets = arrival_offsets(segments, rng)
+    spec = service.spec
+    max_assets = min(load.max_assets or spec.max_assets, spec.max_assets)
+
+    requests = []
+    t_start = mono_now_s()
+    for off in offsets:
+        delay = (t_start + off) - mono_now_s()
+        if delay > 0:
+            time.sleep(delay)  # open loop: the schedule's clock rules
+        kind = rng.choice(list(load.kinds))
+        n_assets = rng.randint(2, max_assets)
+        values, mask = synth_panel(rng, n_assets, spec.months, kind)
+        prio = ("interactive" if rng.random() < load.interactive_fraction
+                else "batch")
+        requests.append(service.submit(kind, values, mask, priority=prio,
+                                       deadline_s=load.deadline_s))
+    # close the books: wait for every request to reach a terminal state,
+    # then drain-stop the worker
+    give_up = mono_now_s() + 30.0
+    for r in requests:
+        r.wait(timeout=max(0.0, give_up - mono_now_s()))
+    service.stop(drain=True)
+    wall_s = mono_now_s() - t_start
+    return build_artifact(service, load, requests, wall_s)
+
+
+def _platform(service: SignalService) -> str:
+    if service.engine.name == "stub":
+        return "stub"
+    import jax
+
+    return jax.default_backend()
+
+
+def build_artifact(service: SignalService, load: LoadConfig,
+                   requests: list, wall_s: float) -> dict:
+    """The SERVE artifact: headline + accounting + latency + batches."""
+    acct = service.accounting()
+    served = [r for r in requests if r.state == "served"]
+    throughput = round(acct["served"] / wall_s, 3) if wall_s > 0 else 0.0
+    lat = {
+        "queue": _percentiles(
+            [r.queue_wait_s for r in requests if r.queue_wait_s is not None]),
+        "service": _percentiles(
+            [r.service_s for r in served if r.service_s is not None]),
+        "total": _percentiles(
+            [r.total_s for r in served if r.total_s is not None]),
+    }
+    fresh = service.fresh_compiles()
+    spec = service.spec
+    workload = (
+        f"open-loop {load.schedule} rps seed {load.seed}, "
+        f"{'/'.join(load.kinds)} mix, buckets "
+        f"B({','.join(map(str, spec.batch_buckets))})x"
+        f"A({','.join(map(str, spec.asset_buckets))})x{spec.months}m "
+        f"({spec.dtype}, {service.config.engine} engine)"
+    )
+    extra = {
+        "platform": _platform(service),
+        "engine": service.config.engine,
+        "workload": workload,
+        "capacity": service.config.capacity,
+        "max_wait_ms": round(1e3 * service.config.max_wait_s, 3),
+        "warm_report": service.warm_report,
+    }
+    if service.spec.name == "serve-smoke":
+        extra["smoke"] = ("smoke-bucket run: pipeline-shaped, workload "
+                          "reduced — NOT a performance capture")
+    return {
+        "kind": "serve",
+        "schema_version": SCHEMA_VERSION,
+        "run_id": load.run_id,
+        "metric": "serve_throughput_rps",
+        "value": throughput,
+        "unit": "req/s",
+        "vs_baseline": 1.0,
+        "wall_s": round(wall_s, 4),
+        "requests": acct,
+        "latency_ms": lat,
+        "batches": service.batch_stats(),
+        "compile": {
+            "in_window_fresh_compiles": fresh,
+            "note": "backend_compiles delta since the pre-serving warmup "
+                    "snapshot: 0 = every dispatch hit a warmed bucket "
+                    "shape (the padding contract held)",
+        },
+        "offered": {
+            "schedule": load.schedule,
+            "seed": load.seed,
+            "n_arrivals": len(requests),
+            "kinds": list(load.kinds),
+            "deadline_ms": (None if load.deadline_s is None
+                            else round(1e3 * load.deadline_s, 3)),
+            "interactive_fraction": load.interactive_fraction,
+        },
+        "extra": extra,
+    }
+
+
+def write_artifact(out_dir: str, obj: dict) -> str:
+    """Atomically land ``SERVE_<run>.json``; returns the path."""
+    name = f"SERVE_{obj['run_id']}.json"
+    path = os.path.join(out_dir, name)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path

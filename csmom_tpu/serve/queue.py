@@ -1,0 +1,382 @@
+"""Bounded admission queue: backpressure, priorities, deadlines, accounting.
+
+The front door of the signal service.  Three properties the rest of the
+serve pipeline (and the chaos scenarios) build on:
+
+- **Bounded, rejecting**: the queue holds at most ``capacity`` requests.
+  A submit against a full queue is REJECTED immediately with a
+  retry-after hint derived from the observed drain rate — backpressure
+  instead of unbounded buffering, so overload degrades into fast, honest
+  rejections rather than a latency collapse followed by an OOM.
+- **Deadlines are cancellations**: every request may carry a monotonic
+  deadline; one that expires while still queued is marked ``expired``
+  and NEVER dispatched (the batcher's collect pass skips it) — scoring a
+  signal nobody is still waiting for would burn device time that live
+  requests need.  A request whose dispatch began before its deadline is
+  served even if it finishes late (the work was already spent).
+- **Closed accounting**: every request presented via :meth:`submit`
+  terminates in exactly one of ``served`` / ``rejected`` / ``expired``,
+  and the counters prove it: ``served + rejected + expired == admitted``
+  once the queue is drained (:meth:`invariant_violations` is the
+  mechanical check the rehearse scenarios and the SERVE artifact
+  validator both run).  Terminal transitions go through one guarded
+  method, so a request can never be double-counted or silently dropped —
+  even when a worker crashes mid-batch.
+
+Two priority classes (``interactive`` > ``batch``): collection always
+starts from the oldest interactive request; batch requests of the same
+endpoint fill the remaining micro-batch slots.
+
+Stdlib-only, thread-safe, and all timing through
+:func:`csmom_tpu.utils.deadline.mono_now_s` (the monotonic helper — the
+time-discipline lint pins this module wall-clock-free).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from collections import deque
+
+from csmom_tpu.utils.deadline import mono_now_s
+
+__all__ = ["AdmissionQueue", "PRIORITIES", "Request", "TERMINAL_STATES"]
+
+PRIORITIES = ("interactive", "batch")
+TERMINAL_STATES = ("served", "rejected", "expired")
+
+_IDS = itertools.count(1)
+
+
+@dataclasses.dataclass
+class Request:
+    """One scoring request and its life-cycle record.
+
+    ``values``/``mask`` are the request's panel (numpy ``[A, M]``); the
+    service pads them into a bucket shape at dispatch.  ``deadline_s`` is
+    ABSOLUTE monotonic seconds (None = no deadline).  State moves
+    ``queued -> dispatched -> served`` on the happy path, or terminates
+    early in ``rejected`` / ``expired``; ``wait()`` blocks the caller
+    until a terminal state.
+    """
+
+    kind: str
+    values: object
+    mask: object
+    n_assets: int
+    priority: str = "interactive"
+    deadline_s: float | None = None
+    req_id: int = dataclasses.field(default_factory=lambda: next(_IDS))
+    state: str = "queued"
+    result: object = None
+    error: str | None = None
+    retry_after_s: float | None = None
+    t_submit_s: float = 0.0
+    t_dispatch_s: float | None = None
+    t_done_s: float | None = None
+    _done: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False, compare=False)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the request is terminal; True iff it is."""
+        return self._done.wait(timeout)
+
+    @property
+    def queue_wait_s(self) -> float | None:
+        """Seconds spent queued before dispatch (or before early
+        termination for rejected/expired requests)."""
+        end = self.t_dispatch_s if self.t_dispatch_s is not None else self.t_done_s
+        return None if end is None else max(0.0, end - self.t_submit_s)
+
+    @property
+    def service_s(self) -> float | None:
+        """Dispatch-to-done seconds (None until served)."""
+        if self.t_dispatch_s is None or self.t_done_s is None:
+            return None
+        return max(0.0, self.t_done_s - self.t_dispatch_s)
+
+    @property
+    def total_s(self) -> float | None:
+        return (None if self.t_done_s is None
+                else max(0.0, self.t_done_s - self.t_submit_s))
+
+    def expired_at(self, now_s: float) -> bool:
+        return self.deadline_s is not None and now_s > self.deadline_s
+
+
+class AdmissionQueue:
+    """Bounded two-priority FIFO with deadline cancellation.
+
+    ``admitted`` counts every request PRESENTED via submit (the
+    accounting denominator): a queue-full rejection is a presented
+    request that terminated in ``rejected``, so the invariant
+    ``served + rejected + expired == admitted`` closes over backpressure
+    too — nothing the caller ever handed us can vanish from the ledger.
+    """
+
+    def __init__(self, capacity: int = 64):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._queues = {p: deque() for p in PRIORITIES}
+        # accounting counters (see invariant_violations)
+        self.admitted = 0
+        self.served = 0
+        self.rejected = 0
+        self.expired = 0
+        self.rejected_queue_full = 0
+        self.rejected_worker_crash = 0
+        self.rejected_unserveable = 0
+        # requests dispatched AFTER their deadline had already passed —
+        # structurally 0 (collect cancels first); the counter exists so
+        # the artifact can CLAIM it, not hope it
+        self.expired_dispatched = 0
+        # EMA of per-request service seconds, feeding the retry-after hint
+        self._ema_per_req_s: float | None = None
+
+    # ------------------------------------------------------------- admit --
+
+    def submit(self, req: Request) -> Request:
+        """Admit or reject ``req``; returns it either way (terminal state
+        and ``retry_after_s`` set on rejection)."""
+        if req.priority not in PRIORITIES:
+            raise ValueError(f"unknown priority {req.priority!r}")
+        from csmom_tpu.chaos.inject import checkpoint
+        from csmom_tpu.obs import metrics
+
+        req.t_submit_s = mono_now_s()
+        checkpoint("serve.admit", kind=req.kind, priority=req.priority)
+        with self._lock:
+            self.admitted += 1
+            if self._depth_locked() >= self.capacity:
+                self.rejected += 1
+                self.rejected_queue_full += 1
+                req.retry_after_s = self._retry_after_locked()
+                self._terminate_locked(
+                    req, "rejected",
+                    error=f"queue full ({self.capacity} queued); retry after "
+                          f"~{req.retry_after_s:.3f}s",
+                )
+                metrics.counter("serve.rejected_queue_full").inc()
+                return req
+            self._queues[req.priority].append(req)
+            metrics.gauge("serve.queue_depth").set(self._depth_locked())
+            self._nonempty.notify()
+        return req
+
+    def _retry_after_locked(self) -> float:
+        # drain-rate estimate: depth * observed per-request service time
+        # (floored so a cold queue still hints SOMETHING actionable)
+        per_req = self._ema_per_req_s if self._ema_per_req_s else 0.005
+        return max(0.001, self._depth_locked() * per_req)
+
+    # ------------------------------------------------------------ collect --
+
+    def _depth_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def depth(self) -> int:
+        with self._lock:
+            return self._depth_locked()
+
+    def _expire_locked(self, now_s: float) -> None:
+        """Cancel every queued request whose deadline has passed — BEFORE
+        any of them can be gathered into a micro-batch."""
+        from csmom_tpu.obs import metrics
+
+        for q in self._queues.values():
+            live = [r for r in q if not r.expired_at(now_s)]
+            if len(live) != len(q):
+                for r in q:
+                    if r.expired_at(now_s):
+                        self.expired += 1
+                        self._terminate_locked(
+                            r, "expired",
+                            error="deadline expired while queued "
+                                  "(never dispatched)",
+                        )
+                        metrics.counter("serve.expired").inc()
+                q.clear()
+                q.extend(live)
+
+    def collect(self, max_n: int, window_s: float,
+                stop: threading.Event) -> list:
+        """Gather up to ``max_n`` same-endpoint requests for one
+        micro-batch, waiting at most ``window_s`` past the first arrival
+        for co-batchable company.
+
+        Blocks until at least one live request exists (or ``stop`` is
+        set, returning ``[]``).  Selection: the oldest request of the
+        highest non-empty priority fixes the endpoint; remaining slots
+        fill with same-endpoint requests, interactive first.  Expired
+        requests are cancelled here and never returned.
+        """
+        deadline = None
+        while not stop.is_set():
+            with self._lock:
+                self._expire_locked(mono_now_s())
+                first = self._peek_locked()
+                if first is not None:
+                    if deadline is None:
+                        deadline = mono_now_s() + window_s
+                    if (self._count_kind_locked(first.kind) >= max_n
+                            or mono_now_s() >= deadline):
+                        return self._take_locked(first.kind, max_n)
+                    # capped wait: queued deadlines may expire before the
+                    # coalescing window closes, so re-sweep periodically
+                    self._nonempty.wait(
+                        timeout=max(min(deadline - mono_now_s(), 0.05),
+                                    0.001))
+                else:
+                    # empty queue: nothing to sweep, nothing to coalesce —
+                    # block until a submit notifies (or stop() wakes us);
+                    # an idle service must not spin.  The stop re-check
+                    # HOLDS THE LOCK: stop() sets the event before wake()
+                    # can acquire it, so a stop that completed between the
+                    # loop-top check and here is seen now instead of its
+                    # notify being lost to a waiter that hadn't waited yet
+                    deadline = None
+                    if stop.is_set():
+                        return []
+                    self._nonempty.wait()
+        return []
+
+    def _peek_locked(self):
+        for p in PRIORITIES:
+            if self._queues[p]:
+                return self._queues[p][0]
+        return None
+
+    def _count_kind_locked(self, kind: str) -> int:
+        return sum(1 for q in self._queues.values() for r in q
+                   if r.kind == kind)
+
+    def _take_locked(self, kind: str, max_n: int) -> list:
+        from csmom_tpu.obs import metrics
+
+        out: list = []
+        for p in PRIORITIES:
+            q = self._queues[p]
+            keep = deque()
+            while q:
+                r = q.popleft()
+                if r.kind == kind and len(out) < max_n:
+                    out.append(r)
+                else:
+                    keep.append(r)
+            self._queues[p] = keep
+        metrics.gauge("serve.queue_depth").set(self._depth_locked())
+        return out
+
+    # ----------------------------------------------------------- terminal --
+
+    def _terminate_locked(self, req: Request, state: str,
+                          result=None, error: str | None = None) -> bool:
+        if req.state in TERMINAL_STATES:
+            return False  # exactly-once: a terminal request never moves
+        req.state = state
+        req.result = result
+        if error is not None:
+            req.error = error
+        req.t_done_s = mono_now_s()
+        req._done.set()
+        return True
+
+    def finish_expired(self, req: Request,
+                       error: str = "deadline expired while queued "
+                                    "(never dispatched)") -> None:
+        """Expire a request OUTSIDE the collect sweep — the dispatch
+        boundary's last-instant check (a deadline can pass in the gap
+        between collection and dispatch; the contract is enforced at the
+        boundary, not hoped about)."""
+        with self._lock:
+            if self._terminate_locked(req, "expired", error=error):
+                self.expired += 1
+
+    def mark_dispatched(self, req: Request, now_s: float) -> None:
+        with self._lock:
+            req.state = "dispatched"
+            req.t_dispatch_s = now_s
+            if req.expired_at(now_s):
+                # structurally unreachable (collect sweeps, then the
+                # dispatch boundary re-checks); counted so the artifact's
+                # expired_dispatched == 0 is a measurement, not a hope
+                self.expired_dispatched += 1
+
+    def finish_served(self, req: Request, result) -> None:
+        with self._lock:
+            if self._terminate_locked(req, "served", result=result):
+                self.served += 1
+                if req.service_s is not None:
+                    ema = self._ema_per_req_s
+                    self._ema_per_req_s = (
+                        req.service_s if ema is None
+                        else 0.8 * ema + 0.2 * req.service_s)
+
+    def reject_at_door(self, req: Request, error: str) -> None:
+        """Present-and-reject in one step (unserveable shape/endpoint):
+        the request still counts toward ``admitted`` so the accounting
+        equation closes over door rejections too."""
+        with self._lock:
+            self.admitted += 1
+            req.t_submit_s = mono_now_s()
+            if self._terminate_locked(req, "rejected", error=error):
+                self.rejected += 1
+                self.rejected_unserveable += 1
+
+    def finish_rejected(self, req: Request, error: str,
+                        worker_crash: bool = False) -> None:
+        with self._lock:
+            if self._terminate_locked(req, "rejected", error=error):
+                self.rejected += 1
+                if worker_crash:
+                    self.rejected_worker_crash += 1
+                else:
+                    self.rejected_unserveable += 1
+
+    # --------------------------------------------------------- accounting --
+
+    def wake(self) -> None:
+        """Nudge a collect() blocked on the condition (shutdown path)."""
+        with self._lock:
+            self._nonempty.notify_all()
+
+    def accounting(self) -> dict:
+        with self._lock:
+            return {
+                "admitted": self.admitted,
+                "served": self.served,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "expired_dispatched": self.expired_dispatched,
+                "rejected_queue_full": self.rejected_queue_full,
+                "rejected_worker_crash": self.rejected_worker_crash,
+                "rejected_unserveable": self.rejected_unserveable,
+                "in_queue": self._depth_locked(),
+            }
+
+    def invariant_violations(self) -> list:
+        """The closed-accounting check (empty = holds).  Valid once the
+        queue is drained: every admitted request must sit in exactly one
+        terminal bucket."""
+        a = self.accounting()
+        out = []
+        if a["in_queue"]:
+            out.append(f"queue not drained: {a['in_queue']} still queued")
+        total = a["served"] + a["rejected"] + a["expired"]
+        if total != a["admitted"]:
+            out.append(
+                f"request accounting broken: served {a['served']} + "
+                f"rejected {a['rejected']} + expired {a['expired']} = "
+                f"{total} != admitted {a['admitted']}"
+            )
+        if a["expired_dispatched"]:
+            out.append(
+                f"{a['expired_dispatched']} request(s) dispatched after "
+                "their deadline — expiry-while-queued must cancel, "
+                "never dispatch"
+            )
+        return out

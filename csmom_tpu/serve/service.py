@@ -1,0 +1,257 @@
+"""The signal service: admission -> coalesce -> dispatch, supervised.
+
+One worker thread drives the pipeline: it blocks on the batcher for the
+next padded micro-batch, dispatches it through the engine as a single
+compiled call, and fans results back out to the batch's requests.  The
+design decisions that matter:
+
+- **Warm before ready**: ``start()`` executes every (endpoint, bucket)
+  shape once (``engine.warm``) and only then opens the queue, so the
+  first real request never pays a compile; everything after the warmup
+  snapshot counts toward ``in_window_fresh_compiles``.
+- **Deadlines cancel, never dispatch**: expiry-while-queued is handled
+  in the queue's collect pass (the request is terminal before a batch
+  can include it); ``expired_dispatched`` stays 0 structurally and the
+  SERVE artifact validator enforces it stays 0 forever.
+- **A worker crash is a terminal outcome, not a leak**: the dispatch is
+  wrapped so ANY failure (including the chaos ``fail`` fault at the
+  ``serve.dispatch`` checkpoint, the rehearsed worker-kill) terminates
+  the batch's in-flight requests as ``rejected`` with the crash as the
+  reason — the accounting invariant holds and the loop continues with
+  the next batch, so the remaining queue drains.  Requests are never
+  silently dropped: every admitted request ends served/rejected/expired.
+
+Chaos checkpoints (``serve.admit`` lives in queue.submit):
+
+=================  ====================================  ===============
+name               site                                  typical faults
+=================  ====================================  ===============
+serve.admit        queue.submit, before admission        sleep
+serve.coalesce     batcher, after gathering a batch      sleep
+serve.dispatch     worker, before the engine call        fail, sleep
+=================  ====================================  ===============
+
+Obs wiring (zero-cost disarmed, like everything else): queue-depth
+gauge, batch-size / queue-wait / service-wall histograms, served /
+rejected / expired counters, ``serve.dispatch`` spans (phase ``row``) on
+the run timeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import numpy as np
+
+from csmom_tpu.serve.batcher import Batcher, Microbatch
+from csmom_tpu.serve.buckets import ENDPOINTS, bucket_spec
+from csmom_tpu.serve.engine import make_engine
+from csmom_tpu.serve.queue import AdmissionQueue, Request
+from csmom_tpu.utils.deadline import mono_now_s
+
+__all__ = ["ServeConfig", "SignalService"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Service parameters (defaults = the production bucket grid)."""
+
+    profile: str = "serve"            # buckets.PROFILES key
+    engine: str = "jax"               # "jax" | "stub"
+    capacity: int = 64                # admission-queue bound
+    max_wait_s: float = 0.010         # coalescing window
+    default_deadline_s: float | None = 0.5   # per-request, None = none
+    lookback: int = 12
+    skip: int = 1
+    n_bins: int = 10
+    mode: str = "rank"                # serve uses the fast ordinal rank
+
+
+class SignalService:
+    """In-process micro-batching signal-scoring service."""
+
+    def __init__(self, config: ServeConfig | None = None):
+        self.config = config or ServeConfig()
+        self.spec = bucket_spec(self.config.profile)
+        self.queue = AdmissionQueue(capacity=self.config.capacity)
+        self.batcher = Batcher(self.spec, max_wait_s=self.config.max_wait_s)
+        self.engine = make_engine(
+            self.config.engine, lookback=self.config.lookback,
+            skip=self.config.skip, n_bins=self.config.n_bins,
+            mode=self.config.mode)
+        self._stop = threading.Event()
+        self._worker: threading.Thread | None = None
+        self.warm_report: dict | None = None
+        self.n_batches = 0
+        self.batch_size_hist: dict = {}
+        self._pad_lanes = 0
+        self._used_lanes = 0
+        self._state_lock = threading.Lock()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "SignalService":
+        if self._worker is not None:
+            raise RuntimeError("service already started")
+        self.warm_report = self.engine.warm(self.spec)
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="csmom-serve-worker", daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop the worker; with ``drain`` (default) first wait until the
+        queue is empty so every admitted request reaches a terminal
+        state — the accounting invariant is checked on a drained queue."""
+        give_up = mono_now_s() + timeout_s
+        if drain:
+            while self.queue.depth() and mono_now_s() < give_up:
+                self._stop.wait(0.01)
+        self._stop.set()
+        self.queue.wake()
+        if self._worker is not None:
+            self._worker.join(timeout=max(0.1, give_up - mono_now_s()))
+
+    # --------------------------------------------------------------- submit
+
+    def submit(self, kind: str, values, mask, priority: str = "interactive",
+               deadline_s: float | None = None) -> Request:
+        """Submit one scoring request (panel ``[A, months]``).
+
+        ``deadline_s`` is RELATIVE seconds from now (None = the config
+        default).  Returns the request handle; an unserveable request
+        (unknown endpoint, too many assets, wrong month count) is
+        rejected at the door — terminal immediately, counted, never
+        queued behind work it can only fail.
+        """
+        values = np.asarray(values)
+        mask = np.asarray(mask, dtype=bool)
+        n_assets = int(values.shape[0]) if values.ndim == 2 else 0
+        rel = (self.config.default_deadline_s if deadline_s is None
+               else deadline_s)
+        req = Request(
+            kind=kind, values=values, mask=mask, n_assets=n_assets,
+            priority=priority,
+            deadline_s=None if rel is None else mono_now_s() + rel,
+        )
+        reason = self._unserveable_reason(kind, values, mask)
+        if reason is not None:
+            self.queue.reject_at_door(req, reason)
+            return req
+        return self.queue.submit(req)
+
+    def _unserveable_reason(self, kind: str, values, mask) -> str | None:
+        if kind not in ENDPOINTS:
+            return f"unknown endpoint {kind!r} (serveable: {ENDPOINTS})"
+        if values.ndim != 2:
+            return f"panel must be [assets, months], got ndim={values.ndim}"
+        if values.shape[1] != self.spec.months:
+            return (f"panel has {values.shape[1]} months; this service "
+                    f"scores {self.spec.months}-month histories "
+                    f"(bucket profile {self.spec.name!r})")
+        if self.spec.asset_bucket_for(values.shape[0]) is None:
+            return (f"{values.shape[0]} assets exceeds the largest bucket "
+                    f"({self.spec.max_assets}); split the universe or use "
+                    "a larger bucket profile")
+        if mask.shape != values.shape:
+            # a malformed mask must fail AT THE DOOR: past it, the padder
+            # would raise inside the worker thread instead
+            return (f"mask shape {mask.shape} does not match the values "
+                    f"panel {values.shape}")
+        return None
+
+    # --------------------------------------------------------------- worker
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            mb = self.batcher.next_batch(self.queue, self._stop)
+            if mb is None:
+                continue
+            self._dispatch(mb)
+
+    def _dispatch(self, mb: Microbatch) -> None:
+        from csmom_tpu.chaos.inject import checkpoint
+        from csmom_tpu.obs import metrics, span
+
+        # last-instant deadline check AT the dispatch boundary: the queue's
+        # collect pass sweeps expiry too, but a deadline can land in the
+        # gap between collection and here — the "expired is never
+        # dispatched" contract is enforced where dispatch actually begins
+        now = mono_now_s()
+        live = []                    # (batch row, request) actually dispatched
+        for b, r in enumerate(mb.requests):
+            if r.expired_at(now):
+                self.queue.finish_expired(
+                    r, error="deadline expired between collection and "
+                             "dispatch (never dispatched)")
+                metrics.counter("serve.expired").inc()
+            else:
+                self.queue.mark_dispatched(r, now)
+                live.append((b, r))
+        if not live:
+            return  # the whole gathered batch expired: nothing to dispatch
+        fired = checkpoint("serve.dispatch", kind=mb.kind,
+                           n=len(live), bucket=f"{mb.batch_bucket}x"
+                           f"{mb.asset_bucket}x{self.spec.months}")
+        try:
+            if fired == "fail":
+                raise RuntimeError(
+                    "injected worker crash (chaos 'fail' at serve.dispatch)")
+            with span("serve.dispatch", phase="row", kind=mb.kind,
+                      b=mb.batch_bucket, a=mb.asset_bucket) as sp:
+                out = self.engine.score(mb.kind, mb.values, mb.mask)
+                sp.set(n=len(live))
+            for b, r in live:
+                if mb.kind == "backtest":
+                    res = {"mean_spread": float(out[b, 0]),
+                           "ann_sharpe": float(out[b, 1])}
+                else:
+                    res = np.array(out[b, :r.n_assets])
+                self.queue.finish_served(r, res)
+                metrics.counter("serve.served").inc()
+                if r.queue_wait_s is not None:
+                    metrics.histogram("serve.queue_wait_s").observe(
+                        r.queue_wait_s)
+                if r.service_s is not None:
+                    metrics.histogram("serve.service_s").observe(r.service_s)
+        except Exception as e:  # worker crash: terminate, keep draining
+            metrics.counter("serve.worker_crashes").inc()
+            reason = (f"worker crashed mid-batch "
+                      f"({type(e).__name__}: {e})"[:200])
+            for _, r in live:
+                self.queue.finish_rejected(r, reason, worker_crash=True)
+        finally:
+            used = sum(r.n_assets for _, r in live)
+            with self._state_lock:
+                self.n_batches += 1
+                k = str(len(live))
+                self.batch_size_hist[k] = self.batch_size_hist.get(k, 0) + 1
+                self._used_lanes += used
+                self._pad_lanes += mb.batch_bucket * mb.asset_bucket - used
+            metrics.histogram("serve.batch_size").observe(len(live))
+
+    # ------------------------------------------------------------ reporting
+
+    def batch_stats(self) -> dict:
+        with self._state_lock:
+            total = self._used_lanes + self._pad_lanes
+            sizes = sum(int(k) * v for k, v in self.batch_size_hist.items())
+            return {
+                "count": self.n_batches,
+                "size_hist": dict(sorted(self.batch_size_hist.items(),
+                                         key=lambda kv: int(kv[0]))),
+                "mean_size": (round(sizes / self.n_batches, 3)
+                              if self.n_batches else None),
+                "pad_fraction": (round(self._pad_lanes / total, 4)
+                                 if total else None),
+            }
+
+    def accounting(self) -> dict:
+        return self.queue.accounting()
+
+    def invariant_violations(self) -> list:
+        return self.queue.invariant_violations()
+
+    def fresh_compiles(self):
+        return self.engine.fresh_compiles()

@@ -44,8 +44,21 @@ import threading
 import time
 from typing import Callable, Optional
 
-__all__ = ["deadline_guard", "file_age_s", "marker_fresh",
+__all__ = ["deadline_guard", "file_age_s", "marker_fresh", "mono_now_s",
            "trip_active_guard", "wall_now_s"]
+
+
+def mono_now_s() -> float:
+    """Current monotonic seconds — THE clock for durations and deadlines.
+
+    The serve/ queue and batcher (and any future timing path) read time
+    through this helper instead of calling ``time.monotonic()`` inline,
+    so the time-discipline lint can pin whole modules to "all timing goes
+    through utils.deadline" the same way it pins the wall clock: one
+    documented home, grep-enforceable, skew-proof by construction (a
+    chaos ``clock_skew`` fault perturbs ``time.time`` only).
+    """
+    return time.monotonic()
 
 
 # -- skew-resistant wall-clock helpers ---------------------------------------

@@ -468,12 +468,19 @@ def test_bool_peak_bytes_rejected_everywhere():
 def test_histrank_multihost_records_are_info_never_gated():
     """Record-SHAPED captures outside the BENCH family (comm ratios,
     equality claims) ride as info rows: visible, never gate-eligible,
-    never the gate's default candidate."""
+    never the gate's default candidate.  SERVE rows are the deliberate
+    exception: the serve family has its own schema + known directions
+    (throughput up, latency down), so its unflagged rows DO gate."""
     L = ld.load(_REPO)
     other = [r for r in L.rows
-             if not r.source.startswith(("BENCH", "TELEMETRY"))]
+             if not r.source.startswith(("BENCH", "TELEMETRY", "SERVE"))]
     assert other, "committed HISTRANK/MULTIHOST should yield info rows"
     assert all("info" in r.flags and not r.gate_eligible() for r in other)
+    serve = [r for r in L.rows if r.source.startswith("SERVE")]
+    assert serve, "the committed SERVE_r10.json should yield rows"
+    assert any(r.gate_eligible() for r in serve), (
+        "unflagged serve rows must be gate-eligible — that is the point "
+        "of ingesting them")
 
 
 def test_top_level_partial_marker_flags_rows(tmp_path):

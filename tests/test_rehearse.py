@@ -33,12 +33,18 @@ def _run_cli(args, timeout):
 
 def test_fast_tier_is_small_and_capture_path_only():
     fast = builtin_matrix(fast=True)
-    assert 1 <= len(fast) <= 3, "the fast tier must stay <= 3 faults"
-    assert all(s.pipeline in ("mini", "shell") for s in fast), (
+    assert 1 <= len(fast) <= 5, "the fast tier must stay <= 5 faults"
+    # mini/shell run as jax-free subprocesses; serve runs IN-PROCESS on
+    # the stub engine — none may need a jax-importing rehearsed pipeline
+    assert all(s.pipeline in ("mini", "shell", "serve") for s in fast), (
         "fast-tier scenarios must not need jax-importing pipelines"
     )
     # the r4/r5 family (deadline loses measured rows) must be represented
-    assert any("deadline" in s.name for s in fast)
+    assert any("deadline" in s.name and s.pipeline == "mini" for s in fast)
+    # ISSUE 5: both serve degradation scenarios ride in the fast tier
+    serve = [s.name for s in fast if s.pipeline == "serve"]
+    assert any("worker-kill" in n for n in serve), serve
+    assert any("deadline-storm" in n for n in serve), serve
 
 
 def test_rehearse_fast_runs_green_and_quick():

@@ -91,13 +91,14 @@ def test_deadline_helpers_are_the_documented_wall_clock_home():
 # --------------------------- committed telemetry sidecars (same tier) ----
 
 def test_telemetry_pattern_is_in_the_tier1_artifact_sweep():
-    """TELEMETRY_*.json validates in the SAME tree sweep as BENCH_*/
-    MULTICHIP_* (test_chaos.test_every_committed_artifact_validates runs
-    it); pin that the pattern stays in the default sweep."""
+    """TELEMETRY_*.json and SERVE_*.json validate in the SAME tree sweep
+    as BENCH_*/MULTICHIP_* (test_chaos.test_every_committed_artifact_
+    validates runs it); pin that the patterns stay in the default sweep."""
     import inspect
 
     sig = inspect.signature(inv.validate_tree)
     assert "TELEMETRY_*.json" in sig.parameters["patterns"].default
+    assert "SERVE_*.json" in sig.parameters["patterns"].default
 
 
 def test_committed_telemetry_sidecars_validate():
@@ -108,19 +109,21 @@ def test_committed_telemetry_sidecars_validate():
 
 
 def test_only_round_sidecars_are_committed():
-    """ISSUE 4 satellite: TELEMETRY_rehearse_*.json once sat at the repo
-    root despite the gitignore declaring rehearse sidecars scratch.  The
-    rule is now code (invariants.committable_sidecar): only round
-    sidecars (TELEMETRY_rNN.json) may be tracked.  Checked against git's
-    own index so an ignored-but-present scratch file (tier-1 rehearse
-    runs regenerate them in cwd) never false-positives."""
+    """ISSUE 4 satellite (extended to SERVE by ISSUE 5):
+    TELEMETRY_rehearse_*.json once sat at the repo root despite the
+    gitignore declaring rehearse sidecars scratch.  The rule is code
+    (invariants.committable_sidecar): only round artifacts
+    (TELEMETRY_rNN.json / SERVE_rNN.json) may be tracked.  Checked
+    against git's own index so an ignored-but-present scratch file
+    (tier-1 rehearse/loadgen runs regenerate them in cwd) never
+    false-positives."""
     import subprocess
     import sys
 
     try:
         p = subprocess.run(
-            ["git", "ls-files", "TELEMETRY_*.json"], cwd=_REPO,
-            capture_output=True, text=True, timeout=30,
+            ["git", "ls-files", "TELEMETRY_*.json", "SERVE_*.json"],
+            cwd=_REPO, capture_output=True, text=True, timeout=30,
         )
     except (OSError, subprocess.TimeoutExpired) as e:  # no git in image
         import pytest
@@ -133,14 +136,61 @@ def test_only_round_sidecars_are_committed():
     tracked = [ln.strip() for ln in p.stdout.splitlines() if ln.strip()]
     offenders = [t for t in tracked if not inv.committable_sidecar(t)]
     assert offenders == [], (
-        f"non-round telemetry sidecars committed: {offenders} — rehearse/"
-        "scratch sidecars are regenerated per run and must stay out of "
-        "the tree (round evidence is TELEMETRY_rNN.json only)"
+        f"non-round telemetry/serve artifacts committed: {offenders} — "
+        "rehearse/smoke/scratch files are regenerated per run and must "
+        "stay out of the tree (round evidence is TELEMETRY_rNN.json / "
+        "SERVE_rNN.json only)"
     )
     # the rule itself stays strict
     assert inv.committable_sidecar("TELEMETRY_r06.json")
     assert not inv.committable_sidecar("TELEMETRY_rehearse_fast.json")
     assert not inv.committable_sidecar("TELEMETRY_r06-1234.json")
+    assert inv.committable_sidecar("SERVE_r10.json")
+    assert not inv.committable_sidecar("SERVE_smoke.json")
+    assert not inv.committable_sidecar("SERVE_rehearse_x.json")
+    assert not inv.committable_sidecar("SERVE_r10-999.json")
+    # other families are not this rule's business
+    assert inv.committable_sidecar("BENCH_r04.json")
+
+
+def test_serve_modules_route_all_timing_through_deadline_helpers():
+    """ISSUE 5 satellite: the serve layer's deadlines/latencies must be
+    monotonic AND single-sourced — no bare wall clock (the global lint
+    covers that) and no inline ``time.monotonic()`` either: every clock
+    read goes through utils.deadline.mono_now_s, so the clock the queue
+    expires on is the clock the artifact's latencies are measured on, by
+    construction.  engine.py is exempt from the monotonic pin only where
+    it has no timing at all (checked: zero matches required there too)."""
+    mono = re.compile(r"time\.monotonic\(\)")
+    serve_modules = (
+        "csmom_tpu/serve/__init__.py",
+        "csmom_tpu/serve/buckets.py",
+        "csmom_tpu/serve/queue.py",
+        "csmom_tpu/serve/batcher.py",
+        "csmom_tpu/serve/engine.py",
+        "csmom_tpu/serve/service.py",
+        "csmom_tpu/serve/loadgen.py",
+        "csmom_tpu/cli/serve.py",
+    )
+    for rel in serve_modules:
+        path = os.path.join(_REPO, rel)
+        assert os.path.exists(path), rel
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        n_wall = len(_WALL_CLOCK.findall(src)) + len(_ARGLESS_NOW.findall(src))
+        assert n_wall == 0, f"{rel}: {n_wall} bare wall-clock call(s)"
+        assert rel not in _ALLOWLIST, (
+            f"{rel} must not be allowlisted: serve deadlines are "
+            "monotonic by contract"
+        )
+        n_mono = len(mono.findall(src))
+        assert n_mono == 0, (
+            f"{rel}: {n_mono} inline time.monotonic() call(s) — serve "
+            "timing goes through utils.deadline.mono_now_s"
+        )
+    from csmom_tpu.utils.deadline import mono_now_s
+
+    assert mono_now_s() <= mono_now_s()  # monotone, and the helper exists
 
 
 def test_perf_ledger_modules_stay_wall_clock_free():
