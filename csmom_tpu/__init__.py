@@ -34,4 +34,17 @@ global collective (all_gather) and ``psum`` for portfolio reductions.
 
 __version__ = "0.1.0"
 
-from csmom_tpu.panel.panel import Panel  # noqa: F401
+
+def __getattr__(name):
+    # Lazy re-export (PEP 562): the eager `from csmom_tpu.panel.panel
+    # import Panel` pulled jax + pandas (~2.3 s) into EVERY process that
+    # touches the package — including pool worker spawns (the serving
+    # tier pays it per worker, per restart, per roll) and jax-free CLI
+    # paths.  Resolving Panel on first attribute access keeps the
+    # package import near-free; `from csmom_tpu import Panel` still
+    # works unchanged.
+    if name == "Panel":
+        from csmom_tpu.panel.panel import Panel
+
+        return Panel
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

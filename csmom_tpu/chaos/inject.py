@@ -37,15 +37,22 @@ mini.row             chaos.minibench, before each measured row   any (fast tier)
 mini.finish          chaos.minibench, before the trailing JSON   stdout_noise
 serve.admit          serve queue, before admission               sleep
 serve.coalesce       serve batcher, after gathering a batch      sleep
-serve.dispatch       serve worker, before the engine call        fail, sleep
+serve.dispatch       serve worker, before the engine call        fail, sleep, kill
+pool.route           pool router, at request admission           sleep
+pool.hedge           pool router, when a hedge fires             sleep
+pool.spawn           pool supervisor, before spawning a worker   sleep
 ===================  =========================================  ==========
 
-The ``serve.*`` points run in the signal service's own threads (the
-serve subsystem is in-process by design), so process-fatal actions
-(kill/exit) take the whole service down; the rehearsed worker-crash
-fault is the ``fail`` action at ``serve.dispatch``, which the worker
-loop treats as a crash of that dispatch — its batch terminates
-``rejected`` and the queue stays drainable.
+The ``serve.*`` points run in the signal service's own threads.  In the
+SINGLE-process service, process-fatal actions (kill/exit) take the whole
+service down, so the rehearsed in-process worker-crash fault is the
+``fail`` action at ``serve.dispatch`` (the batch terminates ``rejected``
+and the queue stays drainable).  In the POOL, each worker is its own
+process that inherits the fault plan from the supervisor, so a ``kill``
+at ``serve.dispatch`` is a REAL worker-process death mid-batch — pair it
+with ``global_once`` so exactly one worker in the fleet dies; the
+router's hedged retries and the supervisor's backoff restart are what
+the scenario then measures.
 """
 
 from __future__ import annotations

@@ -45,6 +45,15 @@ Artifact kinds (detected from keys, see :func:`detect_kind`):
     request ledger does not balance (a silently dropped request, an
     expired request that was dispatched anyway) is invalid evidence,
     full stop.
+``serve_pool``
+    A multi-process pool load record (``SERVE_POOL_*.json``, the
+    router/worker/supervisor tier): the serve closed-book rule enforced
+    ACROSS the process boundary — the router's
+    ``served + rejected + expired == admitted`` must balance no matter
+    which worker crashed mid-batch — plus hedging consistency (a hedge
+    pair that both answered counts exactly one terminal state and one
+    ``duplicates_suppressed``; suppressed/wins can never exceed hedges)
+    and an ``availability`` that reconciles with ``rejected_infra``.
 
 Partial rules: a partial artifact carries ``extra.partial`` (non-empty
 string saying *what* is missing); a partial with a measurement list
@@ -85,14 +94,19 @@ KNOWN_TELEMETRY_SCHEMA_VERSIONS = (1,)
 # — the same closed-world rule as telemetry
 KNOWN_SERVE_SCHEMA_VERSIONS = (1,)
 
-# only ROUND sidecars are committed evidence: TELEMETRY_r<NN>.json and
-# SERVE_r<NN>.json.  Rehearse/smoke/scratch files (TELEMETRY_rehearse_*,
-# SERVE_smoke*, pid-suffixed operator reruns) are regenerated per run
-# and gitignored — one slipped into the tree once, which is why this is
-# a named rule with a tier-1 test behind it instead of a .gitignore
-# comment.
+# serve-pool artifact schema versions (SERVE_POOL_*.json, the
+# multi-process tier) — closed-world like the rest
+KNOWN_SERVE_POOL_SCHEMA_VERSIONS = (1,)
+
+# only ROUND sidecars are committed evidence: TELEMETRY_r<NN>.json,
+# SERVE_r<NN>.json, and SERVE_POOL_r<NN>.json.  Rehearse/smoke/scratch
+# files (TELEMETRY_rehearse_*, SERVE_smoke*, SERVE_POOL_rehearse_*,
+# pid-suffixed operator reruns) are regenerated per run and gitignored —
+# one slipped into the tree once, which is why this is a named rule with
+# a tier-1 test behind it instead of a .gitignore comment.
 _REGENERATED_PREFIXES = ("TELEMETRY_", "SERVE_")
-_COMMITTED_SIDECAR_RE = re.compile(r"^(?:TELEMETRY|SERVE)_r\d+\.json$")
+_COMMITTED_SIDECAR_RE = re.compile(
+    r"^(?:TELEMETRY|SERVE|SERVE_POOL)_r\d+\.json$")
 
 _NUM = (int, float)
 
@@ -124,7 +138,11 @@ def trailing_json(text: str):
 def detect_kind(obj: dict) -> str | None:
     if not isinstance(obj, dict):
         return None
-    # serve before record: a SERVE artifact carries metric/value too
+    # pool before serve, serve before record: each carries the previous
+    # kind's key signature plus its own
+    if obj.get("kind") == "serve_pool" or {"requests", "availability",
+                                           "hedge"} <= set(obj):
+        return "serve_pool"
     if obj.get("kind") == "serve" or {"requests", "latency_ms",
                                       "batches"} <= set(obj):
         return "serve"
@@ -474,9 +492,141 @@ def _validate_serve(obj: dict) -> list:
     return out
 
 
+def _validate_latency_side(side, leg: str, kind: str, out: list) -> None:
+    """Shared percentile rules: numbers-or-null, non-decreasing."""
+    if not isinstance(side, dict):
+        out.append(f"{kind}: latency_ms.{leg} must be a dict of "
+                   "p50/p95/p99")
+        return
+    vals = []
+    for q in ("p50", "p95", "p99"):
+        v = side.get(q)
+        if v is None:
+            continue
+        if not isinstance(v, _NUM) or isinstance(v, bool):
+            out.append(f"{kind}: latency_ms.{leg}.{q} must be a number "
+                       "(milliseconds) or null")
+        else:
+            vals.append(v)
+    if vals != sorted(vals):
+        out.append(f"{kind}: latency_ms.{leg} percentiles must be "
+                   "non-decreasing (p50 <= p95 <= p99)")
+
+
+def _validate_serve_pool(obj: dict) -> list:
+    """The pool artifact contract: the closed request book ACROSS the
+    process boundary, exactly-once hedging arithmetic, and an
+    availability figure that reconciles with its own counters."""
+    out: list = []
+    _require(obj, "run_id", str, "serve_pool", out)
+    ver = _require(obj, "schema_version", int, "serve_pool", out)
+    if ver is not None and ver not in KNOWN_SERVE_POOL_SCHEMA_VERSIONS:
+        out.append(
+            f"serve_pool: unknown schema_version {ver} (this checker "
+            f"understands {list(KNOWN_SERVE_POOL_SCHEMA_VERSIONS)}) — the "
+            "artifact is from a different era of the code; do not "
+            "half-parse it"
+        )
+    _require(obj, "wall_s", _NUM, "serve_pool", out, "a number")
+    out += _validate_record(obj, kind="serve_pool")
+
+    req = _require(obj, "requests", dict, "serve_pool", out)
+    if req is not None:
+        for k in ("admitted", "served", "rejected", "expired",
+                  "rejected_infra", "hedged", "hedge_wins",
+                  "duplicates_suppressed"):
+            v = req.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"serve_pool: requests.{k} must be a "
+                           "non-negative int (the accounting is the "
+                           "contract)")
+                req = None
+                break
+    if req is not None:
+        total = req["served"] + req["rejected"] + req["expired"]
+        if total != req["admitted"]:
+            out.append(
+                f"serve_pool: request accounting broken across the "
+                f"process boundary — served {req['served']} + rejected "
+                f"{req['rejected']} + expired {req['expired']} = {total} "
+                f"!= admitted {req['admitted']} (a request was dropped "
+                "or double-counted between router and workers)"
+            )
+        if req["rejected_infra"] > req["rejected"]:
+            out.append("serve_pool: rejected_infra exceeds rejected")
+        if req["hedge_wins"] > req["hedged"]:
+            out.append(
+                f"serve_pool: hedge_wins {req['hedge_wins']} > hedged "
+                f"{req['hedged']}")
+        if req["duplicates_suppressed"] > req["hedged"]:
+            out.append(
+                f"serve_pool: duplicates_suppressed "
+                f"{req['duplicates_suppressed']} > hedged {req['hedged']}"
+                " — a duplicate terminal without a hedge means "
+                "exactly-once broke"
+            )
+
+    avail = _require(obj, "availability", _NUM, "serve_pool", out,
+                     "a number")
+    if isinstance(avail, _NUM) and not isinstance(avail, bool):
+        if not 0.0 <= avail <= 1.0:
+            out.append(f"serve_pool: availability {avail} outside [0, 1]")
+        elif req is not None and req["admitted"]:
+            want = 1.0 - req["rejected_infra"] / req["admitted"]
+            if abs(avail - want) > 1e-4:
+                out.append(
+                    f"serve_pool: availability {avail} does not reconcile "
+                    f"with 1 - rejected_infra/admitted = {want:.6f} — the "
+                    "headline must be computable from the books"
+                )
+
+    hedge = _require(obj, "hedge", dict, "serve_pool", out)
+    if hedge is not None and req is not None and req["admitted"]:
+        rate = hedge.get("rate")
+        if not isinstance(rate, _NUM) or isinstance(rate, bool):
+            out.append("serve_pool: hedge.rate must be a number")
+        elif abs(rate - req["hedged"] / req["admitted"]) > 1e-3:
+            out.append(
+                f"serve_pool: hedge.rate {rate} does not reconcile with "
+                f"hedged/admitted = {req['hedged'] / req['admitted']:.4f}"
+            )
+
+    lat = _require(obj, "latency_ms", dict, "serve_pool", out)
+    if lat is not None:
+        _validate_latency_side(lat.get("total"), "total", "serve_pool", out)
+
+    pool = _require(obj, "pool", dict, "serve_pool", out)
+    if pool is not None:
+        for k in ("n_workers", "kills", "restarts"):
+            v = pool.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"serve_pool: pool.{k} must be a non-negative "
+                           "int")
+        if "events" in pool and not isinstance(pool["events"], list):
+            out.append("serve_pool: pool.events must be a list")
+
+    workers = _require(obj, "workers", list, "serve_pool", out)
+    if workers is not None:
+        for i, w in enumerate(workers):
+            if not isinstance(w, dict) or not isinstance(
+                    w.get("worker_id"), str):
+                out.append(f"serve_pool: workers[{i}] must be a dict with "
+                           "a worker_id")
+    comp = obj.get("compile")
+    if comp is not None and not isinstance(comp, dict):
+        out.append("serve_pool: compile must be a dict when present")
+    elif isinstance(comp, dict):
+        fc = comp.get("in_window_fresh_compiles")
+        if fc is not None and not isinstance(fc, (int, str)):
+            out.append("serve_pool: compile.in_window_fresh_compiles must "
+                       "be an int count or a reason string")
+    return out
+
+
 _VALIDATORS = {
     "record": _validate_record,
     "serve": _validate_serve,
+    "serve_pool": _validate_serve_pool,
     "telemetry": _validate_telemetry,
     "driver_capture": _validate_driver_capture,
     "multichip": _validate_multichip,
@@ -493,7 +643,7 @@ def validate(obj, kind: str | None = None) -> list:
     if kind is None:
         return ["unrecognized artifact shape: none of the known key "
                 "signatures (record / driver_capture / multichip / phases "
-                "/ tpu_cache / telemetry / serve) match"]
+                "/ tpu_cache / telemetry / serve / serve_pool) match"]
     if kind not in _VALIDATORS:
         return [f"unknown artifact kind {kind!r}"]
     return _VALIDATORS[kind](obj)

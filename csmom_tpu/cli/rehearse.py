@@ -269,6 +269,127 @@ def _serve_scenarios():
     ]
 
 
+def _check_pool_worker_kill(r):
+    """ISSUE 6: a worker-PROCESS death mid-batch (chaos ``kill`` at
+    serve.dispatch, fired inside one worker of the fleet) must lose no
+    request: the router's books stay closed across the process boundary,
+    conn-failed dispatches fail over, the pool keeps serving, and
+    availability stays >= 99%."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve_pool")
+    req = art.get("requests") or {}
+    pool = art.get("pool") or {}
+    if not pool.get("kills"):
+        out.append("no worker death observed — the injected process kill "
+                   "did not fire (or the supervisor missed it)")
+    if not req.get("worker_conn_failures"):
+        out.append("no connection failure recorded — the kill missed "
+                   "every in-flight dispatch (nothing was rescued)")
+    if not req.get("served"):
+        out.append("nothing served — the pool did not keep serving past "
+                   "the dead worker")
+    if (art.get("availability") or 0.0) < 0.99:
+        out.append(f"availability {art.get('availability')} < 0.99 after "
+                   "a single worker kill — hedged retries did not route "
+                   "around the corpse")
+    return out
+
+
+def _check_pool_rolling_restart(r):
+    """ISSUE 6: a rolling restart under load replaces every worker with
+    zero in-window fresh compiles (warm-before-ready via the AOT cache)
+    and zero availability loss — the predecessor drains only after its
+    replacement demonstrated ready."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve_pool")
+    roll = r.get("roll") or {}
+    n_workers = (art.get("pool") or {}).get("n_workers", 0)
+    if roll.get("aborted"):
+        out.append(f"roll aborted: {roll['aborted']}")
+    if len(roll.get("rolled") or []) != n_workers:
+        out.append(f"rolled {len(roll.get('rolled') or [])} of "
+                   f"{n_workers} workers — the roll did not complete")
+    fresh = (art.get("compile") or {}).get("in_window_fresh_compiles")
+    if fresh != 0:
+        out.append(f"in_window_fresh_compiles = {fresh!r} across the "
+                   "rolled fleet — a replacement compiled instead of "
+                   "loading the AOT cache (warm-before-ready broke)")
+    if art.get("availability") != 1.0:
+        out.append(f"availability {art.get('availability')} != 1.0 — the "
+                   "rolling restart dropped requests")
+    if not (art.get("requests") or {}).get("served"):
+        out.append("nothing served during the roll")
+    return out
+
+
+def _check_pool_version_skew(r):
+    """ISSUE 6: AOT-cache version skew between supervisor and worker —
+    the worker must REFUSE ready with a pointed message (naming the skew
+    and the warmup remedy) instead of compiling in the window, and the
+    supervisor must park the slot rather than restart-loop a condition a
+    restart cannot fix."""
+    s = r.get("skew") or {}
+    out = []
+    if s.get("started"):
+        out.append("the pool started with a version-skewed worker — the "
+                   "ready gate did not hold")
+    if s.get("state") != "failed":
+        out.append(f"skewed slot ended {s.get('state')!r}, expected "
+                   "'failed' (parked)")
+    reason = s.get("reason") or ""
+    if "skew" not in reason:
+        out.append(f"refusal reason does not name the version skew: "
+                   f"{reason[:120]!r}")
+    if "csmom warmup" not in reason:
+        out.append("refusal reason lost the `csmom warmup` pointer")
+    if s.get("restarts"):
+        out.append(f"supervisor scheduled {s['restarts']} restart(s) for "
+                   "a skew refusal — a redeploy problem must not be "
+                   "hot-spun")
+    return out
+
+
+def _serve_pool_scenarios():
+    return [
+        Scenario(
+            "pool-worker-kill-mid-batch", "serve-pool",
+            FaultPlan("pool-worker-kill", seed=30, faults=(
+                Fault(point="serve.dispatch", action="kill", after=3,
+                      max_fires=1, global_once=True),
+            )),
+            _check_pool_worker_kill, fast=True,
+            notes="one worker PROCESS dies mid-batch (chaos kill at "
+                  "serve.dispatch, global-once across the fleet): router "
+                  "books stay closed, failover rescues in-flight "
+                  "requests, availability >= 99%",
+            env={"mode": "kill",
+                 "pool": {"n_workers": 2},
+                 "load": {"schedule": "0.6x70", "seed": 13,
+                          "deadline_s": 3.0}},
+        ),
+        Scenario(
+            "pool-rolling-restart-under-load", "serve-pool", None,
+            _check_pool_rolling_restart, fast=True,
+            notes="rolling restart under open-loop load: every "
+                  "replacement warm-before-ready (0 in-window compiles), "
+                  "predecessors drain only after, availability 100%",
+            env={"mode": "roll",
+                 "pool": {"n_workers": 2},
+                 "load": {"schedule": "1.2x40", "seed": 14,
+                          "deadline_s": 3.0}},
+        ),
+        Scenario(
+            "pool-aot-cache-version-skew", "serve-pool", None,
+            _check_pool_version_skew, fast=True,
+            notes="supervisor expects a different AOT cache version: the "
+                  "worker refuses ready with a pointed message (skew + "
+                  "warmup remedy) and the slot parks — no restart loop, "
+                  "no silent in-window compile",
+            env={"mode": "skew", "pool": {"n_workers": 1}},
+        ),
+    ]
+
+
 def _check_bench_partial(r):
     """r5 reproduced and shown fixed: the child lost its window mid-run but
     the already-measured headline landed in an explicitly-partial line."""
@@ -436,7 +557,8 @@ def _check_bench_child_full(r):
 
 
 def builtin_matrix(fast: bool = False):
-    mats = _mini_scenarios() + _shell_scenarios() + _serve_scenarios()
+    mats = (_mini_scenarios() + _shell_scenarios() + _serve_scenarios()
+            + _serve_pool_scenarios())
     if not fast:
         mats += _bench_scenarios()
     else:
@@ -710,6 +832,100 @@ def _run_serve(scenario, box: str) -> dict:
     }
 
 
+def _run_serve_pool(scenario, box: str) -> dict:
+    """Drive the MULTI-PROCESS pool: stub-engine worker subprocesses
+    behind the real supervisor + router (serve-smoke buckets, no jax in
+    any process — the fast tier stays jax-free).
+
+    The fault plan arms via the environment so the worker PROCESSES
+    inherit it (the ``kill`` at ``serve.dispatch`` is a real process
+    death); ``scenario.env`` carries runner kwargs: ``mode``
+    (kill | roll | skew), ``pool`` -> PoolConfig overrides, ``load`` ->
+    LoadConfig overrides.
+    """
+    from csmom_tpu.chaos import inject
+    from csmom_tpu.serve.loadgen import (
+        LoadConfig,
+        run_pool_loadgen,
+        write_artifact,
+    )
+    from csmom_tpu.serve.router import Router, RouterConfig
+    from csmom_tpu.serve.supervisor import PoolConfig, PoolSupervisor
+
+    mode = scenario.env.get("mode", "load")
+    saved = {k: os.environ.get(k) for k in (PLAN_ENV, "CSMOM_FAULT_STATE")}
+    sup = None
+    result: dict = {"rc": 0, "stdout": "", "stderr": "",
+                    "trailing": None, "headline_violations": [],
+                    "sidecar_rows": 0}
+    try:
+        if scenario.plan is not None:
+            plan_path = os.path.join(box, "plan.toml")
+            with open(plan_path, "w") as f:
+                f.write(scenario.plan.to_toml())
+            os.environ[PLAN_ENV] = plan_path
+        else:
+            os.environ.pop(PLAN_ENV, None)
+        os.environ["CSMOM_FAULT_STATE"] = os.path.join(box, "chaos-state")
+        inject.reset()
+        cfg = PoolConfig(
+            profile="serve-smoke", engine="stub",
+            backoff_base_s=0.05, backoff_cap_s=0.5, ready_timeout_s=30.0,
+            **({"expect_cache_version": "skewed-deadbeef"}
+               if mode == "skew" else {}),
+            **scenario.env.get("pool", {}))
+        sup = PoolSupervisor(cfg, box)
+        if mode == "skew":
+            try:
+                sup.start()
+                started = True
+            except RuntimeError:
+                started = False
+            h = sup.handles[0]
+            result["skew"] = {
+                "started": started,
+                "state": h.state,
+                "reason": h.reason or "",
+                "restarts": h.restarts,
+            }
+            return result
+        sup.start()
+        load_over = dict(scenario.env.get("load", {}))
+        deadline = load_over.pop("deadline_s", 3.0)
+        router = Router(sup.ready_workers, RouterConfig(
+            profile="serve-smoke", default_deadline_s=deadline))
+        load = LoadConfig(run_id=f"rehearse_{scenario.name}",
+                          deadline_s=deadline, **load_over)
+        if mode == "roll":
+            roll_box: dict = {}
+
+            def _roll():
+                time.sleep(0.2)  # let the load stream establish first
+                roll_box["roll"] = sup.rolling_restart()
+
+            # books close only after load AND roll settle (the
+            # `concurrent` contract), so the artifact's fleet stats see
+            # the post-roll generation, not a mid-roll race
+            art = run_pool_loadgen(router, sup, load, concurrent=_roll)
+            result["roll"] = roll_box.get("roll")
+        else:
+            art = run_pool_loadgen(router, sup, load)
+        if art is not None:
+            write_artifact(box, art, prefix="SERVE_POOL")
+        result["trailing"] = art
+        result["artifact"] = art
+        return result
+    finally:
+        if sup is not None:
+            sup.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        inject.reset()  # the next scenario must not inherit this plan
+
+
 _RUNNERS = {
     "mini": _run_mini,
     "shell": _run_shell,
@@ -717,6 +933,7 @@ _RUNNERS = {
     "bench": _run_bench_supervisor,
     "warmup": _run_warmup,
     "serve": _run_serve,
+    "serve-pool": _run_serve_pool,
 }
 
 
@@ -759,12 +976,19 @@ def _check_serve_generic(r):
     return inv.validate(r.get("artifact") or {}, "serve")
 
 
+def _check_serve_pool_generic(r):
+    # same rule one tier up: the pool artifact's schema IS the closed
+    # cross-process book plus the hedging arithmetic
+    return inv.validate(r.get("artifact") or {}, "serve_pool")
+
+
 _CUSTOM_CHECKS = {
     "mini": _check_custom_generic,
     "bench-child": _check_custom_generic,
     "bench": _check_bench_supervisor_landed,
     "warmup": _check_warmup_healed,
     "serve": _check_serve_generic,
+    "serve-pool": _check_serve_pool_generic,
 }
 
 

@@ -5,12 +5,27 @@
 report (bucket grid, warmup stats), runs a per-endpoint self-probe so
 "ready" is a demonstrated claim, then serves until ``--duration``
 elapses (0 = until Ctrl-C) and prints the request accounting on the way
-out.
+out.  With ``--workers N`` it instead runs the MULTI-PROCESS tier: a
+supervisor spawns N worker processes (each its own ``SignalService``
+behind a unix socket), a router hedges requests across them, and the
+self-probe goes through the router — the pool serves through worker
+crashes, with rolling restarts available to redeploy without downtime
+(see ``csmom_tpu/serve/{router,worker,supervisor,health}.py``).
+
+Readiness is honest about cold caches: with the jax engine, ``csmom
+serve`` first checks the on-disk AOT warmup evidence for the selected
+bucket profile and exits nonzero pointing at ``csmom warmup --profiles
+serve`` when it is missing or stale — warming is a deploy step, not
+something to silently pay inside a ready probe (``--allow-cold-cache``
+is the explicit opt-out).
 
 ``csmom loadgen`` drives an in-process service with the seeded open-loop
 generator (:mod:`csmom_tpu.serve.loadgen`) and lands a schema-valid
 ``SERVE_<run>.json``: throughput, p50/p95/p99 queue+service latency,
 batch-size distribution, request accounting, in-window compile count.
+``csmom loadgen --pool`` drives the multi-process tier instead and lands
+``SERVE_POOL_<run>.json`` (router accounting, availability, hedge rate,
+per-worker fresh-compile counts — kind ``serve_pool``).
 ``--smoke`` is the tier-1 preset: smoke buckets, a sub-second schedule,
 the whole admission→coalesce→dispatch pipeline on CPU.  Exit is nonzero
 when the artifact fails its own invariants (kind ``serve`` in
@@ -45,6 +60,149 @@ def _mk_service(args, engine_default: str = "jax"):
     return SignalService(cfg)
 
 
+def _check_cache_honesty(args, profile: str) -> int:
+    """The cold-cache gate: with the jax engine, refuse to 'be ready' by
+    compiling — exit 3 with the warmup pointer instead.  Returns 0 when
+    serving may proceed."""
+    if args.stub or getattr(args, "allow_cold_cache", False):
+        return 0
+    from csmom_tpu.serve.health import cache_readiness
+
+    ready, reason = cache_readiness(profile)
+    if not ready:
+        print(f"NOT READY (cold AOT cache): {reason}", file=sys.stderr)
+        print("readiness is a demonstrated claim — compiling inside the "
+              "ready probe would fake it; warm first, or pass "
+              "--allow-cold-cache to accept the compile pause",
+              file=sys.stderr)
+        return 3
+    print(f"AOT cache check: {reason}")
+    return 0
+
+
+def _mk_pool(args, run_dir: str):
+    """Build supervisor + router for pool mode (shared by serve/loadgen)."""
+    from csmom_tpu.serve.router import Router, RouterConfig
+    from csmom_tpu.serve.supervisor import PoolConfig, PoolSupervisor
+
+    profile = args.profile or ("serve-smoke" if getattr(args, "smoke", False)
+                               else "serve")
+    engine = "stub" if args.stub else "jax"
+    cfg = PoolConfig(
+        # --pool without --workers means "a pool": two workers is the
+        # smallest fleet hedging can route around
+        n_workers=args.workers if args.workers > 0 else 2,
+        profile=profile,
+        engine=engine,
+        capacity=args.capacity,
+        max_wait_ms=args.max_wait_ms,
+        deadline_ms=args.deadline_ms or 0.0,
+        require_warm_cache=(engine == "jax"
+                            and not getattr(args, "allow_cold_cache", False)
+                            and not getattr(args, "smoke", False)),
+    )
+    sup = PoolSupervisor(cfg, run_dir).start()
+    router = Router(sup.ready_workers, RouterConfig(
+        profile=profile,
+        default_deadline_s=(None if args.deadline_ms in (None, 0)
+                            else args.deadline_ms / 1e3),
+        hedge_fraction=args.hedge_fraction,
+    ))
+    return sup, router
+
+
+def _print_pool_ready(sup, router) -> None:
+    print(f"serving pool ready: {len(sup.ready_workers())}/"
+          f"{sup.config.n_workers} workers (engine {sup.config.engine}, "
+          f"profile {sup.config.profile})")
+    print(f"  cache version: {sup.expect_cache_version}")
+    for h in sup.handles:
+        rep = h.ready_report or {}
+        print(f"  {h.worker_id} g{h.generation} [{h.state}] pid "
+              f"{h.proc.pid if h.proc else '-'} fresh_compiles "
+              f"{rep.get('fresh_compiles')!r}")
+    print(f"  hedging: fraction {router.config.hedge_fraction}, floor "
+          f"{router.config.hedge_floor_s * 1e3:g} ms, max attempts "
+          f"{router.config.max_attempts}")
+
+
+def _pool_self_probe(router) -> list:
+    """One probe request per endpoint THROUGH the router — the pool's
+    demonstrated-ready claim.  Returns the failed probes (empty = ok)."""
+    import numpy as np
+
+    from csmom_tpu.serve.buckets import ENDPOINTS
+
+    spec = router.spec
+    A = spec.asset_buckets[0]
+    rng = np.random.default_rng(0)
+    probes = []
+    for kind in ENDPOINTS:
+        v = 100.0 * np.exp(np.cumsum(
+            rng.normal(0, 0.03, (A, spec.months)), axis=1))
+        probes.append(router.submit(kind, v.astype(np.float32),
+                                    np.ones((A, spec.months), bool),
+                                    deadline_s=10.0))
+    for p in probes:
+        p.wait(15.0)
+    return [p for p in probes if p.state != "served"]
+
+
+def _cmd_serve_pool(args) -> int:
+    """The multi-process tier behind ``csmom serve --workers N``."""
+    import tempfile
+    import time
+
+    from csmom_tpu.utils.deadline import mono_now_s
+
+    profile = args.profile or "serve"
+    if not args.stub:
+        rc = _check_cache_honesty(args, profile)
+        if rc:
+            return rc
+    run_dir = tempfile.mkdtemp(prefix="csmom-pool-")
+    try:
+        sup, router = _mk_pool(args, run_dir)
+    except RuntimeError as e:
+        print(f"pool failed to start: {e}", file=sys.stderr)
+        return 1
+    # from here every exit path must stop the fleet: worker processes
+    # are independent OS processes that would outlive a crashed CLI
+    try:
+        _print_pool_ready(sup, router)
+        failed = _pool_self_probe(router)
+        print(f"  self-probe: "
+              f"{'all endpoints served' if not failed else 'FAILED'}")
+        if failed:
+            for p in failed:
+                print(f"    {p.kind}: state={p.state} error={p.error}",
+                      file=sys.stderr)
+            return 1
+        try:
+            if args.duration > 0:
+                end = mono_now_s() + args.duration
+                while mono_now_s() < end:
+                    time.sleep(min(0.2, max(0.0, end - mono_now_s())))
+            else:
+                print("pool serving until interrupted (Ctrl-C) ...")
+                while True:
+                    time.sleep(0.5)
+        except KeyboardInterrupt:
+            print("\ninterrupted — draining the fleet")
+        acct = router.accounting()
+        viols = router.invariant_violations()
+    finally:
+        sup.stop()
+    print(f"pool accounting: {acct}")
+    print(f"availability: {router.availability()}")
+    print(f"fleet: kills {sup.summary()['kills']}, restarts "
+          f"{sup.summary()['restarts']}, rolls "
+          f"{sup.summary()['rolls_completed']}")
+    for v in viols:
+        print(f"INVARIANT VIOLATION: {v}", file=sys.stderr)
+    return 1 if viols else 0
+
+
 def _print_ready(svc) -> None:
     from csmom_tpu.serve.buckets import ENDPOINTS
 
@@ -62,11 +220,18 @@ def _print_ready(svc) -> None:
 
 
 def cmd_serve(args) -> int:
-    """Run the micro-batching signal service (in-process, thread-based)."""
+    """Run the signal service: in-process (default) or the multi-process
+    pool (``--workers N``)."""
     import numpy as np
 
     from csmom_tpu.serve.buckets import ENDPOINTS
 
+    if args.workers > 0:
+        return _cmd_serve_pool(args)
+    if not args.stub:
+        rc = _check_cache_honesty(args, args.profile or "serve")
+        if rc:
+            return rc
     svc = _mk_service(args)
     svc.start()
     _print_ready(svc)
@@ -118,9 +283,80 @@ def cmd_serve(args) -> int:
     return 1 if viols else 0
 
 
+def _cmd_loadgen_pool(args, schedule: str, run_id: str) -> int:
+    """Pool-mode loadgen: drive the router, land SERVE_POOL_<run>.json."""
+    import tempfile
+
+    from csmom_tpu.chaos import invariants as inv
+    from csmom_tpu.serve.loadgen import (
+        LoadConfig,
+        run_pool_loadgen,
+        write_artifact,
+    )
+
+    run_dir = tempfile.mkdtemp(prefix="csmom-pool-")
+    try:
+        sup, router = _mk_pool(args, run_dir)
+    except RuntimeError as e:
+        print(f"pool failed to start: {e}", file=sys.stderr)
+        return 1
+    try:
+        _print_pool_ready(sup, router)
+        load = LoadConfig(
+            schedule=schedule,
+            seed=args.seed,
+            deadline_s=(None if args.deadline_ms in (None, 0)
+                        else args.deadline_ms / 1e3),
+            run_id=run_id,
+        )
+        print(f"offering (pool): schedule {schedule} (seed {load.seed}, "
+              f"deadline {load.deadline_s}s) ...")
+        art = run_pool_loadgen(router, sup, load)
+    finally:
+        # a Ctrl-C or a loadgen failure must not leak N live worker
+        # processes — they are independent of this CLI's lifetime
+        sup.stop()
+    out_dir = args.out or os.getcwd()
+    path = write_artifact(out_dir, art, prefix="SERVE_POOL")
+
+    req = art["requests"]
+    lat = art["latency_ms"]["total"]
+    print(f"\nthroughput: {art['value']} req/s over {art['wall_s']}s wall")
+    print(f"requests: admitted {req['admitted']} -> served {req['served']}, "
+          f"rejected {req['rejected']} (infra {req['rejected_infra']}), "
+          f"expired {req['expired']}")
+    print(f"availability: {art['availability']}  hedge rate: "
+          f"{art['hedge']['rate']} ({req['hedged']} hedged, "
+          f"{req['hedge_wins']} wins, {req['duplicates_suppressed']} "
+          "suppressed)")
+    print(f"latency total ms: p50 {lat['p50']}  p95 {lat['p95']}  "
+          f"p99 {lat['p99']}")
+    print(f"fleet: kills {art['pool']['kills']}, restarts "
+          f"{art['pool']['restarts']}, rolls "
+          f"{art['pool']['rolls_completed']}")
+    print(f"in-window fresh compiles: "
+          f"{art['compile']['in_window_fresh_compiles']!r}")
+    print(f"artifact: {path}")
+
+    viols = inv.validate_file(path)
+    if viols:
+        print("ARTIFACT INVALID:", file=sys.stderr)
+        for v in viols:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    fresh = art["compile"]["in_window_fresh_compiles"]
+    if isinstance(fresh, int) and fresh > 0 and not args.allow_fresh_compiles:
+        print(f"error: {fresh} fresh compile(s) inside the serving window "
+              "across the fleet — a worker compiled instead of loading "
+              "the AOT cache; rerun with --allow-fresh-compiles to land "
+              "anyway", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_loadgen(args) -> int:
-    """Open-loop load generation against an in-process service; lands
-    SERVE_<run>.json."""
+    """Open-loop load generation against an in-process service (or the
+    pool with ``--pool``); lands SERVE_<run>.json / SERVE_POOL_<run>.json."""
     from csmom_tpu.chaos import invariants as inv
     from csmom_tpu.serve.loadgen import (
         LoadConfig,
@@ -140,6 +376,8 @@ def cmd_loadgen(args) -> int:
     except ValueError as e:
         print(f"--schedule: {e}", file=sys.stderr)
         return 2
+    if args.pool:
+        return _cmd_loadgen_pool(args, schedule, run_id)
     svc = _mk_service(args)
     svc.start()
     _print_ready(svc)
@@ -207,6 +445,19 @@ def _common_flags(sp) -> None:
                     help="default per-request deadline (0 = none; a "
                          "request expiring while queued is cancelled, "
                          "never dispatched)")
+    sp.add_argument("--workers", type=int, default=0,
+                    help="run the MULTI-PROCESS pool with N supervised "
+                         "worker processes behind a hedging router "
+                         "(0 = the in-process single service; default 0)")
+    sp.add_argument("--hedge-fraction", dest="hedge_fraction", type=float,
+                    default=0.35,
+                    help="pool mode: hedge a request after this fraction "
+                         "of its remaining deadline (default 0.35)")
+    sp.add_argument("--allow-cold-cache", dest="allow_cold_cache",
+                    action="store_true",
+                    help="serve even when the AOT cache is cold/stale "
+                         "for the bucket profile (default: exit 3 with a "
+                         "`csmom warmup --profiles serve` pointer)")
 
 
 def register(sub) -> None:
@@ -232,6 +483,10 @@ def register(sub) -> None:
     lg.add_argument("--smoke", action="store_true",
                     help="tier-1 preset: smoke buckets, sub-second "
                          "schedule, SERVE_smoke.json (gitignored)")
+    lg.add_argument("--pool", action="store_true",
+                    help="drive the multi-process pool (--workers N) "
+                         "instead of the in-process service; lands "
+                         "SERVE_POOL_<run>.json (kind serve_pool)")
     lg.add_argument("--schedule", metavar="DURxRPS,...",
                     help="arrival schedule segments, e.g. 2x25,3x60 "
                          "(default: 2x40; smoke: 0.8x60)")

@@ -328,6 +328,51 @@ def _serve_rows(obj: dict, run: str, num: int, variant,
     return rows
 
 
+def _serve_pool_rows(obj: dict, run: str, num: int, variant,
+                     source: str) -> list:
+    """Rows from a SERVE_POOL artifact: the multi-process tier's
+    trajectory.  Throughput (higher), total-latency percentiles (lower),
+    availability (higher — the robustness headline: the fraction of
+    admitted requests the pool answered honestly), hedge rate (lower —
+    hedges are paid straggler insurance; a rising rate means the fleet
+    is straggling more), and the summed in-window fresh-compile count
+    (lower; zero is the warm-before-ready contract across restarts)."""
+    extra = obj.get("extra") or {}
+    platform = extra.get("platform")
+    device_kind = extra.get("device_kind") or platform
+    workload = extra.get("workload")
+    flags = _flags(obj, variant)
+    base = dict(run=run, run_num=num, source=source, platform=platform,
+                device_kind=device_kind, workload=workload, flags=flags)
+    rows = []
+    v = _num(obj.get("value"))
+    if v is not None:
+        rows.append(Row(metric="serve_pool_throughput_rps", value=v,
+                        unit=str(obj.get("unit", "req/s")),
+                        direction="higher", **base))
+    total = (obj.get("latency_ms") or {}).get("total")
+    if isinstance(total, dict):
+        for q in ("p50", "p95", "p99"):
+            pv = _num(total.get(q))
+            if pv is not None:
+                rows.append(Row(metric=f"serve_pool_{q}_ms", value=pv,
+                                unit="ms", direction="lower", **base))
+    av = _num(obj.get("availability"))
+    if av is not None:
+        rows.append(Row(metric="serve_pool_availability", value=av,
+                        unit="frac", direction="higher", **base))
+    hr = _num((obj.get("hedge") or {}).get("rate"))
+    if hr is not None:
+        rows.append(Row(metric="serve_pool_hedge_rate", value=hr,
+                        unit="frac", direction="lower", **base))
+    fc = _num((obj.get("compile") or {}).get("in_window_fresh_compiles"))
+    if fc is not None:
+        rows.append(Row(metric="serve_pool_in_window_fresh_compiles",
+                        value=fc, unit="compiles", direction="lower",
+                        **base))
+    return rows
+
+
 def _generic_rows(obj: dict, kind: str, run: str, num: int, variant,
                   source: str) -> list:
     """Info rows for the remaining artifact kinds (multichip equality,
@@ -405,6 +450,15 @@ def ingest_file(path: str, have_full_runs=frozenset()) -> tuple:
         return [], [{"source": source,
                      "note": "record artifact with no numeric value axis: "
                              "present but contributes no trajectory rows"}]
+    if kind == "serve_pool":
+        ver = obj.get("schema_version")
+        if ver not in inv.KNOWN_SERVE_POOL_SCHEMA_VERSIONS:
+            return [], [{"source": source,
+                         "note": f"unknown serve_pool schema_version "
+                                 f"{ver!r} (reader understands "
+                                 f"{list(inv.KNOWN_SERVE_POOL_SCHEMA_VERSIONS)}"
+                                 "): not half-parsed into rows"}]
+        return _serve_pool_rows(obj, run, num, variant, source), []
     if kind == "serve":
         # closed-world schema, same rule as telemetry: a serve artifact
         # from a different era must not half-parse into gate rows

@@ -1,0 +1,172 @@
+"""Health and readiness for the serving pool — demonstrated, not declared.
+
+Two distinct probes, because they answer different operational questions
+(the Kubernetes liveness/readiness split, applied to worker processes):
+
+- **Liveness** (:func:`liveness`): "does the process respond?"  A ping
+  over the worker socket with a short timeout.  Failing liveness means
+  restart; it says nothing about whether the worker could serve.
+- **Readiness** (:func:`readiness`): "may the router send traffic?"  The
+  worker's own report: every bucket shape warmed, one self-probe request
+  per endpoint actually SERVED through the full admission → coalesce →
+  dispatch path (the ``cli/serve.py`` demonstrated-ready pattern), zero
+  fresh compiles since the warm snapshot, and a matching AOT cache
+  version.  A worker that cannot prove all four is not ready — the
+  router never routes to it and the supervisor never drains its
+  predecessor during a rolling restart.
+
+**Cache version** (:func:`aot_cache_version`): the rolling-restart
+contract is *warm-before-ready* — a replacement worker loads the
+serialized-executable AOT cache instead of compiling.  That only holds
+when router and worker agree on what the cache contains, so the version
+token fingerprints everything that keys the compiled world: the bucket
+grid, the endpoints, the engine parameters, and the installed jax
+version (a jax upgrade invalidates serialized executables wholesale).  A
+worker handed an ``--expect-cache-version`` that does not match its own
+computation REFUSES to become ready with a pointed message instead of
+silently compiling inside the serving window — version skew must cost a
+deploy abort, never a latency cliff.
+
+**Cold-cache honesty** (:func:`cache_readiness`): ``csmom serve`` with
+the jax engine checks the on-disk warmup evidence BEFORE warming: the
+warmup report must exist in the shared cache namespace and cover every
+entry of the selected bucket profile error-free.  Missing or stale means
+"run ``csmom warmup --profiles serve`` first", as a nonzero exit — not a
+silent multi-second compile pause inside what claimed to be a ready
+probe.
+
+Stdlib-only (numpy rides in via proto): safe to import from the
+supervisor's monitor loop and the fast rehearse tier without jax.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+
+from csmom_tpu.serve import proto
+from csmom_tpu.serve.buckets import ENDPOINTS, bucket_spec
+
+__all__ = ["aot_cache_version", "cache_readiness", "expected_entry_names",
+           "liveness", "readiness"]
+
+# the operator remedy every cold/stale/skewed cache message points at —
+# one string so the tests can pin that the pointer never drifts
+WARMUP_POINTER = "run `csmom warmup --profiles serve` first"
+
+
+def aot_cache_version(profile: str, *, lookback: int = 12, skip: int = 1,
+                      n_bins: int = 10, mode: str = "rank") -> str:
+    """Deterministic fingerprint of the compiled world this pool expects.
+
+    Jax-free: the jax version is read from package metadata, not an
+    import, so the supervisor can stamp versions without initializing a
+    backend.  The token changes iff something that invalidates the AOT
+    cache changes — bucket geometry, endpoint set, engine params, or the
+    jax release that serialized the executables.
+    """
+    spec = bucket_spec(profile)
+    try:
+        from importlib.metadata import version
+
+        jax_ver = version("jax")
+    except Exception:
+        jax_ver = "unknown"
+    basis = {
+        "profile": spec.name,
+        "months": spec.months,
+        "asset_buckets": list(spec.asset_buckets),
+        "batch_buckets": list(spec.batch_buckets),
+        "dtype": spec.dtype,
+        "endpoints": list(ENDPOINTS),
+        "engine_params": {"lookback": lookback, "skip": skip,
+                          "n_bins": n_bins, "mode": mode},
+        "jax": jax_ver,
+    }
+    blob = json.dumps(basis, sort_keys=True).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()[:12]
+
+
+def expected_entry_names(profile: str) -> set:
+    """The manifest entry names ``csmom warmup --profiles <profile>``
+    must have compiled — derived from bucket geometry alone (the same
+    ``serve.{kind}.b{B}@{A}x{M}`` scheme ``compile/manifest.py`` uses),
+    so this check never needs jax."""
+    spec = bucket_spec(profile)
+    return {f"serve.{kind}.b{B}@{A}x{M}"
+            for kind in ENDPOINTS for B, A, M in spec.shapes()}
+
+
+def cache_readiness(profile: str, cache_subdir: str = "bench") -> tuple:
+    """``(ready, reason)`` for the on-disk AOT cache of ``profile``.
+
+    Ready means: the persistent cache is enabled, its warmup report
+    exists, the report covers every expected serve entry with no error,
+    and the cache directory still holds serialized executables (a report
+    describing an evicted cache is stale evidence).  ``reason`` always
+    names the remedy (``WARMUP_POINTER``) when not ready.
+    """
+    from csmom_tpu.compile.aot import REPORT_NAME, read_warmup_report
+    from csmom_tpu.utils.jit_cache import cache_dir
+
+    d = cache_dir(cache_subdir)
+    if d is None:
+        return False, ("persistent AOT cache disabled (CSMOM_JIT_CACHE=0): "
+                       "a zero-compile restart is impossible; re-enable it "
+                       f"and {WARMUP_POINTER}")
+    report = read_warmup_report(cache_subdir)
+    if isinstance(report, str):
+        return False, (f"no warmup evidence for cache {d}: {report} — "
+                       f"{WARMUP_POINTER}")
+    entries = report.get("entries")
+    if not isinstance(entries, list):
+        return False, (f"warmup report in {d} has no entries list — "
+                       f"stale/damaged evidence; {WARMUP_POINTER}")
+    warmed = {e.get("name") for e in entries
+              if isinstance(e, dict) and "error" not in e}
+    missing = sorted(expected_entry_names(profile) - warmed)
+    if missing:
+        return False, (
+            f"AOT cache cold for bucket profile {profile!r}: "
+            f"{len(missing)} of {len(expected_entry_names(profile))} serve "
+            f"shapes have no warm evidence (first missing: {missing[0]}) — "
+            f"{WARMUP_POINTER}")
+    cached = [p for p in glob.glob(os.path.join(d, "*"))
+              if os.path.isfile(p) and os.path.basename(p) != REPORT_NAME]
+    if not cached:
+        return False, (
+            f"warmup report present but cache {d} holds no serialized "
+            f"executables (evicted?) — stale evidence; {WARMUP_POINTER}")
+    return True, (f"cache {d}: all {len(expected_entry_names(profile))} "
+                  f"serve shapes warm, {len(cached)} serialized entries")
+
+
+# ---------------------------------------------------------------- probes ---
+
+def liveness(socket_path: str, timeout_s: float = 2.0) -> tuple:
+    """``(alive, reason)``: does the worker process answer a ping?"""
+    try:
+        obj, _ = proto.request(socket_path, {"op": "ping"},
+                               timeout_s=timeout_s)
+    except (OSError, proto.ProtocolError) as e:
+        return False, f"{type(e).__name__}: {e}"
+    if obj.get("ok"):
+        return True, "pong"
+    return False, f"ping answered without ok: {obj}"
+
+
+def readiness(socket_path: str, timeout_s: float = 5.0) -> dict:
+    """The worker's readiness report (see :mod:`csmom_tpu.serve.worker`),
+    or a not-ready dict carrying the probe failure as the reason.  The
+    report's ``ok`` is the routing decision; everything else is the
+    evidence behind it (warm shapes, per-endpoint probe states, fresh
+    compiles, cache version)."""
+    try:
+        obj, _ = proto.request(socket_path, {"op": "ready"},
+                               timeout_s=timeout_s)
+        return obj
+    except (OSError, proto.ProtocolError) as e:
+        return {"ok": False,
+                "reason": f"readiness probe failed: {type(e).__name__}: {e}"}

@@ -43,9 +43,11 @@ from csmom_tpu.serve.service import ServeConfig, SignalService
 from csmom_tpu.utils.deadline import mono_now_s
 
 __all__ = ["LoadConfig", "arrival_offsets", "build_artifact",
-           "parse_schedule", "run_loadgen", "synth_panel", "write_artifact"]
+           "build_pool_artifact", "parse_schedule", "run_loadgen",
+           "run_pool_loadgen", "synth_panel", "write_artifact"]
 
 SCHEMA_VERSION = 1
+POOL_SCHEMA_VERSION = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -249,9 +251,196 @@ def build_artifact(service: SignalService, load: LoadConfig,
     }
 
 
-def write_artifact(out_dir: str, obj: dict) -> str:
-    """Atomically land ``SERVE_<run>.json``; returns the path."""
-    name = f"SERVE_{obj['run_id']}.json"
+# ------------------------------------------------------------------ pool ---
+
+def run_pool_loadgen(router, supervisor, load: LoadConfig,
+                     concurrent=None) -> dict:
+    """Drive the multi-process pool with the SAME seeded open-loop
+    schedule as :func:`run_loadgen`, through the router.
+
+    The pool is NOT stopped here (the caller may still want to kill /
+    roll / inspect workers); the books close once every admitted request
+    reaches a terminal state — which the router guarantees per request,
+    so waiting on the handles IS the drain.
+
+    ``concurrent`` (optional callable) runs in a thread alongside the
+    load stream — the chaos lever for "do X UNDER load" scenarios
+    (rolling restart, a mid-run kill).  The artifact is built only after
+    BOTH the load's requests are terminal AND ``concurrent`` returned,
+    so worker stats and fleet events are read from a settled pool."""
+    import threading
+
+    rng = random.Random(load.seed)
+    segments = parse_schedule(load.schedule)
+    offsets = arrival_offsets(segments, rng)
+    spec = router.spec
+    max_assets = min(load.max_assets or spec.max_assets, spec.max_assets)
+
+    side = None
+    side_exc: list = []
+    if concurrent is not None:
+        def _side():
+            try:
+                concurrent()
+            except BaseException as e:  # surfaced after join, not lost
+                side_exc.append(e)
+
+        side = threading.Thread(target=_side, daemon=True)
+
+    requests = []
+    t_start = mono_now_s()
+    if side is not None:
+        side.start()
+    for off in offsets:
+        delay = (t_start + off) - mono_now_s()
+        if delay > 0:
+            time.sleep(delay)  # open loop: the schedule's clock rules
+        kind = rng.choice(list(load.kinds))
+        n_assets = rng.randint(2, max_assets)
+        values, mask = synth_panel(rng, n_assets, spec.months, kind)
+        prio = ("interactive" if rng.random() < load.interactive_fraction
+                else "batch")
+        requests.append(router.submit(kind, values, mask, priority=prio,
+                                      deadline_s=load.deadline_s))
+    give_up = mono_now_s() + 60.0
+    for r in requests:
+        r.wait(timeout=max(0.0, give_up - mono_now_s()))
+    wall_s = mono_now_s() - t_start
+    if side is not None:
+        # the artifact's "built after a settled pool" contract: give the
+        # concurrent action its OWN generous budget (a roll can outlast
+        # the request drain), and refuse to build from a still-mutating
+        # fleet rather than land a mid-roll snapshot as evidence
+        side.join(timeout=300.0)
+        if side.is_alive():
+            raise RuntimeError(
+                "concurrent action still running after 300s — refusing "
+                "to build the pool artifact from an unsettled fleet")
+        if side_exc:
+            raise side_exc[0]
+    return build_pool_artifact(router, supervisor, load, requests, wall_s)
+
+
+def _pool_fresh_compiles(workers: list):
+    """Aggregate in-window fresh compiles across the fleet: the SUM of
+    every live worker's count.  A worker that cannot report (dead slot,
+    stats error) degrades the total to a reason string — "unknown" must
+    never be spelled 0."""
+    total = 0
+    gaps = []
+    for w in workers:
+        if w.get("state") != "ready":
+            # a replaced slot's history lives in the replacement; a dead/
+            # failed slot has no count to contribute — named, not zeroed
+            gaps.append(f"{w['worker_id']}: {w.get('state')}")
+            continue
+        fc = w.get("fresh_compiles")
+        if isinstance(fc, int) and not isinstance(fc, bool):
+            total += fc
+        else:
+            gaps.append(f"{w['worker_id']}: {fc!r}")
+    if gaps:
+        return (f"{total} across reporting workers; not measurable for "
+                f"[{'; '.join(gaps)}]")
+    return total
+
+
+def build_pool_artifact(router, supervisor, load: LoadConfig,
+                        requests: list, wall_s: float) -> dict:
+    """The SERVE_POOL artifact: the router's closed cross-process books,
+    hedging/availability headline, and the fleet's evidence."""
+    acct = router.accounting()
+    served = [r for r in requests if r.state == "served"]
+    throughput = round(acct["served"] / wall_s, 3) if wall_s > 0 else 0.0
+    lat = {"total": _percentiles(
+        [r.total_s for r in served if r.total_s is not None])}
+    workers = supervisor.worker_stats()
+    summary = supervisor.summary()
+    fresh = _pool_fresh_compiles(workers)
+    spec = router.spec
+    cfg = supervisor.config
+    ready = [w for w in workers if w.get("state") == "ready"]
+    platform = None
+    for h in supervisor.handles:
+        rep = h.ready_report or {}
+        if isinstance(rep.get("platform"), str):
+            platform = rep["platform"]
+            break
+    workload = (
+        f"pool open-loop {load.schedule} rps seed {load.seed}, "
+        f"{'/'.join(load.kinds)} mix, {cfg.n_workers} workers, buckets "
+        f"B({','.join(map(str, spec.batch_buckets))})x"
+        f"A({','.join(map(str, spec.asset_buckets))})x{spec.months}m "
+        f"({spec.dtype}, {cfg.engine} engine)"
+    )
+    extra = {
+        "platform": platform,
+        "engine": cfg.engine,
+        "workload": workload,
+        "hedge_policy": {
+            "fraction": router.config.hedge_fraction,
+            "floor_ms": round(1e3 * router.config.hedge_floor_s, 3),
+            "max_attempts": router.config.max_attempts,
+        },
+        "cache_version": summary["expect_cache_version"],
+    }
+    if spec.name == "serve-smoke":
+        extra["smoke"] = ("smoke-bucket pool run: pipeline-shaped, "
+                          "workload reduced — NOT a performance capture")
+    admitted = max(1, acct["admitted"])
+    return {
+        "kind": "serve_pool",
+        "schema_version": POOL_SCHEMA_VERSION,
+        "run_id": load.run_id,
+        "metric": "serve_pool_throughput_rps",
+        "value": throughput,
+        "unit": "req/s",
+        "vs_baseline": 1.0,
+        "wall_s": round(wall_s, 4),
+        "requests": acct,
+        "availability": router.availability(),
+        "hedge": {
+            "hedged": acct["hedged"],
+            "rate": round(acct["hedged"] / admitted, 4),
+            "wins": acct["hedge_wins"],
+            "suppressed": acct["duplicates_suppressed"],
+        },
+        "latency_ms": lat,
+        "pool": {
+            "n_workers": cfg.n_workers,
+            "ready_workers_end": len(ready),
+            "kills": summary["kills"],
+            "restarts": summary["restarts"],
+            "rolls_completed": summary["rolls_completed"],
+            "events": summary["events"][:200],
+        },
+        "workers": workers,
+        "compile": {
+            "in_window_fresh_compiles": fresh,
+            "note": "sum of per-worker backend_compiles deltas since each "
+                    "worker's own warmup snapshot: 0 = no worker compiled "
+                    "inside the serving window (warm-before-ready held "
+                    "across spawns, restarts, and rolls)",
+        },
+        "offered": {
+            "schedule": load.schedule,
+            "seed": load.seed,
+            "n_arrivals": len(requests),
+            "kinds": list(load.kinds),
+            "deadline_ms": (None if load.deadline_s is None
+                            else round(1e3 * load.deadline_s, 3)),
+            "interactive_fraction": load.interactive_fraction,
+        },
+        "extra": extra,
+    }
+
+
+def write_artifact(out_dir: str, obj: dict, prefix: str = "SERVE") -> str:
+    """Atomically land ``<prefix>_<run>.json``; returns the path.  Pool
+    artifacts pass ``prefix="SERVE_POOL"`` (same committable-name rule:
+    only ``_rNN`` names are round evidence)."""
+    name = f"{prefix}_{obj['run_id']}.json"
+    os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, name)
     tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:

@@ -1,0 +1,177 @@
+"""Wire protocol for the serving pool: framed JSON + raw array payloads.
+
+The router, the health probes, and the workers speak one tiny protocol
+over a local ``AF_UNIX`` stream socket: a 4-byte big-endian frame
+length, then a length-prefixed JSON header, then the concatenated raw
+bytes of any numpy arrays the header declares (name / dtype / shape /
+nbytes, in order).  Binary payloads because a request panel is up to
+``128 x 60`` float32 — base64-in-JSON would inflate every dispatch by a
+third for nothing; JSON headers because every *control* field stays
+greppable in a socket dump.
+
+Design constraints this encodes:
+
+- **Bounded**: a frame larger than ``MAX_FRAME_BYTES`` is refused at
+  read time (a corrupt length prefix must not allocate gigabytes), and
+  array specs are validated against the declared byte count before a
+  single array is materialized.
+- **Connection-per-request**: the router opens one connection per
+  dispatch attempt.  That keeps hedging trivial (two attempts are two
+  independent sockets; abandoning one cannot corrupt the other's
+  framing) and makes a worker crash legible — the kernel resets the
+  socket, the router sees ``ConnectionError``/EOF, and the attempt
+  fails fast instead of waiting out a deadline on a corpse.
+- **Stdlib + numpy only, no jax**: health probes and the supervisor's
+  monitor loop must stay importable in processes that never touch a
+  device (the same split as ``serve/buckets.py``).
+
+Ops the worker answers (see :mod:`csmom_tpu.serve.worker`):
+
+=========  ==================================================
+op         meaning
+=========  ==================================================
+ping       liveness: "the process responds" — no service state
+ready      readiness report (warm + self-probe + cache version)
+score      one scoring request (arrays: values, mask)
+stats      accounting / batch stats / fresh-compile count
+drain      stop admitting, drain the queue, report accounting
+stop       drain, then exit the worker process
+=========  ==================================================
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+import numpy as np
+
+__all__ = ["MAX_FRAME_BYTES", "ProtocolError", "connect", "recv_msg",
+           "request", "send_msg"]
+
+# largest legal frame: the biggest production micro-panel is ~30 KB, so
+# 32 MB is three orders of magnitude of headroom while still refusing a
+# garbage length prefix before it can exhaust memory
+MAX_FRAME_BYTES = 32 * 1024 * 1024
+
+_LEN = struct.Struct("!I")
+
+
+class ProtocolError(RuntimeError):
+    """A malformed frame (bad length, truncated payload, spec mismatch)."""
+
+
+def connect(socket_path: str, timeout_s: float) -> socket.socket:
+    """One connected, timeout-armed client socket to a worker/router."""
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout_s)
+    try:
+        sock.connect(socket_path)
+    except OSError:
+        sock.close()
+        raise
+    return sock
+
+
+def send_msg(sock: socket.socket, obj: dict, arrays: dict | None = None) -> None:
+    """Send one frame: ``obj`` as the JSON header plus raw array bytes.
+
+    ``arrays`` maps name -> ndarray; each is serialized C-contiguous and
+    declared in the header's ``_arrays`` spec list so the receiver can
+    slice them back without a second round trip.
+    """
+    specs = []
+    blobs = []
+    for name, arr in (arrays or {}).items():
+        a = np.ascontiguousarray(arr)
+        specs.append({"name": name, "dtype": str(a.dtype),
+                      "shape": list(a.shape), "nbytes": int(a.nbytes)})
+        blobs.append(a.tobytes())
+    header = dict(obj)
+    header["_arrays"] = specs
+    hb = json.dumps(header).encode("utf-8")
+    payload = _LEN.pack(len(hb)) + hb + b"".join(blobs)
+    if len(payload) > MAX_FRAME_BYTES:
+        raise ProtocolError(
+            f"frame of {len(payload)} bytes exceeds MAX_FRAME_BYTES "
+            f"({MAX_FRAME_BYTES}); split the request")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ProtocolError(
+                f"connection closed mid-frame ({len(buf)}/{n} bytes read) "
+                "— the peer died or reset")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> tuple:
+    """Receive one frame; returns ``(obj, arrays)``.
+
+    Every declared array is rebuilt from the binary tail; a spec whose
+    byte counts do not reconcile with the frame is a protocol error, not
+    a best-effort parse — half a panel must never score.
+    """
+    (total,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if total > MAX_FRAME_BYTES:
+        raise ProtocolError(
+            f"declared frame length {total} exceeds MAX_FRAME_BYTES "
+            f"({MAX_FRAME_BYTES}) — corrupt length prefix?")
+    payload = _recv_exact(sock, total)
+    if len(payload) < _LEN.size:
+        raise ProtocolError("frame shorter than its header length prefix")
+    (hlen,) = _LEN.unpack(payload[:_LEN.size])
+    if _LEN.size + hlen > total:
+        raise ProtocolError(
+            f"header length {hlen} overruns the {total}-byte frame")
+    try:
+        obj = json.loads(payload[_LEN.size:_LEN.size + hlen].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(f"unparseable frame header: {e}") from None
+    if not isinstance(obj, dict):
+        raise ProtocolError(
+            f"frame header must be a JSON object, got {type(obj).__name__}")
+    specs = obj.pop("_arrays", [])
+    arrays: dict = {}
+    off = _LEN.size + hlen
+    for spec in specs:
+        try:
+            name = spec["name"]
+            dtype = np.dtype(spec["dtype"])
+            shape = tuple(int(s) for s in spec["shape"])
+            nbytes = int(spec["nbytes"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ProtocolError(f"bad array spec {spec!r}: {e}") from None
+        want = dtype.itemsize * int(np.prod(shape)) if shape else dtype.itemsize
+        if nbytes != want or off + nbytes > total:
+            raise ProtocolError(
+                f"array {name!r} spec inconsistent with frame "
+                f"(declared {nbytes} bytes, shape wants {want}, "
+                f"{total - off} remain)")
+        arrays[name] = np.frombuffer(
+            payload[off:off + nbytes], dtype=dtype).reshape(shape).copy()
+        off += nbytes
+    if off != total:
+        raise ProtocolError(
+            f"{total - off} trailing bytes after the declared arrays")
+    return obj, arrays
+
+
+def request(socket_path: str, obj: dict, arrays: dict | None = None,
+            timeout_s: float = 5.0) -> tuple:
+    """One-shot round trip: connect, send, receive one reply, close."""
+    sock = connect(socket_path, timeout_s)
+    try:
+        send_msg(sock, obj, arrays)
+        return recv_msg(sock)
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass

@@ -48,6 +48,16 @@ TERMINAL_STATES = ("served", "rejected", "expired")
 
 _IDS = itertools.count(1)
 
+# retry-after hint bounds (see _retry_after_locked): before the first
+# request has ever been served the EMA drain rate is UNDEFINED, so the
+# hint falls back to a conservative per-request default instead of
+# surfacing None/0 to the first overloaded callers; and however deep the
+# queue or slow the drain, the hint is capped — "retry in 90 s" is not
+# actionable advice from a bounded queue, it is a misread of a transient
+RETRY_AFTER_COLD_PER_REQ_S = 0.005
+RETRY_AFTER_MIN_S = 0.001
+RETRY_AFTER_MAX_S = 2.0
+
 
 @dataclasses.dataclass
 class Request:
@@ -168,10 +178,19 @@ class AdmissionQueue:
         return req
 
     def _retry_after_locked(self) -> float:
-        # drain-rate estimate: depth * observed per-request service time
-        # (floored so a cold queue still hints SOMETHING actionable)
-        per_req = self._ema_per_req_s if self._ema_per_req_s else 0.005
-        return max(0.001, self._depth_locked() * per_req)
+        """Drain-rate estimate: depth * observed per-request service
+        time, clamped to [RETRY_AFTER_MIN_S, RETRY_AFTER_MAX_S].
+
+        Cold start: before anything has been served, ``_ema_per_req_s``
+        is None (and a degenerate 0.0 EMA is falsy too) — the bounded
+        default ``RETRY_AFTER_COLD_PER_REQ_S`` stands in, so the FIRST
+        overload rejection already carries an actionable float hint,
+        never None (the regression that motivated these named bounds).
+        """
+        per_req = (self._ema_per_req_s if self._ema_per_req_s
+                   else RETRY_AFTER_COLD_PER_REQ_S)
+        return min(RETRY_AFTER_MAX_S,
+                   max(RETRY_AFTER_MIN_S, self._depth_locked() * per_req))
 
     # ------------------------------------------------------------ collect --
 

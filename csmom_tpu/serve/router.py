@@ -1,0 +1,471 @@
+"""Pool router: admission, hedged dispatch, closed cross-process books.
+
+The router is the pool's front door.  It admits every request, fans out
+to whichever workers are READY (the supervisor's routable set), and
+enforces the serve layer's core invariant ACROSS the process boundary:
+every admitted request reaches exactly one terminal state — ``served`` /
+``rejected`` / ``expired`` — no matter which worker died, answered late,
+or answered twice.
+
+**Hedged retries** (Dean & Barroso, *The Tail at Scale*, CACM 2013):
+a request is dispatched to one worker; when a fraction of its deadline
+budget elapses with no response, a second attempt fires against a
+DIFFERENT worker.  First response wins; the loser's answer is counted
+``duplicates_suppressed`` and discarded — the terminal transition is
+guarded by one lock, so "exactly once" is structural, not statistical.
+Hedging converts a straggling or dying worker from a p99 cliff into one
+extra dispatch; the ``hedge_rate`` the artifact records keeps the cost
+honest.
+
+**Failover** is the same machinery driven by errors instead of time: a
+connection refused/reset (worker crashed, socket gone) fails the attempt
+immediately and redispatches to the next worker, up to ``max_attempts``.
+Only when every avenue is exhausted does the request terminate
+``rejected`` with ``rejected_infra`` incremented — the counter
+availability is computed from (``1 - rejected_infra / admitted``):
+backpressure and client-deadline expiry are honest answers, infra
+failure is the pool failing its job.
+
+The router holds no panels and no queue of its own — worker admission
+queues are the buffering layer (each worker owns its backpressure,
+Orca-style); the router's state per request is one small record.  All
+timing through ``utils.deadline.mono_now_s``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+
+import numpy as np
+
+from csmom_tpu.serve import proto
+from csmom_tpu.serve.buckets import ENDPOINTS, bucket_spec
+from csmom_tpu.utils.deadline import mono_now_s
+
+__all__ = ["PoolRequest", "Router", "RouterConfig"]
+
+TERMINAL_STATES = ("served", "rejected", "expired")
+
+_IDS = itertools.count(1)
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Dispatch policy knobs (defaults tuned for the CPU pool)."""
+
+    profile: str = "serve"
+    default_deadline_s: float | None = 0.5
+    hedge_fraction: float = 0.35   # of the remaining deadline budget
+    hedge_floor_s: float = 0.05    # never hedge sooner than this
+    hedge_after_s: float = 0.25    # hedge delay for deadline-less requests
+    max_attempts: int = 3          # primary + hedge + one failover
+    connect_timeout_s: float = 2.0
+
+
+@dataclasses.dataclass
+class PoolRequest:
+    """One pool request's life-cycle record (router-side)."""
+
+    kind: str
+    n_assets: int
+    priority: str = "interactive"
+    deadline_s: float | None = None      # ABSOLUTE monotonic, None = none
+    req_id: int = dataclasses.field(default_factory=lambda: next(_IDS))
+    state: str = "routing"
+    result: object = None
+    error: str | None = None
+    worker_id: str | None = None         # who served it
+    hedged: bool = False
+    attempts: int = 0
+    t_submit_s: float = 0.0
+    t_done_s: float | None = None
+    _done: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False, compare=False)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+    @property
+    def total_s(self) -> float | None:
+        return (None if self.t_done_s is None
+                else max(0.0, self.t_done_s - self.t_submit_s))
+
+    def remaining_s(self, now_s: float) -> float | None:
+        return (None if self.deadline_s is None
+                else self.deadline_s - now_s)
+
+
+class Router:
+    """Admit → dispatch (hedged) → exactly-once terminal accounting."""
+
+    def __init__(self, workers_fn, config: RouterConfig | None = None):
+        """``workers_fn() -> list`` of objects with ``.worker_id`` and
+        ``.socket_path`` — the supervisor's current READY set (queried
+        per attempt, so a worker that died between attempts is already
+        gone from the menu)."""
+        self.config = config or RouterConfig()
+        self.spec = bucket_spec(self.config.profile)
+        self._workers_fn = workers_fn
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+        # accounting counters — the cross-process closed book
+        self.admitted = 0
+        self.served = 0
+        self.rejected = 0
+        self.expired = 0
+        self.rejected_infra = 0
+        self.rejected_unserveable = 0
+        self.hedged = 0
+        self.hedge_wins = 0
+        self.duplicates_suppressed = 0
+        self.late_served_suppressed = 0
+        self.retries = 0
+        self.worker_conn_failures = 0
+
+    # --------------------------------------------------------------- admit
+
+    def submit(self, kind: str, values, mask, priority: str = "interactive",
+               deadline_s: float | None = None) -> PoolRequest:
+        """Admit one request; returns its handle (terminal on door
+        rejection).  ``deadline_s`` is RELATIVE seconds (None = config
+        default)."""
+        from csmom_tpu.chaos.inject import checkpoint
+        from csmom_tpu.obs import metrics
+
+        values = np.asarray(values)
+        mask = np.asarray(mask, dtype=bool)
+        n_assets = int(values.shape[0]) if values.ndim == 2 else 0
+        rel = (self.config.default_deadline_s if deadline_s is None
+               else deadline_s)
+        now = mono_now_s()
+        req = PoolRequest(
+            kind=kind, n_assets=n_assets, priority=priority,
+            deadline_s=None if rel is None else now + rel, t_submit_s=now)
+        with self._lock:
+            self.admitted += 1
+        checkpoint("pool.route", kind=kind, req=req.req_id)
+        reason = self._unserveable_reason(kind, values, mask)
+        if reason is not None:
+            self._terminate(req, "rejected", error=reason, unserveable=True)
+            metrics.counter("serve_pool.rejected_unserveable").inc()
+            return req
+        t = threading.Thread(
+            target=self._drive, args=(req, values, mask),
+            name=f"csmom-pool-req-{req.req_id}", daemon=True)
+        t.start()
+        return req
+
+    def _unserveable_reason(self, kind: str, values, mask) -> str | None:
+        # same door checks as service.submit: an unserveable request must
+        # fail here, not burn dispatch attempts on every worker in turn
+        if kind not in ENDPOINTS:
+            return f"unknown endpoint {kind!r} (serveable: {ENDPOINTS})"
+        if values.ndim != 2:
+            return f"panel must be [assets, months], got ndim={values.ndim}"
+        if values.shape[1] != self.spec.months:
+            return (f"panel has {values.shape[1]} months; this pool scores "
+                    f"{self.spec.months}-month histories")
+        if self.spec.asset_bucket_for(values.shape[0]) is None:
+            return (f"{values.shape[0]} assets exceeds the largest bucket "
+                    f"({self.spec.max_assets})")
+        if mask.shape != values.shape:
+            return (f"mask shape {mask.shape} does not match the values "
+                    f"panel {values.shape}")
+        return None
+
+    # ------------------------------------------------------------ dispatch
+
+    def _pick_worker(self, exclude: set):
+        workers = [w for w in self._workers_fn()
+                   if w.worker_id not in exclude]
+        if not workers:
+            return None
+        return workers[next(self._rr) % len(workers)]
+
+    def _hedge_delay(self, req: PoolRequest, now: float) -> float:
+        rem = req.remaining_s(now)
+        if rem is None:
+            return self.config.hedge_after_s
+        return max(self.config.hedge_floor_s,
+                   self.config.hedge_fraction * rem)
+
+    def _drive(self, req: PoolRequest, values, mask) -> None:
+        """Attempt loop: primary, hedge-on-delay, failover-on-error.
+
+        Event-driven: the loop sleeps on the attempt-conclusion event
+        with a timeout set to the next interesting instant (hedge timer,
+        deadline), and on every wake acts on exactly one of: a terminal
+        state (done), a concluded-but-failed attempt (failover or
+        settle), the hedge timer (launch the hedge, at most once), or
+        the deadline (expire — after a short grace when a dispatch is
+        still in flight, since its work is already spent)."""
+        from csmom_tpu.chaos.inject import checkpoint
+        from csmom_tpu.obs import metrics
+
+        tried: set = set()
+        failures: list = []
+        state: dict = {"done": threading.Event(), "lock": threading.Lock(),
+                       "in_flight": 0, "concluded": 0}
+
+        def launch(is_hedge: bool) -> bool:
+            worker = self._pick_worker(tried)
+            if worker is None:
+                return False
+            tried.add(worker.worker_id)
+            with self._lock:
+                req.attempts += 1
+                if is_hedge:
+                    req.hedged = True
+            with state["lock"]:
+                state["in_flight"] += 1
+            threading.Thread(
+                target=self._attempt, args=(req, worker, values, mask,
+                                            is_hedge, state, failures),
+                daemon=True).start()
+            return True
+
+        if not launch(False):
+            self._terminate(req, "rejected", infra=True,
+                            error="no ready worker in the pool (all "
+                                  "crashed, draining, or never became "
+                                  "ready)")
+            metrics.counter("serve_pool.rejected_infra").inc()
+            return
+        hedge_at = mono_now_s() + self._hedge_delay(req, mono_now_s())
+        acted = 0
+        while True:
+            if req.state in TERMINAL_STATES:
+                return
+            now = mono_now_s()
+            rem = req.remaining_s(now)
+            with state["lock"]:
+                in_flight = state["in_flight"]
+                concluded = state["concluded"]
+            if concluded > acted:
+                acted = concluded
+                state["done"].clear()
+                if in_flight == 0:
+                    # every launched attempt failed: failover while the
+                    # budget and the worker menu allow, else settle
+                    if ((rem is None or rem > 0)
+                            and req.attempts < self.config.max_attempts
+                            and launch(False)):
+                        with self._lock:
+                            self.retries += 1
+                        metrics.counter("serve_pool.retries").inc()
+                        continue
+                    self._settle(req, failures)
+                    return
+                continue  # a loser concluded; the other attempt lives on
+            if rem is not None and rem <= 0:
+                if in_flight == 0 or rem <= -_LATE_GRACE_S:
+                    self._terminate(req, "expired",
+                                    error="deadline expired before any "
+                                          "worker answered")
+                    metrics.counter("serve_pool.expired").inc()
+                    return
+            if (hedge_at is not None and now >= hedge_at
+                    and req.attempts < self.config.max_attempts):
+                hedge_at = None  # hedge at most once per request
+                if launch(True):
+                    with self._lock:
+                        self.hedged += 1
+                    checkpoint("pool.hedge", kind=req.kind, req=req.req_id)
+                    metrics.counter("serve_pool.hedges").inc()
+                continue
+            waits = [0.25]  # heartbeat: re-evaluate even with no event
+            if hedge_at is not None:
+                waits.append(max(0.001, hedge_at - now))
+            if rem is not None:
+                waits.append(max(0.001, rem + _LATE_GRACE_S))
+            state["done"].wait(timeout=min(waits))
+
+    def _settle(self, req: PoolRequest, failures: list) -> None:
+        """Close the books on a request no attempt could serve."""
+        from csmom_tpu.obs import metrics
+
+        now = mono_now_s()
+        if req.deadline_s is not None and now > req.deadline_s:
+            self._terminate(req, "expired",
+                            error="deadline expired with every dispatch "
+                                  "attempt failed")
+            metrics.counter("serve_pool.expired").inc()
+            return
+        reason = "; ".join(failures[-3:]) or "no worker answered"
+        # infra iff the pool itself failed (dead sockets, crashed
+        # workers); an honest worker-level rejection (backpressure,
+        # draining) settling here is the pool's honest answer
+        infra = (all("connection failed" in f for f in failures)
+                 if failures else True)
+        self._terminate(req, "rejected", infra=infra,
+                        error=f"all {req.attempts} attempt(s) failed: "
+                              f"{reason}"[:300])
+        metrics.counter("serve_pool.rejected_infra" if infra
+                        else "serve_pool.rejected").inc()
+
+    def _attempt(self, req: PoolRequest, worker, values, mask,
+                 is_hedge: bool, state: dict, failures: list) -> None:
+        """One dispatch attempt against one worker (its own socket)."""
+        from csmom_tpu.obs import metrics, span
+
+        now = mono_now_s()
+        rem = req.remaining_s(now)
+        # a deadline-less request must outwait the WORKER's own terminal
+        # wait (_NO_DEADLINE_WAIT_S in worker.py) — a shorter socket
+        # timeout here would misread slow-but-successful work as an
+        # infra failure and throw the result away
+        wait_budget = rem if rem is not None else _NO_DEADLINE_ATTEMPT_S
+        timeout = (self.config.connect_timeout_s + wait_budget
+                   + _TERMINAL_GRACE_S)
+        try:
+            with span("pool.attempt", phase="row", kind=req.kind,
+                      worker=worker.worker_id, hedge=is_hedge):
+                obj, arrays = proto.request(
+                    worker.socket_path,
+                    {"op": "score", "kind": req.kind,
+                     "req_id": req.req_id, "priority": req.priority,
+                     "deadline_rel_s": rem},
+                    arrays={"values": values, "mask": mask},
+                    timeout_s=timeout)
+        except (OSError, proto.ProtocolError) as e:
+            with self._lock:
+                self.worker_conn_failures += 1
+            metrics.counter("serve_pool.worker_conn_failures").inc()
+            failures.append(
+                f"{worker.worker_id}: connection failed "
+                f"({type(e).__name__}: {e})"[:160])
+            self._conclude_attempt(state)
+            return
+        resp_state = obj.get("state")
+        if resp_state == "served":
+            result = (obj.get("result_obj") if "result_obj" in obj
+                      else arrays.get("result"))
+            if result is not None and not isinstance(result, dict):
+                result = np.asarray(result)[:req.n_assets]
+            won = self._terminate(req, "served", result=result,
+                                  worker_id=obj.get("worker_id"),
+                                  hedge_win=is_hedge)
+            if won:
+                metrics.counter("serve_pool.served").inc()
+            self._conclude_attempt(state)
+            return
+        # a worker-level rejection/expiry is a failed attempt, not (yet)
+        # the request's fate — another worker may still serve it
+        failures.append(
+            f"{worker.worker_id}: {resp_state}: {obj.get('error')}"[:160])
+        self._conclude_attempt(state)
+
+    @staticmethod
+    def _conclude_attempt(state: dict) -> None:
+        with state["lock"]:
+            state["in_flight"] -= 1
+            state["concluded"] += 1
+        state["done"].set()
+
+    # ------------------------------------------------------------ terminal
+
+    def _terminate(self, req: PoolRequest, state: str, result=None,
+                   error: str | None = None, worker_id: str | None = None,
+                   infra: bool = False, unserveable: bool = False,
+                   hedge_win: bool = False) -> bool:
+        """Exactly-once terminal transition; returns True iff this call
+        won.  A losing ``served`` (the hedge pair both answered) counts
+        ``duplicates_suppressed`` — the duplicate is EXPECTED under
+        hedging; silently double-counting it would break the books."""
+        with self._lock:
+            if req.state in TERMINAL_STATES:
+                if state == "served":
+                    if req.hedged:
+                        # the expected loser of a hedge pair
+                        self.duplicates_suppressed += 1
+                    else:
+                        # an UNhedged late answer (e.g. a worker replying
+                        # after the router expired the request): also
+                        # suppressed, but counted apart — the
+                        # duplicates_suppressed <= hedged invariant is
+                        # about hedge arithmetic, and a slow worker must
+                        # not read as "exactly-once broke"
+                        self.late_served_suppressed += 1
+                return False
+            req.state = state
+            req.result = result
+            if error is not None:
+                req.error = error
+            req.worker_id = worker_id
+            req.t_done_s = mono_now_s()
+            if state == "served":
+                self.served += 1
+                if hedge_win:
+                    self.hedge_wins += 1
+            elif state == "expired":
+                self.expired += 1
+            else:
+                self.rejected += 1
+                if infra:
+                    self.rejected_infra += 1
+                if unserveable:
+                    self.rejected_unserveable += 1
+            req._done.set()
+        return True
+
+    # ---------------------------------------------------------- accounting
+
+    def accounting(self) -> dict:
+        with self._lock:
+            return {
+                "admitted": self.admitted,
+                "served": self.served,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "rejected_infra": self.rejected_infra,
+                "rejected_unserveable": self.rejected_unserveable,
+                "hedged": self.hedged,
+                "hedge_wins": self.hedge_wins,
+                "duplicates_suppressed": self.duplicates_suppressed,
+                "late_served_suppressed": self.late_served_suppressed,
+                "retries": self.retries,
+                "worker_conn_failures": self.worker_conn_failures,
+            }
+
+    def availability(self) -> float:
+        """``1 - rejected_infra / admitted``: the fraction of admitted
+        requests that got an HONEST answer (served, backpressure-
+        rejected, or client-deadline-expired).  Only infra failures —
+        the pool failing its own job — count against it."""
+        a = self.accounting()
+        if not a["admitted"]:
+            return 1.0
+        return round(1.0 - a["rejected_infra"] / a["admitted"], 6)
+
+    def invariant_violations(self) -> list:
+        """Closed books across the process boundary (empty = holds)."""
+        a = self.accounting()
+        out = []
+        total = a["served"] + a["rejected"] + a["expired"]
+        if total != a["admitted"]:
+            out.append(
+                f"pool accounting broken: served {a['served']} + rejected "
+                f"{a['rejected']} + expired {a['expired']} = {total} != "
+                f"admitted {a['admitted']}")
+        if a["hedge_wins"] > a["hedged"]:
+            out.append(f"hedge_wins {a['hedge_wins']} > hedged "
+                       f"{a['hedged']}")
+        if a["duplicates_suppressed"] > a["hedged"]:
+            out.append(
+                f"duplicates_suppressed {a['duplicates_suppressed']} > "
+                f"hedged {a['hedged']} — a duplicate without a hedge "
+                "means a terminal state fired twice")
+        if a["rejected_infra"] + a["rejected_unserveable"] > a["rejected"]:
+            out.append("rejection sub-counters exceed rejected")
+        return out
+
+
+_TERMINAL_GRACE_S = 5.0
+# deadline grace while a dispatch is still in flight: the worker's work
+# is already spent, so a response landing a beat late still counts
+_LATE_GRACE_S = 1.0
+# attempt wait for deadline-less requests — matches the worker's
+# _NO_DEADLINE_WAIT_S so the two sides give up together
+_NO_DEADLINE_ATTEMPT_S = 30.0

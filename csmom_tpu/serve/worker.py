@@ -1,0 +1,372 @@
+"""Pool worker: one process owning one ``SignalService``, behind a socket.
+
+``python -m csmom_tpu.serve.worker --socket PATH ...`` runs the existing
+in-process micro-batching service (:mod:`csmom_tpu.serve.service`)
+wrapped in the pool wire protocol (:mod:`csmom_tpu.serve.proto`): the
+router connects per dispatch attempt, the supervisor connects for
+probes and lifecycle ops.  The process is the isolation unit — a crash,
+a GIL stall, or a restart here takes down ONE worker's queue, and the
+router's hedged retries route around it.
+
+Startup discipline (the order is the contract):
+
+1. **Version gate first.**  With ``--expect-cache-version``, the worker
+   computes its own :func:`csmom_tpu.serve.health.aot_cache_version` and
+   on mismatch REFUSES to serve: a pointed message on stderr and exit
+   ``RC_VERSION_SKEW`` — before any warm, so version skew between the
+   router's deploy and this worker's code can never become a fresh
+   compile inside the serving window.
+2. **Cold-cache honesty.**  With ``--require-warm-cache`` (the jax
+   engine's default in pool mode), :func:`health.cache_readiness` must
+   pass before warming begins; otherwise exit ``RC_COLD_CACHE`` pointing
+   at ``csmom warmup --profiles serve``.  Warm-before-ready is only
+   cheap when the serialized-executable cache is the deploy artifact.
+3. **Liveness before readiness.**  The socket binds and answers ``ping``
+   immediately; ``ready`` reports ``ok: false, reason: warming`` until
+   the service has warmed every bucket shape AND served one self-probe
+   request per endpoint end-to-end — readiness is demonstrated, never
+   declared.
+
+Chaos: the service's ``serve.admit``/``serve.coalesce``/
+``serve.dispatch`` checkpoints all fire inside this process (the fault
+plan arrives by env inheritance from the supervisor), so a plan's
+``kill`` at ``serve.dispatch`` is a REAL worker-process death mid-batch
+— the scenario the rehearsal matrix and ``SERVE_POOL_r11.json`` pin.
+``CSMOM_SERVE_WORKER_FAULT=exit:<rc>`` additionally makes the process
+exit at startup (the supervisor backoff-cap rehearsals need a
+deterministic crash-looper).
+
+All timing through :func:`csmom_tpu.utils.deadline.mono_now_s` (the
+time-discipline lint pins this module like the rest of serve/).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import sys
+import threading
+
+import numpy as np
+
+from csmom_tpu.serve import health, proto
+from csmom_tpu.serve.buckets import ENDPOINTS
+from csmom_tpu.utils.deadline import mono_now_s
+
+__all__ = ["RC_COLD_CACHE", "RC_VERSION_SKEW", "WorkerServer", "main"]
+
+RC_COLD_CACHE = 3      # AOT cache missing/stale for the selected profile
+RC_VERSION_SKEW = 4    # --expect-cache-version did not match ours
+
+# startup chaos knob (crash-loop rehearsals): "exit:<rc>" exits rc
+FAULT_ENV = "CSMOM_SERVE_WORKER_FAULT"
+
+# grace beyond a request's own deadline before the worker gives up
+# waiting for a terminal state (the service guarantees terminality; this
+# bounds the reply even if that guarantee breaks)
+_TERMINAL_GRACE_S = 5.0
+_NO_DEADLINE_WAIT_S = 30.0
+
+
+class WorkerServer:
+    """The socket front of one in-process :class:`SignalService`."""
+
+    def __init__(self, socket_path: str, config, worker_id: str = "w0"):
+        from csmom_tpu.serve.service import SignalService
+
+        self.socket_path = socket_path
+        self.worker_id = worker_id
+        self.service = SignalService(config)
+        self._ready_lock = threading.Lock()
+        self._ready_report = {"ok": False, "reason": "warming",
+                              "worker_id": worker_id}
+        self._draining = False
+        self._stop = threading.Event()
+        self._listener: socket.socket | None = None
+        self.cache_version: str | None = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def bind(self) -> None:
+        """Bind + listen and start answering (liveness is up from here;
+        readiness stays false until :meth:`warm_and_probe` succeeds)."""
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(64)
+        self._listener.settimeout(0.2)
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"csmom-worker-{self.worker_id}-accept",
+                             daemon=True)
+        t.start()
+
+    def warm_and_probe(self) -> dict:
+        """Warm every bucket shape, then demonstrate readiness: one
+        self-probe request per endpoint through the full pipeline; ready
+        iff all served with zero fresh compiles since the warm snapshot."""
+        self.service.start()
+        spec = self.service.spec
+        A = spec.asset_buckets[0]
+        rng = np.random.default_rng(0)
+        probes = {}
+        for kind in ENDPOINTS:
+            v = 100.0 * np.exp(np.cumsum(
+                rng.normal(0, 0.03, (A, spec.months)), axis=1))
+            req = self.service.submit(kind, v.astype(np.float32),
+                                      np.ones((A, spec.months), bool),
+                                      deadline_s=10.0)
+            req.wait(15.0)
+            probes[kind] = req.state
+        fresh = self.service.fresh_compiles()
+        ok = (all(s == "served" for s in probes.values())
+              and (not isinstance(fresh, int) or fresh == 0))
+        if self.service.engine.name == "stub":
+            platform = "stub"
+        else:
+            import jax
+
+            platform = jax.default_backend()
+        report = {
+            "ok": ok,
+            "worker_id": self.worker_id,
+            "pid": os.getpid(),
+            "platform": platform,
+            "engine": self.service.engine.name,
+            "profile": spec.name,
+            "cache_version": self.cache_version,
+            "warm": self.service.warm_report,
+            "probes": probes,
+            "fresh_compiles": fresh,
+            "reason": None if ok else (
+                f"self-probe states {probes}, fresh_compiles={fresh!r}"),
+        }
+        with self._ready_lock:
+            self._ready_report = report
+        return report
+
+    def run_until_stopped(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(0.2)
+        self._shutdown()
+
+    def _shutdown(self) -> None:
+        # drain before the lights go out: the SIGTERM path reaches here
+        # without a "stop" op, and queued requests must still terminate
+        # (idempotent when the stop op already drained)
+        try:
+            self.service.stop(drain=True, timeout_s=10.0)
+        except Exception:
+            pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ------------------------------------------------------------- serving
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed under us: shutting down
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.settimeout(60.0)
+        try:
+            obj, arrays = proto.recv_msg(conn)
+            reply, reply_arrays = self._handle(obj, arrays)
+            proto.send_msg(conn, reply, reply_arrays)
+            if obj.get("op") == "stop":
+                self.stop()
+        except (OSError, proto.ProtocolError):
+            pass  # the peer vanished or spoke garbage: drop the conn
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, obj: dict, arrays: dict) -> tuple:
+        op = obj.get("op")
+        if op == "ping":
+            return {"ok": True, "worker_id": self.worker_id,
+                    "pid": os.getpid()}, None
+        if op == "ready":
+            with self._ready_lock:
+                report = dict(self._ready_report)
+            if self._draining:
+                report["ok"] = False
+                report["reason"] = "draining"
+            return report, None
+        if op == "stats":
+            return self._stats(), None
+        if op == "score":
+            return self._score(obj, arrays)
+        if op in ("drain", "stop"):
+            self._draining = True
+            self.service.stop(drain=True)
+            out = self._stats()
+            out["drained"] = True
+            return out, None
+        return {"ok": False, "error": f"unknown op {op!r}"}, None
+
+    def _stats(self) -> dict:
+        return {
+            "ok": True,
+            "worker_id": self.worker_id,
+            "pid": os.getpid(),
+            "accounting": self.service.accounting(),
+            "batches": self.service.batch_stats(),
+            "fresh_compiles": self.service.fresh_compiles(),
+            "invariant_violations": self.service.invariant_violations(),
+        }
+
+    def _score(self, obj: dict, arrays: dict) -> tuple:
+        if self._draining:
+            return {"state": "rejected", "error": "worker draining",
+                    "worker_id": self.worker_id}, None
+        if "values" not in arrays or "mask" not in arrays:
+            return {"state": "rejected",
+                    "error": "score frame missing values/mask arrays",
+                    "worker_id": self.worker_id}, None
+        rel = obj.get("deadline_rel_s")
+        req = self.service.submit(
+            str(obj.get("kind")), arrays["values"], arrays["mask"],
+            priority=str(obj.get("priority", "interactive")),
+            deadline_s=float(rel) if rel is not None else None,
+        )
+        wait_s = (float(rel) + _TERMINAL_GRACE_S if rel is not None
+                  else _NO_DEADLINE_WAIT_S)
+        if not req.wait(wait_s):
+            # the service contract says this is unreachable; answering
+            # anyway bounds the router's exposure to a broken worker
+            return {"state": "rejected",
+                    "error": "request never reached a terminal state "
+                             f"within {wait_s:.1f}s (worker defect)",
+                    "worker_id": self.worker_id}, None
+        reply = {
+            "state": req.state,
+            "error": req.error,
+            "worker_id": self.worker_id,
+            "queue_wait_s": req.queue_wait_s,
+            "service_s": req.service_s,
+        }
+        out_arrays = None
+        if req.state == "served":
+            if isinstance(req.result, dict):
+                reply["result_obj"] = {k: float(v)
+                                       for k, v in req.result.items()}
+            else:
+                out_arrays = {"result": np.asarray(req.result)}
+        return reply, out_arrays
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="csmom_tpu.serve.worker",
+        description="pool worker: SignalService behind a unix socket")
+    ap.add_argument("--socket", required=True, help="unix socket path")
+    ap.add_argument("--worker-id", dest="worker_id", default="w0")
+    ap.add_argument("--profile", default="serve")
+    ap.add_argument("--engine", default="jax", choices=["jax", "stub"])
+    ap.add_argument("--capacity", type=int, default=64)
+    ap.add_argument("--max-wait-ms", dest="max_wait_ms", type=float,
+                    default=10.0)
+    ap.add_argument("--deadline-ms", dest="deadline_ms", type=float,
+                    default=500.0)
+    ap.add_argument("--expect-cache-version", dest="expect_cache_version",
+                    help="refuse ready unless our computed AOT cache "
+                         "version matches (the rolling-deploy skew gate)")
+    ap.add_argument("--require-warm-cache", dest="require_warm_cache",
+                    action="store_true",
+                    help="exit nonzero when the AOT cache is cold/stale "
+                         "for --profile instead of compiling at warm")
+    ap.add_argument("--cache-subdir", dest="cache_subdir", default="bench",
+                    help="persistent-cache namespace shared with "
+                         "`csmom warmup` (default 'bench')")
+    args = ap.parse_args(argv)
+
+    fault = os.environ.get(FAULT_ENV, "")
+    if fault.startswith("exit:"):
+        print(f"[worker {args.worker_id}] chaos {FAULT_ENV}={fault}: "
+              "exiting at startup", file=sys.stderr, flush=True)
+        return int(fault.split(":", 1)[1] or 1)
+
+    my_version = health.aot_cache_version(args.profile)
+    if (args.expect_cache_version
+            and args.expect_cache_version != my_version):
+        print(
+            f"[worker {args.worker_id}] REFUSING READY: AOT cache version "
+            f"skew — supervisor expects {args.expect_cache_version}, this "
+            f"worker's code computes {my_version} (bucket grid / endpoint "
+            "set / engine params / jax release differ).  Serving would "
+            "compile fresh shapes inside the window; redeploy matching "
+            f"code and {health.WARMUP_POINTER}",
+            file=sys.stderr, flush=True,
+        )
+        return RC_VERSION_SKEW
+
+    if args.engine == "jax" and args.require_warm_cache:
+        ready, reason = health.cache_readiness(args.profile,
+                                               args.cache_subdir)
+        if not ready:
+            print(f"[worker {args.worker_id}] NOT READY: {reason}",
+                  file=sys.stderr, flush=True)
+            return RC_COLD_CACHE
+
+    if args.engine == "jax":
+        # point jax at the shared serialized-executable cache BEFORE the
+        # first trace, so warm() loads what `csmom warmup` compiled
+        from csmom_tpu.utils.jit_cache import enable_persistent_cache
+
+        enable_persistent_cache(args.cache_subdir, min_compile_s=0.0)
+
+    from csmom_tpu.serve.service import ServeConfig
+
+    cfg = ServeConfig(
+        profile=args.profile, engine=args.engine, capacity=args.capacity,
+        max_wait_s=args.max_wait_ms / 1e3,
+        default_deadline_s=(None if args.deadline_ms in (None, 0)
+                            else args.deadline_ms / 1e3),
+    )
+    server = WorkerServer(args.socket, cfg, worker_id=args.worker_id)
+    server.cache_version = my_version
+
+    def _term(signum, frame):  # graceful drain on SIGTERM
+        server.stop()
+
+    signal.signal(signal.SIGTERM, _term)
+
+    server.bind()
+    t0 = mono_now_s()
+    report = server.warm_and_probe()
+    print(f"[worker {args.worker_id}] pid {os.getpid()} "
+          f"{'READY' if report['ok'] else 'NOT READY'} in "
+          f"{mono_now_s() - t0:.2f}s: probes {report['probes']}, "
+          f"fresh_compiles {report['fresh_compiles']!r}",
+          file=sys.stderr, flush=True)
+    server.run_until_stopped()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,10 +33,12 @@ def _run_cli(args, timeout):
 
 def test_fast_tier_is_small_and_capture_path_only():
     fast = builtin_matrix(fast=True)
-    assert 1 <= len(fast) <= 5, "the fast tier must stay <= 5 faults"
+    assert 1 <= len(fast) <= 8, "the fast tier must stay <= 8 faults"
     # mini/shell run as jax-free subprocesses; serve runs IN-PROCESS on
-    # the stub engine — none may need a jax-importing rehearsed pipeline
-    assert all(s.pipeline in ("mini", "shell", "serve") for s in fast), (
+    # the stub engine; serve-pool spawns stub-engine worker PROCESSES —
+    # none may need a jax-importing rehearsed pipeline
+    assert all(s.pipeline in ("mini", "shell", "serve", "serve-pool")
+               for s in fast), (
         "fast-tier scenarios must not need jax-importing pipelines"
     )
     # the r4/r5 family (deadline loses measured rows) must be represented
@@ -45,6 +47,13 @@ def test_fast_tier_is_small_and_capture_path_only():
     serve = [s.name for s in fast if s.pipeline == "serve"]
     assert any("worker-kill" in n for n in serve), serve
     assert any("deadline-storm" in n for n in serve), serve
+    # ISSUE 6: the three pool scenarios ride in the fast tier — a real
+    # worker-process kill, a rolling restart under load, and the
+    # AOT-cache version-skew refusal
+    pool = [s.name for s in fast if s.pipeline == "serve-pool"]
+    assert any("worker-kill" in n for n in pool), pool
+    assert any("rolling-restart" in n for n in pool), pool
+    assert any("version-skew" in n for n in pool), pool
 
 
 def test_rehearse_fast_runs_green_and_quick():

@@ -86,6 +86,47 @@ def test_queue_full_rejects_with_retry_after_hint():
     assert a["admitted"] == 4 and a["rejected_queue_full"] == 1
 
 
+def test_retry_after_cold_start_is_bounded():
+    """ISSUE 6 satellite: before ANYTHING has been served the EMA drain
+    rate is undefined — the very first overload rejection must still
+    carry a bounded float hint (never None), and however pathological
+    the drain estimate gets, the hint is capped."""
+    from csmom_tpu.serve.queue import (
+        RETRY_AFTER_MAX_S,
+        RETRY_AFTER_MIN_S,
+    )
+
+    months = 24
+
+    def mk():
+        v, m = _panel(2, months)
+        return Request(kind="momentum", values=v, mask=m, n_assets=2)
+
+    # cold queue (nothing ever served): fill to capacity, then reject
+    q = AdmissionQueue(capacity=2)
+    for _ in range(2):
+        q.submit(mk())
+    r = q.submit(mk())
+    assert r.state == "rejected"
+    assert isinstance(r.retry_after_s, float), (
+        f"cold-start retry-after must be a float, got {r.retry_after_s!r}")
+    assert RETRY_AFTER_MIN_S <= r.retry_after_s <= RETRY_AFTER_MAX_S
+    # degenerate EMA (0.0 is falsy): still bounded, still a float
+    q2 = AdmissionQueue(capacity=1)
+    q2._ema_per_req_s = 0.0
+    q2.submit(mk())
+    r2 = q2.submit(mk())
+    assert RETRY_AFTER_MIN_S <= r2.retry_after_s <= RETRY_AFTER_MAX_S
+    # pathological drain estimate: the cap holds (a bounded queue never
+    # advises a retry further out than RETRY_AFTER_MAX_S)
+    q3 = AdmissionQueue(capacity=64)
+    q3._ema_per_req_s = 30.0
+    for _ in range(64):
+        q3.submit(mk())
+    r3 = q3.submit(mk())
+    assert r3.retry_after_s == RETRY_AFTER_MAX_S
+
+
 def test_expired_while_queued_is_never_dispatched():
     svc = _stub_service()
     months = svc.spec.months

@@ -149,6 +149,12 @@ def test_only_round_sidecars_are_committed():
     assert not inv.committable_sidecar("SERVE_smoke.json")
     assert not inv.committable_sidecar("SERVE_rehearse_x.json")
     assert not inv.committable_sidecar("SERVE_r10-999.json")
+    # ISSUE 6: the pool family obeys the same rule
+    assert inv.committable_sidecar("SERVE_POOL_r11.json")
+    assert not inv.committable_sidecar("SERVE_POOL_smoke.json")
+    assert not inv.committable_sidecar(
+        "SERVE_POOL_rehearse_pool-worker-kill-mid-batch.json")
+    assert not inv.committable_sidecar("SERVE_POOL_r11-42.json")
     # other families are not this rule's business
     assert inv.committable_sidecar("BENCH_r04.json")
 
@@ -171,6 +177,14 @@ def test_serve_modules_route_all_timing_through_deadline_helpers():
         "csmom_tpu/serve/service.py",
         "csmom_tpu/serve/loadgen.py",
         "csmom_tpu/cli/serve.py",
+        # the ISSUE 6 pool tier rides under the same pin: deadlines the
+        # router hedges on and the walls the artifact records must be
+        # the same clock the single-process service uses
+        "csmom_tpu/serve/proto.py",
+        "csmom_tpu/serve/health.py",
+        "csmom_tpu/serve/worker.py",
+        "csmom_tpu/serve/router.py",
+        "csmom_tpu/serve/supervisor.py",
     )
     for rel in serve_modules:
         path = os.path.join(_REPO, rel)
