@@ -41,6 +41,9 @@ serve.dispatch       serve worker, before the engine call        fail, sleep, ki
 pool.route           pool router, at request admission           sleep
 pool.hedge           pool router, when a hedge fires             sleep
 pool.spawn           pool supervisor, before spawning a worker   sleep
+stream.tick          replay feed, per generated tick             tick_late, tick_dup, tick_drop
+stream.ingest        stream ingestor, per offered tick           sleep
+stream.serve         replay serve probe, per probe               version_skew, sleep
 ===================  =========================================  ==========
 
 The ``serve.*`` points run in the signal service's own threads.  In the
@@ -219,7 +222,8 @@ def _execute(fault, seed: int, point: str, ctx: dict) -> None:
         )
     elif act == "stdout_noise":
         _start_stdout_noise(fault, seed)
-    elif act == "fail":
+    elif act in ("fail", "tick_late", "tick_dup", "tick_drop",
+                 "version_skew"):
         pass  # the return value is the fault; the caller interprets it
     else:  # pragma: no cover - plan.validate() bars unknown actions
         raise ValueError(f"unknown fault action {act!r}")

@@ -54,6 +54,17 @@ Artifact kinds (detected from keys, see :func:`detect_kind`):
     pair that both answered counts exactly one terminal state and one
     ``duplicates_suppressed``; suppressed/wins can never exceed hedges)
     and an ``availability`` that reconciles with ``rejected_infra``.
+``replay``
+    An event-time replay record (``REPLAY_*.json``,
+    :mod:`csmom_tpu.stream.replay`): TWO closed books as schema rules —
+    the tick ledger (``applied + merged_late + quarantined + deduped ==
+    offered`` and ``offered == generated + duplicated - dropped_gap``:
+    every tick the feed emitted is in exactly one bucket) and the serve
+    book (same balanced-requests rule as kind ``serve``) — plus
+    ingest-vs-serve panel-version reconciliation: every served
+    response's ``panel_version`` must be one the ingestor issued
+    (``serve_max <= ingest_final``), and skew refusals must reconcile
+    with the serve book's ``rejected_version_skew`` counter.
 
 Partial rules: a partial artifact carries ``extra.partial`` (non-empty
 string saying *what* is missing); a partial with a measurement list
@@ -98,15 +109,19 @@ KNOWN_SERVE_SCHEMA_VERSIONS = (1,)
 # multi-process tier) — closed-world like the rest
 KNOWN_SERVE_POOL_SCHEMA_VERSIONS = (1,)
 
+# replay artifact schema versions (REPLAY_*.json, the event-time
+# streaming harness) — closed-world like the rest
+KNOWN_REPLAY_SCHEMA_VERSIONS = (1,)
+
 # only ROUND sidecars are committed evidence: TELEMETRY_r<NN>.json,
 # SERVE_r<NN>.json, and SERVE_POOL_r<NN>.json.  Rehearse/smoke/scratch
 # files (TELEMETRY_rehearse_*, SERVE_smoke*, SERVE_POOL_rehearse_*,
 # pid-suffixed operator reruns) are regenerated per run and gitignored —
 # one slipped into the tree once, which is why this is a named rule with
 # a tier-1 test behind it instead of a .gitignore comment.
-_REGENERATED_PREFIXES = ("TELEMETRY_", "SERVE_")
+_REGENERATED_PREFIXES = ("TELEMETRY_", "SERVE_", "REPLAY_")
 _COMMITTED_SIDECAR_RE = re.compile(
-    r"^(?:TELEMETRY|SERVE|SERVE_POOL)_r\d+\.json$")
+    r"^(?:TELEMETRY|SERVE|SERVE_POOL|REPLAY)_r\d+\.json$")
 
 _NUM = (int, float)
 
@@ -138,8 +153,11 @@ def trailing_json(text: str):
 def detect_kind(obj: dict) -> str | None:
     if not isinstance(obj, dict):
         return None
-    # pool before serve, serve before record: each carries the previous
-    # kind's key signature plus its own
+    # replay before pool, pool before serve, serve before record: each
+    # carries the previous kind's key signature plus its own
+    if obj.get("kind") == "replay" or {"ticks", "panel",
+                                       "reconcile"} <= set(obj):
+        return "replay"
     if obj.get("kind") == "serve_pool" or {"requests", "availability",
                                            "hedge"} <= set(obj):
         return "serve_pool"
@@ -405,32 +423,9 @@ def _validate_serve(obj: dict) -> list:
     req = _require(obj, "requests", dict, "serve", out)
     served = 0
     if req is not None:
-        for k in ("admitted", "served", "rejected", "expired",
-                  "expired_dispatched"):
-            v = req.get(k)
-            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
-                out.append(f"serve: requests.{k} must be a non-negative "
-                           "int (the accounting is the contract)")
-                req = None
-                break
+        req = _validate_serve_requests(req, "serve", out)
         if req is not None:
             served = req["served"]
-            total = req["served"] + req["rejected"] + req["expired"]
-            if total != req["admitted"]:
-                out.append(
-                    f"serve: request accounting broken — served "
-                    f"{req['served']} + rejected {req['rejected']} + "
-                    f"expired {req['expired']} = {total} != admitted "
-                    f"{req['admitted']} (a request was dropped or "
-                    "double-counted)"
-                )
-            if req["expired_dispatched"] != 0:
-                out.append(
-                    f"serve: expired_dispatched = "
-                    f"{req['expired_dispatched']} — a request that "
-                    "expired while queued must be cancelled, never "
-                    "dispatched"
-                )
 
     lat = _require(obj, "latency_ms", dict, "serve", out)
     if lat is not None:
@@ -623,8 +618,190 @@ def _validate_serve_pool(obj: dict) -> list:
     return out
 
 
+def _validate_serve_requests(req: dict, kind: str, out: list) -> dict | None:
+    """The single-process balanced-request-book rule, shared by the
+    ``serve`` kind and the replay artifact's embedded serve book.  The
+    POOL book is deliberately not this rule: its cross-process ledger
+    carries hedging counters instead of ``expired_dispatched`` (the
+    queue-local claim lives inside each worker), so ``serve_pool``
+    keeps its own validator."""
+    for k in ("admitted", "served", "rejected", "expired",
+              "expired_dispatched"):
+        v = req.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            out.append(f"{kind}: requests.{k} must be a non-negative int "
+                       "(the accounting is the contract)")
+            return None
+    total = req["served"] + req["rejected"] + req["expired"]
+    if total != req["admitted"]:
+        out.append(
+            f"{kind}: request accounting broken — served {req['served']} "
+            f"+ rejected {req['rejected']} + expired {req['expired']} = "
+            f"{total} != admitted {req['admitted']} (a request was "
+            "dropped or double-counted)")
+    if req["expired_dispatched"] != 0:
+        out.append(
+            f"{kind}: expired_dispatched = {req['expired_dispatched']} — "
+            "a request that expired while queued must be cancelled, "
+            "never dispatched")
+    return req
+
+
+def _validate_replay(obj: dict) -> list:
+    """The replay artifact contract: closed tick books, closed serve
+    books, and ingest-vs-serve panel-version reconciliation."""
+    out: list = []
+    _require(obj, "run_id", str, "replay", out)
+    ver = _require(obj, "schema_version", int, "replay", out)
+    if ver is not None and ver not in KNOWN_REPLAY_SCHEMA_VERSIONS:
+        out.append(
+            f"replay: unknown schema_version {ver} (this checker "
+            f"understands {list(KNOWN_REPLAY_SCHEMA_VERSIONS)}) — the "
+            "artifact is from a different era of the code; do not "
+            "half-parse it")
+    _require(obj, "wall_s", _NUM, "replay", out, "a number")
+    out += _validate_record(obj, kind="replay")
+
+    ticks = _require(obj, "ticks", dict, "replay", out)
+    if ticks is not None:
+        keys = ("generated", "offered", "applied", "merged_late",
+                "quarantined", "deduped", "dropped_gap", "duplicated")
+        for k in keys:
+            v = ticks.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"replay: ticks.{k} must be a non-negative int "
+                           "(the tick ledger is the contract)")
+                ticks = None
+                break
+    if ticks is not None:
+        landed = (ticks["applied"] + ticks["merged_late"]
+                  + ticks["quarantined"] + ticks["deduped"])
+        if landed != ticks["offered"]:
+            out.append(
+                f"replay: tick accounting broken — applied "
+                f"{ticks['applied']} + merged_late {ticks['merged_late']} "
+                f"+ quarantined {ticks['quarantined']} + deduped "
+                f"{ticks['deduped']} = {landed} != offered "
+                f"{ticks['offered']} (a tick vanished between the feed "
+                "and the ledger)")
+        want_offered = (ticks["generated"] + ticks["duplicated"]
+                        - ticks["dropped_gap"])
+        if ticks["offered"] != want_offered:
+            out.append(
+                f"replay: feed accounting broken — offered "
+                f"{ticks['offered']} != generated {ticks['generated']} + "
+                f"duplicated {ticks['duplicated']} - dropped_gap "
+                f"{ticks['dropped_gap']} = {want_offered}")
+
+    panel = _require(obj, "panel", dict, "replay", out)
+    if panel is not None:
+        for k in ("version_final", "bars_appended", "gap_bars",
+                  "stale_bars", "unfilled_cells"):
+            v = panel.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"replay: panel.{k} must be a non-negative int")
+
+    serve = _require(obj, "serve", dict, "replay", out)
+    req = None
+    if serve is not None:
+        sreq = serve.get("requests")
+        if not isinstance(sreq, dict):
+            out.append("replay: serve.requests must be a dict (the serve "
+                       "book rides inside the replay artifact)")
+        else:
+            req = _validate_serve_requests(sreq, "replay serve", out)
+        _validate_latency_side((serve.get("latency_ms") or {}).get("total"),
+                               "total", "replay", out)
+
+    versions = _require(obj, "versions", dict, "replay", out)
+    if versions is not None and panel is not None:
+        vf = versions.get("ingest_final")
+        if not isinstance(vf, int) or isinstance(vf, bool):
+            out.append("replay: versions.ingest_final must be an int")
+        elif isinstance(panel.get("version_final"), int) \
+                and vf != panel["version_final"]:
+            out.append(
+                f"replay: versions.ingest_final {vf} != "
+                f"panel.version_final {panel['version_final']} — the "
+                "ingest side must agree with itself")
+        smax = versions.get("serve_max")
+        smin = versions.get("serve_min")
+        for name, v in (("serve_min", smin), ("serve_max", smax)):
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v < 0):
+                out.append(f"replay: versions.{name} must be a "
+                           "non-negative int or null")
+        if (isinstance(smax, int) and isinstance(vf, int)
+                and smax > vf):
+            out.append(
+                f"replay: version reconciliation broken — serve answered "
+                f"from panel version {smax} but ingest only ever issued "
+                f"up to {vf} (a response was computed from a version "
+                "that never existed)")
+        if (isinstance(smin, int) and isinstance(smax, int)
+                and smin > smax):
+            out.append("replay: versions.serve_min > serve_max")
+        for name in ("skew_events", "skew_attempts", "skew_refusals"):
+            v = versions.get(name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"replay: versions.{name} must be a "
+                           "non-negative int")
+        sk = versions.get("skew_refusals")
+        ska = versions.get("skew_attempts")
+        if isinstance(sk, int) and isinstance(ska, int) and sk > ska:
+            out.append(
+                f"replay: skew_refusals {sk} > skew_attempts {ska} — "
+                "more refusals than stale requests were ever submitted")
+        if (isinstance(sk, int) and req is not None
+                and sk != req.get("rejected_version_skew", 0)):
+            out.append(
+                f"replay: versions.skew_refusals {sk} does not reconcile "
+                f"with serve.requests.rejected_version_skew "
+                f"{req.get('rejected_version_skew', 0)}")
+
+    rec = _require(obj, "reconcile", dict, "replay", out)
+    if rec is not None:
+        for k in ("count", "drift_events", "rebuilds"):
+            v = rec.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"replay: reconcile.{k} must be a non-negative "
+                           "int")
+        if (isinstance(rec.get("count"), int)
+                and isinstance(rec.get("drift_events"), int)
+                and rec["drift_events"] > rec["count"]):
+            out.append("replay: reconcile.drift_events exceeds "
+                       "reconcile.count")
+
+    stale = _require(obj, "staleness_ms", dict, "replay", out)
+    if stale is not None:
+        vals = []
+        for q in ("p50", "p95", "p99"):
+            v = stale.get(q)
+            if v is None:
+                continue
+            if not isinstance(v, _NUM) or isinstance(v, bool):
+                out.append(f"replay: staleness_ms.{q} must be a number "
+                           "(milliseconds) or null")
+            else:
+                vals.append(v)
+        if vals != sorted(vals):
+            out.append("replay: staleness_ms percentiles must be "
+                       "non-decreasing")
+
+    comp = obj.get("compile")
+    if comp is not None and not isinstance(comp, dict):
+        out.append("replay: compile must be a dict when present")
+    elif isinstance(comp, dict):
+        fc = comp.get("in_window_fresh_compiles")
+        if fc is not None and not isinstance(fc, (int, str)):
+            out.append("replay: compile.in_window_fresh_compiles must be "
+                       "an int count or a reason string")
+    return out
+
+
 _VALIDATORS = {
     "record": _validate_record,
+    "replay": _validate_replay,
     "serve": _validate_serve,
     "serve_pool": _validate_serve_pool,
     "telemetry": _validate_telemetry,
@@ -643,7 +820,8 @@ def validate(obj, kind: str | None = None) -> list:
     if kind is None:
         return ["unrecognized artifact shape: none of the known key "
                 "signatures (record / driver_capture / multichip / phases "
-                "/ tpu_cache / telemetry / serve / serve_pool) match"]
+                "/ tpu_cache / telemetry / serve / serve_pool / replay) "
+                "match"]
     if kind not in _VALIDATORS:
         return [f"unknown artifact kind {kind!r}"]
     return _VALIDATORS[kind](obj)
@@ -712,7 +890,8 @@ def validate_file(path: str) -> list:
 def validate_tree(root: str, patterns=("BENCH_*.json", "MULTICHIP_*.json",
                                        "MULTIHOST_*.json", "HISTRANK_*.json",
                                        "PHASES_*.json", "TELEMETRY_*.json",
-                                       "SERVE_*.json")) -> dict:
+                                       "SERVE_*.json",
+                                       "REPLAY_*.json")) -> dict:
     """``{relative_path: violations}`` for every committed artifact under
     ``root`` matching ``patterns`` (non-recursive: round artifacts land at
     the repo root by contract).  Paths with no violations are included

@@ -119,6 +119,16 @@ class Fault:
         "raise_oserror",  # raise OSError(errno_) at the checkpoint (ENOSPC)
         "stdout_noise",   # concurrent writer racing the trailing JSON line
         "fail",           # return "fail" for the caller to interpret
+        # stream-replay tick faults (ISSUE 7) — like "fail", these are
+        # RESULT faults the caller interprets: the replay feed holds the
+        # tick back (late/out-of-order arrival), re-offers it
+        # (duplicate), or discards it (gap); "version_skew" makes a
+        # serve probe answer from a stale panel snapshot, which the
+        # service's version gate must refuse
+        "tick_late",
+        "tick_dup",
+        "tick_drop",
+        "version_skew",
     )
 
     def validate(self) -> None:

@@ -1669,6 +1669,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     from csmom_tpu.cli.ledger import register as register_ledger
     from csmom_tpu.cli.rehearse import register as register_rehearse
+    from csmom_tpu.cli.replay import register as register_replay
     from csmom_tpu.cli.serve import register as register_serve
     from csmom_tpu.cli.timeline import register as register_timeline
 
@@ -1676,6 +1677,7 @@ def build_parser() -> argparse.ArgumentParser:
     register_timeline(sub)
     register_ledger(sub)
     register_serve(sub)
+    register_replay(sub)
     # the epilog is built AFTER every registration hook has run, from the
     # registry itself — a subcommand cannot exist without appearing here
     p.epilog = _registry_epilog(sub)

@@ -63,6 +63,25 @@ def _rows_of(obj) -> int:
     return inv.measured_rows(obj or {})
 
 
+def _plan_summary(plan) -> str:
+    """One-line digest of a scenario's armed fault plan for ``--list``:
+    ``seed N: point:action[@after][xfires][!]`` per fault (``!`` marks
+    global-once), or the runner-driven note when no plan arms."""
+    if plan is None:
+        return "none (runner-driven faults / env contract)"
+    parts = []
+    for f in plan.faults:
+        p = f"{f.point}:{f.action}"
+        if f.after:
+            p += f"@{f.after}"
+        if f.max_fires != 1:
+            p += f"x{f.max_fires or 'inf'}"
+        if f.global_once:
+            p += "!"
+        parts.append(p)
+    return f"seed {plan.seed}: " + ", ".join(parts)
+
+
 def _check_partial_no_lost_rows(r):
     """A deadline-hit run must land a partial carrying EVERY measured row."""
     out = list(r["headline_violations"])
@@ -390,6 +409,96 @@ def _serve_pool_scenarios():
     ]
 
 
+def _check_replay_tick_storm(r):
+    """ISSUE 7: under a storm of late / out-of-order / duplicate / gap
+    ticks, the replay must keep BOTH closed books (tick ledger + serve
+    book — schema rules of kind ``replay``), materialize the gap as a
+    stale bar instead of carrying the last price, and the incremental
+    signals must still reconcile bit-for-bit against the full-panel
+    recompute (drift_events == 0; late merges show up as rebuilds)."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "replay")
+    t = art.get("ticks") or {}
+    for k in ("merged_late", "quarantined", "deduped", "dropped_gap"):
+        if not t.get(k):
+            out.append(f"ticks.{k} == 0 — the injected fault did not "
+                       "fire or its outcome was hidden")
+    if not (art.get("panel") or {}).get("gap_bars"):
+        out.append("no gap bar materialized — the dropped bar was "
+                   "papered over instead of marked stale")
+    rec = art.get("reconcile") or {}
+    if not rec.get("count"):
+        out.append("no reconciliation ran — the equivalence check never "
+                   "exercised")
+    if rec.get("drift_events"):
+        out.append(f"reconcile.drift_events = {rec['drift_events']} — "
+                   "the incremental signals drifted from the full "
+                   "recompute under the tick storm")
+    if not rec.get("rebuilds"):
+        out.append("no rebuild after late merges — merged-in-place "
+                   "history must invalidate running sums")
+    if not ((art.get("serve") or {}).get("requests") or {}).get("served"):
+        out.append("nothing served — the live panel never answered "
+                   "under the storm")
+    return out
+
+
+def _check_replay_version_skew(r):
+    """ISSUE 7: a serve probe answering from a stale panel snapshot must
+    be REFUSED and counted (the streaming analogue of the r11 AOT
+    version-skew gate), with the books still balanced and later probes
+    served from fresh snapshots."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "replay")
+    v = art.get("versions") or {}
+    if not v.get("skew_events"):
+        out.append("the version-skew fault never fired — nothing was "
+                   "rehearsed")
+    if not v.get("skew_refusals"):
+        out.append("a stale-snapshot request was NOT refused — the "
+                   "panel-version gate did not hold")
+    if not ((art.get("serve") or {}).get("requests") or {}).get("served"):
+        out.append("nothing served — the gate refused more than the "
+                   "skewed probe")
+    return out
+
+
+def _replay_scenarios():
+    return [
+        Scenario(
+            "replay-tick-storm", "replay",
+            FaultPlan("replay-tick-storm", seed=40, faults=(
+                Fault(point="stream.tick", action="tick_late", after=90,
+                      max_fires=6),
+                Fault(point="stream.tick", action="tick_late", after=140,
+                      max_fires=5),
+                Fault(point="stream.tick", action="tick_dup", after=110,
+                      max_fires=4),
+                # a whole-bar gap: drop every tick of one bar (8 assets)
+                Fault(point="stream.tick", action="tick_drop",
+                      after=22 * 8, max_fires=8),
+            )),
+            _check_replay_tick_storm, fast=True,
+            notes="late/out-of-order/duplicate/gap tick storm: closed "
+                  "tick books, gap marked stale (never price-carried), "
+                  "incremental == full recompute bit-for-bit "
+                  "(rebuild-on-merge, zero drift)",
+        ),
+        Scenario(
+            "replay-ingest-serve-skew", "replay",
+            FaultPlan("replay-ingest-serve-skew", seed=41, faults=(
+                Fault(point="stream.serve", action="version_skew",
+                      after=1, max_fires=1),
+            )),
+            _check_replay_version_skew, fast=True,
+            notes="serve probe answers from a stale snapshot: the "
+                  "panel-version gate refuses it (counted), books stay "
+                  "closed, fresh probes keep serving — the r11 AOT-skew "
+                  "gate's streaming twin",
+        ),
+    ]
+
+
 def _check_bench_partial(r):
     """r5 reproduced and shown fixed: the child lost its window mid-run but
     the already-measured headline landed in an explicitly-partial line."""
@@ -558,7 +667,7 @@ def _check_bench_child_full(r):
 
 def builtin_matrix(fast: bool = False):
     mats = (_mini_scenarios() + _shell_scenarios() + _serve_scenarios()
-            + _serve_pool_scenarios())
+            + _serve_pool_scenarios() + _replay_scenarios())
     if not fast:
         mats += _bench_scenarios()
     else:
@@ -926,6 +1035,53 @@ def _run_serve_pool(scenario, box: str) -> dict:
         inject.reset()  # the next scenario must not inherit this plan
 
 
+def _run_replay(scenario, box: str) -> dict:
+    """Drive the event-time replay IN-PROCESS (stub engine, smoke
+    buckets, no jax — the fast tier stays jax-free).  The fault plan
+    arms via the env contract so the ``stream.*`` checkpoints fire with
+    fresh per-scenario hit counters; ``scenario.env`` may carry a
+    ``replay`` dict of ReplayConfig overrides."""
+    from csmom_tpu.chaos import inject
+    from csmom_tpu.stream.replay import (
+        ReplayConfig,
+        run_replay,
+        write_artifact,
+    )
+
+    saved = {k: os.environ.get(k) for k in (PLAN_ENV, "CSMOM_FAULT_STATE")}
+    try:
+        if scenario.plan is not None:
+            plan_path = os.path.join(box, "plan.toml")
+            with open(plan_path, "w") as f:
+                f.write(scenario.plan.to_toml())
+            os.environ[PLAN_ENV] = plan_path
+        else:
+            os.environ.pop(PLAN_ENV, None)
+        os.environ["CSMOM_FAULT_STATE"] = os.path.join(box, "chaos-state")
+        inject.reset()
+        cfg = ReplayConfig(run_id=f"rehearse_{scenario.name}",
+                           engine="stub", profile="serve-smoke",
+                           **scenario.env.get("replay", {}))
+        art = run_replay(cfg)
+        write_artifact(box, art, prefix="REPLAY")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        inject.reset()  # the next scenario must not inherit this plan
+    return {
+        "rc": 0,
+        "stdout": "",
+        "stderr": "",
+        "trailing": art,
+        "headline_violations": [],
+        "sidecar_rows": 0,
+        "artifact": art,
+    }
+
+
 _RUNNERS = {
     "mini": _run_mini,
     "shell": _run_shell,
@@ -934,6 +1090,7 @@ _RUNNERS = {
     "warmup": _run_warmup,
     "serve": _run_serve,
     "serve-pool": _run_serve_pool,
+    "replay": _run_replay,
 }
 
 
@@ -982,6 +1139,14 @@ def _check_serve_pool_generic(r):
     return inv.validate(r.get("artifact") or {}, "serve_pool")
 
 
+def _check_replay_generic(r):
+    # whatever the custom fault did, the landed REPLAY artifact must be
+    # schema-valid — which INCLUDES the closed tick ledger, the closed
+    # serve book, and the version reconciliation (the replay kind's
+    # core invariants)
+    return inv.validate(r.get("artifact") or {}, "replay")
+
+
 _CUSTOM_CHECKS = {
     "mini": _check_custom_generic,
     "bench-child": _check_custom_generic,
@@ -989,6 +1154,7 @@ _CUSTOM_CHECKS = {
     "warmup": _check_warmup_healed,
     "serve": _check_serve_generic,
     "serve-pool": _check_serve_pool_generic,
+    "replay": _check_replay_generic,
 }
 
 
@@ -1022,9 +1188,13 @@ def cmd_rehearse(args) -> int:
                   file=sys.stderr)
             return 2
     if getattr(args, "list", False):
+        # the scenario matrix, runnable nothing: name, pipeline, tier,
+        # the armed plan's fault summary, and the intent line — enough
+        # to pick an --only target without reading the source
         for s in matrix:
             tier = "fast" if s.fast else "full"
             print(f"{s.name:32s} {s.pipeline:12s} [{tier}] {s.notes}")
+            print(f"{'':32s} {'':12s}        plan: {_plan_summary(s.plan)}")
         return 0
 
     sandbox_root = args.sandbox or tempfile.mkdtemp(prefix="csmom-rehearse-")

@@ -1,0 +1,175 @@
+"""csmom replay — drive a trading day's tick log through the live loop.
+
+Runs the event-time replay harness (:mod:`csmom_tpu.stream.replay`):
+synthetic seeded tick log -> watermark ingest -> incremental signal
+updates -> serve-under-load from versioned snapshots -> periodic
+full-panel reconciliation, and lands a schema-valid ``REPLAY_<run>.json``
+(kind ``replay`` in :mod:`csmom_tpu.chaos.invariants`).
+
+Fault injection: ``--chaos builtin`` arms the canonical replay fault
+plan (late + out-of-order + duplicate + gap ticks, one ingest-serve
+version-skew event); ``--chaos PATH_OR_TOML`` arms a custom plan; a
+pre-armed ``CSMOM_FAULT_PLAN`` is honored as-is.  Either way the run
+must keep BOTH closed books — tick accounting and serve accounting —
+and the version reconciliation, or this command exits nonzero: a replay
+whose ledger doesn't balance is not evidence.
+
+Exit is also nonzero when a jax-engine replay reports in-window fresh
+compiles: the serve buckets and the ``stream`` reconcile entries are a
+closed shape world, and compiling inside the window means the warmup
+contract broke (run ``csmom warmup --profiles serve stream`` first;
+``--smoke`` warms its own tiny shapes inline).
+
+Registered via ``register(sub)`` like rehearse/serve/ledger (the
+cli/main.py split: new subcommands do not grow the monolith).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+__all__ = ["cmd_replay", "register"]
+
+
+def _arm_chaos(args, cfg) -> dict | None:
+    """Arm the requested fault plan via the env contract; returns the
+    saved env state to restore, or None when nothing was armed."""
+    from csmom_tpu.chaos import inject
+    from csmom_tpu.chaos.plan import PLAN_ENV
+
+    if not args.chaos:
+        return None
+    saved = {k: os.environ.get(k) for k in (PLAN_ENV, "CSMOM_FAULT_STATE")}
+    if args.chaos == "builtin":
+        from csmom_tpu.stream.replay import builtin_fault_plan
+
+        plan = builtin_fault_plan(cfg)
+        os.environ[PLAN_ENV] = plan.to_toml()
+    else:
+        os.environ[PLAN_ENV] = args.chaos
+    inject.reset()  # re-read the plan with fresh hit counters
+    return saved
+
+
+def _restore_chaos(saved: dict | None) -> None:
+    from csmom_tpu.chaos import inject
+
+    if saved is None:
+        return
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    inject.reset()
+
+
+def cmd_replay(args) -> int:
+    from csmom_tpu.chaos import invariants as inv
+    from csmom_tpu.stream.replay import (
+        ReplayConfig,
+        run_replay,
+        write_artifact,
+    )
+
+    smoke = bool(args.smoke)
+    engine = "stub" if args.stub else args.engine
+    # full-mode preset first, explicit flags override it (merged BEFORE
+    # unpacking: two ** expansions sharing a key is a TypeError)
+    kw = {} if smoke else {"n_assets": 32, "bars": 96,
+                           "serve_every_bars": 6,
+                           "reconcile_every_bars": 16}
+    if args.assets is not None:
+        kw["n_assets"] = args.assets
+    if args.bars is not None:
+        kw["bars"] = args.bars
+    cfg = ReplayConfig(
+        run_id=args.run_id,
+        seed=args.seed,
+        engine=engine,
+        profile="serve-smoke" if smoke else "serve",
+        **kw,
+    )
+    saved = _arm_chaos(args, cfg)
+    try:
+        art = run_replay(cfg)
+    finally:
+        _restore_chaos(saved)
+
+    out_dir = args.out_dir or os.getcwd()
+    path = write_artifact(out_dir, art, prefix="REPLAY")
+    print(f"landed {path}")
+
+    violations = inv.validate(art, "replay")
+    t = art["ticks"]
+    v = art["versions"]
+    print(
+        f"ticks: offered {t['offered']} = applied {t['applied']} + "
+        f"merged_late {t['merged_late']} + quarantined "
+        f"{t['quarantined']} + deduped {t['deduped']} "
+        f"(gap bars {art['panel']['gap_bars']}, dup {t['duplicated']}, "
+        f"dropped {t['dropped_gap']})"
+    )
+    print(
+        f"versions: ingest v{v['ingest_final']}, served "
+        f"[{v['serve_min']}, {v['serve_max']}]; skew: {v['skew_events']} "
+        f"event(s), {v['skew_refusals']}/{v['skew_attempts']} stale "
+        "request(s) refused"
+    )
+    print(f"reconcile: {art['reconcile']}")
+    fresh = art["compile"]["in_window_fresh_compiles"]
+    print(f"throughput: {art['value']} {art['unit']}; in-window fresh "
+          f"compiles: {fresh}")
+    if isinstance(fresh, int) and fresh > 0:
+        violations.append(
+            f"{fresh} in-window fresh compile(s): the replay window "
+            "dispatched an unwarmed shape — run `csmom warmup --profiles "
+            "serve stream` before replaying")
+    if violations:
+        print("\nREPLAY artifact violates its own invariants:",
+              file=sys.stderr)
+        for viol in violations:
+            print(f"  - {viol}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({"metric": art["metric"], "value": art["value"],
+                          "unit": art["unit"],
+                          "vs_baseline": art["vs_baseline"]}))
+    return 0
+
+
+def register(sub) -> None:
+    """Attach the ``replay`` subparser (called from cli.main)."""
+    sp = sub.add_parser(
+        "replay",
+        help="replay a trading day's tick log through ingest -> "
+             "incremental signals -> serve, deterministically and "
+             "chaos-injectably; lands REPLAY_<run>.json",
+    )
+    sp.add_argument("--run-id", dest="run_id", default="smoke",
+                    help="artifact run id (rNN names are committable "
+                         "round evidence; everything else is scratch)")
+    sp.add_argument("--seed", type=int, default=12,
+                    help="tick-log + fault seed (default 12)")
+    sp.add_argument("--engine", default="jax", choices=["jax", "stub"],
+                    help="serve/reconcile backend (default jax)")
+    sp.add_argument("--stub", action="store_true",
+                    help="shortcut for --engine stub (jax-free)")
+    sp.add_argument("--smoke", action="store_true",
+                    help="smoke preset: tiny panel, smoke serve buckets, "
+                         "sub-second — the tier-1 shape")
+    sp.add_argument("--assets", type=int,
+                    help="universe size (default: 32 full / 8 smoke)")
+    sp.add_argument("--bars", type=int,
+                    help="bars in the day (default: 96 full / 32 smoke)")
+    sp.add_argument("--chaos", metavar="PLAN",
+                    help="'builtin' for the canonical replay fault plan "
+                         "(late/ooo/dup/gap ticks + one version skew), "
+                         "or a fault-plan path / inline TOML")
+    sp.add_argument("--out-dir", dest="out_dir",
+                    help="artifact directory (default: cwd)")
+    sp.add_argument("--json", action="store_true",
+                    help="also print a record-shaped headline line")
+    sp.set_defaults(fn=cmd_replay)

@@ -256,8 +256,47 @@ def _serve_entries(profile: str, dtype=None) -> list[ManifestEntry]:
     return out
 
 
+def _stream_entries(profile: str, dtype=None) -> list[ManifestEntry]:
+    """The event-time replay's on-device reconciliation entries: the
+    REAL jitted ``signals`` engines (momentum + turnover) at the
+    canonical replay panel shapes (:mod:`csmom_tpu.stream.replay` —
+    serve asset buckets x the replay bar count), so a jax-engine
+    replay's periodic full-panel reconciliation dispatches only warmed
+    shapes and the whole window stays zero-compile."""
+    from csmom_tpu.serve.buckets import bucket_spec
+    from csmom_tpu.signals.momentum import momentum
+    from csmom_tpu.signals.turnover import turnover_features
+    from csmom_tpu.stream.replay import (
+        REPLAY_BARS,
+        REPLAY_SMOKE_BARS,
+        ReplayConfig,
+    )
+
+    smoke = profile == "stream-smoke"
+    spec = bucket_spec("serve-smoke" if smoke else "serve")
+    bars = REPLAY_SMOKE_BARS if smoke else REPLAY_BARS
+    cfg = ReplayConfig()  # the single source of the replay signal params
+    dt = np.dtype(dtype or cfg.dtype)
+    out = []
+    for A in spec.asset_buckets:
+        p = _sds((A, bars), dt)
+        m = _sds((A, bars), bool)
+        out.append(ManifestEntry(
+            name=f"stream.momentum@{A}x{bars}",
+            fn=momentum, args=(p, m),
+            kwargs=dict(lookback=cfg.lookback, skip=cfg.skip),
+        ))
+        out.append(ManifestEntry(
+            name=f"stream.turn_avg@{A}x{bars}",
+            fn=turnover_features,
+            args=(p, m, _sds((A,), dt)),
+            kwargs=dict(lookback=cfg.turn_lookback),
+        ))
+    return out
+
+
 PROFILES = ("bench-cpu", "bench-tpu", "golden", "smoke", "serve",
-            "serve-smoke")
+            "serve-smoke", "stream", "stream-smoke")
 
 
 def build_manifest(profile: str, dtype=None) -> list[ManifestEntry]:
@@ -282,6 +321,9 @@ def build_manifest(profile: str, dtype=None) -> list[ManifestEntry]:
       (``csmom_tpu.serve.buckets``) — every (endpoint, batch, assets)
       shape a micro-batch dispatch may take, at the service's own jitted
       entries.  f32 (the serve compute dtype).
+    - ``"stream"`` / ``"stream-smoke"``: the event-time replay's
+      on-device reconciliation entries — the jitted ``signals`` engines
+      at the canonical replay panel shapes.  f32.
 
     ``dtype`` overrides the profile's default float dtype.
     """
@@ -334,6 +376,11 @@ def build_manifest(profile: str, dtype=None) -> list[ManifestEntry]:
         # the online workload's closed shape world: warm it before
         # starting a service and the request path never compiles
         return _serve_entries(profile, dtype)
+    if profile in ("stream", "stream-smoke"):
+        # the replay reconciliation's closed shape world (ISSUE 7): warm
+        # it (with the matching serve profile) before a jax-engine
+        # replay and the whole window stays zero-compile
+        return _stream_entries(profile, dtype)
     raise ValueError(f"unknown warmup profile {profile!r}: use one of {PROFILES}")
 
 

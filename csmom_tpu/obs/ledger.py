@@ -54,6 +54,7 @@ DEFAULT_PATTERNS = (
     "PHASES_*.json",
     "TELEMETRY_*.json",
     "SERVE_*.json",
+    "REPLAY_*.json",
 )
 
 _RUN_RE = re.compile(r"_r(\d+)")
@@ -70,7 +71,7 @@ def _scratch_note(basename: str) -> str | None:
     still ingests — flagged as a variant, never gate-eligible."""
     if basename == "BENCH_TPU_LAST.json":
         return "per-machine TPU session cache, not round evidence: skipped"
-    if (basename.startswith(("TELEMETRY_", "SERVE_"))
+    if (basename.startswith(("TELEMETRY_", "SERVE_", "REPLAY_"))
             and not inv.committable_sidecar(basename)
             and run_of(basename)[0] is None):
         return ("scratch sidecar (uncommittable name, no round id), not "
@@ -373,6 +374,49 @@ def _serve_pool_rows(obj: dict, run: str, num: int, variant,
     return rows
 
 
+def _replay_rows(obj: dict, run: str, num: int, variant,
+                 source: str) -> list:
+    """Rows from a REPLAY artifact: the streaming workload's trajectory.
+
+    Tick throughput (higher) and the serve-side staleness-lag
+    percentile (lower — how far behind the ingest frontier responses
+    were computed) are the gate axes the live tier answers for; the
+    in-window fresh-compile count rides along because the zero-compile
+    replay window is a structural claim (warmed serve buckets + warmed
+    stream reconcile entries), same as serve.  Smoke-bucket replays
+    arrive flagged and never gate."""
+    extra = obj.get("extra") or {}
+    platform = extra.get("platform")
+    device_kind = extra.get("device_kind") or platform
+    workload = extra.get("workload")
+    flags = _flags(obj, variant)
+    base = dict(run=run, run_num=num, source=source, platform=platform,
+                device_kind=device_kind, workload=workload, flags=flags)
+    rows = []
+    v = _num(obj.get("value"))
+    if v is not None:
+        rows.append(Row(metric="replay_ticks_per_s", value=v,
+                        unit=str(obj.get("unit", "ticks/s")),
+                        direction="higher", **base))
+    stale = obj.get("staleness_ms")
+    if isinstance(stale, dict):
+        pv = _num(stale.get("p99"))
+        if pv is not None:
+            rows.append(Row(metric="replay_staleness_p99_ms", value=pv,
+                            unit="ms", direction="lower", **base))
+    total = ((obj.get("serve") or {}).get("latency_ms") or {}).get("total")
+    if isinstance(total, dict):
+        pv = _num(total.get("p99"))
+        if pv is not None:
+            rows.append(Row(metric="replay_serve_p99_ms", value=pv,
+                            unit="ms", direction="lower", **base))
+    fc = _num((obj.get("compile") or {}).get("in_window_fresh_compiles"))
+    if fc is not None:
+        rows.append(Row(metric="replay_in_window_fresh_compiles", value=fc,
+                        unit="compiles", direction="lower", **base))
+    return rows
+
+
 def _generic_rows(obj: dict, kind: str, run: str, num: int, variant,
                   source: str) -> list:
     """Info rows for the remaining artifact kinds (multichip equality,
@@ -450,6 +494,15 @@ def ingest_file(path: str, have_full_runs=frozenset()) -> tuple:
         return [], [{"source": source,
                      "note": "record artifact with no numeric value axis: "
                              "present but contributes no trajectory rows"}]
+    if kind == "replay":
+        ver = obj.get("schema_version")
+        if ver not in inv.KNOWN_REPLAY_SCHEMA_VERSIONS:
+            return [], [{"source": source,
+                         "note": f"unknown replay schema_version {ver!r} "
+                                 f"(reader understands "
+                                 f"{list(inv.KNOWN_REPLAY_SCHEMA_VERSIONS)}"
+                                 "): not half-parsed into rows"}]
+        return _replay_rows(obj, run, num, variant, source), []
     if kind == "serve_pool":
         ver = obj.get("schema_version")
         if ver not in inv.KNOWN_SERVE_POOL_SCHEMA_VERSIONS:

@@ -193,6 +193,21 @@ def _finalize(out: pd.DataFrame, schema, time_col: str, ticker: str) -> pd.DataF
             out[c] = np.nan
     out["ticker"] = ticker
     out = out.dropna(subset=[time_col])
+    # vendor caches occasionally repeat a timestamp (a re-download
+    # appended instead of replacing, a provider correction row): keep
+    # the LAST occurrence — the correction — and say how many were
+    # dropped.  Silently keeping both used to leak duplicate rows into
+    # long_to_panel, where pivot_table's aggfunc quietly picked one.
+    n_dup = int(out.duplicated(subset=[time_col]).sum())
+    if n_dup:
+        log.warning(
+            "%s: %d duplicate %s row(s) in cache — deduplicated "
+            "keep-last (provider corrections win)",
+            ticker, n_dup, time_col,
+        )
+        # .copy() detaches the result from its parent frame so the dtype
+        # normalization below writes a real frame, not a flagged slice
+        out = out.drop_duplicates(subset=[time_col], keep="last").copy()
     # uniform engine-independent dtypes: ns timestamps, f64 numerics
     out[time_col] = out[time_col].astype("datetime64[ns]")
     for c in schema:

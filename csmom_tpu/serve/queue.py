@@ -77,6 +77,10 @@ class Request:
     n_assets: int
     priority: str = "interactive"
     deadline_s: float | None = None
+    # the live-panel version the request's inputs were snapshotted at
+    # (None for batch-panel requests); stamped through to the response so
+    # ingest-vs-serve version reconciliation is checkable arithmetic
+    panel_version: int | None = None
     req_id: int = dataclasses.field(default_factory=lambda: next(_IDS))
     state: str = "queued"
     result: object = None
@@ -140,6 +144,10 @@ class AdmissionQueue:
         self.rejected_queue_full = 0
         self.rejected_worker_crash = 0
         self.rejected_unserveable = 0
+        # requests refused because their live-panel snapshot version had
+        # skewed beyond the service's allowance (the streaming analogue
+        # of the pool's AOT-cache version gate)
+        self.rejected_version_skew = 0
         # requests dispatched AFTER their deadline had already passed —
         # structurally 0 (collect cancels first); the counter exists so
         # the artifact can CLAIM it, not hope it
@@ -335,16 +343,21 @@ class AdmissionQueue:
                         req.service_s if ema is None
                         else 0.8 * ema + 0.2 * req.service_s)
 
-    def reject_at_door(self, req: Request, error: str) -> None:
-        """Present-and-reject in one step (unserveable shape/endpoint):
-        the request still counts toward ``admitted`` so the accounting
-        equation closes over door rejections too."""
+    def reject_at_door(self, req: Request, error: str,
+                       version_skew: bool = False) -> None:
+        """Present-and-reject in one step (unserveable shape/endpoint, or
+        a skewed live-panel version): the request still counts toward
+        ``admitted`` so the accounting equation closes over door
+        rejections too."""
         with self._lock:
             self.admitted += 1
             req.t_submit_s = mono_now_s()
             if self._terminate_locked(req, "rejected", error=error):
                 self.rejected += 1
-                self.rejected_unserveable += 1
+                if version_skew:
+                    self.rejected_version_skew += 1
+                else:
+                    self.rejected_unserveable += 1
 
     def finish_rejected(self, req: Request, error: str,
                         worker_crash: bool = False) -> None:
@@ -374,6 +387,7 @@ class AdmissionQueue:
                 "rejected_queue_full": self.rejected_queue_full,
                 "rejected_worker_crash": self.rejected_worker_crash,
                 "rejected_unserveable": self.rejected_unserveable,
+                "rejected_version_skew": self.rejected_version_skew,
                 "in_queue": self._depth_locked(),
             }
 

@@ -72,6 +72,7 @@ class PoolRequest:
     n_assets: int
     priority: str = "interactive"
     deadline_s: float | None = None      # ABSOLUTE monotonic, None = none
+    panel_version: int | None = None     # live-panel snapshot version
     req_id: int = dataclasses.field(default_factory=lambda: next(_IDS))
     state: str = "routing"
     result: object = None
@@ -127,7 +128,8 @@ class Router:
     # --------------------------------------------------------------- admit
 
     def submit(self, kind: str, values, mask, priority: str = "interactive",
-               deadline_s: float | None = None) -> PoolRequest:
+               deadline_s: float | None = None,
+               panel_version: int | None = None) -> PoolRequest:
         """Admit one request; returns its handle (terminal on door
         rejection).  ``deadline_s`` is RELATIVE seconds (None = config
         default)."""
@@ -142,7 +144,8 @@ class Router:
         now = mono_now_s()
         req = PoolRequest(
             kind=kind, n_assets=n_assets, priority=priority,
-            deadline_s=None if rel is None else now + rel, t_submit_s=now)
+            deadline_s=None if rel is None else now + rel, t_submit_s=now,
+            panel_version=panel_version)
         with self._lock:
             self.admitted += 1
         checkpoint("pool.route", kind=kind, req=req.req_id)
@@ -326,7 +329,8 @@ class Router:
                     worker.socket_path,
                     {"op": "score", "kind": req.kind,
                      "req_id": req.req_id, "priority": req.priority,
-                     "deadline_rel_s": rem},
+                     "deadline_rel_s": rem,
+                     "panel_version": req.panel_version},
                     arrays={"values": values, "mask": mask},
                     timeout_s=timeout)
         except (OSError, proto.ProtocolError) as e:

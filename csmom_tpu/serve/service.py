@@ -88,6 +88,10 @@ class SignalService:
         self._pad_lanes = 0
         self._used_lanes = 0
         self._state_lock = threading.Lock()
+        # live-panel version gate (streaming mode): None = batch panels,
+        # no versioning.  See attach_live_version.
+        self._live_version_fn = None
+        self._max_version_skew = 0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -115,8 +119,23 @@ class SignalService:
 
     # --------------------------------------------------------------- submit
 
+    def attach_live_version(self, version_fn, max_skew: int = 0) -> None:
+        """Arm the live-panel version gate (streaming mode).
+
+        ``version_fn`` returns the ingestor's CURRENT panel version; a
+        request stamped with a ``panel_version`` more than ``max_skew``
+        versions behind it is refused at the door — the streaming
+        analogue of the pool's AOT-cache version-skew gate: a worker
+        must never answer from a panel the ingest side has moved past,
+        it must refuse loudly and be counted
+        (``rejected_version_skew``).
+        """
+        self._live_version_fn = version_fn
+        self._max_version_skew = int(max_skew)
+
     def submit(self, kind: str, values, mask, priority: str = "interactive",
-               deadline_s: float | None = None) -> Request:
+               deadline_s: float | None = None,
+               panel_version: int | None = None) -> Request:
         """Submit one scoring request (panel ``[A, months]``).
 
         ``deadline_s`` is RELATIVE seconds from now (None = the config
@@ -134,7 +153,20 @@ class SignalService:
             kind=kind, values=values, mask=mask, n_assets=n_assets,
             priority=priority,
             deadline_s=None if rel is None else mono_now_s() + rel,
+            panel_version=panel_version,
         )
+        if self._live_version_fn is not None and panel_version is not None:
+            live = int(self._live_version_fn())
+            if live - panel_version > self._max_version_skew:
+                self.queue.reject_at_door(
+                    req,
+                    f"panel-version skew: request snapshotted at v"
+                    f"{panel_version} but ingest is at v{live} "
+                    f"(allowed skew {self._max_version_skew}); refresh "
+                    "the snapshot and resubmit",
+                    version_skew=True,
+                )
+                return req
         reason = self._unserveable_reason(kind, values, mask)
         if reason is not None:
             self.queue.reject_at_door(req, reason)

@@ -249,10 +249,12 @@ class WorkerServer:
                     "error": "score frame missing values/mask arrays",
                     "worker_id": self.worker_id}, None
         rel = obj.get("deadline_rel_s")
+        pv = obj.get("panel_version")
         req = self.service.submit(
             str(obj.get("kind")), arrays["values"], arrays["mask"],
             priority=str(obj.get("priority", "interactive")),
             deadline_s=float(rel) if rel is not None else None,
+            panel_version=int(pv) if pv is not None else None,
         )
         wait_s = (float(rel) + _TERMINAL_GRACE_S if rel is not None
                   else _NO_DEADLINE_WAIT_S)
@@ -269,6 +271,9 @@ class WorkerServer:
             "worker_id": self.worker_id,
             "queue_wait_s": req.queue_wait_s,
             "service_s": req.service_s,
+            # stamped through so the router's books can reconcile which
+            # panel version every response was computed from
+            "panel_version": req.panel_version,
         }
         out_arrays = None
         if req.state == "served":

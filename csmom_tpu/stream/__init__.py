@@ -1,0 +1,30 @@
+"""Live-panel streaming: ring buffer, watermark ingest, incremental
+signals, and the event-time replay harness (ISSUE 7).
+
+Import discipline mirrors ``serve/``: the data-plane modules (``ring``,
+``ingest``, ``incremental``) are numpy/stdlib-only so the fast rehearse
+tier and the plumbing tests never touch jax; the jitted reconcile
+entries live behind the ``signals`` engines and are reached only by a
+jax-engine replay.
+"""
+
+from csmom_tpu.stream.incremental import (
+    IncrementalMomentum,
+    IncrementalTurnover,
+    full_momentum_np,
+    full_turnover_np,
+)
+from csmom_tpu.stream.ingest import StreamIngestor, Tick, WatermarkPolicy
+from csmom_tpu.stream.ring import LiveRing, RingSnapshot
+
+__all__ = [
+    "IncrementalMomentum",
+    "IncrementalTurnover",
+    "LiveRing",
+    "RingSnapshot",
+    "StreamIngestor",
+    "Tick",
+    "WatermarkPolicy",
+    "full_momentum_np",
+    "full_turnover_np",
+]

@@ -168,3 +168,63 @@ class TestVendoredDialectFixtures:
             str(tmp_path), ["QB", "QA", "MA"]
         )
         assert got == ["QA"]
+
+
+# --------------------- ISSUE 7 satellite: duplicate-timestamp dedupe ------
+
+DOCTORED_DUPES = """Date,Adj Close,Close,High,Low,Open,Volume
+,FAKE,FAKE,FAKE,FAKE,FAKE,FAKE
+2020-01-02,10.0,10.5,11.0,9.5,10.0,1000
+2020-01-03,10.2,10.7,11.2,9.7,10.1,1100
+2020-01-03,10.9,10.9,11.9,9.9,10.9,1900
+2020-01-06,10.4,10.8,11.4,9.8,10.2,1200
+"""
+
+
+def _capture_ingest_warnings(caplog):
+    """The csmom_tpu root logger is propagate=False (it owns its own
+    handler), so caplog's root capture misses it — attach caplog's
+    handler to the package logger directly."""
+    import contextlib
+    import logging
+
+    @contextlib.contextmanager
+    def _cm():
+        lg = logging.getLogger("csmom_tpu.panel.ingest")
+        lg.addHandler(caplog.handler)
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 logger="csmom_tpu.panel.ingest"):
+                yield
+        finally:
+            lg.removeHandler(caplog.handler)
+
+    return _cm()
+
+
+def test_duplicate_timestamps_deduped_keep_last(tmp_path, caplog):
+    """A vendor cache carrying a repeated date (a re-download appended a
+    correction row) must dedupe keep-last with a COUNTED warning —
+    silently keeping both rows let pivot_table pick one arbitrarily."""
+    p = _write(tmp_path, "FAKE_daily.csv", DOCTORED_DUPES)
+    with _capture_ingest_warnings(caplog):
+        df = ingest.read_price_csv(p, "FAKE", kind="daily")
+    assert len(df) == 3
+    assert not df["date"].duplicated().any()
+    # keep-LAST: the correction row (10.9) wins over the stale 10.2
+    dup_day = df[df["date"] == pd.Timestamp("2020-01-03")]
+    assert dup_day["adj_close"].tolist() == [10.9]
+    warnings = [r for r in caplog.records
+                if "duplicate" in r.getMessage()]
+    assert len(warnings) == 1
+    assert "1 duplicate" in warnings[0].getMessage()
+
+
+def test_no_duplicate_warning_on_clean_cache(tmp_path, caplog):
+    """A clean cache must not emit the dedupe warning (the counter is a
+    finding, not noise)."""
+    p = _write(tmp_path, "FAKE_daily.csv", DIALECT_A)
+    with _capture_ingest_warnings(caplog):
+        df = ingest.read_price_csv(p, "FAKE", kind="daily")
+    assert len(df) == 2
+    assert not [r for r in caplog.records if "duplicate" in r.getMessage()]

@@ -468,14 +468,21 @@ def test_bool_peak_bytes_rejected_everywhere():
 def test_histrank_multihost_records_are_info_never_gated():
     """Record-SHAPED captures outside the BENCH family (comm ratios,
     equality claims) ride as info rows: visible, never gate-eligible,
-    never the gate's default candidate.  SERVE rows are the deliberate
-    exception: the serve family has its own schema + known directions
-    (throughput up, latency down), so its unflagged rows DO gate."""
+    never the gate's default candidate.  SERVE and REPLAY rows are the
+    deliberate exceptions: those families have their own schemas + known
+    directions (throughput up, latency/staleness down), so their
+    unflagged rows DO gate."""
     L = ld.load(_REPO)
     other = [r for r in L.rows
-             if not r.source.startswith(("BENCH", "TELEMETRY", "SERVE"))]
+             if not r.source.startswith(("BENCH", "TELEMETRY", "SERVE",
+                                         "REPLAY"))]
     assert other, "committed HISTRANK/MULTIHOST should yield info rows"
     assert all("info" in r.flags and not r.gate_eligible() for r in other)
+    replay = [r for r in L.rows if r.source.startswith("REPLAY")]
+    assert replay, "the committed REPLAY_r12.json should yield rows"
+    assert any(r.gate_eligible() for r in replay), (
+        "unflagged replay rows must be gate-eligible — that is the "
+        "point of ingesting them")
     serve = [r for r in L.rows if r.source.startswith("SERVE")]
     assert serve, "the committed SERVE_r10.json should yield rows"
     assert any(r.gate_eligible() for r in serve), (

@@ -33,11 +33,12 @@ def _run_cli(args, timeout):
 
 def test_fast_tier_is_small_and_capture_path_only():
     fast = builtin_matrix(fast=True)
-    assert 1 <= len(fast) <= 8, "the fast tier must stay <= 8 faults"
-    # mini/shell run as jax-free subprocesses; serve runs IN-PROCESS on
-    # the stub engine; serve-pool spawns stub-engine worker PROCESSES —
-    # none may need a jax-importing rehearsed pipeline
-    assert all(s.pipeline in ("mini", "shell", "serve", "serve-pool")
+    assert 1 <= len(fast) <= 10, "the fast tier must stay <= 10 faults"
+    # mini/shell run as jax-free subprocesses; serve and replay run
+    # IN-PROCESS on the stub engine; serve-pool spawns stub-engine
+    # worker PROCESSES — none may need a jax-importing rehearsed pipeline
+    assert all(s.pipeline in ("mini", "shell", "serve", "serve-pool",
+                              "replay")
                for s in fast), (
         "fast-tier scenarios must not need jax-importing pipelines"
     )
@@ -54,6 +55,11 @@ def test_fast_tier_is_small_and_capture_path_only():
     assert any("worker-kill" in n for n in pool), pool
     assert any("rolling-restart" in n for n in pool), pool
     assert any("version-skew" in n for n in pool), pool
+    # ISSUE 7: both replay degradation scenarios ride in the fast tier —
+    # the tick storm (late/ooo/dup/gap) and the ingest-serve skew gate
+    replay = [s.name for s in fast if s.pipeline == "replay"]
+    assert any("tick-storm" in n for n in replay), replay
+    assert any("skew" in n for n in replay), replay
 
 
 def test_rehearse_fast_runs_green_and_quick():

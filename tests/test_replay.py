@@ -1,0 +1,287 @@
+"""Replay harness tests: artifact contract, chaos outcomes, CLI, ledger.
+
+The replay artifact's schema (kind ``replay``) carries the two closed
+books and the version reconciliation as RULES — these tests pin both
+directions: a healthy run validates, and a doctored artifact (vanished
+tick, impossible serve version, unbalanced serve book) is refused.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from csmom_tpu.chaos import invariants as inv
+from csmom_tpu.chaos.plan import PLAN_ENV
+from csmom_tpu.stream.replay import (
+    ReplayConfig,
+    builtin_fault_plan,
+    run_replay,
+    synth_tick_log,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def clean_art():
+    """One fault-free stub replay, shared read-only across the module."""
+    return run_replay(ReplayConfig(run_id="t_clean", engine="stub",
+                                   profile="serve-smoke"))
+
+
+@pytest.fixture(scope="module")
+def chaos_art():
+    """One builtin-fault-plan stub replay (late/ooo/dup/gap + skew)."""
+    cfg = ReplayConfig(run_id="t_chaos", engine="stub",
+                       profile="serve-smoke")
+    from csmom_tpu.chaos import inject
+
+    saved = {k: os.environ.get(k) for k in (PLAN_ENV, "CSMOM_FAULT_STATE")}
+    os.environ[PLAN_ENV] = builtin_fault_plan(cfg).to_toml()
+    os.environ.pop("CSMOM_FAULT_STATE", None)
+    inject.reset()
+    try:
+        return run_replay(cfg)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        inject.reset()
+
+
+def test_synth_log_is_deterministic():
+    cfg = ReplayConfig()
+    a = synth_tick_log(cfg)
+    b = synth_tick_log(cfg)
+    assert [(t.asset, t.bar_time, t.price) for t in a] \
+        == [(t.asset, t.bar_time, t.price) for t in b]
+    assert len(a) == cfg.n_assets * cfg.bars
+
+
+def test_clean_replay_validates_and_books_close(clean_art):
+    art = clean_art
+    assert inv.detect_kind(art) == "replay"
+    assert inv.validate(art) == []
+    t = art["ticks"]
+    assert t["offered"] == t["generated"]
+    assert t["applied"] == t["offered"]
+    assert art["panel"]["unfilled_cells"] == 0
+    assert art["reconcile"]["drift_events"] == 0
+    assert art["serve"]["requests"]["served"] > 0
+    assert art["compile"]["in_window_fresh_compiles"] == 0
+
+
+def test_chaos_replay_exercises_every_degradation(chaos_art):
+    art = chaos_art
+    assert inv.validate(art) == []
+    t = art["ticks"]
+    assert t["merged_late"] > 0, "no late tick merged"
+    assert t["quarantined"] > 0, "no tick quarantined past the watermark"
+    assert t["deduped"] > 0, "no duplicate deduplicated"
+    assert t["dropped_gap"] > 0, "no tick dropped into a gap"
+    assert art["panel"]["gap_bars"] >= 1, "the whole-bar gap vanished"
+    assert art["panel"]["stale_bars"] >= 1, "gap bar not marked stale"
+    v = art["versions"]
+    assert v["skew_events"] == 1
+    assert v["skew_refusals"] > 0, "the version gate did not refuse"
+    assert v["skew_refusals"] <= v["skew_attempts"]
+    assert v["serve_max"] <= v["ingest_final"]
+    # drift-free even under the storm; the merges forced rebuilds
+    assert art["reconcile"]["drift_events"] == 0
+    assert art["reconcile"]["rebuilds"] > 0
+    # the skew refusals are IN the closed serve book
+    req = art["serve"]["requests"]
+    assert req["rejected_version_skew"] == v["skew_refusals"]
+    assert (req["served"] + req["rejected"] + req["expired"]
+            == req["admitted"])
+
+
+class TestReplaySchemaRefusesDoctoredBooks:
+    def _doctor(self, art, fn):
+        bad = copy.deepcopy(art)
+        fn(bad)
+        return inv.validate(bad, "replay")
+
+    def test_vanished_tick_refused(self, clean_art):
+        out = self._doctor(clean_art,
+                           lambda a: a["ticks"].__setitem__(
+                               "applied", a["ticks"]["applied"] - 1))
+        assert any("tick accounting broken" in v for v in out)
+
+    def test_feed_ledger_mismatch_refused(self, clean_art):
+        out = self._doctor(clean_art,
+                           lambda a: a["ticks"].__setitem__(
+                               "dropped_gap", 7))
+        assert any("feed accounting broken" in v for v in out)
+
+    def test_impossible_serve_version_refused(self, clean_art):
+        out = self._doctor(
+            clean_art,
+            lambda a: a["versions"].__setitem__(
+                "serve_max", a["versions"]["ingest_final"] + 5))
+        assert any("version reconciliation broken" in v for v in out)
+
+    def test_unbalanced_serve_book_refused(self, clean_art):
+        out = self._doctor(
+            clean_art,
+            lambda a: a["serve"]["requests"].__setitem__(
+                "served", a["serve"]["requests"]["served"] + 1))
+        assert any("request accounting broken" in v for v in out)
+
+    def test_skew_counter_mismatch_refused(self, clean_art):
+        out = self._doctor(
+            clean_art,
+            lambda a: a["versions"].__setitem__("skew_refusals", 3))
+        assert any("skew_refusals" in v for v in out)
+
+    def test_unknown_schema_version_refused(self, clean_art):
+        out = self._doctor(
+            clean_art, lambda a: a.__setitem__("schema_version", 99))
+        assert any("unknown schema_version" in v for v in out)
+
+
+def test_late_tick_on_final_bar_does_not_read_as_drift():
+    """A tick of the LAST bar held late lands at the end-of-log flush as
+    'applied' into an already-consumed bar — that must dirty the
+    updaters like a merge, not surface as reconcile drift (the
+    regression a first cut of the flush had)."""
+    from csmom_tpu.chaos import inject
+    from csmom_tpu.chaos.plan import Fault, FaultPlan
+
+    cfg = ReplayConfig(run_id="t_lastlate", engine="stub",
+                       profile="serve-smoke")
+    total = cfg.n_assets * cfg.bars
+    plan = FaultPlan("late-on-final-bar", seed=1, faults=(
+        Fault(point="stream.tick", action="tick_late", after=total - 2,
+              max_fires=1),
+    ))
+    saved = {k: os.environ.get(k) for k in (PLAN_ENV, "CSMOM_FAULT_STATE")}
+    os.environ[PLAN_ENV] = plan.to_toml()
+    os.environ.pop("CSMOM_FAULT_STATE", None)
+    inject.reset()
+    try:
+        art = run_replay(cfg)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        inject.reset()
+    assert inv.validate(art) == []
+    assert art["reconcile"]["drift_events"] == 0
+    assert art["reconcile"]["rebuilds"] >= 1
+    assert art["ticks"]["offered"] == art["ticks"]["generated"]
+
+
+def test_replay_sidecar_committable_rule():
+    """Only round REPLAY artifacts may be committed (the TELEMETRY/SERVE
+    rule, extended)."""
+    assert inv.committable_sidecar("REPLAY_r12.json")
+    assert not inv.committable_sidecar("REPLAY_smoke.json")
+    assert not inv.committable_sidecar("REPLAY_rehearse_tick-storm.json")
+    assert not inv.committable_sidecar("REPLAY_r12-999.json")
+
+
+def test_replay_pattern_in_tier1_sweep_and_ledger():
+    import inspect
+
+    from csmom_tpu.obs import ledger
+
+    sig = inspect.signature(inv.validate_tree)
+    assert "REPLAY_*.json" in sig.parameters["patterns"].default
+    assert "REPLAY_*.json" in ledger.DEFAULT_PATTERNS
+
+
+def test_ledger_ingests_replay_rows(tmp_path, clean_art):
+    from csmom_tpu.obs import ledger
+    from csmom_tpu.serve.loadgen import write_artifact
+
+    art = dict(clean_art, run_id="r99")
+    write_artifact(str(tmp_path), art, prefix="REPLAY")
+    led = ledger.load(str(tmp_path))
+    metrics = {r.metric for r in led.rows}
+    assert "replay_ticks_per_s" in metrics
+    assert "replay_staleness_p99_ms" in metrics
+    assert "replay_in_window_fresh_compiles" in metrics
+    # smoke-bucket replays are flagged, never gate-eligible
+    smoke_rows = [r for r in led.rows if r.metric == "replay_ticks_per_s"]
+    assert smoke_rows and not any(r.gate_eligible() for r in smoke_rows)
+
+
+def test_ledger_refuses_unknown_replay_schema(tmp_path, clean_art):
+    from csmom_tpu.obs import ledger
+
+    art = dict(clean_art, run_id="r98", schema_version=42)
+    path = tmp_path / "REPLAY_r98.json"
+    path.write_text(json.dumps(art))
+    led = ledger.load(str(tmp_path))
+    assert led.rows == []
+    assert any("unknown replay schema_version" in p["note"]
+               for p in led.problems)
+
+
+def test_service_version_skew_gate_direct():
+    """The serve-side gate in isolation: a stale panel_version is
+    refused at the door and counted; a fresh one passes."""
+    from csmom_tpu.serve.service import ServeConfig, SignalService
+
+    svc = SignalService(ServeConfig(profile="serve-smoke", engine="stub",
+                                    default_deadline_s=2.0)).start()
+    try:
+        live = {"v": 10}
+        svc.attach_live_version(lambda: live["v"], max_skew=0)
+        values = np.full((4, svc.spec.months), 100.0, np.float32)
+        mask = np.ones_like(values, bool)
+        stale = svc.submit("momentum", values, mask, panel_version=7)
+        assert stale.state == "rejected"
+        assert "skew" in (stale.error or "")
+        fresh = svc.submit("momentum", values, mask, panel_version=10)
+        fresh.wait(5.0)
+        assert fresh.state == "served"
+        assert fresh.panel_version == 10
+        acct = svc.accounting()
+        assert acct["rejected_version_skew"] == 1
+        assert svc.invariant_violations() == []
+    finally:
+        svc.stop(drain=True)
+
+
+def test_cli_replay_smoke_lands_valid_artifact(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.pop(PLAN_ENV, None)
+    p = subprocess.run(
+        [sys.executable, "-m", "csmom_tpu.cli.main", "replay", "--smoke",
+         "--stub", "--chaos", "builtin", "--out-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=120, env=env, cwd=_REPO)
+    assert p.returncode == 0, p.stdout + p.stderr
+    path = tmp_path / "REPLAY_smoke.json"
+    assert path.exists()
+    assert inv.validate_file(str(path)) == []
+    assert "stale request(s) refused" in p.stdout
+
+
+def test_manifest_stream_profiles_validate():
+    """The stream reconcile entries bind against the live signal
+    signatures and enumerate the canonical replay shapes."""
+    from csmom_tpu.compile.manifest import build_manifest
+    from csmom_tpu.stream.replay import REPLAY_BARS, REPLAY_SMOKE_BARS
+
+    for profile, bars in (("stream", REPLAY_BARS),
+                          ("stream-smoke", REPLAY_SMOKE_BARS)):
+        entries = build_manifest(profile)
+        assert entries, profile
+        for e in entries:
+            e.validate()
+            assert f"x{bars}" in e.name
+        kinds = {e.name.split(".")[1].split("@")[0] for e in entries}
+        assert kinds == {"momentum", "turn_avg"}

@@ -1,0 +1,378 @@
+"""Stream vertical tests: ring, watermark ingest, incremental exactness.
+
+The load-bearing claims, in test form:
+
+- the ring's snapshots are immutable and versioned (a consumer holding
+  version v still sees version v after a million more ticks);
+- the ingestor's tick ledger is closed under every arrival disorder
+  (late / out-of-order / duplicate / gap), with the watermark policy
+  deciding merge-vs-quarantine in event time;
+- **the property tests**: after ANY seeded interleaving of in-order,
+  late (merged), duplicate, and dropped ticks, the incremental
+  momentum/turnover state equals the full-panel recompute BIT-FOR-BIT
+  under the NaN/listing masks — in float32 AND float64;
+- the numpy mirrors themselves equal the jitted ``signals`` engines
+  (momentum exactly — same elementwise IEEE ops; turnover to
+  float-association tolerance, XLA's cumsum may reassociate).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from csmom_tpu.stream.incremental import (
+    IncrementalMomentum,
+    IncrementalTurnover,
+    full_momentum_np,
+    full_turnover_np,
+    nan_equal,
+)
+from csmom_tpu.stream.ingest import StreamIngestor, Tick, WatermarkPolicy
+from csmom_tpu.stream.ring import LiveRing
+
+PERIOD = 60 * 10**9  # one-minute bars in ns
+
+
+def _bar(i: int) -> int:
+    return 1_700_000_000_000_000_000 + i * PERIOD
+
+
+# -------------------------------------------------------------------- ring --
+
+class TestLiveRing:
+    def test_append_write_version_monotone(self):
+        ring = LiveRing(["a", "b"], capacity=4, fields=("price",))
+        v0 = ring.version
+        i = ring.append_bar(_bar(0))
+        assert ring.version > v0
+        v1 = ring.version
+        ring.write("price", "a", i, 10.0)
+        assert ring.version > v1
+        assert ring.cell_written("price", "a", i)
+        assert not ring.cell_written("price", "b", i)
+
+    def test_snapshot_is_immutable_and_pinned(self):
+        ring = LiveRing(["a", "b"], capacity=4, fields=("price",))
+        i = ring.append_bar(_bar(0))
+        ring.write("price", "a", i, 10.0)
+        snap = ring.snapshot()
+        v = snap.version
+        # later mutations must not reach the snapshot
+        j = ring.append_bar(_bar(1))
+        ring.write("price", "a", j, 11.0)
+        assert snap.version == v
+        assert snap.n_bars == 1
+        assert snap.values["price"][0, 0] == 10.0
+        with pytest.raises(ValueError):
+            snap.values["price"][0, 0] = 99.0  # read-only
+
+    def test_ring_wraps_and_counts_evictions(self):
+        ring = LiveRing(["a"], capacity=3, fields=("price",))
+        for b in range(5):
+            i = ring.append_bar(_bar(b))
+            ring.write("price", "a", i, float(b))
+        assert ring.n_bars == 3
+        assert ring.evictions == 2
+        assert ring.first_bar_index == 2
+        snap = ring.snapshot()
+        assert snap.values["price"][0].tolist() == [2.0, 3.0, 4.0]
+        assert snap.bar_times.tolist() == [_bar(2), _bar(3), _bar(4)]
+        assert not ring.in_window(1)
+
+    def test_bars_must_ascend(self):
+        ring = LiveRing(["a"], capacity=4, fields=("price",))
+        ring.append_bar(_bar(1))
+        with pytest.raises(ValueError):
+            ring.append_bar(_bar(0))
+
+    def test_stale_gap_bar_clears_on_real_write(self):
+        ring = LiveRing(["a"], capacity=4, fields=("price",))
+        ring.append_bar(_bar(0))
+        g = ring.append_bar(_bar(1), stale=True)
+        assert ring.stats()["stale_bars"] == 1
+        ring.write("price", "a", g, 5.0)
+        assert ring.stats()["stale_bars"] == 0
+
+
+# ------------------------------------------------------------------ ingest --
+
+def _mk(A=3, capacity=32, lateness=2):
+    tickers = [f"a{i}" for i in range(A)]
+    ring = LiveRing(tickers, capacity=capacity, fields=("price", "volume"))
+    ing = StreamIngestor(ring, WatermarkPolicy(
+        bar_period_ns=PERIOD, allowed_lateness_bars=lateness))
+    return ring, ing
+
+
+class TestIngest:
+    def test_in_order_applies(self):
+        ring, ing = _mk()
+        assert ing.offer(Tick("a0", _bar(0), 10.0, 100.0)) == "applied"
+        assert ing.offer(Tick("a1", _bar(0), 11.0, 110.0)) == "applied"
+        assert ing.offer(Tick("a0", _bar(1), 10.5, 105.0)) == "applied"
+        assert ing.invariant_violations() == []
+
+    def test_duplicate_is_idempotent(self):
+        ring, ing = _mk()
+        ing.offer(Tick("a0", _bar(0), 10.0))
+        v = ring.version
+        assert ing.offer(Tick("a0", _bar(0), 99.0)) == "deduped"
+        assert ring.version == v          # first write wins, no bump
+        snap = ring.snapshot()
+        assert snap.values["price"][0, 0] == 10.0
+        assert ing.deduped == 1
+        assert ing.invariant_violations() == []
+
+    def test_late_within_allowance_merges_and_bumps_version(self):
+        ring, ing = _mk(lateness=3)
+        ing.offer(Tick("a0", _bar(0), 10.0))
+        ing.offer(Tick("a0", _bar(2), 12.0))   # a1's bar-0/1 never arrived
+        v = ring.version
+        assert ing.offer(Tick("a1", _bar(1), 11.0)) == "merged_late"
+        assert ring.version > v
+        assert ing.merged_late == 1
+        snap = ring.snapshot()
+        assert snap.values["price"][1, 1] == 11.0
+        assert ing.invariant_violations() == []
+
+    def test_late_beyond_watermark_quarantines(self):
+        ring, ing = _mk(lateness=1)
+        ing.offer(Tick("a0", _bar(0), 10.0))
+        ing.offer(Tick("a0", _bar(5), 15.0))
+        v = ring.version
+        assert ing.offer(Tick("a1", _bar(1), 11.0)) == "quarantined"
+        assert ring.version == v              # nothing written
+        assert ing.quarantined == 1
+        assert not ring.cell_written("price", "a1", 1)
+        q = list(ing.quarantine)
+        assert q and "below watermark" in q[-1]["reason"]
+        assert ing.invariant_violations() == []
+
+    def test_gap_bars_materialize_stale_never_carry(self):
+        ring, ing = _mk()
+        ing.offer(Tick("a0", _bar(0), 10.0))
+        ing.offer(Tick("a0", _bar(3), 13.0))  # bars 1, 2 skipped
+        assert ing.gap_bars == 2
+        snap = ring.snapshot()
+        assert snap.n_bars == 4
+        assert snap.stale.tolist() == [False, True, True, False]
+        # the hole is masked NaN — the last price was NOT carried
+        assert not snap.mask["price"][0, 1]
+        assert not snap.mask["price"][0, 2]
+        assert np.isnan(snap.values["price"][0, 1])
+
+    def test_closed_accounting_equation(self):
+        ring, ing = _mk(lateness=1)
+        ing.offer(Tick("a0", _bar(0), 10.0))
+        ing.offer(Tick("a0", _bar(0), 10.0))   # dup
+        ing.offer(Tick("a0", _bar(4), 14.0))
+        ing.offer(Tick("a1", _bar(3), 13.0))   # late, within
+        ing.offer(Tick("a1", _bar(0), 10.0))   # late, beyond -> quarantine
+        a = ing.accounting()
+        assert (a["applied"] + a["merged_late"] + a["quarantined"]
+                + a["deduped"]) == a["offered"] == 5
+        assert ing.invariant_violations() == []
+
+
+# ---------------------------------------------- incremental property tests --
+
+def _drive_interleaved(seed: int, dtype, A=5, B=40, lateness=2,
+                       lookback=6, skip=1, turn_lookback=3):
+    """One seeded disordered feed: per-tick chances of being dropped
+    (cell gap), delayed within the allowance (merged late), delayed past
+    it (quarantined), or duplicated; whole bars occasionally skipped.
+    After EVERY closed bar, assert the incremental state equals the
+    full-panel mirror bit-for-bit."""
+    rng = random.Random(seed)
+    r = np.random.default_rng(seed)
+    prices = (100.0 * np.exp(np.cumsum(r.normal(0, 0.02, (A, B)),
+                                       axis=1))).astype(dtype)
+    vols = r.lognormal(8.0, 0.5, (A, B)).astype(dtype)
+    tickers = [f"a{i}" for i in range(A)]
+    ring = LiveRing(tickers, capacity=B, fields=("price", "volume"),
+                    dtype=dtype)
+    ing = StreamIngestor(ring, WatermarkPolicy(
+        bar_period_ns=PERIOD, allowed_lateness_bars=lateness))
+    mom = IncrementalMomentum(A, lookback=lookback, skip=skip, dtype=dtype)
+    turn = IncrementalTurnover(A, shares=np.ones(A), lookback=turn_lookback,
+                               dtype=dtype)
+    held = []
+    checks = 0
+    outcomes = {"merged_late": 0, "quarantined": 0, "deduped": 0,
+                "dropped": 0}
+
+    def _offer(t):
+        out = ing.offer(t)
+        if out == "merged_late":
+            mom.mark_dirty()
+            turn.mark_dirty()
+        outcomes[out] = outcomes.get(out, 0) + 1
+
+    for b in range(B):
+        if rng.random() < 0.05 and 0 < b < B - 1:
+            outcomes["dropped"] += A
+            continue  # whole-bar gap
+        for a in rng.sample(range(A), A):
+            t = Tick(tickers[a], _bar(b), float(prices[a, b]),
+                     float(vols[a, b]))
+            u = rng.random()
+            if u < 0.05:
+                outcomes["dropped"] += 1
+                continue                      # cell gap
+            if u < 0.20:
+                held.append((b + rng.randint(1, lateness + 2), t))
+                continue                      # late / out-of-order
+            _offer(t)
+            if u < 0.28:
+                _offer(t)                     # duplicate
+        for h in list(held):
+            if h[0] <= b:
+                _offer(h[1])
+                held.remove(h)
+        if ring.next_bar_index == 0:
+            continue
+        snap = ring.snapshot()
+        mom.sync(snap)
+        turn.sync(snap)
+        ref_m, ref_mok = full_momentum_np(
+            np.asarray(snap.values["price"], dtype), snap.mask["price"],
+            lookback, skip)
+        cur_m, cur_mok = mom.current()
+        assert nan_equal(cur_m, ref_m[:, -1]), (seed, dtype, b, "momentum")
+        assert np.array_equal(cur_mok, ref_mok[:, -1])
+        ref_t, ref_tok = full_turnover_np(
+            np.asarray(snap.values["volume"], dtype), snap.mask["volume"],
+            np.ones(A), turn_lookback)
+        cur_t, cur_tok = turn.current()
+        assert nan_equal(cur_t, ref_t[:, -1]), (seed, dtype, b, "turnover")
+        assert np.array_equal(cur_tok, ref_tok[:, -1])
+        checks += 1
+    assert checks > 10
+    assert ing.invariant_violations() == []
+    return outcomes, mom, turn
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64],
+                         ids=["f32", "f64"])
+@pytest.mark.parametrize("seed", [0, 1, 2, 7])
+def test_incremental_equals_full_recompute_bit_for_bit(seed, dtype):
+    outcomes, mom, turn = _drive_interleaved(seed, dtype)
+    # the interleaving actually exercised the disorder paths
+    assert outcomes["merged_late"] > 0
+    assert outcomes["deduped"] > 0
+    assert outcomes["dropped"] > 0
+    # late merges forced rebuilds; none of them drifted
+    assert mom.rebuilds > 0
+    assert mom.drift_events == 0
+    assert turn.drift_events == 0
+
+
+def test_sync_rebuilds_when_ring_window_moves_past_consumed():
+    """A long session wraps the ring: bars evicted before the updater
+    saw them must trigger a REBUILD at the next sync, not a silent skip
+    — a forward-fill carry that jumped the gap would serve wrong values
+    flagged valid until the next periodic reconcile."""
+    A, cap = 3, 8
+    ring = LiveRing([f"a{i}" for i in range(A)], capacity=cap,
+                    fields=("price",), dtype=np.float64)
+    mom = IncrementalMomentum(A, lookback=2, skip=0, dtype=np.float64)
+    r = np.random.default_rng(5)
+
+    def _bar_full(b):
+        i = ring.append_bar(_bar(b))
+        for a in range(A):
+            ring.write("price", a, i, float(100 + r.normal()))
+
+    for b in range(4):
+        _bar_full(b)
+    mom.sync(ring.snapshot())
+    assert mom.consumed == 4 and mom.rebuilds == 0
+    # 10 more bars land unseen: the window [6, 14) no longer contains
+    # the consumed frontier (4) — sync must rebuild, and the rebuilt
+    # state must equal the mirror on the surviving window
+    for b in range(4, 14):
+        _bar_full(b)
+    snap = ring.snapshot()
+    assert snap.first_bar_index > mom.consumed
+    mom.sync(snap)
+    assert mom.rebuilds == 1
+    ref_m, ref_ok = full_momentum_np(
+        np.asarray(snap.values["price"]), snap.mask["price"], 2, 0)
+    cur_m, cur_ok = mom.current()
+    assert nan_equal(cur_m, ref_m[:, -1])
+    assert np.array_equal(cur_ok, ref_ok[:, -1])
+
+
+def test_reconcile_detects_seeded_drift_and_rebuilds():
+    """Corrupt the running state deliberately: reconcile must DETECT the
+    drift (count it) and rebuild back to exact equality — the safety
+    net is real, not decorative."""
+    ring, ing = _mk(A=4, capacity=32, lateness=2)
+    mom = IncrementalMomentum(4, lookback=5, skip=1, dtype=np.float64)
+    r = np.random.default_rng(3)
+    for b in range(20):
+        for a in range(4):
+            ing.offer(Tick(f"a{a}", _bar(b), float(100 + r.normal()),
+                           float(1000)))
+    snap = ring.snapshot()
+    mom.sync(snap)
+    assert mom.reconcile(snap)["drift"] is False
+    mom._mom = mom._mom + 1.0  # sabotage the running output state
+    verdict = mom.reconcile(snap)
+    assert verdict["drift"] is True
+    assert mom.drift_events == 1
+    assert mom.rebuilds == 1
+    # after the rebuild the state is exact again
+    assert mom.reconcile(snap)["drift"] is False
+
+
+# ------------------------------------------------- mirror vs jax engines --
+
+def _gappy_panel(seed, A, T, dtype):
+    r = np.random.default_rng(seed)
+    steps = r.normal(0, 0.03, (A, T))
+    prices = (100.0 * np.exp(np.cumsum(steps, axis=1))).astype(dtype)
+    mask = r.random((A, T)) > 0.12
+    mask[:, 0] = True
+    # one asset delists mid-panel, one lists late
+    mask[0, T // 2:] = False
+    mask[1, :T // 3] = False
+    values = np.where(mask, prices, np.nan).astype(dtype)
+    return values, mask
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64],
+                         ids=["f32", "f64"])
+def test_momentum_mirror_matches_jax_engine_exactly(dtype):
+    """The reconciliation reference must BE the signals engine: the
+    momentum mirror and the jitted engine share every elementwise IEEE
+    op, so their outputs are bitwise identical."""
+    from csmom_tpu.signals.momentum import momentum
+
+    values, mask = _gappy_panel(11, 6, 48, dtype)
+    ref_m, ref_ok = full_momentum_np(values, mask, 6, 1)
+    jm, jok = momentum(values, mask, lookback=6, skip=1)
+    assert np.array_equal(np.asarray(jok), ref_ok)
+    assert nan_equal(np.asarray(jm), ref_m)
+
+
+@pytest.mark.parametrize("dtype,rtol", [(np.float32, 1e-5),
+                                        (np.float64, 1e-12)],
+                         ids=["f32", "f64"])
+def test_turnover_mirror_matches_jax_engine_to_association(dtype, rtol):
+    """Turnover parity is a tolerance check by design: the mirror (and
+    the incremental updater) accumulate sequentially; XLA's cumsum may
+    associate differently.  Validity planes still match exactly."""
+    from csmom_tpu.signals.turnover import turnover_features
+
+    values, mask = _gappy_panel(13, 6, 48, dtype)
+    vols = np.where(mask, np.abs(values) * 37.0, np.nan).astype(dtype)
+    shares = np.ones(6)
+    ref_t, ref_ok = full_turnover_np(vols, mask, shares, 3)
+    jt, jok = turnover_features(vols, mask, shares.astype(dtype),
+                                lookback=3)["turn_avg"]
+    assert np.array_equal(np.asarray(jok), ref_ok)
+    both = ref_ok
+    np.testing.assert_allclose(np.asarray(jt)[both], ref_t[both],
+                               rtol=rtol)

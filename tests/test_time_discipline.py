@@ -99,6 +99,7 @@ def test_telemetry_pattern_is_in_the_tier1_artifact_sweep():
     sig = inspect.signature(inv.validate_tree)
     assert "TELEMETRY_*.json" in sig.parameters["patterns"].default
     assert "SERVE_*.json" in sig.parameters["patterns"].default
+    assert "REPLAY_*.json" in sig.parameters["patterns"].default
 
 
 def test_committed_telemetry_sidecars_validate():
@@ -122,7 +123,8 @@ def test_only_round_sidecars_are_committed():
 
     try:
         p = subprocess.run(
-            ["git", "ls-files", "TELEMETRY_*.json", "SERVE_*.json"],
+            ["git", "ls-files", "TELEMETRY_*.json", "SERVE_*.json",
+             "REPLAY_*.json"],
             cwd=_REPO, capture_output=True, text=True, timeout=30,
         )
     except (OSError, subprocess.TimeoutExpired) as e:  # no git in image
@@ -155,6 +157,11 @@ def test_only_round_sidecars_are_committed():
     assert not inv.committable_sidecar(
         "SERVE_POOL_rehearse_pool-worker-kill-mid-batch.json")
     assert not inv.committable_sidecar("SERVE_POOL_r11-42.json")
+    # ISSUE 7: the replay family obeys the same rule
+    assert inv.committable_sidecar("REPLAY_r12.json")
+    assert not inv.committable_sidecar("REPLAY_smoke.json")
+    assert not inv.committable_sidecar("REPLAY_rehearse_tick-storm.json")
+    assert not inv.committable_sidecar("REPLAY_r12-7.json")
     # other families are not this rule's business
     assert inv.committable_sidecar("BENCH_r04.json")
 
@@ -205,6 +212,58 @@ def test_serve_modules_route_all_timing_through_deadline_helpers():
     from csmom_tpu.utils.deadline import mono_now_s
 
     assert mono_now_s() <= mono_now_s()  # monotone, and the helper exists
+
+
+def test_stream_modules_are_event_time_only():
+    """ISSUE 7 satellite: the streaming data plane runs on EVENT TIME —
+    bar stamps from the tick log, versions from counters.  The ring,
+    ingestor, and incremental updaters may read NO clock of any kind
+    (wall, monotonic, or the deadline helpers): a clock read in the
+    data plane is a lateness decision smuggled off the event-time axis.
+    The replay harness and its CLI may read the wall only through
+    ``mono_now_s`` (throughput reporting), never inline."""
+    mono = re.compile(r"time\.monotonic\(\)")
+    any_time_import = re.compile(r"^\s*import time\b|^\s*from time import",
+                                 re.MULTILINE)
+
+    event_time_only = (
+        "csmom_tpu/stream/__init__.py",
+        "csmom_tpu/stream/ring.py",
+        "csmom_tpu/stream/ingest.py",
+        "csmom_tpu/stream/incremental.py",
+    )
+    for rel in event_time_only:
+        path = os.path.join(_REPO, rel)
+        assert os.path.exists(path), rel
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        assert not _WALL_CLOCK.findall(src), f"{rel}: bare wall clock"
+        assert not _ARGLESS_NOW.findall(src), f"{rel}: argless now()"
+        assert not mono.findall(src), f"{rel}: inline monotonic read"
+        assert not any_time_import.findall(src), (
+            f"{rel}: imports the time module — the streaming data plane "
+            "is event-time only")
+        assert "mono_now_s" not in src, (
+            f"{rel}: reads the clock via mono_now_s — lateness and "
+            "ordering decisions must come from tick stamps")
+
+    wall_via_helper_only = (
+        "csmom_tpu/stream/replay.py",
+        "csmom_tpu/cli/replay.py",
+    )
+    for rel in wall_via_helper_only:
+        path = os.path.join(_REPO, rel)
+        assert os.path.exists(path), rel
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        n_wall = len(_WALL_CLOCK.findall(src)) + len(_ARGLESS_NOW.findall(src))
+        assert n_wall == 0, f"{rel}: {n_wall} bare wall-clock call(s)"
+        assert not mono.findall(src), (
+            f"{rel}: inline time.monotonic() — replay timing goes "
+            "through utils.deadline.mono_now_s")
+        assert rel not in _ALLOWLIST, (
+            f"{rel} must not be allowlisted: replay walls are "
+            "monotonic-helper-only by contract")
 
 
 def test_perf_ledger_modules_stay_wall_clock_free():
