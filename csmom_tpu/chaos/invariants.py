@@ -44,7 +44,12 @@ Artifact kinds (detected from keys, see :func:`detect_kind`):
     ``expired_dispatched == 0`` are schema rules — an artifact whose
     request ledger does not balance (a silently dropped request, an
     expired request that was dispatched anyway) is invalid evidence,
-    full stop.
+    full stop.  Schema v2 (ISSUE 8) extends the contract: per-SLO-class
+    books that each close and sum to the global book, a result-cache
+    book whose ``stale_hits`` must be 0 and whose ``hit_rate``
+    reconciles with its own counters, and an offered-load record
+    (``offered_rps`` + ``offered_limited``) so an offered-load-limited
+    headline can never be misread as a saturation ceiling.
 ``serve_pool``
     A multi-process pool load record (``SERVE_POOL_*.json``, the
     router/worker/supervisor tier): the serve closed-book rule enforced
@@ -102,8 +107,10 @@ DRIVER_TAIL_CHARS = 2000
 KNOWN_TELEMETRY_SCHEMA_VERSIONS = (1,)
 
 # serve artifact schema versions this checker (and the ledger) understand
-# — the same closed-world rule as telemetry
-KNOWN_SERVE_SCHEMA_VERSIONS = (1,)
+# — the same closed-world rule as telemetry.  v2 (ISSUE 8, adaptive
+# dispatch) adds per-SLO-class books, the result-cache book, and the
+# offered-load record; v1 artifacts (SERVE_r10.json) stay valid as-is.
+KNOWN_SERVE_SCHEMA_VERSIONS = (1, 2)
 
 # serve-pool artifact schema versions (SERVE_POOL_*.json, the
 # multi-process tier) — closed-world like the rest
@@ -484,6 +491,103 @@ def _validate_serve(obj: dict) -> list:
         if fc is not None and not isinstance(fc, (int, str)):
             out.append("serve: compile.in_window_fresh_compiles must be "
                        "an int count or a reason string")
+    if ver == 2:
+        out += _validate_serve_v2(obj, req)
+    return out
+
+
+def _validate_serve_v2(obj: dict, req: dict | None) -> list:
+    """The ISSUE 8 additions: closed PER-CLASS books that sum to the
+    global book, a cache book with zero stale hits and a reconciling
+    hit rate, and an offered-load record carrying ``offered_rps`` so an
+    offered-load-limited headline can never be misread as a saturation
+    ceiling."""
+    out: list = []
+    classes = _require(obj, "classes", dict, "serve", out)
+    if isinstance(classes, dict):
+        if not classes:
+            out.append("serve: classes must name at least one SLO class")
+        sums = dict.fromkeys(("admitted", "served", "rejected",
+                              "expired"), 0)
+        broken = False
+        for name, book in classes.items():
+            if not isinstance(book, dict):
+                out.append(f"serve: classes[{name!r}] must be a dict")
+                broken = True
+                continue
+            for k in ("admitted", "served", "rejected", "expired",
+                      "rejected_quota"):
+                v = book.get(k)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    out.append(f"serve: classes[{name!r}].{k} must be a "
+                               "non-negative int (the per-class book is "
+                               "the contract)")
+                    broken = True
+                    break
+            else:
+                total = book["served"] + book["rejected"] + book["expired"]
+                if total != book["admitted"]:
+                    out.append(
+                        f"serve: class {name!r} book broken — served "
+                        f"{book['served']} + rejected {book['rejected']} + "
+                        f"expired {book['expired']} = {total} != admitted "
+                        f"{book['admitted']}")
+                for k in sums:
+                    sums[k] += book[k]
+        if not broken and req is not None:
+            for k, csum in sums.items():
+                if csum != req[k]:
+                    out.append(
+                        f"serve: class books do not sum to the global "
+                        f"book — sum({k}) = {csum} != requests.{k} "
+                        f"{req[k]} (a request escaped its class ledger)")
+    cache = _require(obj, "cache", dict, "serve", out)
+    if isinstance(cache, dict) and cache.get("enabled", True):
+        ok = True
+        for k in ("hits", "misses", "stale_blocked", "stale_hits",
+                  "lookups", "inserts", "evictions"):
+            v = cache.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"serve: cache.{k} must be a non-negative int")
+                ok = False
+        if ok:
+            if cache["stale_hits"] != 0:
+                out.append(
+                    f"serve: cache.stale_hits = {cache['stale_hits']} — a "
+                    "result computed from a panel version the floor has "
+                    "passed was SERVED; stale cache hits are invalid "
+                    "evidence, full stop")
+            want = (cache["hits"] + cache["misses"]
+                    + cache["stale_blocked"])
+            if cache["lookups"] != want:
+                out.append(
+                    f"serve: cache.lookups {cache['lookups']} != hits + "
+                    f"misses + stale_blocked = {want}")
+            hr = cache.get("hit_rate")
+            if not isinstance(hr, _NUM) or isinstance(hr, bool):
+                out.append("serve: cache.hit_rate must be a number")
+            elif not 0.0 <= hr <= 1.0:
+                out.append(f"serve: cache.hit_rate {hr} outside [0, 1]")
+            elif cache["lookups"] and abs(
+                    hr - cache["hits"] / cache["lookups"]) > 1e-3:
+                out.append(
+                    f"serve: cache.hit_rate {hr} does not reconcile with "
+                    f"hits/lookups = "
+                    f"{cache['hits'] / cache['lookups']:.4f}")
+    offered = _require(obj, "offered", dict, "serve", out)
+    if isinstance(offered, dict):
+        orps = offered.get("offered_rps")
+        if not isinstance(orps, _NUM) or isinstance(orps, bool) \
+                or orps < 0:
+            out.append("serve: offered.offered_rps must be a non-negative "
+                       "number (the achieved-vs-offered distinction is "
+                       "the r11 footnote made mechanical)")
+        if not isinstance(offered.get("schedule_kind"), str):
+            out.append("serve: offered.schedule_kind must be a string "
+                       "(bursty/diurnal/adversarial/custom)")
+    if not isinstance(obj.get("offered_limited"), bool):
+        out.append("serve: offered_limited must be a bool (did the run "
+                   "measure the load or the ceiling?)")
     return out
 
 

@@ -129,6 +129,11 @@ class Fault:
         "tick_dup",
         "tick_drop",
         "version_skew",
+        # serve result-cache fault (ISSUE 8) — caller-interpreted at the
+        # serve.cache checkpoint: the cache plants an entry under the
+        # looked-up key stamped BELOW the version floor; the get path's
+        # floor check must refuse it (stale_blocked), never serve it
+        "cache_poison",
     )
 
     def validate(self) -> None:

@@ -256,6 +256,86 @@ def _check_serve_deadline_storm(r):
     return out
 
 
+def _check_serve_burst_storm(r):
+    """ISSUE 8: a bulk-heavy burst storm against the SLO classes — the
+    bulk quota must actually enforce (rejected_quota > 0 in bulk's own
+    book), every interactive request must be SERVED (none rejected or
+    expired behind the flood), interactive must never queue behind bulk
+    (its p99 bounded by bulk's — the rank-order claim), and the
+    per-class books must close (schema rules of serve v2).
+
+    The starvation evidence is deliberately scheduling-invariant: an
+    absolute wall-clock p99 bound flakes when the REHEARSAL machine is
+    contended (the whole run slows uniformly), but quota rejections and
+    the interactive-never-behind-bulk ordering hold at any machine
+    speed.  The absolute per-class budget claim lives in the committed
+    SERVE_r13.json (a dedicated capture, not a shared-tier test) and in
+    tests/test_serve_slo.py's paced starvation test."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve")
+    classes = art.get("classes") or {}
+    bulk = classes.get("bulk") or {}
+    inter = classes.get("interactive") or {}
+    if not bulk.get("rejected_quota"):
+        out.append("bulk.rejected_quota == 0 — the burst never hit the "
+                   "quota; the storm rehearsed nothing (tune the "
+                   "schedule or the quota)")
+    if not inter.get("served"):
+        out.append("no interactive request served under the bulk storm")
+    elif inter.get("served") != inter.get("admitted"):
+        out.append(
+            f"interactive served {inter.get('served')} of "
+            f"{inter.get('admitted')} admitted — the bulk storm cost "
+            "interactive requests (rejected/expired), which is exactly "
+            "the starvation the SLO classes exist to prevent")
+    ip99 = (inter.get("latency_ms") or {}).get("p99")
+    bp99 = (bulk.get("latency_ms") or {}).get("p99")
+    if (isinstance(ip99, (int, float)) and isinstance(bp99, (int, float))
+            and inter.get("within_budget") is not True
+            and ip99 > bp99 + 100.0):
+        out.append(
+            f"interactive p99 {ip99} ms exceeds bulk's served p99 "
+            f"{bp99} ms (and its own budget) — interactive queued "
+            "BEHIND bulk, rank-ordered collection did not hold")
+    return out
+
+
+def _check_serve_cache_poison(r):
+    """ISSUE 8: the chaos ``cache_poison`` action plants entries under
+    live keys stamped below the version floor — the get path must refuse
+    every one (``stale_blocked`` > 0, ``stale_hits`` == 0 BY SCHEMA),
+    genuine repeats must still hit, and the books must close."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve")
+    cache = art.get("cache") or {}
+    if not cache.get("hits"):
+        out.append("cache.hits == 0 — the reuse stream produced no "
+                   "genuine hits; the scenario rehearsed nothing")
+    if not cache.get("stale_blocked"):
+        out.append("cache.stale_blocked == 0 — the poison fault never "
+                   "fired (or its entry was silently served)")
+    # stale_hits != 0 is already a schema violation; restate it pointedly
+    if cache.get("stale_hits"):
+        out.append(f"cache.stale_hits = {cache['stale_hits']} — a "
+                   "POISONED result reached a caller")
+    return out
+
+
+def _burst_policy():
+    """The burst-storm SLO policy: default shape, but a bulk quota small
+    enough that the rehearse burst provably exceeds it even when a
+    contended machine stretches the run (token refill is time-based, so
+    a slower run earns MORE tokens — the margin must survive that)."""
+    from csmom_tpu.serve.slo import SLOClass, SLOPolicy
+
+    return SLOPolicy((
+        SLOClass("interactive", rank=0, deadline_s=0.5),
+        SLOClass("standard", rank=1, deadline_s=1.0, queue_share=0.75),
+        SLOClass("bulk", rank=2, deadline_s=3.0,
+                 quota_rps=15.0, quota_burst=5.0, queue_share=0.5),
+    ))
+
+
 def _serve_scenarios():
     return [
         Scenario(
@@ -284,6 +364,40 @@ def _serve_scenarios():
             env={"load": {"schedule": "0.4x150", "seed": 12,
                           "deadline_s": 0.08},
                  "serve": {"capacity": 24}},
+        ),
+        Scenario(
+            "serve-burst-storm", "serve", None,
+            _check_serve_burst_storm, fast=True,
+            notes="bulk-heavy burst storm against the SLO classes: the "
+                  "bulk token bucket rejects over-quota admissions, "
+                  "every interactive request is served and never queues "
+                  "behind bulk, and the per-class books close BY SCHEMA "
+                  "(serve v2)",
+            env={"load": {"schedule": "0.2x30,0.15x280,0.2x30,0.15x300",
+                          "seed": 22,
+                          "class_mix": (("interactive", 0.4),
+                                        ("bulk", 0.6)),
+                          # generous explicit deadlines: a contended
+                          # rehearse machine must not expire requests
+                          # the scheduling property would have served
+                          "deadline_s": 10.0,
+                          "schedule_kind": "bursty"},
+                 "serve": {"policy": _burst_policy(), "capacity": 256}},
+        ),
+        Scenario(
+            "serve-cache-poison", "serve",
+            FaultPlan("serve-cache-poison", seed=23, faults=(
+                Fault(point="serve.cache", action="cache_poison",
+                      after=3, max_fires=4),
+            )),
+            _check_serve_cache_poison, fast=True,
+            notes="chaos plants stale-version entries under live cache "
+                  "keys: the get-path version floor refuses every one "
+                  "(stale_blocked > 0, stale_hits == 0 by schema) while "
+                  "genuine repeats keep hitting and books stay closed",
+            env={"load": {"schedule": "0.5x120", "seed": 24,
+                          "reuse_fraction": 0.6, "version_bumps": 1,
+                          "deadline_s": 2.0}},
         ),
     ]
 

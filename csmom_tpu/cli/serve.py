@@ -54,7 +54,10 @@ def _mk_service(args, engine_default: str = "jax"):
         engine="stub" if args.stub else engine_default,
         capacity=args.capacity,
         max_wait_s=args.max_wait_ms / 1e3,
-        default_deadline_s=(None if args.deadline_ms in (None, 0)
+        # unset --deadline-ms = the SLO class budgets; 0 = no default
+        # deadline; an explicit value wins for every class (r10 mode)
+        default_deadline_s=("class" if args.deadline_ms is None
+                            else None if args.deadline_ms == 0
                             else args.deadline_ms / 1e3),
     )
     return SignalService(cfg)
@@ -88,6 +91,9 @@ def _mk_pool(args, run_dir: str):
     profile = args.profile or ("serve-smoke" if getattr(args, "smoke", False)
                                else "serve")
     engine = "stub" if args.stub else "jax"
+    # the pool wire carries per-request deadlines from the router, so
+    # the worker-side default keeps plain float semantics (r10 mode)
+    pool_deadline_ms = 500.0 if args.deadline_ms is None else args.deadline_ms
     cfg = PoolConfig(
         # --pool without --workers means "a pool": two workers is the
         # smallest fleet hedging can route around
@@ -96,7 +102,7 @@ def _mk_pool(args, run_dir: str):
         engine=engine,
         capacity=args.capacity,
         max_wait_ms=args.max_wait_ms,
-        deadline_ms=args.deadline_ms or 0.0,
+        deadline_ms=pool_deadline_ms,
         require_warm_cache=(engine == "jax"
                             and not getattr(args, "allow_cold_cache", False)
                             and not getattr(args, "smoke", False)),
@@ -104,8 +110,8 @@ def _mk_pool(args, run_dir: str):
     sup = PoolSupervisor(cfg, run_dir).start()
     router = Router(sup.ready_workers, RouterConfig(
         profile=profile,
-        default_deadline_s=(None if args.deadline_ms in (None, 0)
-                            else args.deadline_ms / 1e3),
+        default_deadline_s=(None if pool_deadline_ms == 0
+                            else pool_deadline_ms / 1e3),
         hedge_fraction=args.hedge_fraction,
     ))
     return sup, router
@@ -283,7 +289,9 @@ def cmd_serve(args) -> int:
     return 1 if viols else 0
 
 
-def _cmd_loadgen_pool(args, schedule: str, run_id: str) -> int:
+def _cmd_loadgen_pool(args, schedule: str, run_id: str,
+                      schedule_kind: str = "custom",
+                      preset: dict | None = None) -> int:
     """Pool-mode loadgen: drive the router, land SERVE_POOL_<run>.json."""
     import tempfile
 
@@ -302,10 +310,26 @@ def _cmd_loadgen_pool(args, schedule: str, run_id: str) -> int:
         return 1
     try:
         _print_pool_ready(sup, router)
+        # a named schedule's preset applies where the pool loadgen
+        # implements it (the class mix); cache reuse / version bumps are
+        # single-process shapes today (the pool has no shared cache yet
+        # — ROADMAP item 3's remaining depth) and are dropped LOUDLY so
+        # the artifact's schedule_kind never overclaims
+        preset = dict(preset or {})
+        class_mix = preset.pop("class_mix", None)
+        preset.pop("use_class_deadlines", None)  # pool deadlines are
+        # per-request floats through the router, not class budgets
+        if preset:
+            print(f"note: named-schedule preset keys {sorted(preset)} "
+                  "apply to the single-process loadgen only; this pool "
+                  "run uses the schedule + class mix")
         load = LoadConfig(
             schedule=schedule,
+            schedule_kind=schedule_kind,
             seed=args.seed,
-            deadline_s=(None if args.deadline_ms in (None, 0)
+            class_mix=class_mix,
+            deadline_s=(None if args.deadline_ms == 0
+                        else 0.5 if args.deadline_ms is None
                         else args.deadline_ms / 1e3),
             run_id=run_id,
         )
@@ -321,7 +345,10 @@ def _cmd_loadgen_pool(args, schedule: str, run_id: str) -> int:
 
     req = art["requests"]
     lat = art["latency_ms"]["total"]
-    print(f"\nthroughput: {art['value']} req/s over {art['wall_s']}s wall")
+    print(f"\nthroughput: {art['value']} req/s achieved vs "
+          f"{art['offered']['offered_rps']} req/s offered over "
+          f"{art['wall_s']}s wall"
+          + (" (offered-load-limited)" if art["offered_limited"] else ""))
     print(f"requests: admitted {req['admitted']} -> served {req['served']}, "
           f"rejected {req['rejected']} (infra {req['rejected_infra']}), "
           f"expired {req['expired']}")
@@ -361,46 +388,72 @@ def cmd_loadgen(args) -> int:
     from csmom_tpu.serve.loadgen import (
         LoadConfig,
         parse_schedule,
+        resolve_schedule,
         run_loadgen,
         write_artifact,
     )
 
     if args.smoke:
-        schedule = args.schedule or "0.8x60"
+        raw = args.schedule or "0.8x60"
         run_id = args.run_id or "smoke"
     else:
-        schedule = args.schedule or "2x40"
+        raw = args.schedule or "2x40"
         run_id = args.run_id or f"loadgen-{os.getpid()}"
+    schedule, schedule_kind, preset = resolve_schedule(raw)
     try:
         parse_schedule(schedule)
     except ValueError as e:
         print(f"--schedule: {e}", file=sys.stderr)
         return 2
     if args.pool:
-        return _cmd_loadgen_pool(args, schedule, run_id)
+        return _cmd_loadgen_pool(args, schedule, run_id, schedule_kind,
+                                 preset)
     svc = _mk_service(args)
     svc.start()
     _print_ready(svc)
     load = LoadConfig(
         schedule=schedule,
+        schedule_kind=schedule_kind,
         seed=args.seed,
-        deadline_s=(None if args.deadline_ms in (None, 0)
+        deadline_s=(None if args.deadline_ms == 0
+                    else 0.5 if args.deadline_ms is None
                     else args.deadline_ms / 1e3),
         run_id=run_id,
+        **preset,
     )
-    print(f"offering: schedule {schedule} (seed {load.seed}, deadline "
-          f"{load.deadline_s}s) ...")
+    print(f"offering: schedule {schedule_kind} = {schedule} (seed "
+          f"{load.seed}, deadline "
+          f"{'class budgets' if load.use_class_deadlines else load.deadline_s}"
+          ") ...")
     art = run_loadgen(svc, load)
     out_dir = args.out or os.getcwd()
     path = write_artifact(out_dir, art)
 
     req = art["requests"]
     lat = art["latency_ms"]["total"]
-    print(f"\nthroughput: {art['value']} req/s over {art['wall_s']}s wall")
-    print(f"requests: admitted {req['admitted']} -> served {req['served']}, "
-          f"rejected {req['rejected']} (queue-full "
-          f"{req['rejected_queue_full']}, crash "
+    print(f"\nthroughput: {art['value']} req/s achieved vs "
+          f"{art['offered']['offered_rps']} req/s offered over "
+          f"{art['wall_s']}s wall"
+          + (" (offered-load-limited)" if art["offered_limited"] else ""))
+    print(f"requests: admitted {req['admitted']} -> served {req['served']} "
+          f"(cache hits {req['served_cache_hits']}, coalesced "
+          f"{req['served_coalesced']}), rejected {req['rejected']} "
+          f"(queue-full {req['rejected_queue_full']}, quota "
+          f"{req['rejected_quota']}, crash "
           f"{req['rejected_worker_crash']}), expired {req['expired']}")
+    for name, book in art["classes"].items():
+        wb = book["within_budget"]
+        print(f"  class {name}: {book['served']}/{book['admitted']} served, "
+              f"quota-rejected {book['rejected_quota']}, p99 "
+              f"{book['latency_ms']['p99']} ms vs budget "
+              f"{book['budget_ms']} ms "
+              f"[{'ok' if wb else 'unused' if wb is None else 'BUSTED'}]")
+    cache = art["cache"]
+    if cache.get("enabled"):
+        print(f"cache: hit rate {cache['hit_rate']} ({cache['hits']} hits / "
+              f"{cache['lookups']} lookups), stale hits "
+              f"{cache['stale_hits']}, stale blocked "
+              f"{cache['stale_blocked']}, evictions {cache['evictions']}")
     print(f"latency total ms: p50 {lat['p50']}  p95 {lat['p95']}  "
           f"p99 {lat['p99']}")
     print(f"batches: {art['batches']}")
@@ -441,10 +494,13 @@ def _common_flags(sp) -> None:
                     default=10.0,
                     help="micro-batch coalescing window (default 10 ms)")
     sp.add_argument("--deadline-ms", dest="deadline_ms", type=float,
-                    default=500.0,
-                    help="default per-request deadline (0 = none; a "
-                         "request expiring while queued is cancelled, "
-                         "never dispatched)")
+                    default=None,
+                    help="default per-request deadline (unset = each "
+                         "request inherits its SLO class budget — "
+                         "interactive 500 ms / standard 1 s / bulk 3 s; "
+                         "an explicit value applies to every class; "
+                         "0 = none; a request expiring while queued is "
+                         "cancelled, never dispatched)")
     sp.add_argument("--workers", type=int, default=0,
                     help="run the MULTI-PROCESS pool with N supervised "
                          "worker processes behind a hedging router "
@@ -487,9 +543,15 @@ def register(sub) -> None:
                     help="drive the multi-process pool (--workers N) "
                          "instead of the in-process service; lands "
                          "SERVE_POOL_<run>.json (kind serve_pool)")
-    lg.add_argument("--schedule", metavar="DURxRPS,...",
-                    help="arrival schedule segments, e.g. 2x25,3x60 "
-                         "(default: 2x40; smoke: 0.8x60)")
+    lg.add_argument("--schedule", metavar="DURxRPS|NAME",
+                    help="arrival schedule: explicit segments (2x25,3x60) "
+                         "or a named traffic shape — bursty (quiet + hard "
+                         "bursts, bulk-heavy mix, panel reuse + mid-run "
+                         "panel_version bump), diurnal (compressed-day "
+                         "ramp), adversarial (bucket-boundary-hugging "
+                         "universe sizes).  Named schedules preset the "
+                         "class mix / reuse / version bumps that make "
+                         "them meaningful (default: 2x40; smoke: 0.8x60)")
     lg.add_argument("--seed", type=int, default=0,
                     help="load stream seed (arrivals, mixes, panels; "
                          "same seed = same request stream)")

@@ -301,31 +301,77 @@ def _serve_rows(obj: dict, run: str, num: int, variant,
     count rides along because the zero-compile property is the serve
     layer's structural claim and a regression there is a padding/warmup
     bug, not noise.  Smoke-bucket runs arrive flagged (``extra.smoke``)
-    and therefore never gate — same provenance discipline as bench."""
+    and therefore never gate — same provenance discipline as bench.
+
+    v2 artifacts (ISSUE 8) add: ``serve_offered_rps`` (info — what the
+    schedule asked for), an ``offered-limited`` flag on the THROUGHPUT
+    row when the service fully kept up (achieved == offered measures
+    the load, not the ceiling — such a row must never gate against a
+    saturation-limited one, the r11 footnote made mechanical; latency
+    rows still gate), ``serve_cache_hit_rate`` (higher), per-class p99
+    rows (``serve_<class>_p99_ms``, lower — each class's budget
+    promise), and ``serve_p99_under_burst_ms`` for bursty-schedule runs
+    (lower — the tail-under-burst gate row, so a tail regression fails
+    the PR, not the postmortem)."""
     extra = obj.get("extra") or {}
     platform = extra.get("platform")
     device_kind = extra.get("device_kind") or platform
     workload = extra.get("workload")
     flags = _flags(obj, variant)
     base = dict(run=run, run_num=num, source=source, platform=platform,
-                device_kind=device_kind, workload=workload, flags=flags)
+                device_kind=device_kind, workload=workload)
     rows = []
     v = _num(obj.get("value"))
     if v is not None:
+        thr_flags = flags
+        if obj.get("offered_limited") is True:
+            thr_flags = flags + ("offered-limited",)
         rows.append(Row(metric="serve_throughput_rps", value=v,
                         unit=str(obj.get("unit", "req/s")),
-                        direction="higher", **base))
+                        direction="higher", flags=thr_flags, **base))
+    orps = _num((obj.get("offered") or {}).get("offered_rps"))
+    if orps is not None:
+        rows.append(Row(metric="serve_offered_rps", value=orps,
+                        unit="req/s", direction="higher",
+                        flags=_flags(obj, variant, info=True), **base))
     total = (obj.get("latency_ms") or {}).get("total")
     if isinstance(total, dict):
         for q in ("p50", "p95", "p99"):
             pv = _num(total.get(q))
             if pv is not None:
                 rows.append(Row(metric=f"serve_{q}_ms", value=pv, unit="ms",
-                                direction="lower", **base))
+                                direction="lower", flags=flags, **base))
+        if (obj.get("offered") or {}).get("schedule_kind") == "bursty":
+            pv = _num(total.get("p99"))
+            if pv is not None:
+                # the tail-under-burst gate row: same measurement as
+                # serve_p99_ms, named so the gate's verdict reads as
+                # what it is — tail latency under bursty load
+                rows.append(Row(metric="serve_p99_under_burst_ms",
+                                value=pv, unit="ms", direction="lower",
+                                flags=flags, **base))
+    cache = obj.get("cache")
+    if isinstance(cache, dict) and cache.get("enabled", True):
+        hr = _num(cache.get("hit_rate"))
+        if hr is not None:
+            rows.append(Row(metric="serve_cache_hit_rate", value=hr,
+                            unit="frac", direction="higher", flags=flags,
+                            **base))
+    classes = obj.get("classes")
+    if isinstance(classes, dict):
+        for name, book in sorted(classes.items()):
+            if not isinstance(book, dict):
+                continue
+            pv = _num((book.get("latency_ms") or {}).get("p99"))
+            if pv is not None:
+                rows.append(Row(metric=f"serve_{name}_p99_ms", value=pv,
+                                unit="ms", direction="lower", flags=flags,
+                                **base))
     fc = _num((obj.get("compile") or {}).get("in_window_fresh_compiles"))
     if fc is not None:
         rows.append(Row(metric="serve_in_window_fresh_compiles", value=fc,
-                        unit="compiles", direction="lower", **base))
+                        unit="compiles", direction="lower", flags=flags,
+                        **base))
     return rows
 
 
@@ -348,9 +394,22 @@ def _serve_pool_rows(obj: dict, run: str, num: int, variant,
     rows = []
     v = _num(obj.get("value"))
     if v is not None:
+        # same honesty rule as single-process serve: a pool that fully
+        # kept up measured the offered load, not a saturation ceiling —
+        # flagged so it never gates against a saturated run
+        if obj.get("offered_limited") is True:
+            thr_base = dict(base, flags=flags + ("offered-limited",))
+        else:
+            thr_base = base
         rows.append(Row(metric="serve_pool_throughput_rps", value=v,
                         unit=str(obj.get("unit", "req/s")),
-                        direction="higher", **base))
+                        direction="higher", **thr_base))
+    orps = _num((obj.get("offered") or {}).get("offered_rps"))
+    if orps is not None:
+        rows.append(Row(metric="serve_pool_offered_rps", value=orps,
+                        unit="req/s", direction="higher",
+                        **dict(base, flags=_flags(obj, variant,
+                                                  info=True))))
     total = (obj.get("latency_ms") or {}).get("total")
     if isinstance(total, dict):
         for q in ("p50", "p95", "p99"):

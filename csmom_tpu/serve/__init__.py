@@ -6,15 +6,28 @@ built the way inference servers batch (continuous/micro-batching in the
 Orca spirit, Yu et al. OSDI '22) and composed from subsystems earlier
 rounds landed:
 
+- :mod:`~csmom_tpu.serve.slo` — named SLO classes (interactive /
+  standard / bulk; the r10 ``batch`` name aliases): per-class deadline
+  budgets, token-bucket admission quotas, and queue-share bounds, so a
+  bulk tenant provably cannot starve interactive scoring.
 - :mod:`~csmom_tpu.serve.queue` — bounded admission queue: per-request
-  monotonic deadlines, two priority classes, and BACKPRESSURE — a full
-  queue rejects with a retry-after hint instead of buffering unboundedly.
-  Every request presented to the service terminates in exactly one of
-  ``served`` / ``rejected`` / ``expired`` (the accounting invariant the
-  chaos scenarios assert: served + rejected + expired == admitted).
-- :mod:`~csmom_tpu.serve.batcher` — micro-batch coalescer: waits up to a
-  max-latency window, then pads the gathered same-endpoint requests up to
-  the nearest :mod:`~csmom_tpu.serve.buckets` shape bucket, so every
+  monotonic deadlines, SLO-class-ranked collection, and BACKPRESSURE — a
+  full queue rejects with a retry-after hint instead of buffering
+  unboundedly.  Every request presented to the service terminates in
+  exactly one of ``served`` / ``rejected`` / ``expired`` (the accounting
+  invariant the chaos scenarios assert: served + rejected + expired ==
+  admitted — globally AND per class).
+- :mod:`~csmom_tpu.serve.cache` — version-keyed idempotent result cache
+  (content fingerprint + signal params + ``panel_version``) with
+  in-flight coalescing: identical concurrent requests share one
+  dispatch, ``panel_version`` bumps invalidate, stale hits are zero BY
+  SCHEMA.
+- :mod:`~csmom_tpu.serve.batcher` — adaptive micro-batch coalescer
+  (deadline-aware continuous batching, Orca-style): fires early when a
+  queued deadline is at risk, refills with a zero window when the
+  engine frees under backlog, waits the coalescing window only when
+  idle — then pads the gathered same-endpoint requests up to the
+  nearest :mod:`~csmom_tpu.serve.buckets` shape bucket, so every
   dispatch hits a shape the engine already warmed — zero in-window fresh
   compiles by construction, verified via ``profiling.compile_stats``.
 - :mod:`~csmom_tpu.serve.engine` — the scoring backends: ``JaxEngine``

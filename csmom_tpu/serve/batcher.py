@@ -1,8 +1,32 @@
-"""Micro-batch coalescer: gathered requests -> one padded bucket dispatch.
+"""Adaptive micro-batch coalescer: deadline-aware continuous batching.
 
 The batcher turns the queue's per-request panels into the CLOSED set of
-shapes the engine warmed (:mod:`csmom_tpu.serve.buckets`): it waits up
-to the coalescing window for same-endpoint company, then pads
+shapes the engine warmed (:mod:`csmom_tpu.serve.buckets`).  r10's
+version waited a FIXED max-latency window before every dispatch; this
+one decides adaptively (the continuous-batching refinement of Orca
+[Yu et al., OSDI 2022 — PAPERS.md [4]], adapted to padded shape
+buckets):
+
+- **Fire early when a deadline is at risk**: before every wait the
+  queue reports the smallest remaining deadline budget among gatherable
+  requests; when it dips under the risk margin — an EMA of recent batch
+  service walls times a safety factor, plus a floor — the batch fires
+  NOW, because waiting out the window would expire the request.  The
+  margin adapts to the engine actually being driven (a TPU batch and a
+  CPU batch learn different margins from the same code).
+- **Refill the instant the engine frees**: when the previous dispatch
+  returns and work is already queued, the next micro-batch collects
+  with a ZERO window ("refill" fire reason) — under sustained load the
+  coalescing window adds no latency and batches grow toward the bucket
+  grid's ceiling on their own, because everything that arrived during
+  the previous engine call is taken at once.  This is Orca's
+  iteration-level scheduling mapped onto our iteration unit: one padded
+  bucket dispatch.
+- **Coalesce only when idle**: a request arriving at an idle service
+  waits at most ``max_wait_s`` for co-batchable company (r10's window
+  behavior — the right trade when there is no backlog to refill from).
+
+Every dispatch still pads onto the warmed bucket grid:
 
 - each request's asset axis up to the smallest asset bucket that holds
   it (padded lanes carry a False mask, so kernels ignore them exactly
@@ -12,7 +36,8 @@ to the coalescing window for same-endpoint company, then pads
 
 so every dispatch is one of ``len(batch_buckets) x len(asset_buckets)``
 shapes per endpoint — the zero-in-window-compiles property is a
-consequence of this padding, not of luck about what clients send.
+consequence of this padding, not of luck about what clients send, and
+adaptivity changes WHEN a batch fires, never what SHAPES exist.
 
 Why pad instead of compiling per request shape: a fresh XLA compile is
 seconds (CPU) to ~30 s (tunneled TPU) of request-path latency, paid by
@@ -20,6 +45,11 @@ the first caller of every new universe size and again after every
 restart; padding costs masked FLOPs bounded by the bucket step (< 4x
 worst case, measured per run as ``pad_fraction`` in the SERVE artifact).
 For a service the trade is not close — see ARCHITECTURE "Serving".
+
+The per-batch fire reasons (``full`` / ``deadline_risk`` / ``window`` /
+``refill``) are counted and land in the SERVE artifact's ``batches``
+block, so the dispatch policy's actual behavior under a given load is
+evidence, not intent.
 
 Numpy-only (the jax side lives in :mod:`csmom_tpu.serve.engine`), so the
 stub engine path and the fast rehearse tier stay jax-free.
@@ -37,6 +67,13 @@ from csmom_tpu.serve.queue import AdmissionQueue
 
 __all__ = ["Batcher", "Microbatch"]
 
+# deadline-risk margin: fire early when a queued deadline's remaining
+# budget <= SAFETY * (batch service EMA) + FLOOR.  SAFETY covers pad/
+# fan-out overhead around the engine call; FLOOR covers the cold start
+# before any batch has been measured.
+RISK_SAFETY = 2.0
+RISK_FLOOR_S = 0.002
+
 
 @dataclasses.dataclass
 class Microbatch:
@@ -48,6 +85,7 @@ class Microbatch:
     asset_bucket: int            # A: padded asset lanes
     values: np.ndarray           # f32[B, A, M]
     mask: np.ndarray             # bool[B, A, M]
+    fire_reason: str = "window"  # why collect fired (see queue.collect)
 
     @property
     def pad_fraction(self) -> float:
@@ -59,16 +97,41 @@ class Microbatch:
 
 
 class Batcher:
-    """Coalesce queued requests into padded bucket-shaped micro-batches."""
+    """Coalesce queued requests into padded bucket-shaped micro-batches,
+    deciding WHEN to fire adaptively (deadline risk, refill, window)."""
 
     def __init__(self, spec: BucketSpec, max_wait_s: float = 0.01):
         self.spec = spec
         self.max_wait_s = max_wait_s
+        self._lock = threading.Lock()
+        self._service_ema_s: float | None = None
+        self.fire_reasons: dict = {}
+
+    def note_service_wall(self, wall_s: float) -> None:
+        """Feed one batch's dispatch wall into the risk-margin EMA (the
+        service calls this after every engine call, crash or not)."""
+        with self._lock:
+            ema = self._service_ema_s
+            self._service_ema_s = (wall_s if ema is None
+                                   else 0.8 * ema + 0.2 * wall_s)
+
+    def risk_margin_s(self) -> float:
+        """How much remaining deadline budget a queued request needs for
+        waiting to still be safe: below this, fire immediately."""
+        with self._lock:
+            ema = self._service_ema_s or 0.0
+        return RISK_SAFETY * ema + RISK_FLOOR_S
 
     def next_batch(self, queue: AdmissionQueue,
                    stop: threading.Event) -> Microbatch | None:
         """Block for the next micro-batch; None when ``stop`` is set (or
         every gathered request had already expired, or padding failed).
+
+        Continuous-batching refill: when work is already queued at entry
+        (the engine just freed with a backlog), collect runs with a zero
+        window and fires immediately with everything gatherable — the
+        idle-arrival coalescing window only applies when the queue was
+        empty.
 
         Padding failure is CONTAINED here, not propagated: once requests
         have been taken off the queue, an escaping exception would kill
@@ -80,18 +143,29 @@ class Batcher:
         from csmom_tpu.chaos.inject import checkpoint
         from csmom_tpu.obs import metrics
 
-        reqs = queue.collect(self.spec.max_batch, self.max_wait_s, stop)
+        window_s = 0.0 if queue.depth() > 0 else self.max_wait_s
+        reqs, reason = queue.collect(self.spec.max_batch, window_s, stop,
+                                     risk_s=self.risk_margin_s())
         if not reqs:
             return None
-        checkpoint("serve.coalesce", kind=reqs[0].kind, n=len(reqs))
+        with self._lock:
+            self.fire_reasons[reason] = self.fire_reasons.get(reason, 0) + 1
+        checkpoint("serve.coalesce", kind=reqs[0].kind, n=len(reqs),
+                   fire=reason)
         try:
-            return self.pad(reqs)
+            mb = self.pad(reqs)
+            mb.fire_reason = reason
+            return mb
         except Exception as e:
             metrics.counter("serve.pad_failures").inc()
-            reason = f"could not pad batch ({type(e).__name__}: {e})"[:200]
+            reason_s = f"could not pad batch ({type(e).__name__}: {e})"[:200]
             for r in reqs:
-                queue.finish_rejected(r, reason)
+                queue.finish_rejected(r, reason_s)
             return None
+
+    def fire_reason_counts(self) -> dict:
+        with self._lock:
+            return dict(sorted(self.fire_reasons.items()))
 
     def pad(self, reqs: list) -> Microbatch:
         """Pad ``reqs`` (same endpoint, each ``values/mask`` = [A_i, M])

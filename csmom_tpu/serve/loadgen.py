@@ -9,18 +9,35 @@ coordinated omission.)
 
 Determinism: one seeded ``random.Random`` drives everything — arrival
 times (exponential inter-arrivals per schedule segment: Poisson
-traffic), endpoint mix, universe sizes, priorities, and the synthetic
-panels — so a rehearse scenario or a regression hunt replays the exact
-request stream from ``(schedule, seed)`` alone.
+traffic), endpoint mix, SLO-class mix, universe sizes, panel reuse, and
+the synthetic panels — so a rehearse scenario or a regression hunt
+replays the exact request stream from ``(schedule, seed)`` alone.
 
-The run lands as ``SERVE_<run>.json``: throughput headline, request
-accounting (the served + rejected + expired == admitted invariant is IN
-the schema — :mod:`csmom_tpu.chaos.invariants` kind ``serve`` refuses an
-artifact whose books do not balance), p50/p95/p99 queue / service /
-total latency, the batch-size histogram with the padding overhead, and
-the in-window fresh-compile count.  :mod:`csmom_tpu.obs.ledger` ingests
-these rows (``serve_throughput_rps``, ``serve_p99_ms``, ...), so serve
-performance joins the cross-run regression gate like every bench wall.
+Schedules are either explicit (``"2x30,2x60"`` = 2 s at 30 req/s then
+2 s at 60) or NAMED (ISSUE 8): ``bursty`` (quiet baseline punctuated by
+hard bursts — the adaptive batcher's reason to exist), ``diurnal`` (a
+compressed day: ramp up, peak, ramp down), and ``adversarial``
+(universe sizes hugging the bucket-grid boundaries, the worst case for
+padding overhead).  A named schedule also presets the load SHAPE that
+makes it meaningful: bursty/diurnal mix in a heavy ``bulk`` share (so
+quota enforcement is exercised), reuse a fraction of panels (so the
+result cache sees repeats), and bump the panel version mid-run (so
+cache invalidation is demonstrated inside the same artifact, with zero
+stale hits as a schema rule).
+
+The run lands as ``SERVE_<run>.json`` (schema v2): throughput headline
+PLUS ``offered_rps`` (so an offered-load-limited run is never misread
+as a saturation ceiling — the r11 footnote, now a field), request
+accounting globally AND per SLO class (both closed by schema:
+:mod:`csmom_tpu.chaos.invariants` kind ``serve``), per-class latency
+percentiles against each class's budget, the cache book (hit rate,
+zero stale hits), p50/p95/p99 queue / service / total latency, the
+batch-size histogram with padding overhead and fire reasons, and the
+in-window fresh-compile count.  :mod:`csmom_tpu.obs.ledger` ingests
+these rows (``serve_throughput_rps``, ``serve_p99_ms``,
+``serve_cache_hit_rate``, per-class p99s, ``serve_p99_under_burst_ms``
+for bursty runs), so serve performance joins the cross-run regression
+gate like every bench wall.
 
 Naming rule (the TELEMETRY rule, extended): only round artifacts
 (``SERVE_rNN.json``) are committable evidence; ``SERVE_smoke*.json`` /
@@ -42,12 +59,52 @@ from csmom_tpu.serve.buckets import ENDPOINTS
 from csmom_tpu.serve.service import ServeConfig, SignalService
 from csmom_tpu.utils.deadline import mono_now_s
 
-__all__ = ["LoadConfig", "arrival_offsets", "build_artifact",
-           "build_pool_artifact", "parse_schedule", "run_loadgen",
-           "run_pool_loadgen", "synth_panel", "write_artifact"]
+__all__ = ["LoadConfig", "NAMED_SCHEDULES", "arrival_offsets",
+           "build_artifact", "build_pool_artifact", "parse_schedule",
+           "resolve_schedule", "run_loadgen", "run_pool_loadgen",
+           "synth_panel", "write_artifact"]
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 POOL_SCHEMA_VERSION = 1
+
+# the r10/r11 default mixes, expressed as an SLO-class mix
+_DEFAULT_MIX = (("interactive", 0.6), ("standard", 0.15), ("bulk", 0.25))
+
+# named schedules (ISSUE 8): segment string + the load shape that makes
+# the schedule meaningful.  All well under 4 s of wall on CPU.
+NAMED_SCHEDULES = {
+    # quiet baseline punctuated by hard bursts: the bursts outrun the
+    # bulk quota (rejected_quota > 0) while interactive stays inside its
+    # budget; panels repeat within a version epoch (cache hits) and the
+    # panel version bumps mid-run (invalidation, zero stale hits)
+    "bursty": {
+        "schedule": "0.5x8,0.3x240,0.5x8,0.3x300,0.5x10,0.3x260,0.4x8",
+        "class_mix": (("interactive", 0.45), ("standard", 0.15),
+                      ("bulk", 0.4)),
+        "reuse_fraction": 0.35,
+        "version_bumps": 1,
+        "use_class_deadlines": True,
+    },
+    # a compressed trading day: ramp to a midday peak and back down
+    "diurnal": {
+        "schedule": "0.35x10,0.35x40,0.35x90,0.35x140,0.35x90,"
+                    "0.35x40,0.35x10",
+        "class_mix": (("interactive", 0.5), ("standard", 0.2),
+                      ("bulk", 0.3)),
+        "reuse_fraction": 0.25,
+        "version_bumps": 1,
+        "use_class_deadlines": True,
+    },
+    # universe sizes hugging the bucket-grid boundaries: every request
+    # lands exactly AT a bucket edge or one past it, maximizing padding
+    # pressure and bucket churn — the worst honest case for pad_fraction
+    "adversarial": {
+        "schedule": "1.6x70",
+        "class_mix": _DEFAULT_MIX,
+        "boundary_hug": True,
+        "use_class_deadlines": True,
+    },
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,7 +115,10 @@ class Segment:
 
 def parse_schedule(spec: str) -> tuple:
     """``"2x25,3x60"`` -> (Segment(2, 25), Segment(3, 60)): run 2 s at
-    25 req/s, then 3 s at 60 req/s."""
+    25 req/s, then 3 s at 60 req/s.  Named schedules resolve first via
+    :func:`resolve_schedule`."""
+    if spec in NAMED_SCHEDULES:
+        spec = NAMED_SCHEDULES[spec]["schedule"]
     out = []
     for part in spec.split(","):
         part = part.strip()
@@ -69,11 +129,28 @@ def parse_schedule(spec: str) -> tuple:
             out.append(Segment(float(dur), float(rate)))
         except ValueError:
             raise ValueError(
-                f"bad schedule segment {part!r}: use DURxRPS, e.g. 2x25"
+                f"bad schedule segment {part!r}: use DURxRPS, e.g. 2x25, "
+                f"or a named schedule ({', '.join(sorted(NAMED_SCHEDULES))})"
             ) from None
     if not out:
         raise ValueError(f"empty schedule {spec!r}")
     return tuple(out)
+
+
+def resolve_schedule(spec: str) -> tuple:
+    """``(schedule_str, schedule_kind, preset_overrides)`` for a CLI
+    ``--schedule`` value: named schedules expand to their segments and
+    carry the LoadConfig preset that makes them meaningful; an explicit
+    DURxRPS string passes through with kind ``custom``."""
+    if spec in NAMED_SCHEDULES:
+        preset = dict(NAMED_SCHEDULES[spec])
+        schedule = preset.pop("schedule")
+        return schedule, spec, preset
+    return spec, "custom", {}
+
+
+def schedule_duration_s(segments: tuple) -> float:
+    return sum(seg.duration_s for seg in segments)
 
 
 def arrival_offsets(segments: tuple, rng: random.Random) -> list:
@@ -119,9 +196,47 @@ class LoadConfig:
     seed: int = 0
     kinds: tuple = ENDPOINTS
     deadline_s: float | None = 0.5
-    interactive_fraction: float = 0.7
-    max_assets: int | None = None     # default: the spec's largest bucket
+    interactive_fraction: float = 0.7   # legacy 2-class knob (see mix())
+    class_mix: tuple | None = None      # ((class, weight), ...) wins
+    schedule_kind: str = "custom"       # "bursty"/"diurnal"/... or custom
+    reuse_fraction: float = 0.0         # P(reuse a recent panel) -> hits
+    version_bumps: int = 0              # mid-run panel_version bumps
+    use_class_deadlines: bool = False   # None deadline -> class budget
+    boundary_hug: bool = False          # adversarial bucket-edge sizes
+    max_assets: int | None = None       # default: the spec's largest bucket
     run_id: str = "smoke"
+
+    def mix(self) -> tuple:
+        """The effective class mix: explicit ``class_mix`` wins; else the
+        legacy two-way split (``batch`` spelled as its alias target)."""
+        if self.class_mix:
+            return tuple(self.class_mix)
+        f = self.interactive_fraction
+        return (("interactive", f), ("bulk", 1.0 - f))
+
+
+def _pick_class(mix: tuple, rng: random.Random) -> str:
+    total = sum(w for _, w in mix) or 1.0
+    x = rng.random() * total
+    acc = 0.0
+    for name, w in mix:
+        acc += w
+        if x <= acc:
+            return name
+    return mix[-1][0]
+
+
+def _boundary_sizes(spec, max_assets: int) -> list:
+    """Bucket-boundary-hugging universe sizes: exactly AT each asset
+    bucket (zero asset padding) and one PAST each non-largest bucket
+    (forcing the next bucket — maximum padding), clipped to the cap."""
+    sizes = set()
+    for i, a in enumerate(spec.asset_buckets):
+        if a <= max_assets:
+            sizes.add(a)
+        if i + 1 < len(spec.asset_buckets) and a + 1 <= max_assets:
+            sizes.add(a + 1)
+    return sorted(sizes) or [max_assets]
 
 
 def _percentiles(samples: list) -> dict:
@@ -154,20 +269,50 @@ def run_loadgen(service: SignalService, load: LoadConfig) -> dict:
     offsets = arrival_offsets(segments, rng)
     spec = service.spec
     max_assets = min(load.max_assets or spec.max_assets, spec.max_assets)
+    mix = load.mix()
+    boundary = (_boundary_sizes(spec, max_assets)
+                if load.boundary_hug else None)
+
+    # panel-version epochs: with bumps armed, every request is stamped
+    # with the current epoch and the version floor rises mid-run — the
+    # cache must show hits inside an epoch and ZERO stale hits across
+    # the bump (the acceptance property SERVE_r13.json pins)
+    epoch = 1 if load.version_bumps > 0 else None
+    bump_at = sorted(
+        max(1, round(len(offsets) * (k + 1) / (load.version_bumps + 1)))
+        for k in range(load.version_bumps)
+    ) if load.version_bumps > 0 else []
+    recent: dict = {k: [] for k in load.kinds}
 
     requests = []
     t_start = mono_now_s()
-    for off in offsets:
+    for i, off in enumerate(offsets):
+        if bump_at and i == bump_at[0]:
+            bump_at.pop(0)
+            epoch += 1
+            service.notify_panel_version(epoch)
         delay = (t_start + off) - mono_now_s()
         if delay > 0:
             time.sleep(delay)  # open loop: the schedule's clock rules
         kind = rng.choice(list(load.kinds))
-        n_assets = rng.randint(2, max_assets)
-        values, mask = synth_panel(rng, n_assets, spec.months, kind)
-        prio = ("interactive" if rng.random() < load.interactive_fraction
-                else "batch")
-        requests.append(service.submit(kind, values, mask, priority=prio,
-                                       deadline_s=load.deadline_s))
+        pool = recent[kind]
+        if pool and rng.random() < load.reuse_fraction:
+            values, mask = pool[rng.randrange(len(pool))]
+        else:
+            if boundary is not None:
+                n_assets = boundary[rng.randrange(len(boundary))]
+            else:
+                n_assets = rng.randint(2, max_assets)
+            values, mask = synth_panel(rng, n_assets, spec.months, kind)
+            pool.append((values, mask))
+            del pool[:-8]  # a small window of reusable recents per kind
+        cls = _pick_class(mix, rng)
+        requests.append(service.submit(
+            kind, values, mask, priority=cls,
+            deadline_s=(None if load.use_class_deadlines
+                        else load.deadline_s),
+            panel_version=epoch,
+        ))
     # close the books: wait for every request to reach a terminal state,
     # then drain-stop the worker
     give_up = mono_now_s() + 30.0
@@ -186,12 +331,43 @@ def _platform(service: SignalService) -> str:
     return jax.default_backend()
 
 
+def _class_blocks(service: SignalService, requests: list) -> dict:
+    """The per-class books + measured latency vs budget.  ``within_budget``
+    is the class's p99 promise judged against measurement: True/False
+    once the class served anything, None when it never did."""
+    stats = service.class_stats()
+    out = {}
+    for name, book in stats.items():
+        served = [r for r in requests
+                  if r.priority == name and r.state == "served"]
+        lat = _percentiles([r.total_s for r in served
+                            if r.total_s is not None])
+        p99 = lat["p99"]
+        budget = book.get("budget_ms")
+        out[name] = {
+            **{k: book[k] for k in ("admitted", "served", "rejected",
+                                    "expired", "rejected_quota")},
+            "rank": book["rank"],
+            "budget_ms": budget,
+            "quota_rps": book["quota_rps"],
+            "queue_share": book["queue_share"],
+            "latency_ms": lat,
+            "within_budget": (None if p99 is None or budget is None
+                              else bool(p99 <= budget)),
+        }
+    return out
+
+
 def build_artifact(service: SignalService, load: LoadConfig,
                    requests: list, wall_s: float) -> dict:
-    """The SERVE artifact: headline + accounting + latency + batches."""
+    """The SERVE artifact (schema v2): headline + offered load + global
+    and per-class accounting + cache book + latency + batches."""
     acct = service.accounting()
     served = [r for r in requests if r.state == "served"]
     throughput = round(acct["served"] / wall_s, 3) if wall_s > 0 else 0.0
+    segments = parse_schedule(load.schedule)
+    duration = schedule_duration_s(segments)
+    offered_rps = round(len(requests) / duration, 3) if duration else 0.0
     lat = {
         "queue": _percentiles(
             [r.queue_wait_s for r in requests if r.queue_wait_s is not None]),
@@ -202,8 +378,10 @@ def build_artifact(service: SignalService, load: LoadConfig,
     }
     fresh = service.fresh_compiles()
     spec = service.spec
+    sched_label = (load.schedule_kind if load.schedule_kind != "custom"
+                   else load.schedule)
     workload = (
-        f"open-loop {load.schedule} rps seed {load.seed}, "
+        f"open-loop {sched_label} rps seed {load.seed}, "
         f"{'/'.join(load.kinds)} mix, buckets "
         f"B({','.join(map(str, spec.batch_buckets))})x"
         f"A({','.join(map(str, spec.asset_buckets))})x{spec.months}m "
@@ -229,7 +407,14 @@ def build_artifact(service: SignalService, load: LoadConfig,
         "unit": "req/s",
         "vs_baseline": 1.0,
         "wall_s": round(wall_s, 4),
+        # achieved == offered (no rejection, no expiry) means the run
+        # measured the LOAD, not the service's ceiling: the ledger flags
+        # the throughput row so it never gates against a saturated run
+        "offered_limited": bool(acct["rejected"] == 0
+                                and acct["expired"] == 0),
         "requests": acct,
+        "classes": _class_blocks(service, requests),
+        "cache": service.cache_stats(),
         "latency_ms": lat,
         "batches": service.batch_stats(),
         "compile": {
@@ -240,12 +425,18 @@ def build_artifact(service: SignalService, load: LoadConfig,
         },
         "offered": {
             "schedule": load.schedule,
+            "schedule_kind": load.schedule_kind,
             "seed": load.seed,
             "n_arrivals": len(requests),
+            "duration_s": round(duration, 4),
+            "offered_rps": offered_rps,
             "kinds": list(load.kinds),
-            "deadline_ms": (None if load.deadline_s is None
+            "deadline_ms": ("class-budget" if load.use_class_deadlines
+                            else None if load.deadline_s is None
                             else round(1e3 * load.deadline_s, 3)),
-            "interactive_fraction": load.interactive_fraction,
+            "class_mix": {name: w for name, w in load.mix()},
+            "reuse_fraction": load.reuse_fraction,
+            "version_bumps": load.version_bumps,
         },
         "extra": extra,
     }
@@ -275,6 +466,7 @@ def run_pool_loadgen(router, supervisor, load: LoadConfig,
     offsets = arrival_offsets(segments, rng)
     spec = router.spec
     max_assets = min(load.max_assets or spec.max_assets, spec.max_assets)
+    mix = load.mix()
 
     side = None
     side_exc: list = []
@@ -298,9 +490,8 @@ def run_pool_loadgen(router, supervisor, load: LoadConfig,
         kind = rng.choice(list(load.kinds))
         n_assets = rng.randint(2, max_assets)
         values, mask = synth_panel(rng, n_assets, spec.months, kind)
-        prio = ("interactive" if rng.random() < load.interactive_fraction
-                else "batch")
-        requests.append(router.submit(kind, values, mask, priority=prio,
+        requests.append(router.submit(kind, values, mask,
+                                      priority=_pick_class(mix, rng),
                                       deadline_s=load.deadline_s))
     give_up = mono_now_s() + 60.0
     for r in requests:
@@ -352,6 +543,9 @@ def build_pool_artifact(router, supervisor, load: LoadConfig,
     acct = router.accounting()
     served = [r for r in requests if r.state == "served"]
     throughput = round(acct["served"] / wall_s, 3) if wall_s > 0 else 0.0
+    segments = parse_schedule(load.schedule)
+    duration = schedule_duration_s(segments)
+    offered_rps = round(len(requests) / duration, 3) if duration else 0.0
     lat = {"total": _percentiles(
         [r.total_s for r in served if r.total_s is not None])}
     workers = supervisor.worker_stats()
@@ -397,6 +591,10 @@ def build_pool_artifact(router, supervisor, load: LoadConfig,
         "unit": "req/s",
         "vs_baseline": 1.0,
         "wall_s": round(wall_s, 4),
+        # same honesty flag as the single-process artifact: a run the
+        # pool fully kept up with measured the LOAD, not the ceiling
+        "offered_limited": bool(acct["rejected"] == 0
+                                and acct["expired"] == 0),
         "requests": acct,
         "availability": router.availability(),
         "hedge": {
@@ -424,12 +622,15 @@ def build_pool_artifact(router, supervisor, load: LoadConfig,
         },
         "offered": {
             "schedule": load.schedule,
+            "schedule_kind": load.schedule_kind,
             "seed": load.seed,
             "n_arrivals": len(requests),
+            "duration_s": round(duration, 4),
+            "offered_rps": offered_rps,
             "kinds": list(load.kinds),
             "deadline_ms": (None if load.deadline_s is None
                             else round(1e3 * load.deadline_s, 3)),
-            "interactive_fraction": load.interactive_fraction,
+            "class_mix": {name: w for name, w in load.mix()},
         },
         "extra": extra,
     }

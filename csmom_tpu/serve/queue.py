@@ -1,6 +1,6 @@
-"""Bounded admission queue: backpressure, priorities, deadlines, accounting.
+"""Bounded admission queue: SLO classes, deadlines, closed per-class books.
 
-The front door of the signal service.  Three properties the rest of the
+The front door of the signal service.  Four properties the rest of the
 serve pipeline (and the chaos scenarios) build on:
 
 - **Bounded, rejecting**: the queue holds at most ``capacity`` requests.
@@ -8,24 +8,40 @@ serve pipeline (and the chaos scenarios) build on:
   retry-after hint derived from the observed drain rate — backpressure
   instead of unbounded buffering, so overload degrades into fast, honest
   rejections rather than a latency collapse followed by an OOM.
+- **SLO classes, not bare priorities** (:mod:`csmom_tpu.serve.slo`):
+  every request belongs to a named class (``interactive`` > ``standard``
+  > ``bulk``; the r10 name ``batch`` aliases to ``bulk``) carrying a
+  deadline budget, an admission token-bucket quota, and a queue-share
+  bound.  Over-quota and over-share submissions reject at the door
+  (``rejected_quota``, per class) BEFORE they can occupy capacity — a
+  bulk tenant provably cannot starve interactive admission, and
+  collection order prefers lower rank, so it cannot starve dispatch
+  either.
 - **Deadlines are cancellations**: every request may carry a monotonic
   deadline; one that expires while still queued is marked ``expired``
   and NEVER dispatched (the batcher's collect pass skips it) — scoring a
   signal nobody is still waiting for would burn device time that live
   requests need.  A request whose dispatch began before its deadline is
   served even if it finishes late (the work was already spent).
-- **Closed accounting**: every request presented via :meth:`submit`
-  terminates in exactly one of ``served`` / ``rejected`` / ``expired``,
-  and the counters prove it: ``served + rejected + expired == admitted``
-  once the queue is drained (:meth:`invariant_violations` is the
-  mechanical check the rehearse scenarios and the SERVE artifact
-  validator both run).  Terminal transitions go through one guarded
-  method, so a request can never be double-counted or silently dropped —
-  even when a worker crashes mid-batch.
+- **Closed accounting, globally AND per class**: every request presented
+  via :meth:`submit` terminates in exactly one of ``served`` /
+  ``rejected`` / ``expired``, and the counters prove it —
+  ``served + rejected + expired == admitted`` once drained, for the
+  global book and for every class book (:meth:`invariant_violations` is
+  the mechanical check; the SERVE artifact schema enforces both).
+  Terminal transitions go through one guarded method, so a request can
+  never be double-counted or silently dropped — even when a worker
+  crashes mid-batch.  Coalesced followers (identical in-flight requests
+  sharing one dispatch, :mod:`csmom_tpu.serve.cache`) resolve INSIDE the
+  leader's exactly-once transition, so each waiter reaches its terminal
+  state exactly once and the books count every one of them.
 
-Two priority classes (``interactive`` > ``batch``): collection always
-starts from the oldest interactive request; batch requests of the same
-endpoint fill the remaining micro-batch slots.
+Collection is deadline-aware (the adaptive batcher's contract): collect
+fires when a full bucket's worth is waiting, when the coalescing window
+closes, or EARLY when any queued request's remaining deadline budget
+dips under the caller's risk margin — the Orca-style continuous-
+batching refinement adapted to padded shape buckets (see
+:mod:`csmom_tpu.serve.batcher` and PAPERS.md [4]).
 
 Stdlib-only, thread-safe, and all timing through
 :func:`csmom_tpu.utils.deadline.mono_now_s` (the monotonic helper — the
@@ -39,10 +55,12 @@ import itertools
 import threading
 from collections import deque
 
+from csmom_tpu.serve.slo import SLOPolicy, default_policy
 from csmom_tpu.utils.deadline import mono_now_s
 
 __all__ = ["AdmissionQueue", "PRIORITIES", "Request", "TERMINAL_STATES"]
 
+# legacy export (the r10 pair); the live class set comes from the policy
 PRIORITIES = ("interactive", "batch")
 TERMINAL_STATES = ("served", "rejected", "expired")
 
@@ -58,6 +76,10 @@ RETRY_AFTER_COLD_PER_REQ_S = 0.005
 RETRY_AFTER_MIN_S = 0.001
 RETRY_AFTER_MAX_S = 2.0
 
+# the per-class terminal counter names every class book carries
+_CLASS_COUNTERS = ("admitted", "served", "rejected", "expired",
+                   "rejected_quota")
+
 
 @dataclasses.dataclass
 class Request:
@@ -68,7 +90,8 @@ class Request:
     ABSOLUTE monotonic seconds (None = no deadline).  State moves
     ``queued -> dispatched -> served`` on the happy path, or terminates
     early in ``rejected`` / ``expired``; ``wait()`` blocks the caller
-    until a terminal state.
+    until a terminal state.  A coalesced follower (state ``coalesced``)
+    never enters the deques: it resolves with its leader.
     """
 
     kind: str
@@ -86,9 +109,14 @@ class Request:
     result: object = None
     error: str | None = None
     retry_after_s: float | None = None
+    cache_hit: bool = False
+    coalesced: bool = False
+    cache_key: object = None     # set on cache-eligible leaders (service)
     t_submit_s: float = 0.0
     t_dispatch_s: float | None = None
     t_done_s: float | None = None
+    followers: list = dataclasses.field(default_factory=list, repr=False,
+                                        compare=False)
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False, compare=False)
 
@@ -120,22 +148,27 @@ class Request:
 
 
 class AdmissionQueue:
-    """Bounded two-priority FIFO with deadline cancellation.
+    """Bounded multi-class FIFO with quotas and deadline cancellation.
 
     ``admitted`` counts every request PRESENTED via submit (the
-    accounting denominator): a queue-full rejection is a presented
-    request that terminated in ``rejected``, so the invariant
+    accounting denominator): a queue-full or over-quota rejection is a
+    presented request that terminated in ``rejected``, so the invariant
     ``served + rejected + expired == admitted`` closes over backpressure
-    too — nothing the caller ever handed us can vanish from the ledger.
+    and quota enforcement too — nothing the caller ever handed us can
+    vanish from the ledger.  The same equation closes PER CLASS.
     """
 
-    def __init__(self, capacity: int = 64):
+    def __init__(self, capacity: int = 64,
+                 policy: SLOPolicy | None = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
+        self.policy = policy or default_policy()
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
-        self._queues = {p: deque() for p in PRIORITIES}
+        self._queues = {name: deque() for name in self.policy.names()}
+        self._buckets = {c.name: c.make_bucket()
+                         for c in self.policy.classes}
         # accounting counters (see invariant_violations)
         self.admitted = 0
         self.served = 0
@@ -144,6 +177,10 @@ class AdmissionQueue:
         self.rejected_queue_full = 0
         self.rejected_worker_crash = 0
         self.rejected_unserveable = 0
+        self.rejected_quota = 0
+        self.served_cache_hits = 0
+        self.served_coalesced = 0
+        self.rejected_coalesced = 0
         # requests refused because their live-panel snapshot version had
         # skewed beyond the service's allowance (the streaming analogue
         # of the pool's AOT-cache version gate)
@@ -152,38 +189,119 @@ class AdmissionQueue:
         # structurally 0 (collect cancels first); the counter exists so
         # the artifact can CLAIM it, not hope it
         self.expired_dispatched = 0
+        # per-class books: class name -> {admitted, served, ...}
+        self.by_class = {name: dict.fromkeys(_CLASS_COUNTERS, 0)
+                         for name in self.policy.names()}
         # EMA of per-request service seconds, feeding the retry-after hint
         self._ema_per_req_s: float | None = None
+
+    def resolve_class(self, name: str) -> str:
+        return self.policy.resolve_name(name)
 
     # ------------------------------------------------------------- admit --
 
     def submit(self, req: Request) -> Request:
         """Admit or reject ``req``; returns it either way (terminal state
-        and ``retry_after_s`` set on rejection)."""
-        if req.priority not in PRIORITIES:
-            raise ValueError(f"unknown priority {req.priority!r}")
+        and ``retry_after_s`` set on rejection).  Admission order:
+        global capacity (a full queue is backpressure no matter the
+        class), class queue share, THEN the class quota bucket — a
+        request the queue could not have held anyway must not burn a
+        quota token, or one overload episode would punish the class
+        twice (once as backpressure, again as a drained bucket when the
+        queue frees)."""
         from csmom_tpu.chaos.inject import checkpoint
         from csmom_tpu.obs import metrics
 
+        cls = self.policy.resolve(req.priority)
+        req.priority = cls.name
         req.t_submit_s = mono_now_s()
         checkpoint("serve.admit", kind=req.kind, priority=req.priority)
         with self._lock:
             self.admitted += 1
-            if self._depth_locked() >= self.capacity:
-                self.rejected += 1
-                self.rejected_queue_full += 1
+            self.by_class[cls.name]["admitted"] += 1
+            queue_full = self._depth_locked() >= self.capacity
+            over_share = (not queue_full
+                          and len(self._queues[cls.name])
+                          >= cls.max_queued(self.capacity))
+            if queue_full or over_share:
+                if over_share:
+                    # the class hit ITS bound, not the queue's: quota
+                    # enforcement, counted in the class's own book
+                    self.rejected_quota += 1
+                    self.by_class[cls.name]["rejected_quota"] += 1
+                else:
+                    self.rejected_queue_full += 1
                 req.retry_after_s = self._retry_after_locked()
+                what = (f"class {cls.name!r} queue share "
+                        f"({cls.max_queued(self.capacity)} of "
+                        f"{self.capacity} slots)" if over_share
+                        else f"queue full ({self.capacity} queued)")
                 self._terminate_locked(
                     req, "rejected",
-                    error=f"queue full ({self.capacity} queued); retry after "
+                    error=f"{what}; retry after "
                           f"~{req.retry_after_s:.3f}s",
                 )
-                metrics.counter("serve.rejected_queue_full").inc()
+                # metrics mirror the books: a share rejection is quota
+                # enforcement, not capacity exhaustion
+                metrics.counter("serve.rejected_quota" if over_share
+                                else "serve.rejected_queue_full").inc()
                 return req
-            self._queues[req.priority].append(req)
+            bucket = self._buckets[cls.name]
+            if bucket is not None and not bucket.try_take(req.t_submit_s):
+                self.rejected_quota += 1
+                self.by_class[cls.name]["rejected_quota"] += 1
+                req.retry_after_s = max(RETRY_AFTER_MIN_S,
+                                        min(RETRY_AFTER_MAX_S,
+                                            1.0 / bucket.rate))
+                self._terminate_locked(
+                    req, "rejected",
+                    error=f"class {cls.name!r} over its admission quota "
+                          f"({cls.quota_rps:g} req/s sustained); retry "
+                          f"after ~{req.retry_after_s:.3f}s",
+                )
+                metrics.counter("serve.rejected_quota").inc()
+                return req
+            self._queues[cls.name].append(req)
             metrics.gauge("serve.queue_depth").set(self._depth_locked())
             self._nonempty.notify()
         return req
+
+    def serve_at_door(self, req: Request, result) -> Request:
+        """Present-and-serve in one step: a cache hit.  The request still
+        counts toward ``admitted`` and ``served`` so the books close over
+        cache hits like everything else."""
+        from csmom_tpu.obs import metrics
+
+        cls = self.policy.resolve(req.priority)
+        req.priority = cls.name
+        with self._lock:
+            self.admitted += 1
+            self.by_class[cls.name]["admitted"] += 1
+            req.t_submit_s = mono_now_s()
+            req.cache_hit = True
+            if self._terminate_locked(req, "served", result=result):
+                self.served_cache_hits += 1
+                metrics.counter("serve.cache_hits").inc()
+        return req
+
+    def attach_follower(self, leader: Request, follower: Request) -> bool:
+        """Attach ``follower`` to ``leader`` (identical in-flight request
+        sharing one dispatch).  False iff the leader is already terminal
+        — the caller re-checks the cache instead.  An attached follower
+        is admitted (counted) and resolves inside the leader's terminal
+        transition."""
+        cls = self.policy.resolve(follower.priority)
+        follower.priority = cls.name
+        with self._lock:
+            if leader.state in TERMINAL_STATES:
+                return False
+            follower.state = "coalesced"
+            follower.coalesced = True
+            follower.t_submit_s = mono_now_s()
+            leader.followers.append(follower)
+            self.admitted += 1
+            self.by_class[cls.name]["admitted"] += 1
+        return True
 
     def _retry_after_locked(self) -> float:
         """Drain-rate estimate: depth * observed per-request service
@@ -219,7 +337,6 @@ class AdmissionQueue:
             if len(live) != len(q):
                 for r in q:
                     if r.expired_at(now_s):
-                        self.expired += 1
                         self._terminate_locked(
                             r, "expired",
                             error="deadline expired while queued "
@@ -229,34 +346,75 @@ class AdmissionQueue:
                 q.clear()
                 q.extend(live)
 
-    def collect(self, max_n: int, window_s: float,
-                stop: threading.Event) -> list:
+    def _min_budget_locked(self, kind: str, now_s: float) -> float | None:
+        """Smallest remaining deadline budget among queued requests of
+        ``kind`` (None = none carries a deadline) — the early-fire
+        signal the adaptive batcher acts on."""
+        best = None
+        for q in self._queues.values():
+            for r in q:
+                if r.kind == kind and r.deadline_s is not None:
+                    rem = r.deadline_s - now_s
+                    if best is None or rem < best:
+                        best = rem
+        return best
+
+    def collect(self, max_n: int, window_s: float, stop: threading.Event,
+                risk_s: float = 0.0) -> tuple:
         """Gather up to ``max_n`` same-endpoint requests for one
-        micro-batch, waiting at most ``window_s`` past the first arrival
-        for co-batchable company.
+        micro-batch; returns ``(requests, fire_reason)``.
 
         Blocks until at least one live request exists (or ``stop`` is
-        set, returning ``[]``).  Selection: the oldest request of the
-        highest non-empty priority fixes the endpoint; remaining slots
-        fill with same-endpoint requests, interactive first.  Expired
-        requests are cancelled here and never returned.
+        set, returning ``([], "stopped")``).  Selection: the oldest
+        request of the lowest-rank non-empty class fixes the endpoint;
+        remaining slots fill with same-endpoint requests, lower ranks
+        first.  Expired requests are cancelled here and never returned.
+
+        Fire reasons (the adaptive-dispatch decision, recorded per batch
+        in the SERVE artifact):
+
+        - ``"full"``: a full ``max_n`` is waiting — dispatch now, the
+          batch cannot grow further on the warmed bucket grid.
+        - ``"deadline_risk"``: some queued request's remaining budget
+          dipped under ``risk_s`` (the caller's estimate of one batch
+          service time plus margin) — firing later would expire it.
+        - ``"window"``: the coalescing window since the first arrival
+          closed without either trigger above.
+        - ``"refill"``: ``window_s <= 0`` — the engine just freed with
+          work already waiting, so the next micro-batch dispatches
+          immediately with whatever is queued (continuous batching:
+          under sustained load the window never adds latency).
         """
         deadline = None
         while not stop.is_set():
             with self._lock:
-                self._expire_locked(mono_now_s())
+                now = mono_now_s()
+                self._expire_locked(now)
                 first = self._peek_locked()
                 if first is not None:
                     if deadline is None:
-                        deadline = mono_now_s() + window_s
-                    if (self._count_kind_locked(first.kind) >= max_n
-                            or mono_now_s() >= deadline):
-                        return self._take_locked(first.kind, max_n)
-                    # capped wait: queued deadlines may expire before the
-                    # coalescing window closes, so re-sweep periodically
+                        deadline = now + max(0.0, window_s)
+                    n_kind = self._count_kind_locked(first.kind)
+                    if n_kind >= max_n:
+                        return self._take_locked(first.kind, max_n), "full"
+                    if risk_s > 0.0:
+                        budget = self._min_budget_locked(first.kind, now)
+                        # at risk = the request cannot survive waiting
+                        # out the REST of the coalescing window and then
+                        # one batch service time: fire now, don't let a
+                        # window optimization expire a live deadline
+                        if budget is not None and budget <= (
+                                (deadline - now) + risk_s):
+                            return (self._take_locked(first.kind, max_n),
+                                    "deadline_risk")
+                    if now >= deadline:
+                        reason = "refill" if window_s <= 0.0 else "window"
+                        return self._take_locked(first.kind, max_n), reason
+                    # capped wait: queued deadlines may expire (or dip
+                    # into risk) before the coalescing window closes, so
+                    # re-sweep periodically
                     self._nonempty.wait(
-                        timeout=max(min(deadline - mono_now_s(), 0.05),
-                                    0.001))
+                        timeout=max(min(deadline - now, 0.05), 0.001))
                 else:
                     # empty queue: nothing to sweep, nothing to coalesce —
                     # block until a submit notifies (or stop() wakes us);
@@ -267,14 +425,14 @@ class AdmissionQueue:
                     # notify being lost to a waiter that hadn't waited yet
                     deadline = None
                     if stop.is_set():
-                        return []
+                        return [], "stopped"
                     self._nonempty.wait()
-        return []
+        return [], "stopped"
 
     def _peek_locked(self):
-        for p in PRIORITIES:
-            if self._queues[p]:
-                return self._queues[p][0]
+        for name in self.policy.names():
+            if self._queues[name]:
+                return self._queues[name][0]
         return None
 
     def _count_kind_locked(self, kind: str) -> int:
@@ -285,8 +443,8 @@ class AdmissionQueue:
         from csmom_tpu.obs import metrics
 
         out: list = []
-        for p in PRIORITIES:
-            q = self._queues[p]
+        for name in self.policy.names():
+            q = self._queues[name]
             keep = deque()
             while q:
                 r = q.popleft()
@@ -294,7 +452,7 @@ class AdmissionQueue:
                     out.append(r)
                 else:
                     keep.append(r)
-            self._queues[p] = keep
+            self._queues[name] = keep
         metrics.gauge("serve.queue_depth").set(self._depth_locked())
         return out
 
@@ -302,6 +460,10 @@ class AdmissionQueue:
 
     def _terminate_locked(self, req: Request, state: str,
                           result=None, error: str | None = None) -> bool:
+        """The single guarded terminal transition.  Increments the
+        terminal counters (global + per class) and resolves any coalesced
+        followers — all inside the exactly-once guard, so neither the
+        leader nor a follower can be double-counted."""
         if req.state in TERMINAL_STATES:
             return False  # exactly-once: a terminal request never moves
         req.state = state
@@ -309,8 +471,68 @@ class AdmissionQueue:
         if error is not None:
             req.error = error
         req.t_done_s = mono_now_s()
+        self._bump_class_locked(req.priority, state)
+        if state == "served":
+            self.served += 1
+            if req.service_s is not None:
+                ema = self._ema_per_req_s
+                self._ema_per_req_s = (
+                    req.service_s if ema is None
+                    else 0.8 * ema + 0.2 * req.service_s)
+        elif state == "expired":
+            self.expired += 1
+        else:
+            self.rejected += 1
         req._done.set()
+        # coalesced followers ride the leader's fate: served with the
+        # same result, or rejected with the leader's outcome as reason.
+        # The deadline contract survives coalescing: a follower whose
+        # own deadline had already passed when the shared dispatch BEGAN
+        # expires (the same never-dispatch-expired rule the deques
+        # enforce); one whose dispatch began in time is served even if
+        # it finishes late (the work was already spent — shared or not).
+        if req.followers:
+            followers, req.followers = req.followers, []
+            for f in followers:
+                if f.state in TERMINAL_STATES:
+                    continue  # defensive; a follower is only ever ours
+                if state == "served" and f.expired_at(
+                        req.t_dispatch_s if req.t_dispatch_s is not None
+                        else req.t_done_s):
+                    f.state = "expired"
+                    f.error = ("deadline expired before the coalesced "
+                               "dispatch began (never dispatched)")
+                    self.expired += 1
+                    self._bump_class_locked(f.priority, "expired")
+                elif state == "served":
+                    f.state = "served"
+                    # mutable dict payloads are copied per waiter so no
+                    # coalesced caller can edit what another one reads
+                    # (ndarray payloads arrive frozen from the dispatch)
+                    f.result = (dict(result) if isinstance(result, dict)
+                                else result)
+                    # the leader's dispatch served the follower too: its
+                    # timeline shares the dispatch instant
+                    f.t_dispatch_s = req.t_dispatch_s
+                    self.served += 1
+                    self.served_coalesced += 1
+                    self._bump_class_locked(f.priority, "served")
+                else:
+                    f.state = "rejected"
+                    f.error = (f"coalesced onto request "
+                               f"{req.req_id} which ended {state}"
+                               + (f": {error}" if error else ""))
+                    self.rejected += 1
+                    self.rejected_coalesced += 1
+                    self._bump_class_locked(f.priority, "rejected")
+                f.t_done_s = req.t_done_s
+                f._done.set()
         return True
+
+    def _bump_class_locked(self, class_name: str, state: str) -> None:
+        book = self.by_class.get(class_name)
+        if book is not None:
+            book[state] += 1
 
     def finish_expired(self, req: Request,
                        error: str = "deadline expired while queued "
@@ -320,8 +542,7 @@ class AdmissionQueue:
         between collection and dispatch; the contract is enforced at the
         boundary, not hoped about)."""
         with self._lock:
-            if self._terminate_locked(req, "expired", error=error):
-                self.expired += 1
+            self._terminate_locked(req, "expired", error=error)
 
     def mark_dispatched(self, req: Request, now_s: float) -> None:
         with self._lock:
@@ -335,13 +556,7 @@ class AdmissionQueue:
 
     def finish_served(self, req: Request, result) -> None:
         with self._lock:
-            if self._terminate_locked(req, "served", result=result):
-                self.served += 1
-                if req.service_s is not None:
-                    ema = self._ema_per_req_s
-                    self._ema_per_req_s = (
-                        req.service_s if ema is None
-                        else 0.8 * ema + 0.2 * req.service_s)
+            self._terminate_locked(req, "served", result=result)
 
     def reject_at_door(self, req: Request, error: str,
                        version_skew: bool = False) -> None:
@@ -349,11 +564,13 @@ class AdmissionQueue:
         a skewed live-panel version): the request still counts toward
         ``admitted`` so the accounting equation closes over door
         rejections too."""
+        cls = self.policy.resolve(req.priority)
+        req.priority = cls.name
         with self._lock:
             self.admitted += 1
+            self.by_class[cls.name]["admitted"] += 1
             req.t_submit_s = mono_now_s()
             if self._terminate_locked(req, "rejected", error=error):
-                self.rejected += 1
                 if version_skew:
                     self.rejected_version_skew += 1
                 else:
@@ -363,7 +580,6 @@ class AdmissionQueue:
                         worker_crash: bool = False) -> None:
         with self._lock:
             if self._terminate_locked(req, "rejected", error=error):
-                self.rejected += 1
                 if worker_crash:
                     self.rejected_worker_crash += 1
                 else:
@@ -388,14 +604,25 @@ class AdmissionQueue:
                 "rejected_worker_crash": self.rejected_worker_crash,
                 "rejected_unserveable": self.rejected_unserveable,
                 "rejected_version_skew": self.rejected_version_skew,
+                "rejected_quota": self.rejected_quota,
+                "rejected_coalesced": self.rejected_coalesced,
+                "served_cache_hits": self.served_cache_hits,
+                "served_coalesced": self.served_coalesced,
                 "in_queue": self._depth_locked(),
             }
+
+    def class_accounting(self) -> dict:
+        """Per-class books (class name -> closed terminal counters)."""
+        with self._lock:
+            return {name: dict(book)
+                    for name, book in self.by_class.items()}
 
     def invariant_violations(self) -> list:
         """The closed-accounting check (empty = holds).  Valid once the
         queue is drained: every admitted request must sit in exactly one
-        terminal bucket."""
+        terminal bucket — globally and inside every class book."""
         a = self.accounting()
+        classes = self.class_accounting()
         out = []
         if a["in_queue"]:
             out.append(f"queue not drained: {a['in_queue']} still queued")
@@ -412,4 +639,20 @@ class AdmissionQueue:
                 "their deadline — expiry-while-queued must cancel, "
                 "never dispatch"
             )
+        for name, book in classes.items():
+            ct = book["served"] + book["rejected"] + book["expired"]
+            if ct != book["admitted"]:
+                out.append(
+                    f"class {name!r} book broken: served {book['served']} "
+                    f"+ rejected {book['rejected']} + expired "
+                    f"{book['expired']} = {ct} != admitted "
+                    f"{book['admitted']}"
+                )
+        for key in ("admitted", "served", "rejected", "expired"):
+            csum = sum(book[key] for book in classes.values())
+            if csum != a[key]:
+                out.append(
+                    f"class books do not sum to the global book: "
+                    f"sum({key}) = {csum} != {a[key]}"
+                )
         return out

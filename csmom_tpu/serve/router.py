@@ -42,6 +42,7 @@ import numpy as np
 
 from csmom_tpu.serve import proto
 from csmom_tpu.serve.buckets import ENDPOINTS, bucket_spec
+from csmom_tpu.serve.slo import default_policy
 from csmom_tpu.utils.deadline import mono_now_s
 
 __all__ = ["PoolRequest", "Router", "RouterConfig"]
@@ -108,9 +109,16 @@ class Router:
         gone from the menu)."""
         self.config = config or RouterConfig()
         self.spec = bucket_spec(self.config.profile)
+        self.policy = default_policy()
         self._workers_fn = workers_fn
         self._lock = threading.Lock()
         self._rr = itertools.count()
+        # per-SLO-class books (closed like the global one); the policy
+        # resolves legacy names ("batch" -> "bulk") so the wire protocol
+        # and the in-process service count the same classes
+        self.by_class = {name: {"admitted": 0, "served": 0, "rejected": 0,
+                                "expired": 0}
+                         for name in self.policy.names()}
         # accounting counters — the cross-process closed book
         self.admitted = 0
         self.served = 0
@@ -142,12 +150,18 @@ class Router:
         rel = (self.config.default_deadline_s if deadline_s is None
                else deadline_s)
         now = mono_now_s()
+        try:
+            priority = self.policy.resolve_name(priority)
+        except ValueError:
+            pass  # the worker's own door rejects unknown classes
         req = PoolRequest(
             kind=kind, n_assets=n_assets, priority=priority,
             deadline_s=None if rel is None else now + rel, t_submit_s=now,
             panel_version=panel_version)
         with self._lock:
             self.admitted += 1
+            if priority in self.by_class:
+                self.by_class[priority]["admitted"] += 1
         checkpoint("pool.route", kind=kind, req=req.req_id)
         reason = self._unserveable_reason(kind, values, mask)
         if reason is not None:
@@ -411,6 +425,8 @@ class Router:
                     self.rejected_infra += 1
                 if unserveable:
                     self.rejected_unserveable += 1
+            if req.priority in self.by_class:
+                self.by_class[req.priority][state] += 1
             req._done.set()
         return True
 
@@ -432,6 +448,12 @@ class Router:
                 "retries": self.retries,
                 "worker_conn_failures": self.worker_conn_failures,
             }
+
+    def class_accounting(self) -> dict:
+        """Per-SLO-class books (closed like the global one)."""
+        with self._lock:
+            return {name: dict(book)
+                    for name, book in self.by_class.items()}
 
     def availability(self) -> float:
         """``1 - rejected_infra / admitted``: the fraction of admitted

@@ -1,14 +1,29 @@
 """The signal service: admission -> coalesce -> dispatch, supervised.
 
-One worker thread drives the pipeline: it blocks on the batcher for the
-next padded micro-batch, dispatches it through the engine as a single
-compiled call, and fans results back out to the batch's requests.  The
-design decisions that matter:
+One worker thread drives the pipeline: it blocks on the adaptive batcher
+for the next padded micro-batch, dispatches it through the engine as a
+single compiled call, and fans results back out to the batch's requests.
+The design decisions that matter:
 
 - **Warm before ready**: ``start()`` executes every (endpoint, bucket)
   shape once (``engine.warm``) and only then opens the queue, so the
   first real request never pays a compile; everything after the warmup
   snapshot counts toward ``in_window_fresh_compiles``.
+- **SLO classes at the door** (:mod:`csmom_tpu.serve.slo`): every
+  request resolves to a named class whose deadline budget supplies the
+  default deadline and whose quota/share bounds are enforced by the
+  queue BEFORE capacity — bulk load cannot starve interactive scoring.
+- **Cache first, coalesce second, queue third**
+  (:mod:`csmom_tpu.serve.cache`): an identical already-scored request
+  is served at the door from the version-keyed result cache; an
+  identical IN-FLIGHT request attaches to its leader and shares that
+  one dispatch; only novel work enters the queue.  Every path is
+  counted (``served_cache_hits`` / ``served_coalesced``) and the
+  accounting books close over all of them.  A ``panel_version`` bump
+  from ``stream/`` ingestion invalidates every older cache entry
+  (:meth:`SignalService.notify_panel_version`), and the get path
+  refuses stale entries even if one survives — zero stale hits is a
+  schema rule of the SERVE artifact, not a hope.
 - **Deadlines cancel, never dispatch**: expiry-while-queued is handled
   in the queue's collect pass (the request is terminal before a batch
   can include it); ``expired_dispatched`` stays 0 structurally and the
@@ -21,20 +36,22 @@ design decisions that matter:
   the next batch, so the remaining queue drains.  Requests are never
   silently dropped: every admitted request ends served/rejected/expired.
 
-Chaos checkpoints (``serve.admit`` lives in queue.submit):
+Chaos checkpoints (``serve.admit`` lives in queue.submit,
+``serve.cache`` in the cache's get path):
 
 =================  ====================================  ===============
 name               site                                  typical faults
 =================  ====================================  ===============
 serve.admit        queue.submit, before admission        sleep
+serve.cache        ResultCache.get, per lookup           cache_poison
 serve.coalesce     batcher, after gathering a batch      sleep
 serve.dispatch     worker, before the engine call        fail, sleep
 =================  ====================================  ===============
 
 Obs wiring (zero-cost disarmed, like everything else): queue-depth
 gauge, batch-size / queue-wait / service-wall histograms, served /
-rejected / expired counters, ``serve.dispatch`` spans (phase ``row``) on
-the run timeline.
+rejected / expired / cache-hit counters, ``serve.dispatch`` spans
+(phase ``row``) on the run timeline.
 """
 
 from __future__ import annotations
@@ -46,8 +63,15 @@ import numpy as np
 
 from csmom_tpu.serve.batcher import Batcher, Microbatch
 from csmom_tpu.serve.buckets import ENDPOINTS, bucket_spec
+from csmom_tpu.serve.cache import (
+    CacheKey,
+    InflightCoalescer,
+    ResultCache,
+    panel_fingerprint,
+)
 from csmom_tpu.serve.engine import make_engine
 from csmom_tpu.serve.queue import AdmissionQueue, Request
+from csmom_tpu.serve.slo import SLOPolicy, default_policy
 from csmom_tpu.utils.deadline import mono_now_s
 
 __all__ = ["ServeConfig", "SignalService"]
@@ -55,17 +79,31 @@ __all__ = ["ServeConfig", "SignalService"]
 
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
-    """Service parameters (defaults = the production bucket grid)."""
+    """Service parameters (defaults = the production bucket grid).
+
+    ``default_deadline_s`` governs requests that name no deadline of
+    their own: the default sentinel ``"class"`` gives each request its
+    SLO class's budget (interactive 0.5 s, standard 1 s, bulk 3 s); an
+    explicit float keeps the r10 semantics (that value, for every
+    class); ``None`` disables default deadlines entirely.  The
+    three-way split exists so an operator-configured float is never
+    silently overridden by class budgets.
+    """
 
     profile: str = "serve"            # buckets.PROFILES key
     engine: str = "jax"               # "jax" | "stub"
     capacity: int = 64                # admission-queue bound
-    max_wait_s: float = 0.010         # coalescing window
-    default_deadline_s: float | None = 0.5   # per-request, None = none
+    max_wait_s: float = 0.010         # idle-arrival coalescing window
+    # "class" = per-class budget; a float = that value; None = none
+    default_deadline_s: float | str | None = "class"
     lookback: int = 12
     skip: int = 1
     n_bins: int = 10
     mode: str = "rank"                # serve uses the fast ordinal rank
+    policy: SLOPolicy | None = None   # SLO classes (None = default_policy)
+    cache_enabled: bool = True        # the version-keyed result cache
+    cache_entries: int = 512
+    cache_bytes: int = 32 << 20
 
 
 class SignalService:
@@ -74,12 +112,22 @@ class SignalService:
     def __init__(self, config: ServeConfig | None = None):
         self.config = config or ServeConfig()
         self.spec = bucket_spec(self.config.profile)
-        self.queue = AdmissionQueue(capacity=self.config.capacity)
+        self.policy = self.config.policy or default_policy()
+        self.queue = AdmissionQueue(capacity=self.config.capacity,
+                                    policy=self.policy)
         self.batcher = Batcher(self.spec, max_wait_s=self.config.max_wait_s)
         self.engine = make_engine(
             self.config.engine, lookback=self.config.lookback,
             skip=self.config.skip, n_bins=self.config.n_bins,
             mode=self.config.mode)
+        self.cache = (ResultCache(self.config.cache_entries,
+                                  self.config.cache_bytes)
+                      if self.config.cache_enabled else None)
+        self._coalescer = InflightCoalescer()
+        # the part of the cache key that is engine identity, not panel
+        self._params_key = (self.config.engine, self.config.lookback,
+                            self.config.skip, self.config.n_bins,
+                            self.config.mode)
         self._stop = threading.Event()
         self._worker: threading.Thread | None = None
         self.warm_report: dict | None = None
@@ -128,35 +176,68 @@ class SignalService:
         analogue of the pool's AOT-cache version-skew gate: a worker
         must never answer from a panel the ingest side has moved past,
         it must refuse loudly and be counted
-        (``rejected_version_skew``).
+        (``rejected_version_skew``).  The same reading drives cache
+        invalidation: every submit raises the cache's version floor to
+        ``live - max_skew``, so results computed from panels the gate
+        would now refuse can never be served from the cache either.
         """
         self._live_version_fn = version_fn
         self._max_version_skew = int(max_skew)
 
+    def notify_panel_version(self, version: int) -> int:
+        """Ingestion-side panel_version bump: invalidate every cache
+        entry computed from an older panel.  Returns how many entries
+        were dropped.  (``stream/`` calls this on bar close; the loadgen
+        mid-run bump drives it too.)"""
+        if self.cache is None:
+            return 0
+        return self.cache.set_version_floor(int(version))
+
     def submit(self, kind: str, values, mask, priority: str = "interactive",
                deadline_s: float | None = None,
-               panel_version: int | None = None) -> Request:
+               panel_version: int | None = None,
+               cacheable: bool = True) -> Request:
         """Submit one scoring request (panel ``[A, months]``).
 
-        ``deadline_s`` is RELATIVE seconds from now (None = the config
-        default).  Returns the request handle; an unserveable request
-        (unknown endpoint, too many assets, wrong month count) is
-        rejected at the door — terminal immediately, counted, never
-        queued behind work it can only fail.
+        ``deadline_s`` is RELATIVE seconds from now (None = the SLO
+        class's budget, falling back to the config default).  Returns
+        the request handle; an unserveable request (unknown endpoint or
+        class, too many assets, wrong month count) is rejected at the
+        door — terminal immediately, counted, never queued behind work
+        it can only fail.  ``cacheable=False`` opts one request out of
+        the result cache and coalescing (its dispatch is forced).
         """
+        from csmom_tpu.obs import metrics
+
         values = np.asarray(values)
         mask = np.asarray(mask, dtype=bool)
         n_assets = int(values.shape[0]) if values.ndim == 2 else 0
-        rel = (self.config.default_deadline_s if deadline_s is None
-               else deadline_s)
+        try:
+            cls = self.policy.resolve(priority)
+        except ValueError as e:
+            req = Request(kind=kind, values=values, mask=mask,
+                          n_assets=n_assets,
+                          priority=self.policy.names()[0])
+            self.queue.reject_at_door(req, str(e))
+            return req
+        if deadline_s is not None:
+            rel = deadline_s
+        elif self.config.default_deadline_s == "class":
+            rel = cls.deadline_s
+        else:
+            rel = self.config.default_deadline_s
         req = Request(
             kind=kind, values=values, mask=mask, n_assets=n_assets,
-            priority=priority,
+            priority=cls.name,
             deadline_s=None if rel is None else mono_now_s() + rel,
             panel_version=panel_version,
         )
         if self._live_version_fn is not None and panel_version is not None:
             live = int(self._live_version_fn())
+            if self.cache is not None:
+                # the gate's threshold IS the cache floor: anything the
+                # door would now refuse must not be servable from cache
+                self.cache.set_version_floor(live - self._max_version_skew)
             if live - panel_version > self._max_version_skew:
                 self.queue.reject_at_door(
                     req,
@@ -171,7 +252,54 @@ class SignalService:
         if reason is not None:
             self.queue.reject_at_door(req, reason)
             return req
-        return self.queue.submit(req)
+        key = None
+        if self.cache is not None and cacheable:
+            key = CacheKey(kind=kind, params=self._params_key,
+                           months=self.spec.months, n_assets=n_assets,
+                           fingerprint=panel_fingerprint(values, mask),
+                           panel_version=panel_version)
+            # cache -> coalesce, re-checking the cache when a leader
+            # went terminal mid-attach (its completion filled the cache,
+            # so the retry is usually a hit, not a duplicate dispatch).
+            # Bounded: a pathological race storm degrades to leading an
+            # uncoalesced dispatch — correct, just uncached.
+            role = "leader"
+            for _ in range(3):
+                hit, result = self.cache.get(key)
+                if hit:
+                    return self.queue.serve_at_door(
+                        req, self._share_result(result))
+                role = self._coalescer.lead_or_follow(
+                    key, req, self.queue.attach_follower)
+                if role != "retry":
+                    break
+            if role == "follower":
+                metrics.counter("serve.coalesced").inc()
+                return req
+            if role == "leader":
+                req.cache_key = key
+            else:
+                key = None  # retry storm: dispatch uncoalesced, uncached
+        out = self.queue.submit(req)
+        if key is not None and req.state == "rejected":
+            # a door-rejected leader (quota/backpressure) must free the
+            # in-flight slot; any follower that attached in the gap was
+            # resolved inside the rejection's terminal transition
+            self._coalescer.unregister(key, req)
+        return out
+
+    @staticmethod
+    def _share_result(result):
+        """A cached result handed to a caller: numpy payloads go out as
+        read-only views and dict payloads as copies, so no caller can
+        mutate the shared cache entry."""
+        if isinstance(result, np.ndarray):
+            view = result.view()
+            view.setflags(write=False)
+            return view
+        if isinstance(result, dict):
+            return dict(result)
+        return result
 
     def _unserveable_reason(self, kind: str, values, mask) -> str | None:
         if kind not in ENDPOINTS:
@@ -202,6 +330,11 @@ class SignalService:
                 continue
             self._dispatch(mb)
 
+    def _release_key(self, req: Request) -> None:
+        key = getattr(req, "cache_key", None)
+        if key is not None:
+            self._coalescer.unregister(key, req)
+
     def _dispatch(self, mb: Microbatch) -> None:
         from csmom_tpu.chaos.inject import checkpoint
         from csmom_tpu.obs import metrics, span
@@ -217,6 +350,7 @@ class SignalService:
                 self.queue.finish_expired(
                     r, error="deadline expired between collection and "
                              "dispatch (never dispatched)")
+                self._release_key(r)
                 metrics.counter("serve.expired").inc()
             else:
                 self.queue.mark_dispatched(r, now)
@@ -226,6 +360,7 @@ class SignalService:
         fired = checkpoint("serve.dispatch", kind=mb.kind,
                            n=len(live), bucket=f"{mb.batch_bucket}x"
                            f"{mb.asset_bucket}x{self.spec.months}")
+        t_engine = mono_now_s()
         try:
             if fired == "fail":
                 raise RuntimeError(
@@ -240,7 +375,19 @@ class SignalService:
                            "ann_sharpe": float(out[b, 1])}
                 else:
                     res = np.array(out[b, :r.n_assets])
+                    # ONE object reaches the cache, the leader, and
+                    # every coalesced follower: freeze it so no caller
+                    # can mutate what another (or a later cache hit)
+                    # will read
+                    res.setflags(write=False)
+                key = getattr(r, "cache_key", None)
+                if key is not None and self.cache is not None:
+                    # fill the cache BEFORE resolving the leader, so a
+                    # submit racing the terminal transition finds the
+                    # result instead of re-leading a dispatch
+                    self.cache.put(key, res)
                 self.queue.finish_served(r, res)
+                self._release_key(r)
                 metrics.counter("serve.served").inc()
                 if r.queue_wait_s is not None:
                     metrics.histogram("serve.queue_wait_s").observe(
@@ -253,7 +400,9 @@ class SignalService:
                       f"({type(e).__name__}: {e})"[:200])
             for _, r in live:
                 self.queue.finish_rejected(r, reason, worker_crash=True)
+                self._release_key(r)
         finally:
+            self.batcher.note_service_wall(mono_now_s() - t_engine)
             used = sum(r.n_assets for _, r in live)
             with self._state_lock:
                 self.n_batches += 1
@@ -269,7 +418,7 @@ class SignalService:
         with self._state_lock:
             total = self._used_lanes + self._pad_lanes
             sizes = sum(int(k) * v for k, v in self.batch_size_hist.items())
-            return {
+            stats = {
                 "count": self.n_batches,
                 "size_hist": dict(sorted(self.batch_size_hist.items(),
                                          key=lambda kv: int(kv[0]))),
@@ -278,6 +427,23 @@ class SignalService:
                 "pad_fraction": (round(self._pad_lanes / total, 4)
                                  if total else None),
             }
+        stats["fire_reasons"] = self.batcher.fire_reason_counts()
+        return stats
+
+    def cache_stats(self) -> dict:
+        if self.cache is None:
+            return {"enabled": False}
+        out = self.cache.stats()
+        out["enabled"] = True
+        out["inflight_leaders"] = self._coalescer.inflight()
+        return out
+
+    def class_stats(self) -> dict:
+        """Per-class books + the policy's budgets (the SERVE artifact's
+        ``classes`` block is built from this)."""
+        books = self.queue.class_accounting()
+        policy = self.policy.summary()
+        return {name: {**books[name], **policy[name]} for name in books}
 
     def accounting(self) -> dict:
         return self.queue.accounting()

@@ -235,6 +235,8 @@ class WorkerServer:
             "worker_id": self.worker_id,
             "pid": os.getpid(),
             "accounting": self.service.accounting(),
+            "classes": self.service.class_stats(),
+            "cache": self.service.cache_stats(),
             "batches": self.service.batch_stats(),
             "fresh_compiles": self.service.fresh_compiles(),
             "invariant_violations": self.service.invariant_violations(),

@@ -1,0 +1,563 @@
+"""Adaptive dispatch (ISSUE 8): SLO classes, result cache, coalescing.
+
+Four properties this file exists to pin, per the issue's test satellite:
+
+- **cache correctness**: hit -> ``panel_version`` bump -> miss, with the
+  bumped-over entries invalidated and ZERO stale hits (the floor refuses
+  a stale entry even when one is planted under a live key);
+- **in-flight coalescing**: identical concurrent requests share ONE
+  dispatch and every waiter gets the result exactly once, counted;
+- **bounded memory**: the cache evicts LRU under both the entry cap and
+  the byte cap, and eviction is counted;
+- **starvation-proofness**: bulk saturation (over-quota burst) with
+  interactive p99 still inside its class budget and every class book
+  closed.
+
+Everything runs on the stub engine (no jax), like the rest of the serve
+plumbing tier.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from csmom_tpu.chaos import invariants as inv
+from csmom_tpu.serve.cache import CacheKey, ResultCache, panel_fingerprint
+from csmom_tpu.serve.service import ServeConfig, SignalService
+from csmom_tpu.serve.slo import (
+    SLOClass,
+    SLOPolicy,
+    TokenBucket,
+    default_policy,
+)
+
+def _panel(n_assets: int, months: int, seed: int = 0):
+    r = np.random.default_rng(seed)
+    v = 100.0 * np.exp(np.cumsum(r.normal(0, 0.03, (n_assets, months)),
+                                 axis=1)).astype(np.float32)
+    return v, np.ones((n_assets, months), bool)
+
+
+def _stub_service(**over) -> SignalService:
+    kw = dict(profile="serve-smoke", engine="stub", max_wait_s=0.005)
+    kw.update(over)
+    return SignalService(ServeConfig(**kw)).start()
+
+
+# ------------------------------------------------------------------ slo ----
+
+def test_token_bucket_rate_and_burst():
+    b = TokenBucket(rate=10.0, burst=3.0)
+    # burst credit: 3 immediate takes, then dry
+    assert [b.try_take(0.0) for _ in range(4)] == [True, True, True, False]
+    # 0.1 s at 10 rps refills exactly one token
+    assert b.try_take(0.1) is True
+    assert b.try_take(0.1) is False
+    # refill never exceeds burst
+    assert [b.try_take(100.0) for _ in range(4)] == [True, True, True,
+                                                     False]
+
+
+def test_policy_resolves_aliases_and_rejects_unknown():
+    p = default_policy()
+    assert p.names() == ("interactive", "standard", "bulk")
+    assert p.resolve_name("batch") == "bulk"   # the r10 legacy name
+    assert p.resolve("interactive").rank == 0
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        p.resolve("vip")
+    with pytest.raises(ValueError, match="duplicate SLO class"):
+        SLOPolicy((SLOClass("a", 0, 1.0), SLOClass("a", 1, 1.0)))
+
+
+def test_unknown_class_rejects_at_the_door_and_books_close():
+    svc = _stub_service()
+    r = svc.submit("momentum", *_panel(3, svc.spec.months), priority="vip")
+    assert r.state == "rejected" and "unknown SLO class" in (r.error or "")
+    svc.stop()
+    assert svc.invariant_violations() == []
+
+
+def test_class_deadline_budget_is_the_default_deadline():
+    svc = _stub_service()
+    months = svc.spec.months
+    reqs = {cls: svc.submit("momentum", *_panel(3, months, seed=i),
+                            priority=cls, cacheable=False)
+            for i, cls in enumerate(("interactive", "standard", "bulk"))}
+    for r in reqs.values():
+        assert r.wait(5.0) and r.state == "served"
+    budgets = {c.name: c.deadline_s for c in svc.policy.classes}
+    for cls, r in reqs.items():
+        want = r.t_submit_s + budgets[cls]
+        # the absolute deadline was anchored slightly before t_submit_s
+        assert abs(r.deadline_s - want) < 0.2, (cls, r.deadline_s, want)
+    svc.stop()
+    assert svc.invariant_violations() == []
+
+
+# ---------------------------------------------------------------- cache ----
+
+def _key(n=3, months=24, version=None, seed=0, kind="momentum"):
+    v, m = _panel(n, months, seed)
+    return CacheKey(kind=kind, params=("stub", 12, 1, 10, "rank"),
+                    months=months, n_assets=n,
+                    fingerprint=panel_fingerprint(v, m),
+                    panel_version=version)
+
+
+def test_cache_hit_then_version_bump_then_miss():
+    """The issue's named sequence: a versioned hit, a panel_version bump,
+    then the SAME panel misses (old entries invalidated, new version is
+    a new key) — with zero stale hits throughout."""
+    c = ResultCache()
+    k1 = _key(version=1)
+    assert c.get(k1) == (False, None)
+    assert c.put(k1, np.arange(3.0))
+    hit, res = c.get(k1)
+    assert hit and list(res) == [0.0, 1.0, 2.0]
+    # the bump: ingestion moved to panel version 2
+    assert c.set_version_floor(2) == 1          # the v1 entry dropped
+    assert c.get(k1) == (False, None)            # and can never hit again
+    k2 = _key(version=2)
+    assert c.get(k2) == (False, None)            # new version = new key
+    # a result computed from the OLD panel arriving late is refused
+    assert not c.put(k1, np.arange(3.0))
+    s = c.stats()
+    assert s["stale_hits"] == 0
+    assert s["invalidated"] == 1
+    assert s["stale_put_refused"] == 1
+    assert s["version_floor"] == 2
+
+
+def test_cache_version_floor_blocks_planted_stale_entry():
+    """Defense in depth: even an entry that EXISTS under a live key but
+    is stamped below the floor (the cache_poison chaos shape) is refused
+    by the get path and counted stale_blocked, never returned."""
+    c = ResultCache()
+    c.set_version_floor(5)
+    k = _key(version=5)
+    with c._lock:
+        from csmom_tpu.serve.cache import _Entry
+
+        c._entries[k] = _Entry(result="POISON", version=3, nbytes=8)
+    hit, res = c.get(k)
+    assert not hit and res is None
+    s = c.stats()
+    assert s["stale_blocked"] == 1 and s["stale_hits"] == 0
+    assert s["entries"] == 0  # the poisoned entry was evicted on sight
+
+
+def test_cache_bounded_by_entries_and_bytes():
+    c = ResultCache(max_entries=3, max_bytes=1 << 30)
+    keys = [_key(seed=i, version=None) for i in range(5)]
+    for k in keys:
+        c.put(k, np.zeros(4))
+    s = c.stats()
+    assert s["entries"] == 3 and s["evictions"] == 2
+    # LRU: the two oldest are gone, the three newest hit
+    assert c.get(keys[0]) == (False, None)
+    assert c.get(keys[1]) == (False, None)
+    assert all(c.get(k)[0] for k in keys[2:])
+    # byte bound: each entry is 800 bytes, cap at ~2 entries
+    c2 = ResultCache(max_entries=100, max_bytes=1600)
+    for i in range(4):
+        c2.put(_key(seed=10 + i), np.zeros(100))
+    s2 = c2.stats()
+    assert s2["entries"] <= 2 and s2["evictions"] >= 2
+    assert s2["size_bytes"] <= 1600
+
+
+def test_service_cache_hit_roundtrip_and_readonly_result():
+    svc = _stub_service()
+    months = svc.spec.months
+    v, m = _panel(4, months)
+    a = svc.submit("momentum", v, m)
+    assert a.wait(5.0) and a.state == "served" and not a.cache_hit
+    b = svc.submit("momentum", v, m)
+    assert b.wait(5.0) and b.state == "served" and b.cache_hit
+    assert np.allclose(np.asarray(a.result), np.asarray(b.result),
+                       equal_nan=True)
+    # a cached payload goes out read-only: no caller can poison the cache
+    with pytest.raises(ValueError):
+        np.asarray(b.result)[0] = 1.0
+    svc.stop()
+    assert svc.invariant_violations() == []
+    assert svc.accounting()["served_cache_hits"] == 1
+    assert svc.cache_stats()["hit_rate"] > 0
+
+
+def test_service_version_bump_invalidates_between_submissions():
+    svc = _stub_service()
+    months = svc.spec.months
+    v, m = _panel(4, months)
+    a = svc.submit("momentum", v, m, panel_version=1)
+    assert a.wait(5.0) and a.state == "served"
+    b = svc.submit("momentum", v, m, panel_version=1)
+    assert b.wait(5.0) and b.cache_hit
+    assert svc.notify_panel_version(2) == 1     # the v1 entry invalidated
+    c = svc.submit("momentum", v, m, panel_version=2)
+    assert c.wait(5.0) and c.state == "served" and not c.cache_hit
+    svc.stop()
+    s = svc.cache_stats()
+    assert s["stale_hits"] == 0 and s["invalidated"] == 1
+    assert svc.invariant_violations() == []
+
+
+# ------------------------------------------------------------ coalescing ----
+
+def test_inflight_coalescing_shares_one_dispatch_exactly_once():
+    """Identical concurrent requests: one leader dispatch, every waiter
+    served exactly once with the shared result, books count them all."""
+    # a long coalescing window stalls the leader in the queue so the
+    # followers provably attach while it is in flight
+    svc = _stub_service(max_wait_s=0.25)
+    months = svc.spec.months
+    v, m = _panel(4, months)
+    lead = svc.submit("momentum", v, m, deadline_s=5.0)
+    followers = [svc.submit("momentum", v, m, deadline_s=5.0)
+                 for _ in range(3)]
+    for r in [lead] + followers:
+        assert r.wait(5.0), r.state
+        assert r.state == "served", (r.state, r.error)
+    assert not lead.coalesced
+    assert all(f.coalesced for f in followers)
+    for f in followers:
+        assert np.allclose(np.asarray(f.result), np.asarray(lead.result),
+                           equal_nan=True)
+        # the follower's timeline shares the leader's dispatch instant
+        assert f.t_dispatch_s == lead.t_dispatch_s
+    svc.stop()
+    a = svc.accounting()
+    assert a["served_coalesced"] == 3
+    assert a["admitted"] == 4 and a["served"] == 4
+    # ONE dispatch for the four of them
+    assert svc.batch_stats()["count"] == 1
+    assert svc.invariant_violations() == []
+
+
+def test_coalesced_followers_ride_a_crashed_leader_to_terminal(
+        tmp_path, monkeypatch):
+    """A leader that dies mid-batch takes its followers to a TERMINAL
+    state (rejected, with the leader's fate as the reason) — coalescing
+    must never strand a waiter."""
+    from csmom_tpu.chaos import inject
+    from csmom_tpu.chaos.plan import Fault, FaultPlan
+
+    plan = FaultPlan("crash", seed=1, faults=(
+        Fault(point="serve.dispatch", action="fail", after=0, max_fires=1),
+    ))
+    p = tmp_path / "plan.toml"
+    p.write_text(plan.to_toml())
+    monkeypatch.setenv("CSMOM_FAULT_PLAN", str(p))
+    monkeypatch.setenv("CSMOM_FAULT_STATE", str(tmp_path / "state"))
+    inject.reset()
+    try:
+        svc = _stub_service(max_wait_s=0.25)
+        months = svc.spec.months
+        v, m = _panel(4, months)
+        lead = svc.submit("momentum", v, m, deadline_s=5.0)
+        follower = svc.submit("momentum", v, m, deadline_s=5.0)
+        assert lead.wait(5.0) and follower.wait(5.0)
+        assert lead.state == "rejected"
+        assert follower.state == "rejected"
+        assert "coalesced onto request" in (follower.error or "")
+        svc.stop()
+        assert svc.invariant_violations() == []
+        assert svc.accounting()["rejected_coalesced"] == 1
+    finally:
+        inject.reset()
+
+
+def test_coalesced_follower_expires_when_dispatch_begins_too_late():
+    """Coalescing must not void the deadline contract: a follower whose
+    own deadline passed BEFORE the shared dispatch began expires (never
+    'served late'), while followers whose dispatch began in time ride
+    the leader — same rule the deques enforce for queued requests."""
+    # stall the worker in a long coalescing window so the leader is in
+    # flight long past the tight follower's deadline
+    svc = _stub_service(max_wait_s=0.3)
+    months = svc.spec.months
+    v, m = _panel(4, months)
+    lead = svc.submit("momentum", v, m, deadline_s=5.0)
+    tight = svc.submit("momentum", v, m, deadline_s=0.02)   # follower
+    loose = svc.submit("momentum", v, m, deadline_s=5.0)    # follower
+    for r in (lead, tight, loose):
+        assert r.wait(5.0), r.state
+    assert lead.state == "served"
+    assert loose.state == "served" and loose.coalesced
+    assert tight.state == "expired", (tight.state, tight.error)
+    assert "before the coalesced dispatch" in (tight.error or "")
+    svc.stop()
+    assert svc.invariant_violations() == []
+
+
+def test_coalesced_backtest_followers_get_their_own_dict():
+    """A shared mutable dict result would let one coalesced caller edit
+    what another reads; every waiter must get its own copy."""
+    svc = _stub_service(max_wait_s=0.25)
+    months = svc.spec.months
+    v, m = _panel(4, months)
+    lead = svc.submit("backtest", v, m, deadline_s=5.0)
+    follower = svc.submit("backtest", v, m, deadline_s=5.0)
+    assert lead.wait(5.0) and follower.wait(5.0)
+    assert lead.state == follower.state == "served"
+    assert follower.result == lead.result
+    assert follower.result is not lead.result
+    follower.result["ann_sharpe"] = 99.0
+    assert lead.result["ann_sharpe"] != 99.0
+    # and a later cache hit is untouched by either caller's edits
+    hit = svc.submit("backtest", v, m, deadline_s=5.0)
+    assert hit.wait(5.0) and hit.cache_hit
+    assert hit.result["ann_sharpe"] != 99.0
+    svc.stop()
+    assert svc.invariant_violations() == []
+
+
+# ------------------------------------------------------------ starvation ----
+
+def test_bulk_saturation_cannot_starve_interactive():
+    """THE starvation test: a bulk flood (way over quota) concurrent with
+    an interactive stream — every interactive request is served inside
+    its class budget, bulk absorbs the rejections, and every book
+    closes."""
+    policy = SLOPolicy((
+        SLOClass("interactive", rank=0, deadline_s=0.5),
+        SLOClass("standard", rank=1, deadline_s=1.0, queue_share=0.75),
+        SLOClass("bulk", rank=2, deadline_s=3.0,
+                 quota_rps=20.0, quota_burst=5.0, queue_share=0.5),
+    ))
+    svc = _stub_service(policy=policy, capacity=16)
+    months = svc.spec.months
+    stop = threading.Event()
+    bulk_reqs: list = []
+
+    def _flood():
+        i = 0
+        while not stop.is_set() and i < 400:
+            v, m = _panel(4, months, seed=1000 + i)
+            bulk_reqs.append(svc.submit("momentum", v, m, priority="bulk",
+                                        cacheable=False))
+            i += 1
+
+    flood = threading.Thread(target=_flood, daemon=True)
+    flood.start()
+    inter = []
+    for i in range(20):
+        v, m = _panel(4, months, seed=i)
+        inter.append(svc.submit("momentum", v, m, priority="interactive",
+                                cacheable=False))
+        # an interactive STREAM, not an interactive flood: arrivals are
+        # paced like a client, the bulk side is the saturating tenant
+        threading.Event().wait(0.003)
+    stop.set()
+    flood.join(timeout=10.0)
+    for r in inter:
+        assert r.wait(5.0), r.state
+    for r in bulk_reqs:
+        assert r.wait(5.0), r.state
+    svc.stop()
+    assert svc.invariant_violations() == []
+    books = svc.queue.class_accounting()
+    # the flood provably hit the quota
+    assert books["bulk"]["rejected_quota"] > 0, books["bulk"]
+    # every interactive request was served, inside the class budget
+    assert all(r.state == "served" for r in inter), (
+        [(r.state, r.error) for r in inter if r.state != "served"])
+    budget_s = policy.resolve("interactive").deadline_s
+    walls = sorted(r.total_s for r in inter)
+    # judge all-but-one against the budget: the property under test is
+    # scheduling (interactive never queues behind bulk), and a single
+    # straggler on a contended test machine is machine noise, not a
+    # starvation signal — but the p95 busting a 0.5 s budget when stub
+    # dispatches take microseconds could only be bulk in the way
+    assert walls[-2] <= budget_s, (
+        f"interactive p95 {walls[-2] * 1e3:.1f} ms busted the "
+        f"{budget_s * 1e3:.0f} ms class budget under bulk saturation "
+        f"(walls ms: {[round(w * 1e3, 1) for w in walls]})")
+
+
+def test_queue_share_bounds_bulk_occupancy():
+    """Even inside its rate quota, bulk can never occupy more than its
+    share of the queue slots — interactive admission capacity survives a
+    bulk pile-up by construction."""
+    from csmom_tpu.serve.queue import AdmissionQueue, Request
+
+    policy = SLOPolicy((
+        SLOClass("interactive", rank=0, deadline_s=0.5),
+        SLOClass("bulk", rank=1, deadline_s=3.0, queue_share=0.5),
+    ))
+    q = AdmissionQueue(capacity=8, policy=policy)  # bulk may hold 4
+
+    def mk(prio):
+        v, m = _panel(2, 24)
+        return Request(kind="momentum", values=v, mask=m, n_assets=2,
+                       priority=prio)
+
+    outcomes = [q.submit(mk("bulk")).state for _ in range(6)]
+    assert outcomes == ["queued"] * 4 + ["rejected"] * 2
+    assert q.rejected_quota == 2
+    # the other half of the queue is still open for interactive
+    assert all(q.submit(mk("interactive")).state == "queued"
+               for _ in range(4))
+
+
+# ------------------------------------------------- artifact + validator ----
+
+def _v2_artifact(**over):
+    art = {
+        "kind": "serve", "schema_version": 2, "run_id": "x",
+        "metric": "serve_throughput_rps", "value": 10.0, "unit": "req/s",
+        "vs_baseline": 1.0, "wall_s": 1.0, "offered_limited": False,
+        "requests": {"admitted": 6, "served": 4, "rejected": 2,
+                     "expired": 0, "expired_dispatched": 0},
+        "classes": {
+            "interactive": {"admitted": 4, "served": 4, "rejected": 0,
+                            "expired": 0, "rejected_quota": 0,
+                            "latency_ms": {"p50": 1.0, "p95": 2.0,
+                                           "p99": 3.0},
+                            "budget_ms": 500.0, "within_budget": True},
+            "bulk": {"admitted": 2, "served": 0, "rejected": 2,
+                     "expired": 0, "rejected_quota": 2,
+                     "latency_ms": {"p50": None, "p95": None, "p99": None},
+                     "budget_ms": 3000.0, "within_budget": None},
+        },
+        "cache": {"enabled": True, "hits": 2, "misses": 3,
+                  "stale_blocked": 1, "stale_hits": 0, "lookups": 6,
+                  "hit_rate": round(2 / 6, 4), "inserts": 3,
+                  "evictions": 0},
+        "latency_ms": {
+            "queue": {"p50": 1.0, "p95": 2.0, "p99": 3.0},
+            "service": {"p50": 1.0, "p95": 2.0, "p99": 3.0},
+            "total": {"p50": 2.0, "p95": 4.0, "p99": 6.0},
+        },
+        "batches": {"count": 4, "size_hist": {"1": 4}, "mean_size": 1.0,
+                    "pad_fraction": 0.0,
+                    "fire_reasons": {"window": 3, "deadline_risk": 1}},
+        "compile": {"in_window_fresh_compiles": 0},
+        "offered": {"schedule": "1x10", "schedule_kind": "bursty",
+                    "offered_rps": 10.0, "n_arrivals": 10},
+        "extra": {"platform": "cpu", "engine": "stub", "workload": "w"},
+    }
+    art.update(over)
+    return art
+
+
+def test_serve_v2_validator_enforces_class_and_cache_books():
+    assert inv.validate(_v2_artifact()) == []
+    # broken per-class book
+    bad = json.loads(json.dumps(_v2_artifact()))
+    bad["classes"]["interactive"]["served"] = 3
+    assert any("class 'interactive' book broken" in v
+               for v in inv.validate(bad))
+    # class books that do not sum to the global book
+    bad = json.loads(json.dumps(_v2_artifact()))
+    bad["classes"].pop("bulk")
+    bad["requests"]["admitted"] = 4
+    assert any("accounting broken" in v or "do not sum" in v
+               for v in inv.validate(bad))
+    # a stale cache hit is invalid evidence, full stop
+    bad = json.loads(json.dumps(_v2_artifact()))
+    bad["cache"]["stale_hits"] = 1
+    assert any("stale" in v for v in inv.validate(bad))
+    # hit_rate must reconcile with its own counters
+    bad = json.loads(json.dumps(_v2_artifact()))
+    bad["cache"]["hit_rate"] = 0.9
+    assert any("hit_rate" in v for v in inv.validate(bad))
+    # offered_rps is required in v2 (the r11 footnote, made mechanical)
+    bad = json.loads(json.dumps(_v2_artifact()))
+    del bad["offered"]["offered_rps"]
+    assert any("offered_rps" in v for v in inv.validate(bad))
+    # v1 artifacts (SERVE_r10.json's era) validate WITHOUT the v2 blocks
+    v1 = json.loads(json.dumps(_v2_artifact()))
+    v1["schema_version"] = 1
+    for k in ("classes", "cache", "offered_limited"):
+        v1.pop(k, None)
+    assert inv.validate(v1) == []
+
+
+def test_ledger_ingests_v2_rows_and_flags_offered_limited(tmp_path):
+    from csmom_tpu.obs import ledger as ld
+
+    sat = _v2_artifact()                        # rejects: saturation
+    lim = _v2_artifact(offered_limited=True)    # fully kept up
+    lim["requests"] = {"admitted": 6, "served": 6, "rejected": 0,
+                       "expired": 0, "expired_dispatched": 0}
+    lim["classes"]["bulk"] = {
+        "admitted": 2, "served": 2, "rejected": 0, "expired": 0,
+        "rejected_quota": 0,
+        "latency_ms": {"p50": 1.0, "p95": 2.0, "p99": 3.0},
+        "budget_ms": 3000.0, "within_budget": True}
+    with open(tmp_path / "SERVE_r01.json", "w") as f:
+        json.dump(sat, f)
+    with open(tmp_path / "SERVE_r02.json", "w") as f:
+        json.dump(lim, f)
+    L = ld.load(str(tmp_path))
+    metrics = {r.metric for r in L.rows}
+    assert {"serve_throughput_rps", "serve_offered_rps",
+            "serve_cache_hit_rate", "serve_interactive_p99_ms",
+            "serve_p99_under_burst_ms"} <= metrics
+    thr = {r.run: r for r in L.rows if r.metric == "serve_throughput_rps"}
+    # the saturated run's throughput gates; the offered-limited one is
+    # visible but flagged — it measured the load, not the ceiling
+    assert thr["r01"].gate_eligible()
+    assert not thr["r02"].gate_eligible()
+    assert "offered-limited" in thr["r02"].flags
+    # latency rows still gate on both runs
+    p99 = [r for r in L.rows if r.metric == "serve_p99_ms"]
+    assert all(r.gate_eligible() for r in p99) and len(p99) == 2
+    # offered rows are informational, never gating
+    off = [r for r in L.rows if r.metric == "serve_offered_rps"]
+    assert all(not r.gate_eligible() for r in off)
+
+
+# ------------------------------------------------------ adaptive batcher ----
+
+def test_deadline_risk_fires_before_the_window_expires_the_request():
+    """A tight deadline inside a LONG coalescing window: the adaptive
+    batcher must fire early (the request is served), where the r10
+    fixed-window batcher would have let it expire in the queue."""
+    svc = _stub_service(max_wait_s=0.4)
+    months = svc.spec.months
+    # train the service EMA with one dispatch
+    w = svc.submit("momentum", *_panel(3, months, seed=9), deadline_s=5.0)
+    assert w.wait(5.0) and w.state == "served"
+    r = svc.submit("momentum", *_panel(3, months, seed=10),
+                   deadline_s=0.08, cacheable=False)
+    assert r.wait(5.0)
+    assert r.state == "served", (r.state, r.error)
+    assert r.total_s < 0.4, "the window was waited out, not cut short"
+    svc.stop()
+    reasons = svc.batch_stats()["fire_reasons"]
+    assert reasons.get("deadline_risk", 0) >= 1, reasons
+    assert svc.invariant_violations() == []
+
+
+def test_refill_fires_immediately_under_backlog():
+    """Continuous batching: with a backlog waiting when the engine frees,
+    the next batch collects with a zero window (fire reason refill) —
+    sustained load never pays the idle coalescing wait."""
+    svc = _stub_service(max_wait_s=0.2)
+    months = svc.spec.months
+    # stall the engine so a real backlog builds while a batch is in
+    # flight — the refill decision needs work WAITING when it frees
+    real_score = svc.engine.score
+
+    def slow_score(kind, values, mask):
+        threading.Event().wait(0.05)
+        return real_score(kind, values, mask)
+
+    svc.engine.score = slow_score
+    reqs = [svc.submit("momentum", *_panel(3, months, seed=i),
+                       deadline_s=5.0, cacheable=False)
+            for i in range(10)]
+    for r in reqs:
+        assert r.wait(5.0) and r.state == "served", (r.state, r.error)
+    svc.stop()
+    reasons = svc.batch_stats()["fire_reasons"]
+    # under backlog the engine-freed path fires with a zero window:
+    # either a grown full batch or an immediate refill — never only the
+    # idle window
+    assert (reasons.get("refill", 0) + reasons.get("full", 0) >= 1
+            and reasons.get("refill", 0) >= 1), reasons
+    assert svc.invariant_violations() == []

@@ -192,6 +192,13 @@ def test_serve_modules_route_all_timing_through_deadline_helpers():
         "csmom_tpu/serve/worker.py",
         "csmom_tpu/serve/router.py",
         "csmom_tpu/serve/supervisor.py",
+        # the ISSUE 8 adaptive-dispatch tier rides under the same pin:
+        # SLO deadline budgets and token-bucket refills are mono-only
+        # (the bucket never even reads a clock — callers pass now_s from
+        # mono_now_s), and the result cache reads NO clock at all (LRU
+        # order is recency, version floors are counters)
+        "csmom_tpu/serve/slo.py",
+        "csmom_tpu/serve/cache.py",
     )
     for rel in serve_modules:
         path = os.path.join(_REPO, rel)
