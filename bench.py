@@ -239,8 +239,14 @@ def child_main():
 
     from csmom_tpu.backtest.event import event_backtest
     from csmom_tpu.compile import workloads as wl
-    from csmom_tpu.compile.entries import batched_event_fn, grid_scalar_fn
+    from csmom_tpu.registry import entry_factory
     from csmom_tpu.utils.profiling import compile_stats
+
+    # the hot-entry factories come from the engine registry (ISSUE 9):
+    # the same lru-shared callables `csmom warmup` lowers, fetched by
+    # registered name instead of a per-module import list
+    grid_scalar_fn = entry_factory("grid.jk")
+    batched_event_fn = entry_factory("event.panel")
 
     # telemetry: join the supervisor's event stream (env contract) — or
     # stay disarmed, in which case every span below is the shared no-op

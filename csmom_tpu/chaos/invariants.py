@@ -109,8 +109,11 @@ KNOWN_TELEMETRY_SCHEMA_VERSIONS = (1,)
 # serve artifact schema versions this checker (and the ledger) understand
 # — the same closed-world rule as telemetry.  v2 (ISSUE 8, adaptive
 # dispatch) adds per-SLO-class books, the result-cache book, and the
-# offered-load record; v1 artifacts (SERVE_r10.json) stay valid as-is.
-KNOWN_SERVE_SCHEMA_VERSIONS = (1, 2)
+# offered-load record; v3 (ISSUE 9, engine registry) adds per-ENDPOINT
+# books whose name set must be registered engines — the artifact's
+# endpoint world is validated against the registry, not a literal.
+# v1/v2 artifacts (SERVE_r10.json / SERVE_r13.json) stay valid as-is.
+KNOWN_SERVE_SCHEMA_VERSIONS = (1, 2, 3)
 
 # serve-pool artifact schema versions (SERVE_POOL_*.json, the
 # multi-process tier) — closed-world like the rest
@@ -491,8 +494,85 @@ def _validate_serve(obj: dict) -> list:
         if fc is not None and not isinstance(fc, (int, str)):
             out.append("serve: compile.in_window_fresh_compiles must be "
                        "an int count or a reason string")
-    if ver == 2:
+    if isinstance(ver, int) and ver >= 2:
         out += _validate_serve_v2(obj, req)
+    if isinstance(ver, int) and ver >= 3:
+        out += _validate_serve_v3(obj, req)
+    return out
+
+
+def _registered_serve_endpoints() -> tuple:
+    """The live endpoint registry (the v3 ground truth).  Imported
+    lazily: this module stays cheap for validators that never see a v3
+    serve artifact, and the registry's core is jax-free by design."""
+    from csmom_tpu.registry import serve_endpoints
+
+    return serve_endpoints()
+
+
+def _validate_serve_v3(obj: dict, req: dict | None) -> list:
+    """The ISSUE 9 additions: per-ENDPOINT books that close and sum to
+    the global book, with the endpoint NAME SET validated against the
+    VALIDATING PROCESS's live engine registry.  Committed round
+    evidence uses builtin endpoints, which every process registers; an
+    artifact produced by a runtime-registered plugin endpoint validates
+    only in processes that also register that plugin — the same
+    process-level discipline the serving tier itself applies (a worker
+    without the plugin cannot serve it either)."""
+    out: list = []
+    registered = _registered_serve_endpoints()
+    eps = _require(obj, "endpoints", dict, "serve", out)
+    if isinstance(eps, dict):
+        if not eps:
+            out.append("serve: endpoints must name at least one endpoint "
+                       "(the per-endpoint book is v3's contract)")
+        served_sum = 0
+        broken = False
+        for name, book in eps.items():
+            if name not in registered:
+                out.append(
+                    f"serve: endpoints[{name!r}] is not a registered "
+                    f"engine (registry: {list(registered)}) — the "
+                    "artifact's endpoint set must come from the "
+                    "registry, not a literal")
+                broken = True
+                continue
+            if not isinstance(book, dict):
+                out.append(f"serve: endpoints[{name!r}] must be a dict")
+                broken = True
+                continue
+            for k in ("submitted", "served", "rejected", "expired"):
+                v = book.get(k)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    out.append(f"serve: endpoints[{name!r}].{k} must be a "
+                               "non-negative int")
+                    broken = True
+                    break
+            else:
+                total = (book["served"] + book["rejected"]
+                         + book["expired"])
+                if total != book["submitted"]:
+                    out.append(
+                        f"serve: endpoint {name!r} book broken — served "
+                        f"{book['served']} + rejected {book['rejected']} "
+                        f"+ expired {book['expired']} = {total} != "
+                        f"submitted {book['submitted']}")
+                served_sum += book["served"]
+                _validate_latency_side(book.get("latency_ms"),
+                                       f"endpoints.{name}", "serve", out)
+        if not broken and req is not None and served_sum != req["served"]:
+            out.append(
+                f"serve: endpoint books do not sum to the global book — "
+                f"sum(served) = {served_sum} != requests.served "
+                f"{req['served']} (a request escaped its endpoint "
+                "ledger)")
+    kinds = (obj.get("offered") or {}).get("kinds")
+    if isinstance(kinds, list):
+        rogue = [k for k in kinds if k not in registered]
+        if rogue:
+            out.append(
+                f"serve: offered.kinds contains unregistered endpoints "
+                f"{rogue} (registry: {list(registered)})")
     return out
 
 
@@ -870,6 +950,13 @@ def _validate_replay(obj: dict) -> list:
             if not isinstance(v, int) or isinstance(v, bool) or v < 0:
                 out.append(f"replay: reconcile.{k} must be a non-negative "
                            "int")
+        # r14's window-slide counter: optional (pre-r14 artifacts lack
+        # it) but typed like its sibling counters when present
+        v = rec.get("reanchors")
+        if v is not None and (not isinstance(v, int) or isinstance(v, bool)
+                              or v < 0):
+            out.append("replay: reconcile.reanchors must be a "
+                       "non-negative int when present")
         if (isinstance(rec.get("count"), int)
                 and isinstance(rec.get("drift_events"), int)
                 and rec["drift_events"] > rec["count"]):

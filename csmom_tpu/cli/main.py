@@ -1668,6 +1668,7 @@ def build_parser() -> argparse.ArgumentParser:
         sp.set_defaults(fn=fn)
 
     from csmom_tpu.cli.ledger import register as register_ledger
+    from csmom_tpu.cli.registry import register as register_registry
     from csmom_tpu.cli.rehearse import register as register_rehearse
     from csmom_tpu.cli.replay import register as register_replay
     from csmom_tpu.cli.serve import register as register_serve
@@ -1678,6 +1679,7 @@ def build_parser() -> argparse.ArgumentParser:
     register_ledger(sub)
     register_serve(sub)
     register_replay(sub)
+    register_registry(sub)
     # the epilog is built AFTER every registration hook has run, from the
     # registry itself — a subcommand cannot exist without appearing here
     p.epilog = _registry_epilog(sub)

@@ -1,0 +1,99 @@
+"""csmom registry — inspect the engine registry (ISSUE 9).
+
+``csmom registry list`` prints every registered engine with its kind
+and the surfaces registration bought it: which warmup profiles carry
+its manifest entries, whether it is a live serve endpoint / loadgen
+workload leg, whether a donated-buffer variant exists, and the state of
+its sharded hook (``stub`` until ROADMAP item 1 fills partition rules
+in).  ``--kind`` filters; ``--endpoints`` prints just the serving
+tier's endpoint names (the set ``serve/buckets.py::ENDPOINTS`` used to
+hard-code — scripts that consumed that literal read it here now).
+
+Registered via ``register(sub)`` like serve/replay/ledger (the
+cli/main.py split: new subcommands do not grow the monolith).
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["cmd_registry", "register"]
+
+
+def _surfaces(spec) -> str:
+    """One engine's surface summary, compact enough for a table row."""
+    out = []
+    if spec.profiles:
+        out.append(f"manifest({','.join(spec.profiles)})")
+    if spec.kind == "serve":
+        out.append("serve")
+        if spec.workload:
+            out.append("loadgen")
+        out.append("donated")  # auto-derived for every serve engine
+    elif spec.donated_fn is not None:
+        out.append("donated")
+    if spec.entry_fn is not None:
+        out.append("entry")
+    if spec.kind != "strategy":
+        out.append("sharded" if spec.sharded_fn is not None
+                   else "sharded:stub")
+    return " ".join(out) or "-"
+
+
+def cmd_registry(args) -> int:
+    """List registered engines and the surfaces registration bought them."""
+    from csmom_tpu.registry import engine_specs, serve_endpoints
+
+    if args.action != "list":
+        print(f"unknown registry action {args.action!r} (try: list)",
+              file=sys.stderr)
+        return 2
+    if args.endpoints:
+        for name in serve_endpoints():
+            print(name)
+        return 0
+    kinds = (args.kind,) if args.kind else ("serve", "compile", "strategy")
+    n = 0
+    for kind in kinds:
+        specs = engine_specs(kind)
+        if kind == "strategy" and not specs:
+            # strategies register on zoo import; force it so the listing
+            # is complete without the caller knowing that detail
+            from csmom_tpu.registry import strategies
+
+            strategies()
+            specs = engine_specs(kind)
+        if not specs:
+            continue
+        print(f"{kind} ({len(specs)}):")
+        for spec in specs:
+            n += 1
+            print(f"  {spec.name:<22} {_surfaces(spec)}")
+            if spec.description and not args.terse:
+                print(f"  {'':<22} {spec.description}")
+        print()
+    print(f"{n} engines registered — one registration buys: shape-"
+          "manifest entries (csmom warmup), a donated-buffer variant, "
+          "a serve endpoint on the bucket grid, a loadgen workload leg "
+          "with ledger rows, and the (stubbed) sharded hook")
+    return 0
+
+
+def register(sub) -> None:
+    """Attach the ``registry`` subparser (from cli.main)."""
+    sp = sub.add_parser(
+        "registry",
+        help="inspect the engine registry: every registered engine and "
+             "the production surfaces registration bought it",
+    )
+    sp.add_argument("action", nargs="?", default="list",
+                    help="what to do (list: print the registry table)")
+    sp.add_argument("--kind", choices=["serve", "compile", "strategy"],
+                    help="only this kind of engine")
+    sp.add_argument("--endpoints", action="store_true",
+                    help="print only the serve endpoint names (one per "
+                         "line; the old ENDPOINTS literal, read from "
+                         "the registry)")
+    sp.add_argument("--terse", action="store_true",
+                    help="omit descriptions (names + surfaces only)")
+    sp.set_defaults(fn=cmd_registry)

@@ -483,11 +483,20 @@ def _check_pool_version_skew(r):
 
 
 def _serve_pool_scenarios():
+    # chaos hit counters are PER-PROCESS: every worker's own readiness
+    # self-probe dispatches once per REGISTERED endpoint before any load
+    # arrives, so the kill's `after` must skip exactly that many hits or
+    # the worker kills itself during its probe (and the load sees no
+    # failure to rescue).  Derived from the registry, like the probe.
+    from csmom_tpu.registry import serve_endpoints
+
+    probe_dispatches = len(serve_endpoints())
     return [
         Scenario(
             "pool-worker-kill-mid-batch", "serve-pool",
             FaultPlan("pool-worker-kill", seed=30, faults=(
-                Fault(point="serve.dispatch", action="kill", after=3,
+                Fault(point="serve.dispatch", action="kill",
+                      after=probe_dispatches,
                       max_fires=1, global_once=True),
             )),
             _check_pool_worker_kill, fast=True,

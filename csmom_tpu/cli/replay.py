@@ -85,6 +85,8 @@ def cmd_replay(args) -> int:
         kw["n_assets"] = args.assets
     if args.bars is not None:
         kw["bars"] = args.bars
+    if args.capacity is not None:
+        kw["capacity"] = args.capacity
     cfg = ReplayConfig(
         run_id=args.run_id,
         seed=args.seed,
@@ -162,6 +164,12 @@ def register(sub) -> None:
                          "sub-second — the tier-1 shape")
     sp.add_argument("--assets", type=int,
                     help="universe size (default: 32 full / 8 smoke)")
+    sp.add_argument("--capacity", type=int,
+                    help="ring capacity in bars (default: 3/4 of the "
+                         "log, floored at the serve window — the ring "
+                         "WRAPS by default so the window-slide "
+                         "reconcile path is always exercised; pass "
+                         "capacity == bars for a non-evicting ring)")
     sp.add_argument("--bars", type=int,
                     help="bars in the day (default: 96 full / 32 smoke)")
     sp.add_argument("--chaos", metavar="PLAN",

@@ -137,13 +137,13 @@ def _pool_self_probe(router) -> list:
     demonstrated-ready claim.  Returns the failed probes (empty = ok)."""
     import numpy as np
 
-    from csmom_tpu.serve.buckets import ENDPOINTS
+    from csmom_tpu.registry import serve_endpoints
 
     spec = router.spec
     A = spec.asset_buckets[0]
     rng = np.random.default_rng(0)
     probes = []
-    for kind in ENDPOINTS:
+    for kind in serve_endpoints():
         v = 100.0 * np.exp(np.cumsum(
             rng.normal(0, 0.03, (A, spec.months)), axis=1))
         probes.append(router.submit(kind, v.astype(np.float32),
@@ -210,12 +210,12 @@ def _cmd_serve_pool(args) -> int:
 
 
 def _print_ready(svc) -> None:
-    from csmom_tpu.serve.buckets import ENDPOINTS
+    from csmom_tpu.registry import serve_endpoints
 
     spec = svc.spec
     print(f"signal service ready: engine {svc.engine.name}, bucket "
           f"profile {spec.name}")
-    print(f"  endpoints: {', '.join(ENDPOINTS)}")
+    print(f"  endpoints: {', '.join(serve_endpoints())}")
     print(f"  buckets: B({','.join(map(str, spec.batch_buckets))}) x "
           f"A({','.join(map(str, spec.asset_buckets))}) x {spec.months} "
           f"months ({spec.dtype})")
@@ -230,7 +230,7 @@ def cmd_serve(args) -> int:
     pool (``--workers N``)."""
     import numpy as np
 
-    from csmom_tpu.serve.buckets import ENDPOINTS
+    from csmom_tpu.registry import serve_endpoints
 
     if args.workers > 0:
         return _cmd_serve_pool(args)
@@ -248,7 +248,7 @@ def cmd_serve(args) -> int:
     A = spec.asset_buckets[0]
     rng = np.random.default_rng(0)
     probes = []
-    for kind in ENDPOINTS:
+    for kind in serve_endpoints():
         v = 100.0 * np.exp(np.cumsum(
             rng.normal(0, 0.03, (A, spec.months)), axis=1))
         probes.append(svc.submit(kind, v.astype(np.float32),

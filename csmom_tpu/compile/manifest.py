@@ -6,7 +6,7 @@ compiles so a later process — a bench child inside a tunnel window, a
 CLI invocation — finds every hot shape already serialized in the
 persistent executable cache (``utils.jit_cache``).
 
-Two properties keep it honest:
+Three properties keep it honest:
 
 - **no drift**: every entry is BOUND against its function's real
   signature (``inspect.signature(...).bind``) at validation time, so a
@@ -16,14 +16,19 @@ Two properties keep it honest:
   :mod:`csmom_tpu.compile.workloads` (the same constants bench builds its
   inputs from) and month counts are derived from the same calendar
   generator the packs use — there is no hand-maintained shape table to
-  fall out of sync.
+  fall out of sync;
+- **no per-module profile tables** (ISSUE 9): which engines feed which
+  warmup profile, at which shapes, is declared on the engine's
+  registration (:mod:`csmom_tpu.registry`).  :func:`build_manifest` is a
+  registry QUERY — the per-profile ``if/elif`` dispatch this module used
+  to own is gone, so a newly registered engine (including one registered
+  at runtime) AOT-warms and memory-profiles with no edit here.
 
-Entries cover the hot jitted computations across the engine layers:
-``backtest/grid.py`` (``_jk_grid_backtest`` plain + donated, and
-``_grid_net_core``), ``backtest/monthly.py``'s three jitted kernels,
-``backtest/event.py``'s panel engines (threshold + hysteresis, plain +
-donated), ``parallel/histrank.py``'s histogram rank, and
-``parallel/online_ridge.py``'s time-sharded scan.
+This module keeps the manifest DATA MODEL (:class:`ManifestEntry`) and
+the shape-binding helpers the registered engines build their entries
+from (``grid_entries``/``monthly_entries``/... — given a panel size,
+produce bound entries); the enumeration of who uses them lives in
+:mod:`csmom_tpu.registry.builtin`.
 """
 
 from __future__ import annotations
@@ -35,6 +40,20 @@ from typing import Any, Callable, Mapping
 import numpy as np
 
 from csmom_tpu.compile import workloads as wl
+
+__all__ = [
+    "ManifestEntry",
+    "build_manifest",
+    "event_entries",
+    "golden_event_entries",
+    "grid_entries",
+    "grid_net_entry",
+    "histrank_entry",
+    "monthly_entries",
+    "months_of",
+    "online_ridge_entry",
+    "sds",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -75,21 +94,27 @@ class ManifestEntry:
         return ", ".join(parts)
 
 
-def _sds(shape, dtype):
+def sds(shape, dtype):
+    """A ``jax.ShapeDtypeStruct`` leaf (the manifest's abstract array)."""
     import jax
 
     return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
 
 
-def _grid_entries(A: int, M: int, dtype, *, modes_impls, tag: str,
-                  donated: bool = False) -> list[ManifestEntry]:
+# ---------------------------------------------------------------------------
+# shape-binding helpers: given ONE panel size, produce bound entries.
+# The registry's builtin specs call these with their declared shapes.
+# ---------------------------------------------------------------------------
+
+def grid_entries(A: int, M: int, dtype, *, modes_impls, tag: str,
+                 donated: bool = False) -> list[ManifestEntry]:
     """Grid scalar entries (the bench hot path) at one panel size, plus —
     when ``donated`` — the donated full-result grid entry point."""
     from csmom_tpu.backtest.grid import _jk_grid_backtest_donated
     from csmom_tpu.compile.entries import grid_scalar_fn
 
-    p = _sds((A, M), dtype)
-    m = _sds((A, M), bool)
+    p = sds((A, M), dtype)
+    m = sds((A, M), bool)
     out = [
         ManifestEntry(
             name=f"grid.jk16.{mode}.{impl}@{tag}",
@@ -103,15 +128,15 @@ def _grid_entries(A: int, M: int, dtype, *, modes_impls, tag: str,
         out.append(ManifestEntry(
             name=f"grid.jk16.rank.xla.donated@{tag}",
             fn=_jk_grid_backtest_donated,
-            args=(p, m, _sds((len(wl.GRID_JS),), idx),
-                  _sds((len(wl.GRID_KS),), idx), wl.GRID_SKIP),
+            args=(p, m, sds((len(wl.GRID_JS),), idx),
+                  sds((len(wl.GRID_KS),), idx), wl.GRID_SKIP),
             kwargs=dict(n_bins=10, mode="rank", max_hold=max(wl.GRID_KS),
                         freq=12, impl="xla"),
         ))
     return out
 
 
-def _monthly_entries(A: int, M: int, dtype, tag: str) -> list[ManifestEntry]:
+def monthly_entries(A: int, M: int, dtype, tag: str) -> list[ManifestEntry]:
     """The three jitted monthly kernels at the golden monthly panel size."""
     from csmom_tpu.backtest.monthly import (
         monthly_spread_backtest,
@@ -119,8 +144,8 @@ def _monthly_entries(A: int, M: int, dtype, tag: str) -> list[ManifestEntry]:
         sector_neutral_backtest,
     )
 
-    p = _sds((A, M), dtype)
-    m = _sds((A, M), bool)
+    p = sds((A, M), dtype)
+    m = sds((A, M), bool)
     i32 = np.int32
     return [
         ManifestEntry(
@@ -132,21 +157,21 @@ def _monthly_entries(A: int, M: int, dtype, tag: str) -> list[ManifestEntry]:
         ManifestEntry(
             name=f"monthly.sector_neutral@{tag}",
             fn=sector_neutral_backtest,
-            args=(p, m, _sds((A,), i32)),
+            args=(p, m, sds((A,), i32)),
             kwargs=dict(n_sectors=5, lookback=12, skip=1, n_bins=10,
                         mode="qcut"),
         ),
         ManifestEntry(
             name=f"monthly.net_of_costs@{tag}",
             fn=net_of_costs_arrays,
-            args=(_sds((A, M), i32), _sds((10, M), i32), _sds((M,), dtype),
-                  _sds((M,), bool), 0.0005),
+            args=(sds((A, M), i32), sds((10, M), i32), sds((M,), dtype),
+                  sds((M,), bool), 0.0005),
             kwargs=dict(n_bins=10),
         ),
     ]
 
 
-def _grid_net_entry(A: int, M: int, dtype, tag: str) -> ManifestEntry:
+def grid_net_entry(A: int, M: int, dtype, tag: str) -> ManifestEntry:
     """``_grid_net_core`` (the CLI --tc-bps netting pass) at the grid size."""
     from csmom_tpu.backtest.grid import _grid_net_core
 
@@ -155,14 +180,14 @@ def _grid_net_entry(A: int, M: int, dtype, tag: str) -> ManifestEntry:
     return ManifestEntry(
         name=f"grid.net_core@{tag}",
         fn=_grid_net_core,
-        args=(_sds((A, M), dtype), _sds((A, M), bool), _sds((nJ,), idx),
-              _sds((nJ, nK, M), dtype), _sds((nJ, nK, M), bool), 1.0),
+        args=(sds((A, M), dtype), sds((A, M), bool), sds((nJ,), idx),
+              sds((nJ, nK, M), dtype), sds((nJ, nK, M), bool), 1.0),
         kwargs=dict(Ks_c=wl.GRID_KS, skip=wl.GRID_SKIP, n_bins=10,
                     mode="rank", freq=12),
     )
 
 
-def _event_entries(A: int, T: int, dtype, tag: str) -> list[ManifestEntry]:
+def event_entries(A: int, T: int, dtype, tag: str) -> list[ManifestEntry]:
     """The event panel engines (threshold plain + donated, hysteresis) at
     one minute-panel size."""
     from csmom_tpu.backtest.event import (
@@ -171,11 +196,11 @@ def _event_entries(A: int, T: int, dtype, tag: str) -> list[ManifestEntry]:
         event_backtest_donated,
     )
 
-    p = _sds((A, T), dtype)
-    v = _sds((A, T), bool)
-    s = _sds((A, T), dtype)
-    a = _sds((A,), dtype)
-    vo = _sds((A,), dtype)
+    p = sds((A, T), dtype)
+    v = sds((A, T), bool)
+    s = sds((A, T), dtype)
+    a = sds((A,), dtype)
+    vo = sds((A,), dtype)
     return [
         ManifestEntry(name=f"event.threshold@{tag}", fn=event_backtest,
                       args=(p, v, s, a, vo)),
@@ -188,17 +213,18 @@ def _event_entries(A: int, T: int, dtype, tag: str) -> list[ManifestEntry]:
     ]
 
 
-def _histrank_entry(A: int, M: int, dtype, tag: str) -> ManifestEntry:
+def histrank_entry(A: int, M: int, dtype, tag: str) -> ManifestEntry:
     from csmom_tpu.compile.entries import histrank_labels_fn
 
     return ManifestEntry(
         name=f"parallel.histrank@{tag}",
         fn=histrank_labels_fn(10),
-        args=(_sds((A, M), dtype), _sds((A, M), bool)),
+        args=(sds((A, M), dtype), sds((A, M), bool)),
     )
 
 
-def _online_ridge_entry(R: int, A: int, F: int, dtype, tag: str) -> ManifestEntry:
+def online_ridge_entry(R: int, A: int, F: int, dtype,
+                       tag: str) -> ManifestEntry:
     """The time-sharded online-ridge scan on a 1-device mesh (the warmup
     process may not have the test tier's 8 virtual devices; the scan's
     compiled structure is shard-count-generic)."""
@@ -212,7 +238,7 @@ def _online_ridge_entry(R: int, A: int, F: int, dtype, tag: str) -> ManifestEntr
     return ManifestEntry(
         name=f"parallel.online_ridge@{tag}",
         fn=fn,
-        args=(_sds((R, A, F), dtype), _sds((R, A), dtype), _sds((R, A), dtype)),
+        args=(sds((R, A, F), dtype), sds((R, A), dtype), sds((R, A), dtype)),
     )
 
 
@@ -221,167 +247,34 @@ def _online_ridge_entry(R: int, A: int, F: int, dtype, tag: str) -> ManifestEntr
 _MONTH_CACHE: dict[int, int] = {}
 
 
-def _months(T: int) -> int:
+def months_of(T: int) -> int:
     if T not in _MONTH_CACHE:
         _MONTH_CACHE[T] = wl.months_in_days(T)
     return _MONTH_CACHE[T]
 
 
-def _serve_entries(profile: str, dtype=None) -> list[ManifestEntry]:
-    """The serve bucket grid: every (endpoint, batch, assets) shape the
-    signal service may dispatch (:mod:`csmom_tpu.serve.buckets`).
-
-    The entries wrap the SAME ``lru_cache``-shared jitted callables the
-    live service dispatches (``serve.engine.serve_entry_fn`` at the
-    ``ServeConfig`` defaults), so ``csmom warmup --profiles serve``
-    AOT-persists byte-identical HLO and a restarted service loads every
-    bucket executable from disk instead of compiling at startup."""
-    from csmom_tpu.serve.buckets import ENDPOINTS, bucket_spec
-    from csmom_tpu.serve.engine import serve_entry_fn
-    from csmom_tpu.serve.service import ServeConfig
-
-    spec = bucket_spec(profile)
-    dt = np.dtype(dtype or spec.dtype)
-    cfg = ServeConfig()  # the single source of the service's signal params
-    out = []
-    for kind in ENDPOINTS:
-        fn = serve_entry_fn(kind, cfg.lookback, cfg.skip, cfg.n_bins,
-                            cfg.mode)
-        for B, A, M in spec.shapes():
-            out.append(ManifestEntry(
-                name=f"serve.{kind}.b{B}@{A}x{M}",
-                fn=fn,
-                args=(_sds((B, A, M), dt), _sds((B, A, M), bool)),
-            ))
-    return out
-
-
-def _stream_entries(profile: str, dtype=None) -> list[ManifestEntry]:
-    """The event-time replay's on-device reconciliation entries: the
-    REAL jitted ``signals`` engines (momentum + turnover) at the
-    canonical replay panel shapes (:mod:`csmom_tpu.stream.replay` —
-    serve asset buckets x the replay bar count), so a jax-engine
-    replay's periodic full-panel reconciliation dispatches only warmed
-    shapes and the whole window stays zero-compile."""
-    from csmom_tpu.serve.buckets import bucket_spec
-    from csmom_tpu.signals.momentum import momentum
-    from csmom_tpu.signals.turnover import turnover_features
-    from csmom_tpu.stream.replay import (
-        REPLAY_BARS,
-        REPLAY_SMOKE_BARS,
-        ReplayConfig,
-    )
-
-    smoke = profile == "stream-smoke"
-    spec = bucket_spec("serve-smoke" if smoke else "serve")
-    bars = REPLAY_SMOKE_BARS if smoke else REPLAY_BARS
-    cfg = ReplayConfig()  # the single source of the replay signal params
-    dt = np.dtype(dtype or cfg.dtype)
-    out = []
-    for A in spec.asset_buckets:
-        p = _sds((A, bars), dt)
-        m = _sds((A, bars), bool)
-        out.append(ManifestEntry(
-            name=f"stream.momentum@{A}x{bars}",
-            fn=momentum, args=(p, m),
-            kwargs=dict(lookback=cfg.lookback, skip=cfg.skip),
-        ))
-        out.append(ManifestEntry(
-            name=f"stream.turn_avg@{A}x{bars}",
-            fn=turnover_features,
-            args=(p, m, _sds((A,), dt)),
-            kwargs=dict(lookback=cfg.turn_lookback),
-        ))
-    return out
-
-
-PROFILES = ("bench-cpu", "bench-tpu", "golden", "smoke", "serve",
-            "serve-smoke", "stream", "stream-smoke")
-
-
 def build_manifest(profile: str, dtype=None) -> list[ManifestEntry]:
-    """Manifest entries for one warmup profile.
+    """Manifest entries for one warmup profile — a registry query.
 
-    Profiles:
-
-    - ``"bench-cpu"``: every shape a CPU bench child compiles
-      unconditionally or budget-permitting — the golden event panel, the
-      reduced 512-stock grid (rank/qcut/matmul + donated), the full
-      north-star-size grid legs (rank xla/matmul), and the netting core.
-      f64 (bench enables x64 on CPU).
-    - ``"bench-tpu"``: the accelerator child's shapes — golden event
-      (+32-wide batched), the north-star grid in every impl, netting
-      core.  f32.
-    - ``"golden"``: the CLI-facing reference-scale kernels — monthly
-      spread / sector-neutral / net-of-costs at the 20-ticker monthly
-      panel, histrank, online ridge.
-    - ``"smoke"``: tiny shapes of every entry kind — the test tier's
-      profile (fast to compile, exercises every manifest code path).
-    - ``"serve"`` / ``"serve-smoke"``: the signal service's bucket grids
-      (``csmom_tpu.serve.buckets``) — every (endpoint, batch, assets)
-      shape a micro-batch dispatch may take, at the service's own jitted
-      entries.  f32 (the serve compute dtype).
-    - ``"stream"`` / ``"stream-smoke"``: the event-time replay's
-      on-device reconciliation entries — the jitted ``signals`` engines
-      at the canonical replay panel shapes.  f32.
-
+    The profile's contents are whatever the registered engines declared
+    (:mod:`csmom_tpu.registry.builtin` for the builtins): the bench grid
+    shapes, the golden/smoke kernels, the serve bucket grid generated
+    from the live endpoint registry, the stream reconcile entries.
     ``dtype`` overrides the profile's default float dtype.
     """
-    if profile == "bench-cpu":
-        dt = np.dtype(dtype or np.float64)
-        A_r, T_r = wl.REDUCED_GRID
-        A_f, T_f = wl.NORTH_STAR_GRID
-        M_r, M_f = _months(T_r), _months(T_f)
-        entries = _grid_entries(
-            A_r, M_r, dt, tag=f"{A_r}x{M_r}", donated=True,
-            modes_impls=[("rank", "xla"), ("qcut", "xla"), ("rank", "matmul")],
-        )
-        entries += _grid_entries(
-            A_f, M_f, dt, tag=f"{A_f}x{M_f}",
-            modes_impls=[("rank", "xla"), ("rank", "matmul")],
-        )
-        entries.append(_grid_net_entry(A_r, M_r, dt, tag=f"{A_r}x{M_r}"))
-        return entries
-    if profile == "bench-tpu":
-        dt = np.dtype(dtype or np.float32)
-        A_f, T_f = wl.NORTH_STAR_GRID
-        M_f = _months(T_f)
-        entries = _grid_entries(
-            A_f, M_f, dt, tag=f"{A_f}x{M_f}", donated=True,
-            modes_impls=[("rank", "xla"), ("qcut", "xla"), ("rank", "matmul"),
-                         ("rank", "matmul_bf16"), ("rank", "pallas")],
-        )
-        entries.append(_grid_net_entry(A_f, M_f, dt, tag=f"{A_f}x{M_f}"))
-        return entries
-    if profile == "golden":
-        dt = np.dtype(dtype or np.float64)
-        A, M = 20, 60  # the 20-ticker demo universe, ~5y of months
-        entries = _monthly_entries(A, M, dt, tag=f"{A}x{M}")
-        entries.append(_histrank_entry(4096, 120, np.float32, tag="4096x120"))
-        entries.append(_online_ridge_entry(64, 8, 4, dt, tag="64x8x4"))
-        return entries
-    if profile == "smoke":
-        dt = np.dtype(dtype or np.float64)
-        entries = _grid_entries(
-            16, 48, dt, tag="16x48", donated=True,
-            modes_impls=[("rank", "xla")],
-        )
-        entries += _monthly_entries(8, 24, dt, tag="8x24")
-        entries.append(_grid_net_entry(16, 48, dt, tag="16x48"))
-        entries += _event_entries(4, 32, dt, tag="4x32")
-        entries.append(_histrank_entry(32, 6, np.float32, tag="32x6"))
-        entries.append(_online_ridge_entry(12, 3, 2, dt, tag="12x3x2"))
-        return entries
-    if profile in ("serve", "serve-smoke"):
-        # the online workload's closed shape world: warm it before
-        # starting a service and the request path never compiles
-        return _serve_entries(profile, dtype)
-    if profile in ("stream", "stream-smoke"):
-        # the replay reconciliation's closed shape world (ISSUE 7): warm
-        # it (with the matching serve profile) before a jax-engine
-        # replay and the whole window stays zero-compile
-        return _stream_entries(profile, dtype)
-    raise ValueError(f"unknown warmup profile {profile!r}: use one of {PROFILES}")
+    from csmom_tpu.registry import manifest_entries
+
+    return manifest_entries(profile, dtype)
+
+
+def __getattr__(name: str):
+    if name == "PROFILES":
+        # derived from the registry, not a literal: the set of profiles
+        # is exactly what registered engines declared
+        from csmom_tpu.registry import manifest_profiles
+
+        return manifest_profiles()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def golden_event_entries(dtype, batch: int | None = None) -> list[ManifestEntry]:
@@ -404,14 +297,14 @@ def golden_event_entries(dtype, batch: int | None = None) -> list[ManifestEntry]
     price, valid, score, adv, vol, _ = wl.golden_event_inputs(np.dtype(dtype))
     A, T = price.shape
     dt = np.dtype(dtype)
-    entries = _event_entries(A, T, dt, tag=f"golden{A}x{T}")
+    entries = event_entries(A, T, dt, tag=f"golden{A}x{T}")
     if batch:
-        p = _sds((A, T), dt)
-        v = _sds((A, T), bool)
+        p = sds((A, T), dt)
+        v = sds((A, T), bool)
         entries.append(ManifestEntry(
             name=f"event.batched{batch}@golden{A}x{T}",
             fn=batched_event_fn(batch),
-            args=(p, v, _sds((batch, A, T), dt), _sds((A,), dt),
-                  _sds((A,), dt)),
+            args=(p, v, sds((batch, A, T), dt), sds((A,), dt),
+                  sds((A,), dt)),
         ))
     return entries

@@ -367,6 +367,27 @@ def _serve_rows(obj: dict, run: str, num: int, variant,
                 rows.append(Row(metric=f"serve_{name}_p99_ms", value=pv,
                                 unit="ms", direction="lower", flags=flags,
                                 **base))
+    # v3 (ISSUE 9): per-ENDPOINT rows.  Metric keys derive from the
+    # artifact's endpoint names — which the schema validator pins to the
+    # engine registry — so a newly registered endpoint lands its own
+    # ledger trajectory (serve_ep_<name>_p99_ms gates; served rides as
+    # info) with no edit here.
+    endpoints = obj.get("endpoints")
+    if isinstance(endpoints, dict):
+        for name, book in sorted(endpoints.items()):
+            if not isinstance(book, dict):
+                continue
+            pv = _num((book.get("latency_ms") or {}).get("p99"))
+            if pv is not None:
+                rows.append(Row(metric=f"serve_ep_{name}_p99_ms", value=pv,
+                                unit="ms", direction="lower", flags=flags,
+                                **base))
+            sv = _num(book.get("served"))
+            if sv is not None:
+                rows.append(Row(metric=f"serve_ep_{name}_served", value=sv,
+                                unit="req", direction="higher",
+                                flags=_flags(obj, variant, info=True),
+                                **base))
     fc = _num((obj.get("compile") or {}).get("in_window_fresh_compiles"))
     if fc is not None:
         rows.append(Row(metric="serve_in_window_fresh_compiles", value=fc,

@@ -1,0 +1,107 @@
+"""csmom_tpu.registry — register an engine once, get every surface.
+
+Public query API (each call loads the builtin registrations on first
+use):
+
+- :func:`serve_endpoints` — the serving tier's endpoint names (what
+  ``serve/buckets.py::ENDPOINTS`` used to hard-code);
+- :func:`serve_surface` — one endpoint's :class:`ServeSurface` (batch /
+  stub factories, output shape, synthetic panel family);
+- :func:`workload_kinds` — the loadgen endpoint mix (surface (d));
+- :func:`manifest_entries` / :func:`manifest_profiles` — the warmup
+  shape manifest (surface (a); ``compile/manifest.py`` builds from
+  these);
+- :func:`entry_factory` — the raw ``lru_cache``-shared jitted-entry
+  factory (what ``bench.py`` fetches);
+- :func:`get_engine` / :func:`engine_specs` — spec access (donated
+  variants, the sharded hook, descriptions for ``csmom registry
+  list``);
+- :func:`strategies` — the Strategy plugin zoo (forces the builtin
+  strategy module import, which is where strategy registration
+  happens);
+- :func:`register_engine` / :func:`unregister_engine` — runtime
+  registration (plugins, tests).
+
+See :mod:`csmom_tpu.registry.core` for the model and
+:mod:`csmom_tpu.registry.builtin` for what ships registered.
+"""
+
+from __future__ import annotations
+
+from csmom_tpu.registry.core import (
+    REGISTRY,
+    EngineRegistry,
+    EngineSpec,
+    ServeSurface,
+    ensure_builtin,
+    register_engine,
+)
+
+__all__ = [
+    "EngineRegistry",
+    "EngineSpec",
+    "REGISTRY",
+    "ServeSurface",
+    "engine_specs",
+    "entry_factory",
+    "get_engine",
+    "manifest_entries",
+    "manifest_profiles",
+    "register_engine",
+    "serve_endpoints",
+    "serve_surface",
+    "strategies",
+    "unregister_engine",
+    "workload_kinds",
+]
+
+
+def serve_endpoints() -> tuple:
+    return ensure_builtin().serve_endpoints()
+
+
+def serve_surface(name: str) -> ServeSurface:
+    return ensure_builtin().serve_surface(name)
+
+
+def workload_kinds() -> tuple:
+    return ensure_builtin().workload_kinds()
+
+
+def manifest_profiles() -> tuple:
+    return ensure_builtin().manifest_profiles()
+
+
+def manifest_entries(profile: str, dtype=None) -> list:
+    return ensure_builtin().manifest_entries(profile, dtype)
+
+
+def get_engine(name: str, kind: str | None = None) -> EngineSpec:
+    return ensure_builtin().get(name, kind)
+
+
+def engine_specs(kind: str | None = None) -> tuple:
+    return ensure_builtin().specs(kind)
+
+
+def entry_factory(name: str):
+    """The engine's raw jitted-entry factory (``lru_cache``-shared, so
+    every caller in one process gets one callable and every caller
+    across processes lowers identical HLO)."""
+    spec = ensure_builtin().get(name, kind="compile")
+    if spec.entry_fn is None:
+        raise KeyError(f"engine {name!r} declares no entry factory")
+    return spec.entry_fn
+
+
+def strategies() -> dict:
+    """name -> Strategy class; importing the builtin strategy zoo is
+    what registers it (strategy modules import jax, so this is the one
+    query that is not jax-free)."""
+    import csmom_tpu.strategy.builtin  # noqa: F401  (registers the zoo)
+
+    return ensure_builtin().strategies()
+
+
+def unregister_engine(name: str, kind: str | None = None) -> None:
+    ensure_builtin().unregister(name, kind)

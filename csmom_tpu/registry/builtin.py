@@ -1,0 +1,565 @@
+"""Builtin engine registrations — the one table the old four lists fed.
+
+Everything that used to be enumerated per-module lands here, attached
+to :data:`csmom_tpu.registry.core.REGISTRY`:
+
+- the **serve endpoints** (``serve/buckets.py`` used to hard-code
+  ``("momentum", "turnover", "backtest")``; registration now also ships
+  the previously research-only ``low_volatility`` and ``zscore_combo``
+  strategies as live endpoints — the tentpole's point: a new endpoint
+  is one registration, not four edits);
+- the **compile entries** (the grid/event/monthly/histrank/online-ridge
+  shape tables that used to be ``compile/manifest.py``'s per-profile
+  ``if/elif`` dispatch), each engine declaring its own canonical shapes
+  per warmup profile;
+- the **serve/stream manifest feeders**, which generate their entries by
+  iterating the registry AT CALL TIME — so an engine registered later
+  (a plugin, a test's toy engine) appears in ``csmom warmup --profiles
+  serve`` with no edit here.
+
+jax stays inside factories; numpy inside the stub builders.  Importing
+this module costs registrations only, which is what lets the jax-free
+consumers (invariants, health fingerprints, the fast rehearse tier)
+query endpoint names cheaply.
+"""
+
+from __future__ import annotations
+
+from csmom_tpu.registry.core import REGISTRY, EngineSpec, ServeSurface
+
+# ---------------------------------------------------------------------------
+# serve endpoint factories: batch_fn(params) -> one(values[A,M], mask[A,M])
+# (jax; the engine vmaps+jits), stub_fn(params) -> fn(values[B,A,M], mask)
+# (numpy; the plumbing/rehearse engine).
+# ---------------------------------------------------------------------------
+
+# days constant the turnover stub shares with signals.turnover's ADV proxy
+_TRADING_DAYS_PER_MONTH = 21.0
+
+
+def _np():
+    import numpy as np
+
+    return np
+
+
+def _nanmean(a, axis: int):
+    """All-NaN-slice-safe nanmean (np.nanmean warns on empty slices; a
+    padded stub batch is full of them by design)."""
+    np = _np()
+    ok = np.isfinite(a)
+    c = ok.sum(axis=axis)
+    s = np.where(ok, a, 0.0).sum(axis=axis)
+    return np.where(c > 0, s / np.maximum(c, 1), np.nan)
+
+
+def _xs_z_np(score, valid):
+    """Cross-sectional z-score over the asset axis of f[B, A] (the stub
+    mirror of ``strategy.base.xs_zscore`` at the last formation date)."""
+    np = _np()
+    v = valid & np.isfinite(score)
+    n = np.maximum(v.sum(axis=1, keepdims=True), 1)
+    x = np.where(v, np.nan_to_num(score), 0.0)
+    mu = x.sum(axis=1, keepdims=True) / n
+    sd = np.sqrt(np.where(v, (x - mu) ** 2, 0.0).sum(axis=1,
+                                                     keepdims=True) / n)
+    z = np.where(sd > 0, (x - mu) / np.where(sd == 0, 1.0, sd), 0.0)
+    return np.where(v, z, 0.0)
+
+
+def _momentum_batch(params):
+    import jax.numpy as jnp
+
+    from csmom_tpu.signals.momentum import momentum
+
+    lookback, skip = params["lookback"], params["skip"]
+
+    def one(values, mask):
+        mom, ok = momentum(values, mask, lookback=lookback, skip=skip)
+        return jnp.where(ok[:, -1], mom[:, -1], jnp.nan)
+
+    return one
+
+
+def _momentum_stub(params):
+    lookback, skip = params["lookback"], params["skip"]
+    np = _np()
+
+    def fn(values, mask):
+        v = np.where(mask, values, np.nan)
+        end = v[:, :, -1 - skip]
+        start = v[:, :, -1 - skip - lookback]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return end / start - 1.0
+
+    return fn
+
+
+def _turnover_batch(params):
+    import jax.numpy as jnp
+
+    from csmom_tpu.signals.turnover import turnover_features
+
+    lookback = params["lookback"]
+
+    def one(values, mask):
+        shares = jnp.ones((values.shape[0],), values.dtype)
+        turn, ok = turnover_features(
+            values, mask, shares, lookback=lookback)["turn_avg"]
+        return jnp.where(ok[:, -1], turn[:, -1], jnp.nan)
+
+    return one
+
+
+def _turnover_stub(params):
+    lookback = params["lookback"]
+    np = _np()
+
+    def fn(values, mask):
+        v = np.where(mask, values, np.nan)
+        return (_nanmean(v[:, :, -lookback:], -1)
+                / _TRADING_DAYS_PER_MONTH)
+
+    return fn
+
+
+def _backtest_batch(params):
+    import jax.numpy as jnp
+
+    from csmom_tpu.backtest.monthly import monthly_spread_backtest
+
+    lookback, skip = params["lookback"], params["skip"]
+    n_bins, mode = params["n_bins"], params["mode"]
+
+    def one(values, mask):
+        res = monthly_spread_backtest(
+            values, mask, lookback=lookback, skip=skip, n_bins=n_bins,
+            mode=mode)
+        return jnp.stack([res.mean_spread, res.ann_sharpe])
+
+    return one
+
+
+def _backtest_stub(params):
+    np = _np()
+
+    def fn(values, mask):
+        v = np.where(mask, values, np.nan)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ret = v[:, :, 1:] / v[:, :, :-1] - 1.0
+        mean = _nanmean(_nanmean(ret, 1), -1)
+        return np.stack([np.nan_to_num(mean), np.zeros_like(mean)], axis=-1)
+
+    return fn
+
+
+def _strategy_last_column(make_strategy_instance):
+    """The generic strategy -> serve-endpoint adapter: score the panel
+    through ``Strategy.signal`` and serve the LAST formation column —
+    exactly what a live scoring request wants from a research signal.
+    The strategy instance is built once per (endpoint, params) and rides
+    as a jit-static closure, so each parametrization compiles once."""
+
+    def batch(params):
+        import jax.numpy as jnp
+
+        strat = make_strategy_instance(params)
+
+        def one(values, mask):
+            score, valid = strat.signal(values, mask)
+            return jnp.where(valid[:, -1], score[:, -1], jnp.nan)
+
+        return one
+
+    return batch
+
+
+def _low_volatility_stub(params):
+    np = _np()
+    window = 36  # the registered endpoint's canonical LowVolatility()
+
+    def fn(values, mask):
+        v = np.where(mask, values, np.nan)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ret = v[:, :, 1:] / v[:, :, :-1] - 1.0
+        w = ret[:, :, -window:]
+        ok = np.isfinite(w)
+        n = ok.sum(-1)
+        x = np.where(ok, w, 0.0)
+        mean = x.sum(-1) / np.maximum(n, 1)
+        var = (np.where(ok, (x - mean[..., None]) ** 2, 0.0).sum(-1)
+               / np.maximum(n - 1, 1))
+        return np.where(n >= 2, -np.sqrt(var), np.nan)
+
+    return fn
+
+
+def _zscore_combo_stub(params):
+    np = _np()
+    mom_stub = _momentum_stub(params)
+
+    def fn(values, mask):
+        v = np.where(mask, values, np.nan)
+        mom = mom_stub(values, mask)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            rev = -(v[:, :, -1] / v[:, :, -2] - 1.0)
+        valid = np.isfinite(mom) & np.isfinite(rev)
+        z = 0.5 * _xs_z_np(mom, valid) + 0.5 * _xs_z_np(rev, valid)
+        return np.where(valid, z, np.nan)
+
+    return fn
+
+
+def _mk_low_volatility(params):
+    from csmom_tpu.strategy.builtin import LowVolatility
+
+    return LowVolatility()
+
+
+def _mk_zscore_combo(params):
+    from csmom_tpu.strategy.builtin import ZScoreCombo
+
+    # the canonical live combo: equal-weight momentum + short-term
+    # reversal, both z-scored per date (prices-only components, so the
+    # serve panel pair is all it needs)
+    return ZScoreCombo("momentum:0.5,reversal:0.5")
+
+
+REGISTRY.register(EngineSpec(
+    name="momentum", kind="serve",
+    description="compounded (J, skip) price momentum at the last "
+                "formation date (the reference's signal)",
+    axes="values f[B,A,M] month-end prices, mask bool[B,A,M] -> f[B,A]",
+    serve=ServeSurface(batch_fn=_momentum_batch, stub_fn=_momentum_stub,
+                       panel_family="price"),
+))
+
+REGISTRY.register(EngineSpec(
+    name="turnover", kind="serve",
+    description="trailing-lookback average turnover proxy (monthly "
+                "share volume / ADV denominator)",
+    axes="values f[B,A,M] monthly volumes, mask bool[B,A,M] -> f[B,A]",
+    serve=ServeSurface(batch_fn=_turnover_batch, stub_fn=_turnover_stub,
+                       panel_family="volume"),
+))
+
+REGISTRY.register(EngineSpec(
+    name="backtest", kind="serve",
+    description="full monthly decile spread backtest per request panel "
+                "-> (mean_spread, ann_sharpe)",
+    axes="values f[B,A,M], mask bool[B,A,M] -> f[B,2]",
+    serve=ServeSurface(batch_fn=_backtest_batch, stub_fn=_backtest_stub,
+                       output="summary",
+                       summary_fields=("mean_spread", "ann_sharpe"),
+                       panel_family="price"),
+))
+
+REGISTRY.register(EngineSpec(
+    name="low_volatility", kind="serve",
+    description="Blitz-van Vliet volatility effect: negated trailing "
+                "36m return volatility (research-only until ISSUE 9; "
+                "now a live endpoint via the strategy adapter)",
+    axes="values f[B,A,M] month-end prices, mask bool[B,A,M] -> f[B,A]",
+    serve=ServeSurface(
+        batch_fn=_strategy_last_column(_mk_low_volatility),
+        stub_fn=_low_volatility_stub, panel_family="price"),
+))
+
+REGISTRY.register(EngineSpec(
+    name="zscore_combo", kind="serve",
+    description="equal-weight z-scored momentum + short-term reversal "
+                "combo (research-only until ISSUE 9; now a live "
+                "endpoint via the strategy adapter)",
+    axes="values f[B,A,M] month-end prices, mask bool[B,A,M] -> f[B,A]",
+    serve=ServeSurface(
+        batch_fn=_strategy_last_column(_mk_zscore_combo),
+        stub_fn=_zscore_combo_stub, panel_family="price"),
+))
+
+
+# ---------------------------------------------------------------------------
+# compile entries: the per-profile shape tables that used to live as
+# compile/manifest.py's if/elif dispatch.  Each engine declares its own
+# shapes; REGISTRY.manifest_entries(profile) aggregates them.
+# ---------------------------------------------------------------------------
+
+def _dt(profile: str, dtype):
+    """The profile's default float dtype (bench policy: f64 on CPU
+    profiles, f32 on accelerator-shaped ones), overridable."""
+    import numpy as np
+
+    if dtype is not None:
+        return np.dtype(dtype)
+    return np.dtype(np.float32 if profile == "bench-tpu" else np.float64)
+
+
+def _manifest_mod():
+    from csmom_tpu.compile import manifest as m
+
+    return m
+
+
+def _grid_manifest(profile: str, dtype) -> list:
+    from csmom_tpu.compile import workloads as wl
+
+    m = _manifest_mod()
+    dt = _dt(profile, dtype)
+    A_r, T_r = wl.REDUCED_GRID
+    A_f, T_f = wl.NORTH_STAR_GRID
+    if profile == "bench-cpu":
+        M_r, M_f = m.months_of(T_r), m.months_of(T_f)
+        entries = m.grid_entries(
+            A_r, M_r, dt, tag=f"{A_r}x{M_r}", donated=True,
+            modes_impls=[("rank", "xla"), ("qcut", "xla"),
+                         ("rank", "matmul")],
+        )
+        entries += m.grid_entries(
+            A_f, M_f, dt, tag=f"{A_f}x{M_f}",
+            modes_impls=[("rank", "xla"), ("rank", "matmul")],
+        )
+        return entries
+    if profile == "bench-tpu":
+        M_f = m.months_of(T_f)
+        return m.grid_entries(
+            A_f, M_f, dt, tag=f"{A_f}x{M_f}", donated=True,
+            modes_impls=[("rank", "xla"), ("qcut", "xla"),
+                         ("rank", "matmul"), ("rank", "matmul_bf16"),
+                         ("rank", "pallas")],
+        )
+    # smoke: tiny shapes, every grid code path
+    return m.grid_entries(16, 48, dt, tag="16x48", donated=True,
+                          modes_impls=[("rank", "xla")])
+
+
+def _grid_net_manifest(profile: str, dtype) -> list:
+    from csmom_tpu.compile import workloads as wl
+
+    m = _manifest_mod()
+    dt = _dt(profile, dtype)
+    if profile == "bench-cpu":
+        A, T = wl.REDUCED_GRID
+    elif profile == "bench-tpu":
+        A, T = wl.NORTH_STAR_GRID
+    else:  # smoke
+        return [m.grid_net_entry(16, 48, dt, tag="16x48")]
+    M = m.months_of(T)
+    return [m.grid_net_entry(A, M, dt, tag=f"{A}x{M}")]
+
+
+def _monthly_manifest(profile: str, dtype) -> list:
+    m = _manifest_mod()
+    dt = _dt(profile, dtype)
+    if profile == "golden":
+        A, M = 20, 60  # the 20-ticker demo universe, ~5y of months
+    else:  # smoke
+        A, M = 8, 24
+    return m.monthly_entries(A, M, dt, tag=f"{A}x{M}")
+
+
+def _event_manifest(profile: str, dtype) -> list:
+    # the golden-shape event entries are data-dependent and resolve via
+    # manifest.golden_event_entries; the smoke profile pins the tiny
+    # fixed-shape coverage of every event code path
+    return _manifest_mod().event_entries(4, 32, _dt(profile, dtype),
+                                         tag="4x32")
+
+
+def _histrank_manifest(profile: str, dtype) -> list:
+    import numpy as np
+
+    m = _manifest_mod()
+    if profile == "golden":
+        return [m.histrank_entry(4096, 120, np.float32, tag="4096x120")]
+    return [m.histrank_entry(32, 6, np.float32, tag="32x6")]
+
+
+def _online_ridge_manifest(profile: str, dtype) -> list:
+    m = _manifest_mod()
+    dt = _dt(profile, dtype)
+    if profile == "golden":
+        return [m.online_ridge_entry(64, 8, 4, dt, tag="64x8x4")]
+    return [m.online_ridge_entry(12, 3, 2, dt, tag="12x3x2")]
+
+
+def _grid_entry_factory(*args, **kwargs):
+    from csmom_tpu.compile.entries import grid_scalar_fn
+
+    return grid_scalar_fn(*args, **kwargs)
+
+
+def _batched_event_factory(*args, **kwargs):
+    from csmom_tpu.compile.entries import batched_event_fn
+
+    return batched_event_fn(*args, **kwargs)
+
+
+def _histrank_factory(*args, **kwargs):
+    from csmom_tpu.compile.entries import histrank_labels_fn
+
+    return histrank_labels_fn(*args, **kwargs)
+
+
+def _grid_donated_factory(**params):
+    from csmom_tpu.backtest.grid import _jk_grid_backtest_donated
+
+    return _jk_grid_backtest_donated
+
+
+def _event_donated_factory(**params):
+    from csmom_tpu.backtest.event import event_backtest_donated
+
+    return event_backtest_donated
+
+
+REGISTRY.register(EngineSpec(
+    name="grid.jk", kind="compile",
+    description="the J x K grid backtest hot entry (in-jit scalar "
+                "reduction; bench's grid legs + donated variant)",
+    axes="prices f[A,M], mask bool[A,M] -> scalar",
+    profiles=("bench-cpu", "bench-tpu", "smoke"),
+    manifest_fn=_grid_manifest,
+    entry_fn=_grid_entry_factory,
+    donated_fn=_grid_donated_factory,
+))
+
+REGISTRY.register(EngineSpec(
+    name="grid.net_core", kind="compile",
+    description="the --tc-bps netting pass over a precomputed grid",
+    axes="prices f[A,M] + per-cell label planes -> net grid",
+    profiles=("bench-cpu", "bench-tpu", "smoke"),
+    manifest_fn=_grid_net_manifest,
+))
+
+REGISTRY.register(EngineSpec(
+    name="monthly.kernels", kind="compile",
+    description="the three jitted monthly kernels (spread, "
+                "sector-neutral, net-of-costs) at the golden panel",
+    axes="prices f[A,M], mask bool[A,M]",
+    profiles=("golden", "smoke"),
+    manifest_fn=_monthly_manifest,
+))
+
+REGISTRY.register(EngineSpec(
+    name="event.panel", kind="compile",
+    description="the event panel engines (threshold plain + donated, "
+                "hysteresis) and the batched vmapped event leg",
+    axes="price/valid/score f[A,T] minute panels",
+    profiles=("smoke",),
+    manifest_fn=_event_manifest,
+    entry_fn=_batched_event_factory,
+    donated_fn=_event_donated_factory,
+))
+
+REGISTRY.register(EngineSpec(
+    name="parallel.histrank", kind="compile",
+    description="sort-free histogram-rank decile labels (collectives "
+                "degenerate to identities on one device)",
+    axes="x f[A,M], valid bool[A,M] -> labels i32[A,M]",
+    profiles=("golden", "smoke"),
+    manifest_fn=_histrank_manifest,
+    entry_fn=_histrank_factory,
+))
+
+REGISTRY.register(EngineSpec(
+    name="parallel.online_ridge", kind="compile",
+    description="time-sharded online-ridge scan on a 1-device mesh",
+    axes="X f[R,A,F], y f[R,A], w f[R,A]",
+    profiles=("golden", "smoke"),
+    manifest_fn=_online_ridge_manifest,
+))
+
+
+# ---------------------------------------------------------------------------
+# serve + stream manifest feeders: entries generated by iterating the
+# registry AT CALL TIME, so a later-registered endpoint (plugin, toy
+# test engine) warms and memory-profiles with no edit here.
+# ---------------------------------------------------------------------------
+
+def serve_profile_entries(profile: str, dtype=None) -> list:
+    """Surface (a) for every servable engine: the serve bucket grid —
+    every (endpoint, batch, assets) shape a micro-batch dispatch may
+    take — wrapping the SAME ``lru_cache``-shared jitted callables the
+    live service dispatches, so ``csmom warmup --profiles serve``
+    AOT-persists byte-identical HLO."""
+    import numpy as np
+
+    from csmom_tpu.compile.manifest import ManifestEntry, sds
+    from csmom_tpu.serve.buckets import bucket_spec
+    from csmom_tpu.serve.engine import serve_entry_fn
+    from csmom_tpu.serve.service import ServeConfig
+
+    spec = bucket_spec(profile)
+    dt = np.dtype(dtype or spec.dtype)
+    cfg = ServeConfig()  # the single source of the service's signal params
+    out = []
+    for kind in REGISTRY.serve_endpoints():
+        fn = serve_entry_fn(kind, cfg.lookback, cfg.skip, cfg.n_bins,
+                            cfg.mode)
+        for B, A, M in spec.shapes():
+            out.append(ManifestEntry(
+                name=f"serve.{kind}.b{B}@{A}x{M}",
+                fn=fn,
+                args=(sds((B, A, M), dt), sds((B, A, M), bool)),
+            ))
+    return out
+
+
+def _stream_manifest(profile: str, dtype=None) -> list:
+    """The event-time replay's on-device reconciliation entries: the
+    REAL jitted ``signals`` engines (momentum + turnover) at the
+    canonical replay panel shapes, so a jax-engine replay's periodic
+    full-panel reconciliation dispatches only warmed shapes."""
+    import numpy as np
+
+    from csmom_tpu.compile.manifest import ManifestEntry, sds
+    from csmom_tpu.serve.buckets import bucket_spec
+    from csmom_tpu.signals.momentum import momentum
+    from csmom_tpu.signals.turnover import turnover_features
+    from csmom_tpu.stream.replay import (
+        REPLAY_BARS,
+        REPLAY_SMOKE_BARS,
+        ReplayConfig,
+    )
+
+    smoke = profile == "stream-smoke"
+    spec = bucket_spec("serve-smoke" if smoke else "serve")
+    bars = REPLAY_SMOKE_BARS if smoke else REPLAY_BARS
+    cfg = ReplayConfig()  # the single source of the replay signal params
+    dt = np.dtype(dtype or cfg.dtype)
+    out = []
+    for A in spec.asset_buckets:
+        p = sds((A, bars), dt)
+        m = sds((A, bars), bool)
+        out.append(ManifestEntry(
+            name=f"stream.momentum@{A}x{bars}",
+            fn=momentum, args=(p, m),
+            kwargs=dict(lookback=cfg.lookback, skip=cfg.skip),
+        ))
+        out.append(ManifestEntry(
+            name=f"stream.turn_avg@{A}x{bars}",
+            fn=turnover_features,
+            args=(p, m, sds((A,), dt)),
+            kwargs=dict(lookback=cfg.turn_lookback),
+        ))
+    return out
+
+
+REGISTRY.register(EngineSpec(
+    name="serve.buckets", kind="compile",
+    description="the serving tier's closed shape world: every "
+                "(endpoint, batch, assets) bucket shape, generated from "
+                "the registry's serve endpoints at call time",
+    axes="values f[B,A,M], mask bool[B,A,M] per endpoint",
+    profiles=("serve", "serve-smoke"),
+    manifest_fn=serve_profile_entries,
+))
+
+REGISTRY.register(EngineSpec(
+    name="stream.signals", kind="compile",
+    description="the replay harness's on-device reconciliation entries "
+                "(jitted momentum/turnover at the canonical replay "
+                "shapes)",
+    axes="prices/volumes f[A,bars], mask bool[A,bars]",
+    profiles=("stream", "stream-smoke"),
+    manifest_fn=_stream_manifest,
+))

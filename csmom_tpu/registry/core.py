@@ -1,0 +1,327 @@
+"""The engine registry: register once, get the whole production surface.
+
+Before this package (ISSUE 9), a computation became a production
+citizen through four hand-maintained enumerations: the ``lru_cache``'d
+jit wrappers in ``compile/entries.py``, the ``ENDPOINTS`` tuple
+``serve/buckets.py`` baked into the serving tier, the loadgen/bench
+workload mixes, and the ``strategy/base.py`` plugin table.  Adding the
+double-sort or low-volatility engine to the serving tier meant an edit
+in every one of them — which is why, three serving rounds in, only the
+original three endpoints were servable.
+
+This module is the single table.  An engine registered once (name,
+callable factory, shape signature, dtype, axis semantics) automatically
+receives every surface the production stack offers:
+
+(a) **shape-manifest entries** — ``csmom warmup`` AOT-compiles and
+    memory-profiles it like the grid/event entries
+    (:func:`EngineRegistry.manifest_entries` is what
+    ``compile/manifest.py`` now builds from);
+(b) **a donated-buffer jit variant** (:meth:`EngineSpec.donated`);
+(c) **a serve endpoint** padded onto the existing shape-bucket grid —
+    zero in-window compiles by construction, because the registry is
+    also what enumerates the warm set;
+(d) **a loadgen workload leg** that lands per-endpoint ledger rows
+    (``serve/loadgen.py`` resolves its endpoint mix here);
+(e) **a declared sharded-variant hook** (:meth:`EngineSpec.sharded`) —
+    stubbed until ROADMAP item 1 fills in the partition rules, so the
+    sharding round needs no new enumeration pass.
+
+Layering: this module is stdlib-only (no numpy, no jax) so the
+jax-free consumers — ``chaos/invariants.py`` validating an artifact's
+endpoint set, ``serve/health.py`` fingerprinting the warm contract,
+the fast rehearse tier — can query names and surfaces without paying
+an accelerator import.  The builtin registrations live in
+:mod:`csmom_tpu.registry.builtin` (loaded lazily on first query) and
+keep jax imports inside their factories for the same reason.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Mapping
+
+__all__ = [
+    "EngineRegistry",
+    "EngineSpec",
+    "REGISTRY",
+    "ServeSurface",
+    "register_engine",
+]
+
+KINDS = ("serve", "compile", "strategy")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeSurface:
+    """What a servable engine contributes to the serving tier.
+
+    ``batch_fn(params)`` returns the per-request scorer
+    ``one(values f[A, M], mask bool[A, M]) -> f[A] | f[len(fields)]``
+    (jax; the serve engine vmaps and jits it into the one-dispatch
+    micro-batch entry).  ``stub_fn(params)`` returns the jax-free numpy
+    mirror over the WHOLE batch ``(values f[B, A, M], mask bool[B, A, M])``
+    — a simplified model, not a parity claim: every stub consumer is
+    testing queue/batcher/chaos plumbing, never signal values.
+
+    ``params`` is the service's engine-identity dict
+    (``lookback``/``skip``/``n_bins``/``mode``); a factory uses what it
+    needs and ignores the rest, exactly like a Strategy ignores panels
+    it does not consume.
+    """
+
+    batch_fn: Callable
+    stub_fn: Callable
+    output: str = "per_asset"       # "per_asset" (f[B, A]) | "summary"
+    summary_fields: tuple = ()      # names of the summary lanes (f[B, len])
+    panel_family: str = "price"     # loadgen synthetic family: price|volume
+
+    def __post_init__(self):
+        if self.output not in ("per_asset", "summary"):
+            raise ValueError(
+                f"output must be 'per_asset' or 'summary', got "
+                f"{self.output!r}")
+        if self.output == "summary" and not self.summary_fields:
+            raise ValueError("a summary endpoint must name its fields")
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineSpec:
+    """One registered engine and everything the stack derives from it.
+
+    ``kind``:
+
+    - ``"serve"`` — a request-path endpoint; ``serve`` (the
+      :class:`ServeSurface`) is required.  Gets surfaces (a)-(e).
+    - ``"compile"`` — an offline hot entry (grid/event/histrank/...);
+      ``manifest_fn(profile, dtype) -> [ManifestEntry]`` declares its
+      canonical shapes for each profile in ``profiles``.
+    - ``"strategy"`` — a :class:`csmom_tpu.strategy.base.Strategy`
+      plugin class (``strategy_cls``); the CLI/config layer's zoo.
+
+    ``entry_fn`` is the raw (``lru_cache``-shared) jitted-entry factory
+    — what ``bench.py`` fetches so bench and warmup keep lowering
+    byte-identical HLO.  ``donated_fn`` is the donated-buffer variant
+    factory; serve engines get an auto-derived one from the engine
+    layer when none is declared.  ``sharded_fn`` is the mesh-variant
+    hook: None means *declared but not yet implemented* —
+    :meth:`sharded` raises a pointed NotImplementedError instead of
+    silently missing, so ROADMAP item 1 fills in partition rules
+    without another enumeration pass.
+    """
+
+    name: str
+    kind: str
+    description: str = ""
+    dtype: str | None = None        # canonical compute dtype, when fixed
+    axes: str | None = None         # axis semantics, e.g. "f[B,A,M] panels"
+    profiles: tuple = ()            # warmup profiles this engine feeds
+    manifest_fn: Callable | None = None
+    entry_fn: Callable | None = None
+    donated_fn: Callable | None = None
+    sharded_fn: Callable | None = None
+    serve: ServeSurface | None = None
+    strategy_cls: type | None = None
+    workload: bool = True           # serve engines default into loadgen
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"kind must be one of {KINDS}, got "
+                             f"{self.kind!r}")
+        if self.kind == "serve" and self.serve is None:
+            raise ValueError(f"serve engine {self.name!r} needs a "
+                             "ServeSurface")
+        if self.kind == "strategy" and self.strategy_cls is None:
+            raise ValueError(f"strategy {self.name!r} needs strategy_cls")
+
+    def donated(self, **params):
+        """The donated-buffer jit variant (surface (b)).
+
+        Serve engines fall back to the engine layer's auto-derived
+        variant (same scorer, input buffers donated) when no explicit
+        ``donated_fn`` was declared.
+        """
+        if self.donated_fn is not None:
+            return self.donated_fn(**params)
+        if self.kind == "serve":
+            from csmom_tpu.serve.engine import serve_entry_fn_donated
+
+            return serve_entry_fn_donated(
+                self.name, params.get("lookback", 12),
+                params.get("skip", 1), params.get("n_bins", 10),
+                params.get("mode", "rank"))
+        raise NotImplementedError(
+            f"engine {self.name!r} declares no donated-buffer variant")
+
+    def sharded(self, *args, **kwargs):
+        """The sharded-variant hook (surface (e)).
+
+        Declared on every engine; implemented by none of the builtins
+        yet.  ROADMAP item 1 supplies ``sharded_fn`` per engine
+        (``match_partition_rules`` over a named mesh — asset-axis for
+        large universes, batch-axis for serve micro-batches); until
+        then the hook refuses loudly instead of pretending.
+        """
+        if self.sharded_fn is None:
+            raise NotImplementedError(
+                f"engine {self.name!r}: sharded variant is declared but "
+                "not yet implemented — ROADMAP item 1 (device-mesh "
+                "sharding) supplies sharded_fn via the partition-rule "
+                "pattern; register the engine with sharded_fn=... to "
+                "fill it in")
+        return self.sharded_fn(*args, **kwargs)
+
+
+class EngineRegistry:
+    """Ordered, thread-safe ``(kind, name)`` -> :class:`EngineSpec` table.
+
+    Keys are namespaced by kind: ``momentum`` the serve endpoint and
+    ``momentum`` the Strategy plugin are different registrations of the
+    same underlying signal family, and each surface queries its own
+    kind — collisions are only an error WITHIN a kind.
+    """
+
+    def __init__(self):
+        self._specs: dict[tuple, EngineSpec] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ mutate --
+
+    def register(self, spec: EngineSpec, replace: bool = False) -> EngineSpec:
+        key = (spec.kind, spec.name)
+        with self._lock:
+            if not replace and key in self._specs \
+                    and self._specs[key] != spec:
+                raise ValueError(
+                    f"{spec.kind} engine {spec.name!r} is already "
+                    "registered; pass replace=True to overwrite "
+                    "deliberately")
+            self._specs[key] = spec
+        return spec
+
+    def unregister(self, name: str, kind: str | None = None) -> None:
+        with self._lock:
+            for key in [k for k in self._specs
+                        if k[1] == name and (kind is None or k[0] == kind)]:
+                self._specs.pop(key, None)
+
+    # ------------------------------------------------------------- query --
+
+    def get(self, name: str, kind: str | None = None) -> EngineSpec:
+        if kind is not None:
+            try:
+                return self._specs[(kind, name)]
+            except KeyError:
+                raise KeyError(
+                    f"unknown {kind} engine {name!r}; registered "
+                    f"{kind} engines: {self.names(kind)}") from None
+        with self._lock:
+            matches = [s for k, s in self._specs.items() if k[1] == name]
+        if not matches:
+            raise KeyError(
+                f"unknown engine {name!r}; registered: "
+                f"{sorted(k[1] for k in self._specs)}")
+        if len(matches) > 1:
+            raise KeyError(
+                f"engine name {name!r} exists in several kinds "
+                f"({sorted(s.kind for s in matches)}); pass kind=")
+        return matches[0]
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return any(k[1] == name for k in self._specs)
+
+    def _snapshot(self) -> list:
+        """A stable view for iteration: a registration may land WHILE a
+        query runs (a manifest feeder's factory importing the strategy
+        zoo is the canonical case), and iterating the live dict then is
+        a RuntimeError."""
+        with self._lock:
+            return list(self._specs.values())
+
+    def specs(self, kind: str | None = None) -> tuple:
+        """Registered specs in registration order (optionally one kind)."""
+        return tuple(s for s in self._snapshot()
+                     if kind is None or s.kind == kind)
+
+    def names(self, kind: str | None = None) -> tuple:
+        return tuple(s.name for s in self.specs(kind))
+
+    def serve_endpoints(self) -> tuple:
+        """The serving tier's endpoint names, in registration order —
+        what ``ENDPOINTS`` used to hard-code."""
+        return self.names("serve")
+
+    def serve_surface(self, name: str) -> ServeSurface:
+        return self.get(name, kind="serve").serve
+
+    def workload_kinds(self) -> tuple:
+        """The loadgen endpoint mix: every servable engine that opted
+        into the synthetic workload (surface (d))."""
+        return tuple(s.name for s in self.specs("serve") if s.workload)
+
+    def strategies(self) -> dict:
+        """name -> Strategy class for every registered strategy plugin."""
+        return {s.name: s.strategy_cls for s in self.specs("strategy")}
+
+    # ---------------------------------------------------------- manifest --
+
+    def manifest_profiles(self) -> tuple:
+        """Every warmup profile any engine feeds, registration-ordered."""
+        out: list = []
+        for s in self._snapshot():
+            for p in s.profiles:
+                if p not in out:
+                    out.append(p)
+        return tuple(out)
+
+    def manifest_entries(self, profile: str, dtype=None) -> list:
+        """Surface (a): the profile's manifest, aggregated across every
+        engine that declared it.  This is what ``compile/manifest.py``'s
+        ``build_manifest`` now returns — the per-profile entry tables
+        live on the specs, not in a module-level dispatch."""
+        if profile not in self.manifest_profiles():
+            raise ValueError(
+                f"unknown warmup profile {profile!r}: use one of "
+                f"{self.manifest_profiles()}")
+        entries: list = []
+        for spec in self._snapshot():
+            if profile in spec.profiles and spec.manifest_fn is not None:
+                entries += spec.manifest_fn(profile, dtype)
+        return entries
+
+
+# the process-wide registry; builtins attach on first query (lazily, so
+# importing this module costs nothing beyond the dataclasses above)
+REGISTRY = EngineRegistry()
+
+_BUILTIN_LOCK = threading.Lock()
+_BUILTIN_LOADED = False
+
+
+def ensure_builtin() -> EngineRegistry:
+    """Load the builtin registrations exactly once; returns REGISTRY."""
+    global _BUILTIN_LOADED
+    if not _BUILTIN_LOADED:
+        with _BUILTIN_LOCK:
+            if not _BUILTIN_LOADED:
+                import csmom_tpu.registry.builtin  # noqa: F401
+
+                _BUILTIN_LOADED = True
+    return REGISTRY
+
+
+def register_engine(spec: EngineSpec | None = None, *, replace: bool = False,
+                    **fields) -> EngineSpec:
+    """Register one engine (a built ``EngineSpec`` or its fields).
+
+    The module-level entry point user code and tests use — a toy engine
+    registered here immediately has all five surfaces: it appears in
+    the serve-profile manifest, warms, serves, joins the loadgen mix,
+    and carries the sharded hook, with no other file edited.
+    """
+    if spec is None:
+        spec = EngineSpec(**fields)
+    ensure_builtin()
+    return REGISTRY.register(spec, replace=replace)

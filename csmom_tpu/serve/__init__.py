@@ -57,6 +57,7 @@ Clock discipline: all timing goes through
 :func:`csmom_tpu.utils.deadline.mono_now_s` (monotonic, skew-proof).
 """
 
-from csmom_tpu.serve.buckets import ENDPOINTS, BucketSpec, bucket_spec
+from csmom_tpu.registry import serve_endpoints
+from csmom_tpu.serve.buckets import BucketSpec, bucket_spec
 
-__all__ = ["ENDPOINTS", "BucketSpec", "bucket_spec"]
+__all__ = ["BucketSpec", "bucket_spec", "serve_endpoints"]

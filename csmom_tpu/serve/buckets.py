@@ -19,7 +19,10 @@ honest.  Bucket sizes are powers-of-two-ish steps so the worst-case pad
 waste is bounded (< 4x on the asset axis, < 2x between batch steps).
 
 This module is stdlib-only: the queue/batcher/service plumbing and the
-fast rehearse tier import bucket geometry without touching jax.
+fast rehearse tier import bucket geometry without touching jax.  The
+ENDPOINT set is deliberately NOT here anymore (ISSUE 9): endpoints are
+registered engines — :func:`csmom_tpu.registry.serve_endpoints` is the
+one enumeration, and this module owns only shape geometry.
 """
 
 from __future__ import annotations
@@ -27,12 +30,7 @@ from __future__ import annotations
 import bisect
 import dataclasses
 
-__all__ = ["ENDPOINTS", "BucketSpec", "PROFILES", "bucket_spec"]
-
-# the service's endpoint names (engine.py implements each; the Lee-
-# Swaminathan signal family: price momentum, turnover, and the
-# mini-backtest that scores a whole panel to (mean_spread, sharpe))
-ENDPOINTS = ("momentum", "turnover", "backtest")
+__all__ = ["BucketSpec", "PROFILES", "bucket_spec"]
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,13 +1,22 @@
 """Serve scoring engines: vmapped jax kernels + a jax-free stub.
 
+The per-endpoint scorers are NOT defined here (ISSUE 9): every endpoint
+is a registered engine (:mod:`csmom_tpu.registry`), and this module is
+the adapter that turns a registered :class:`~csmom_tpu.registry.core.
+ServeSurface` into the two live backends:
+
 ``JaxEngine`` is the real thing: one jitted, vmapped entry per endpoint
-(momentum / turnover / mini-backtest), shared process-wide through
-``lru_cache`` exactly like :mod:`csmom_tpu.compile.entries` — the same
-callable the ``compile/manifest.py`` ``serve`` profile lowers, so an AOT
-``csmom warmup --profiles serve`` and a live service compile
-byte-identical HLO and the serialized-executable cache connects them.
-Each micro-batch is ONE dispatch returning a fixed-shape array; the
-engine never sees a shape outside the bucket grid.
+(``serve_entry_fn``), shared process-wide through ``lru_cache`` exactly
+like :mod:`csmom_tpu.compile.entries` — the same callable the
+``compile/manifest.py`` ``serve`` profile lowers (via the registry's
+serve feeder), so an AOT ``csmom warmup --profiles serve`` and a live
+service compile byte-identical HLO and the serialized-executable cache
+connects them.  Each micro-batch is ONE dispatch returning a
+fixed-shape array; the engine never sees a shape outside the bucket
+grid.  ``serve_entry_fn_donated`` is the registry's surface (b): the
+same scorer with input buffers donated — for pipelines that own their
+batch buffers (the service's request path does not: cached results
+outlive the dispatch, so it keeps the plain variant).
 
 Freshness accounting: ``warm()`` executes every (endpoint, bucket) shape
 once and snapshots ``profiling.compile_stats``; ``fresh_compiles()`` is
@@ -16,13 +25,13 @@ count of computations built during the serving window, which the SERVE
 artifact records as ``in_window_fresh_compiles`` (0 by construction when
 every dispatch stayed on the bucket grid).
 
-``StubEngine`` scores with plain numpy (deterministic, jax-free): the
-queue/batcher/chaos plumbing is engine-agnostic, so the fast rehearse
-tier and the plumbing tests drive the stub and stay off jax entirely —
-the same split the chaos harness makes between ``minibench`` and the
-real ``bench.py``.
+``StubEngine`` scores with plain numpy (deterministic, jax-free) through
+the registered stub factories: the queue/batcher/chaos plumbing is
+engine-agnostic, so the fast rehearse tier and the plumbing tests drive
+the stub and stay off jax entirely — the same split the chaos harness
+makes between ``minibench`` and the real ``bench.py``.
 
-jax imports stay inside ``JaxEngine`` so importing this module costs
+jax imports stay inside the factories so importing this module costs
 nothing jax-side.
 """
 
@@ -32,72 +41,78 @@ from functools import lru_cache
 
 import numpy as np
 
-from csmom_tpu.serve.buckets import ENDPOINTS, BucketSpec
+from csmom_tpu.registry import serve_endpoints, serve_surface
+from csmom_tpu.serve.buckets import BucketSpec
 
-__all__ = ["ENDPOINTS", "JaxEngine", "StubEngine", "make_engine",
-           "serve_entry_fn"]
-
-# days constant the turnover stub shares with signals.turnover's ADV proxy
-_TRADING_DAYS_PER_MONTH = 21.0
+__all__ = ["JaxEngine", "StubEngine", "make_engine", "serve_entry_fn",
+           "serve_entry_fn_donated", "unpack_result"]
 
 
-def _nanmean(a: np.ndarray, axis: int) -> np.ndarray:
-    """All-NaN-slice-safe nanmean (np.nanmean warns on empty slices; a
-    padded stub batch is full of them by design)."""
-    ok = np.isfinite(a)
-    c = ok.sum(axis=axis)
-    s = np.where(ok, a, 0.0).sum(axis=axis)
-    return np.where(c > 0, s / np.maximum(c, 1), np.nan)
+def _surface_or_raise(kind: str):
+    try:
+        return serve_surface(kind)
+    except KeyError:
+        raise ValueError(
+            f"unknown endpoint {kind!r}: registered endpoints are "
+            f"{serve_endpoints()}") from None
 
 
-@lru_cache(maxsize=32)
+@lru_cache(maxsize=64)
+def _jit_entry(surface, lookback: int, skip: int, n_bins: int, mode: str,
+               donated: bool):
+    """Process-shared jit cache, keyed on the SURFACE OBJECT (not the
+    endpoint name): re-registering a name with a new surface must build
+    a fresh scorer, never serve the old compiled one — while every
+    caller resolving the same registered surface (the service, the
+    manifest feeder, warmup) still shares one callable and lowers
+    byte-identical HLO."""
+    import jax
+
+    one = surface.batch_fn(dict(lookback=lookback, skip=skip,
+                                n_bins=n_bins, mode=mode))
+    return jax.jit(jax.vmap(one),
+                   donate_argnums=(0, 1) if donated else ())
+
+
 def serve_entry_fn(kind: str, lookback: int, skip: int, n_bins: int,
                    mode: str):
-    """The jitted batch scorer for one endpoint (process-shared).
+    """The jitted batch scorer for one registered endpoint
+    (process-shared).
 
     Signature (all endpoints): ``fn(values f[B, A, M], mask bool[B, A, M])``
-    — one array pair in, one fixed-shape array out, so a micro-batch is a
-    single dispatch:
-
-    - ``momentum``: ``f[B, A]`` — the (J, skip) compounded momentum at
-      the last formation date, NaN where invalid/padded.
-    - ``turnover``: ``f[B, A]`` — trailing-``lookback`` average turnover
-      proxy (values = monthly share volume; the offline shares-unknown
-      proxy, like ``csmom doublesort`` without ``--fetch-shares``).
-    - ``backtest``: ``f[B, 2]`` — (mean_spread, ann_sharpe) of the full
-      monthly decile backtest per request panel.
+    — one array pair in, one fixed-shape array out, so a micro-batch is
+    a single dispatch.  Per-asset endpoints return ``f[B, A]`` (NaN
+    where invalid/padded); summary endpoints (``backtest``) return
+    ``f[B, len(summary_fields)]``.
     """
-    if kind not in ENDPOINTS:
-        raise ValueError(f"unknown endpoint {kind!r}: use one of {ENDPOINTS}")
-    import jax
-    import jax.numpy as jnp
+    return _jit_entry(_surface_or_raise(kind), lookback, skip, n_bins,
+                      mode, False)
 
-    if kind == "momentum":
-        from csmom_tpu.signals.momentum import momentum
 
-        def one(values, mask):
-            mom, ok = momentum(values, mask, lookback=lookback, skip=skip)
-            return jnp.where(ok[:, -1], mom[:, -1], jnp.nan)
+def serve_entry_fn_donated(kind: str, lookback: int, skip: int,
+                           n_bins: int, mode: str):
+    """Surface (b): the same scorer with the batch buffers donated —
+    XLA may reuse the input HBM block for the output.  Callers must own
+    (and surrender) their arrays; the service's request path keeps the
+    plain variant because results and cached panels outlive a dispatch.
+    """
+    return _jit_entry(_surface_or_raise(kind), lookback, skip, n_bins,
+                      mode, True)
 
-    elif kind == "turnover":
-        from csmom_tpu.signals.turnover import turnover_features
 
-        def one(values, mask):
-            shares = jnp.ones((values.shape[0],), values.dtype)
-            turn, ok = turnover_features(
-                values, mask, shares, lookback=lookback)["turn_avg"]
-            return jnp.where(ok[:, -1], turn[:, -1], jnp.nan)
-
-    else:  # backtest
-        from csmom_tpu.backtest.monthly import monthly_spread_backtest
-
-        def one(values, mask):
-            res = monthly_spread_backtest(
-                values, mask, lookback=lookback, skip=skip, n_bins=n_bins,
-                mode=mode)
-            return jnp.stack([res.mean_spread, res.ann_sharpe])
-
-    return jax.jit(jax.vmap(one))
+def unpack_result(kind: str, out: np.ndarray, row: int, n_assets: int):
+    """One request's result from a batch output, per the registered
+    output spec: a read-only per-asset vector, or the summary dict."""
+    surface = _surface_or_raise(kind)
+    if surface.output == "summary":
+        return {f: float(out[row, i])
+                for i, f in enumerate(surface.summary_fields)}
+    res = np.array(out[row, :n_assets])
+    # ONE object may reach the cache, the leader, and every coalesced
+    # follower: freeze it so no caller can mutate what another (or a
+    # later cache hit) will read
+    res.setflags(write=False)
+    return res
 
 
 class JaxEngine:
@@ -125,9 +140,10 @@ class JaxEngine:
         from csmom_tpu.obs import span
         from csmom_tpu.utils.profiling import compile_stats
 
+        kinds = serve_endpoints()
         n = 0
         with span("serve.warmup", phase="warmup", spec=spec.name):
-            for kind in ENDPOINTS:
+            for kind in kinds:
                 fn = self._fn(kind)
                 for B, A, M in spec.shapes():
                     v = np.zeros((B, A, M), np.dtype(spec.dtype))
@@ -135,7 +151,7 @@ class JaxEngine:
                     jax.block_until_ready(fn(v, m))
                     n += 1
         self._stats0 = compile_stats()
-        return {"n_shapes_warmed": n, "endpoints": list(ENDPOINTS)}
+        return {"n_shapes_warmed": n, "endpoints": list(kinds)}
 
     def score(self, kind: str, values: np.ndarray,
               mask: np.ndarray) -> np.ndarray:
@@ -155,10 +171,10 @@ class JaxEngine:
 class StubEngine:
     """Deterministic numpy scorer — the plumbing-test / rehearse engine.
 
-    Shapes and NaN semantics mirror the jax engine; the numbers are a
-    simplified model (no pad-parity forward fill), which is fine: every
-    consumer of the stub is testing the queue/batcher/chaos path, not
-    signal values.
+    Shapes and NaN semantics mirror the jax engine via the registered
+    stub factories; the numbers are a simplified model, which is fine:
+    every consumer of the stub is testing the queue/batcher/chaos path,
+    not signal values.
     """
 
     name = "stub"
@@ -167,29 +183,27 @@ class StubEngine:
                  mode: str = "rank"):
         self.lookback = lookback
         self.skip = skip
+        self.n_bins = n_bins
+        self.mode = mode
+        self._fns: dict = {}  # per-engine-instance scorer cache
 
     def warm(self, spec: BucketSpec) -> dict:
         return {"n_shapes_warmed": 0,
+                "endpoints": list(serve_endpoints()),
                 "note": "stub engine: nothing to compile"}
 
     def score(self, kind: str, values: np.ndarray,
               mask: np.ndarray) -> np.ndarray:
-        v = np.where(mask, values, np.nan)
-        if kind == "momentum":
-            end = v[:, :, -1 - self.skip]
-            start = v[:, :, -1 - self.skip - self.lookback]
-            with np.errstate(divide="ignore", invalid="ignore"):
-                return end / start - 1.0
-        if kind == "turnover":
-            return (_nanmean(v[:, :, -self.lookback:], -1)
-                    / _TRADING_DAYS_PER_MONTH)
-        if kind == "backtest":
-            with np.errstate(divide="ignore", invalid="ignore"):
-                ret = v[:, :, 1:] / v[:, :, :-1] - 1.0
-            mean = _nanmean(_nanmean(ret, 1), -1)
-            return np.stack([np.nan_to_num(mean),
-                             np.zeros_like(mean)], axis=-1)
-        raise ValueError(f"unknown endpoint {kind!r}")
+        # build each stub scorer once per engine instance, not per
+        # dispatch: the rehearse tier pushes thousands of micro-batches
+        # through here and the factory closure is pure in (kind, params)
+        fn = self._fns.get(kind)
+        if fn is None:
+            surface = _surface_or_raise(kind)
+            fn = self._fns[kind] = surface.stub_fn(
+                dict(lookback=self.lookback, skip=self.skip,
+                     n_bins=self.n_bins, mode=self.mode))
+        return fn(values, mask)
 
     def fresh_compiles(self) -> int:
         return 0  # nothing ever compiles: trivially warm

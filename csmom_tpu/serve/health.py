@@ -46,8 +46,9 @@ import hashlib
 import json
 import os
 
+from csmom_tpu.registry import serve_endpoints
 from csmom_tpu.serve import proto
-from csmom_tpu.serve.buckets import ENDPOINTS, bucket_spec
+from csmom_tpu.serve.buckets import bucket_spec
 
 __all__ = ["aot_cache_version", "cache_readiness", "expected_entry_names",
            "liveness", "readiness"]
@@ -80,7 +81,7 @@ def aot_cache_version(profile: str, *, lookback: int = 12, skip: int = 1,
         "asset_buckets": list(spec.asset_buckets),
         "batch_buckets": list(spec.batch_buckets),
         "dtype": spec.dtype,
-        "endpoints": list(ENDPOINTS),
+        "endpoints": list(serve_endpoints()),
         "engine_params": {"lookback": lookback, "skip": skip,
                           "n_bins": n_bins, "mode": mode},
         "jax": jax_ver,
@@ -96,7 +97,7 @@ def expected_entry_names(profile: str) -> set:
     so this check never needs jax."""
     spec = bucket_spec(profile)
     return {f"serve.{kind}.b{B}@{A}x{M}"
-            for kind in ENDPOINTS for B, A, M in spec.shapes()}
+            for kind in serve_endpoints() for B, A, M in spec.shapes()}
 
 
 def cache_readiness(profile: str, cache_subdir: str = "bench") -> tuple:

@@ -25,19 +25,21 @@ result cache sees repeats), and bump the panel version mid-run (so
 cache invalidation is demonstrated inside the same artifact, with zero
 stale hits as a schema rule).
 
-The run lands as ``SERVE_<run>.json`` (schema v2): throughput headline
+The run lands as ``SERVE_<run>.json`` (schema v3): throughput headline
 PLUS ``offered_rps`` (so an offered-load-limited run is never misread
 as a saturation ceiling — the r11 footnote, now a field), request
-accounting globally AND per SLO class (both closed by schema:
-:mod:`csmom_tpu.chaos.invariants` kind ``serve``), per-class latency
+accounting globally, per SLO class AND per ENDPOINT (all closed by
+schema: :mod:`csmom_tpu.chaos.invariants` kind ``serve``; the endpoint
+name set must be registered engines — ISSUE 9), per-class latency
 percentiles against each class's budget, the cache book (hit rate,
 zero stale hits), p50/p95/p99 queue / service / total latency, the
 batch-size histogram with padding overhead and fire reasons, and the
 in-window fresh-compile count.  :mod:`csmom_tpu.obs.ledger` ingests
 these rows (``serve_throughput_rps``, ``serve_p99_ms``,
-``serve_cache_hit_rate``, per-class p99s, ``serve_p99_under_burst_ms``
-for bursty runs), so serve performance joins the cross-run regression
-gate like every bench wall.
+``serve_cache_hit_rate``, per-class p99s, per-endpoint
+``serve_ep_<name>_p99_ms``, ``serve_p99_under_burst_ms`` for bursty
+runs), so serve performance joins the cross-run regression gate like
+every bench wall.
 
 Naming rule (the TELEMETRY rule, extended): only round artifacts
 (``SERVE_rNN.json``) are committable evidence; ``SERVE_smoke*.json`` /
@@ -55,7 +57,7 @@ import time
 
 import numpy as np
 
-from csmom_tpu.serve.buckets import ENDPOINTS
+from csmom_tpu.registry import serve_surface, workload_kinds
 from csmom_tpu.serve.service import ServeConfig, SignalService
 from csmom_tpu.utils.deadline import mono_now_s
 
@@ -64,7 +66,9 @@ __all__ = ["LoadConfig", "NAMED_SCHEDULES", "arrival_offsets",
            "resolve_schedule", "run_loadgen", "run_pool_loadgen",
            "synth_panel", "write_artifact"]
 
-SCHEMA_VERSION = 2
+# schema v3 (ISSUE 9): per-endpoint books + latency, endpoint set
+# validated against the engine registry by chaos/invariants
+SCHEMA_VERSION = 3
 POOL_SCHEMA_VERSION = 1
 
 # the r10/r11 default mixes, expressed as an SLO-class mix
@@ -174,9 +178,16 @@ def synth_panel(rng: random.Random, n_assets: int, months: int,
                 kind: str) -> tuple:
     """One deterministic request panel: a positive random walk (prices)
     or positive level noise (volume), with a seeded sprinkle of masked
-    gaps so the mask path is always exercised."""
+    gaps so the mask path is always exercised.  The family is the
+    REGISTERED endpoint's declaration (``panel_family``), so a new
+    endpoint states what its synthetic workload looks like at
+    registration instead of patching the generator."""
     r = np.random.default_rng(rng.getrandbits(32))
-    if kind == "turnover":
+    try:
+        family = serve_surface(kind).panel_family
+    except (KeyError, ValueError):
+        family = "price"  # an unknown kind still gets a well-formed panel
+    if family == "volume":
         values = r.lognormal(mean=12.0, sigma=0.5,
                              size=(n_assets, months)).astype(np.float32)
     else:
@@ -194,7 +205,7 @@ class LoadConfig:
 
     schedule: str = "2x40"
     seed: int = 0
-    kinds: tuple = ENDPOINTS
+    kinds: tuple | None = None          # None = every registered workload
     deadline_s: float | None = 0.5
     interactive_fraction: float = 0.7   # legacy 2-class knob (see mix())
     class_mix: tuple | None = None      # ((class, weight), ...) wins
@@ -205,6 +216,13 @@ class LoadConfig:
     boundary_hug: bool = False          # adversarial bucket-edge sizes
     max_assets: int | None = None       # default: the spec's largest bucket
     run_id: str = "smoke"
+
+    def resolved_kinds(self) -> tuple:
+        """The endpoint mix: explicit ``kinds`` wins; the default is
+        surface (d) — every registered servable engine that opted into
+        the synthetic workload, so a newly registered endpoint joins
+        the load mix (and lands ledger rows) with no loadgen edit."""
+        return tuple(self.kinds) if self.kinds else workload_kinds()
 
     def mix(self) -> tuple:
         """The effective class mix: explicit ``class_mix`` wins; else the
@@ -282,7 +300,8 @@ def run_loadgen(service: SignalService, load: LoadConfig) -> dict:
         max(1, round(len(offsets) * (k + 1) / (load.version_bumps + 1)))
         for k in range(load.version_bumps)
     ) if load.version_bumps > 0 else []
-    recent: dict = {k: [] for k in load.kinds}
+    kinds = load.resolved_kinds()
+    recent: dict = {k: [] for k in kinds}
 
     requests = []
     t_start = mono_now_s()
@@ -294,7 +313,7 @@ def run_loadgen(service: SignalService, load: LoadConfig) -> dict:
         delay = (t_start + off) - mono_now_s()
         if delay > 0:
             time.sleep(delay)  # open loop: the schedule's clock rules
-        kind = rng.choice(list(load.kinds))
+        kind = rng.choice(list(kinds))
         pool = recent[kind]
         if pool and rng.random() < load.reuse_fraction:
             values, mask = pool[rng.randrange(len(pool))]
@@ -358,10 +377,31 @@ def _class_blocks(service: SignalService, requests: list) -> dict:
     return out
 
 
+def _endpoint_blocks(load: LoadConfig, requests: list) -> dict:
+    """Surface (d)'s evidence: per-ENDPOINT books + latency, keyed by
+    registry name.  Every submitted request lands in exactly one
+    endpoint's book, so the served counts sum to the global book (a
+    schema rule of serve v3)."""
+    out = {}
+    for kind in load.resolved_kinds():
+        mine = [r for r in requests if r.kind == kind]
+        served = [r for r in mine if r.state == "served"]
+        out[kind] = {
+            "submitted": len(mine),
+            "served": len(served),
+            "rejected": sum(1 for r in mine if r.state == "rejected"),
+            "expired": sum(1 for r in mine if r.state == "expired"),
+            "latency_ms": _percentiles(
+                [r.total_s for r in served if r.total_s is not None]),
+        }
+    return out
+
+
 def build_artifact(service: SignalService, load: LoadConfig,
                    requests: list, wall_s: float) -> dict:
-    """The SERVE artifact (schema v2): headline + offered load + global
-    and per-class accounting + cache book + latency + batches."""
+    """The SERVE artifact (schema v3): headline + offered load + global,
+    per-class AND per-endpoint accounting + cache book + latency +
+    batches."""
     acct = service.accounting()
     served = [r for r in requests if r.state == "served"]
     throughput = round(acct["served"] / wall_s, 3) if wall_s > 0 else 0.0
@@ -382,7 +422,7 @@ def build_artifact(service: SignalService, load: LoadConfig,
                    else load.schedule)
     workload = (
         f"open-loop {sched_label} rps seed {load.seed}, "
-        f"{'/'.join(load.kinds)} mix, buckets "
+        f"{'/'.join(load.resolved_kinds())} mix, buckets "
         f"B({','.join(map(str, spec.batch_buckets))})x"
         f"A({','.join(map(str, spec.asset_buckets))})x{spec.months}m "
         f"({spec.dtype}, {service.config.engine} engine)"
@@ -414,6 +454,7 @@ def build_artifact(service: SignalService, load: LoadConfig,
                                 and acct["expired"] == 0),
         "requests": acct,
         "classes": _class_blocks(service, requests),
+        "endpoints": _endpoint_blocks(load, requests),
         "cache": service.cache_stats(),
         "latency_ms": lat,
         "batches": service.batch_stats(),
@@ -430,7 +471,7 @@ def build_artifact(service: SignalService, load: LoadConfig,
             "n_arrivals": len(requests),
             "duration_s": round(duration, 4),
             "offered_rps": offered_rps,
-            "kinds": list(load.kinds),
+            "kinds": list(load.resolved_kinds()),
             "deadline_ms": ("class-budget" if load.use_class_deadlines
                             else None if load.deadline_s is None
                             else round(1e3 * load.deadline_s, 3)),
@@ -467,6 +508,7 @@ def run_pool_loadgen(router, supervisor, load: LoadConfig,
     spec = router.spec
     max_assets = min(load.max_assets or spec.max_assets, spec.max_assets)
     mix = load.mix()
+    kinds = list(load.resolved_kinds())  # hoisted out of the timed loop
 
     side = None
     side_exc: list = []
@@ -487,7 +529,7 @@ def run_pool_loadgen(router, supervisor, load: LoadConfig,
         delay = (t_start + off) - mono_now_s()
         if delay > 0:
             time.sleep(delay)  # open loop: the schedule's clock rules
-        kind = rng.choice(list(load.kinds))
+        kind = rng.choice(kinds)
         n_assets = rng.randint(2, max_assets)
         values, mask = synth_panel(rng, n_assets, spec.months, kind)
         requests.append(router.submit(kind, values, mask,
@@ -562,7 +604,7 @@ def build_pool_artifact(router, supervisor, load: LoadConfig,
             break
     workload = (
         f"pool open-loop {load.schedule} rps seed {load.seed}, "
-        f"{'/'.join(load.kinds)} mix, {cfg.n_workers} workers, buckets "
+        f"{'/'.join(load.resolved_kinds())} mix, {cfg.n_workers} workers, buckets "
         f"B({','.join(map(str, spec.batch_buckets))})x"
         f"A({','.join(map(str, spec.asset_buckets))})x{spec.months}m "
         f"({spec.dtype}, {cfg.engine} engine)"
@@ -627,7 +669,7 @@ def build_pool_artifact(router, supervisor, load: LoadConfig,
             "n_arrivals": len(requests),
             "duration_s": round(duration, 4),
             "offered_rps": offered_rps,
-            "kinds": list(load.kinds),
+            "kinds": list(load.resolved_kinds()),
             "deadline_ms": (None if load.deadline_s is None
                             else round(1e3 * load.deadline_s, 3)),
             "class_mix": {name: w for name, w in load.mix()},

@@ -41,7 +41,8 @@ import threading
 import numpy as np
 
 from csmom_tpu.serve import proto
-from csmom_tpu.serve.buckets import ENDPOINTS, bucket_spec
+from csmom_tpu.registry import serve_endpoints
+from csmom_tpu.serve.buckets import bucket_spec
 from csmom_tpu.serve.slo import default_policy
 from csmom_tpu.utils.deadline import mono_now_s
 
@@ -177,8 +178,9 @@ class Router:
     def _unserveable_reason(self, kind: str, values, mask) -> str | None:
         # same door checks as service.submit: an unserveable request must
         # fail here, not burn dispatch attempts on every worker in turn
-        if kind not in ENDPOINTS:
-            return f"unknown endpoint {kind!r} (serveable: {ENDPOINTS})"
+        kinds = serve_endpoints()
+        if kind not in kinds:
+            return f"unknown endpoint {kind!r} (serveable: {kinds})"
         if values.ndim != 2:
             return f"panel must be [assets, months], got ndim={values.ndim}"
         if values.shape[1] != self.spec.months:

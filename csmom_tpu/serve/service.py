@@ -61,15 +61,16 @@ import threading
 
 import numpy as np
 
+from csmom_tpu.registry import serve_endpoints
 from csmom_tpu.serve.batcher import Batcher, Microbatch
-from csmom_tpu.serve.buckets import ENDPOINTS, bucket_spec
+from csmom_tpu.serve.buckets import bucket_spec
 from csmom_tpu.serve.cache import (
     CacheKey,
     InflightCoalescer,
     ResultCache,
     panel_fingerprint,
 )
-from csmom_tpu.serve.engine import make_engine
+from csmom_tpu.serve.engine import make_engine, unpack_result
 from csmom_tpu.serve.queue import AdmissionQueue, Request
 from csmom_tpu.serve.slo import SLOPolicy, default_policy
 from csmom_tpu.utils.deadline import mono_now_s
@@ -302,8 +303,9 @@ class SignalService:
         return result
 
     def _unserveable_reason(self, kind: str, values, mask) -> str | None:
-        if kind not in ENDPOINTS:
-            return f"unknown endpoint {kind!r} (serveable: {ENDPOINTS})"
+        kinds = serve_endpoints()
+        if kind not in kinds:
+            return f"unknown endpoint {kind!r} (serveable: {kinds})"
         if values.ndim != 2:
             return f"panel must be [assets, months], got ndim={values.ndim}"
         if values.shape[1] != self.spec.months:
@@ -370,16 +372,9 @@ class SignalService:
                 out = self.engine.score(mb.kind, mb.values, mb.mask)
                 sp.set(n=len(live))
             for b, r in live:
-                if mb.kind == "backtest":
-                    res = {"mean_spread": float(out[b, 0]),
-                           "ann_sharpe": float(out[b, 1])}
-                else:
-                    res = np.array(out[b, :r.n_assets])
-                    # ONE object reaches the cache, the leader, and
-                    # every coalesced follower: freeze it so no caller
-                    # can mutate what another (or a later cache hit)
-                    # will read
-                    res.setflags(write=False)
+                # per-asset vs summary unpacking is the registered
+                # engine's declaration, not a name special-case here
+                res = unpack_result(mb.kind, out, b, r.n_assets)
                 key = getattr(r, "cache_key", None)
                 if key is not None and self.cache is not None:
                     # fill the cache BEFORE resolving the leader, so a

@@ -52,7 +52,7 @@ import threading
 import numpy as np
 
 from csmom_tpu.serve import health, proto
-from csmom_tpu.serve.buckets import ENDPOINTS
+from csmom_tpu.registry import serve_endpoints
 from csmom_tpu.utils.deadline import mono_now_s
 
 __all__ = ["RC_COLD_CACHE", "RC_VERSION_SKEW", "WorkerServer", "main"]
@@ -114,7 +114,7 @@ class WorkerServer:
         A = spec.asset_buckets[0]
         rng = np.random.default_rng(0)
         probes = {}
-        for kind in ENDPOINTS:
+        for kind in serve_endpoints():
             v = 100.0 * np.exp(np.cumsum(
                 rng.normal(0, 0.03, (A, spec.months)), axis=1))
             req = self.service.submit(kind, v.astype(np.float32),

@@ -85,16 +85,26 @@ def consumed_panels(strategy) -> frozenset:
     return frozenset(names)
 
 
-_REGISTRY: dict[str, type[Strategy]] = {}
-
-
 def register_strategy(name: str):
-    """Class decorator: expose a Strategy to the CLI/config layer by name."""
+    """Class decorator: expose a Strategy to the CLI/config layer by name.
+
+    The backing table is the unified engine registry (ISSUE 9): a
+    strategy registers once as a kind-``strategy`` engine and the
+    CLI/config zoo, ``csmom registry list``, and any future surface all
+    read the same row — there is no separate plugin dict to drift.
+    """
 
     def deco(cls):
         if not (isinstance(cls, type) and issubclass(cls, Strategy)):
             raise TypeError(f"{cls!r} is not a Strategy subclass")
-        _REGISTRY[name] = cls
+        from csmom_tpu.registry.core import REGISTRY, EngineSpec
+
+        doc = (cls.__doc__ or "").strip().splitlines()
+        REGISTRY.register(EngineSpec(
+            name=name, kind="strategy", strategy_cls=cls,
+            description=doc[0] if doc else "",
+            axes="prices f[A,M], mask bool[A,M] -> (score, valid)",
+        ), replace=True)
         return cls
 
     return deco
@@ -102,17 +112,20 @@ def register_strategy(name: str):
 
 def make_strategy(name: str, **params) -> Strategy:
     """Instantiate a registered strategy by name with keyword params."""
+    zoo = available_strategies()
     try:
-        cls = _REGISTRY[name]
+        cls = zoo[name]
     except KeyError:
         raise KeyError(
-            f"unknown strategy {name!r}; available: {sorted(_REGISTRY)}"
+            f"unknown strategy {name!r}; available: {sorted(zoo)}"
         ) from None
     return cls(**params)
 
 
 def available_strategies() -> dict[str, type[Strategy]]:
-    return dict(_REGISTRY)
+    from csmom_tpu.registry import strategies
+
+    return strategies()
 
 
 def xs_zscore(score, valid):

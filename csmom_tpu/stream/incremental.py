@@ -164,14 +164,31 @@ def nan_equal(a: np.ndarray, b: np.ndarray) -> bool:
 # ------------------------------------------------------ incremental state --
 
 class _UpdaterBase:
-    """Shared consume/rebuild/reconcile plumbing."""
+    """Shared consume/rebuild/reconcile plumbing.
+
+    ``anchor`` is the global bar index the running prefix state is
+    anchored at: 0 for a fresh updater, the snapshot's window start
+    after any rebuild.  Reconciliation is only bitwise-comparable when
+    the reference recompute shares that anchor — once the ring's window
+    slides past it (bar count exceeds ring capacity), a window-anchored
+    recompute legitimately differs from the live state: its forward
+    fills start blind at the window edge and its float prefix sums
+    carry no common prefix to cancel.  The pre-fix reconcile compared
+    them anyway and reported spurious drift (ROADMAP item 4 defect (a),
+    masked by ``run_replay`` pinning capacity == bars); the fix
+    re-anchors first (counted in ``reanchors``) and cross-checks the
+    live state against the re-anchored one within a documented
+    float-cancellation tolerance, so real corruption still surfaces as
+    drift while anchor mismatch alone never does."""
 
     def __init__(self, n_assets: int, dtype):
         self.n_assets = int(n_assets)
         self.dtype = np.dtype(dtype)
         self.consumed = 0          # bars consumed (global index of next)
+        self.anchor = 0            # global bar the prefix state starts at
         self.dirty = False         # a late merge rewrote consumed history
         self.rebuilds = 0
+        self.reanchors = 0         # window slid past anchor at reconcile
         self.reconciliations = 0
         self.drift_events = 0
 
@@ -210,32 +227,69 @@ class _UpdaterBase:
     def rebuild(self, snapshot) -> None:
         """Replay the exact mirror recurrence over the snapshot window —
         the rebuild-from-scratch path late merges and detected drift
-        both take."""
+        both take.  Re-anchors the prefix state at the window start."""
         self._reset()
+        self.anchor = snapshot.first_bar_index
         self.consumed = snapshot.first_bar_index
         self.dirty = False
         self.rebuilds += 1
         self.sync(snapshot)
 
+    def _cross_atol(self) -> np.ndarray | float:
+        """Per-asset absolute tolerance for the live-vs-re-anchored
+        cross-check.  0.0 where the last-bar value depends only on
+        in-window data (momentum: identical forward fills wherever the
+        window recompute is valid, so bitwise); overridden where the
+        state carries globally-anchored float prefix sums whose common
+        prefix cancels only in exact arithmetic (turnover)."""
+        return 0.0
+
     def reconcile(self, snapshot) -> dict:
-        """Full-panel recompute vs the running state, bit-for-bit.  On
-        drift: count it and rebuild from scratch.  Returns the verdict."""
+        """Full-panel recompute vs the running state.  On drift: count
+        it and rebuild from scratch.  Returns the verdict.
+
+        Anchored case (window still starts at our anchor): bitwise, as
+        ever.  Slid-window case: capture the live last-bar state, then
+        REBUILD from the snapshot (a re-anchor, counted — not drift:
+        the anchors differing is the ring doing its job) and compare
+        (a) the re-anchored incremental recurrence against the
+        vectorized mirror bitwise, and (b) the live state against the
+        re-anchored one on lanes both call valid, within
+        :meth:`_cross_atol` — (a) proves the recurrence, (b) catches
+        real corruption of the long-running state."""
         self.sync(snapshot)
+        self.reconciliations += 1
+        reanchored = snapshot.first_bar_index != self.anchor
+        live_val = live_ok = None
+        atol = 0.0
+        if reanchored:
+            live_val, live_ok = self.current()
+            atol = self._cross_atol()
+            self.reanchors += 1
+            self.rebuild(snapshot)
         ref_val, ref_ok = self._reference(snapshot)
         cur_val, cur_ok = self.current()
-        ok = (nan_equal(cur_val, ref_val[:, -1])
-              and bool(np.array_equal(cur_ok, ref_ok[:, -1])))
-        self.reconciliations += 1
-        if not ok:
+        drift = not (nan_equal(cur_val, ref_val[:, -1])
+                     and bool(np.array_equal(cur_ok, ref_ok[:, -1])))
+        if reanchored and not drift:
+            both = live_ok & cur_ok
+            if both.any():
+                diff = np.abs(live_val[both] - cur_val[both])
+                tol = np.broadcast_to(np.asarray(atol), live_ok.shape)[both]
+                if not bool(np.all(diff <= tol)):
+                    drift = True
+        if drift:
             self.drift_events += 1
-            self.rebuild(snapshot)
-        return {"drift": not ok, "bars": snapshot.n_bars,
-                "version": snapshot.version}
+            if not reanchored:
+                self.rebuild(snapshot)  # re-anchored path already rebuilt
+        return {"drift": drift, "bars": snapshot.n_bars,
+                "version": snapshot.version, "reanchored": reanchored}
 
     def stats(self) -> dict:
         return {
             "consumed_bars": self.consumed,
             "rebuilds": self.rebuilds,
+            "reanchors": self.reanchors,
             "reconciliations": self.reconciliations,
             "drift_events": self.drift_events,
         }
@@ -373,6 +427,17 @@ class IncrementalTurnover(_UpdaterBase):
 
     def _snapshot_field(self, snapshot):
         return snapshot.values[self.field], snapshot.mask[self.field]
+
+    def _cross_atol(self):
+        """The trailing mean is a difference of globally-anchored float
+        prefix sums; re-anchoring drops the common prefix, which cancels
+        exactly only in exact arithmetic.  Bound the float residue by a
+        few ulps of the prefix magnitude per asset — generous enough to
+        never flag the anchor change, tight enough that genuine state
+        corruption (which is O(signal), not O(ulp)) still reads as
+        drift."""
+        eps = np.finfo(self.dtype).eps
+        return 32.0 * eps * (np.abs(self._cs) + 1.0)
 
     def _consume(self, values_col: np.ndarray, mask_col: np.ndarray) -> None:
         t = self._t

@@ -117,6 +117,24 @@ class StreamIngestor:
         checkpoint("stream.ingest", asset=tick.asset, seq=tick.seq)
         bar_time = int(tick.bar_time)
 
+        if not np.isfinite(tick.price):
+            # a non-finite price is rejected data, not data (ROADMAP
+            # item 4 defect (b)): it must NOT advance the bar grid, and
+            # above all must NOT mark the (asset, bar) cell seen — the
+            # ring's mask would stay False (write() masks on finiteness)
+            # while the dedupe state claimed the cell was filled, so the
+            # later REAL tick would be counted `deduped` and the cell
+            # would stay unfilled forever with the books still
+            # balancing.  Quarantine keeps the ledger closed and the
+            # reason auditable; dedupe state is untouched.
+            self.quarantined += 1
+            self.quarantine.append({
+                "asset": tick.asset, "bar_time": bar_time,
+                "seq": tick.seq,
+                "reason": f"non-finite price {tick.price!r}",
+            })
+            return "quarantined"
+
         if self._max_bar_time is not None:
             wm = self.policy.watermark(self._max_bar_time)
             if bar_time < wm:

@@ -71,6 +71,13 @@ class ReplayConfig:
     seed: int = 0
     n_assets: int = 8
     bars: int = REPLAY_SMOKE_BARS
+    # ring capacity in bars.  None = the default 3/4 of the log (floored
+    # at the serve window), so every replay WRAPS the ring and exercises
+    # the window-slide reconcile path by default — the r12 harness
+    # pinned capacity == bars, which masked the reconcile false-drift
+    # defect (ROADMAP item 4 (a)); set capacity == bars explicitly to
+    # get the old no-eviction behavior.
+    capacity: int | None = None
     bar_period_ns: int = 60_000_000_000        # one-minute bars
     t0_ns: int = 1_700_000_000_000_000_000     # event-time origin
     allowed_lateness_bars: int = 3
@@ -87,6 +94,15 @@ class ReplayConfig:
     dtype: str = "float32"
     max_version_skew: int = 0                  # the feed is synchronous
 
+    def resolved_capacity(self) -> int:
+        """The ring capacity this run uses (see ``capacity``)."""
+        if self.capacity is not None:
+            return int(self.capacity)
+        from csmom_tpu.serve.buckets import bucket_spec
+
+        months = bucket_spec(self.profile).months
+        return max(months, (3 * self.bars) // 4)
+
     def validate(self) -> None:
         from csmom_tpu.serve.buckets import bucket_spec
 
@@ -96,6 +112,11 @@ class ReplayConfig:
                 f"bars={self.bars} < serve months {spec.months} "
                 f"(profile {self.profile!r}): the serve leg could never "
                 "slice a scoring window")
+        if self.resolved_capacity() < spec.months:
+            raise ValueError(
+                f"capacity={self.resolved_capacity()} < serve months "
+                f"{spec.months}: a snapshot window could never carry a "
+                "full scoring history")
         if self.n_assets > spec.max_assets:
             raise ValueError(
                 f"n_assets={self.n_assets} exceeds the largest serve "
@@ -247,8 +268,8 @@ def run_replay(cfg: ReplayConfig) -> dict:
     dt = np.dtype(cfg.dtype)
     log = synth_tick_log(cfg)
     tickers = sorted({t.asset for t in log})
-    ring = LiveRing(tickers, capacity=cfg.bars, fields=("price", "volume"),
-                    dtype=dt)
+    ring = LiveRing(tickers, capacity=cfg.resolved_capacity(),
+                    fields=("price", "volume"), dtype=dt)
     ing = StreamIngestor(ring, WatermarkPolicy(
         bar_period_ns=cfg.bar_period_ns,
         allowed_lateness_bars=cfg.allowed_lateness_bars))
@@ -451,6 +472,10 @@ def build_artifact(cfg, ing, ring, svc, requests, wall_s, *, generated,
         "count": mom_upd.reconciliations + turn_upd.reconciliations,
         "drift_events": mom_upd.drift_events + turn_upd.drift_events,
         "rebuilds": mom_upd.rebuilds + turn_upd.rebuilds,
+        # window-slide re-anchors (ring wrapped past the prefix anchor):
+        # expected whenever bars > capacity, and NOT drift — the defect
+        # (a) fix made this a counted, first-class event
+        "reanchors": mom_upd.reanchors + turn_upd.reanchors,
         "engine_checks": 0 if engine_rec is None else engine_rec.checks,
         "engine_max_abs_diff": (
             0.0 if engine_rec is None

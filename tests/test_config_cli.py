@@ -183,8 +183,9 @@ def test_cli_strategies_robust_to_bare_plugins(capsys):
     the listing."""
     import dataclasses as dc
 
+    from csmom_tpu.registry import unregister_engine
     from csmom_tpu.strategy import register_strategy
-    from csmom_tpu.strategy.base import _REGISTRY, Strategy
+    from csmom_tpu.strategy.base import Strategy
 
     @register_strategy("_bare_test_plugin")
     @dc.dataclass(frozen=True)
@@ -201,7 +202,7 @@ def test_cli_strategies_robust_to_bare_plugins(capsys):
         assert "_bare_test_plugin(required_knob)" in out
         assert "_MISSING_TYPE" not in out
     finally:
-        _REGISTRY.pop("_bare_test_plugin", None)
+        unregister_engine("_bare_test_plugin", kind="strategy")
 
 
 @requires_reference

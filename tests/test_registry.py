@@ -1,0 +1,416 @@
+"""ISSUE 9: the engine registry — completeness, the toy-engine contract,
+and the enumeration-drift lint.
+
+Three layers:
+
+- **completeness** (tier-1): every registered serve engine round-trips
+  through manifest -> AOT warm -> serve dispatch on BOTH engines (stub
+  and jax-on-CPU), with zero in-window fresh compiles — registration IS
+  the production surface, there is no second list to also be on;
+- **the toy-engine contract**: registering a throwaway engine in-test
+  yields all five surfaces (manifest entries, donated variant, serve
+  dispatch, loadgen leg + ledger rows, sharded hook) with NO other file
+  edited;
+- **the lint**: no module outside ``csmom_tpu/registry/`` may define an
+  endpoint/entry/workload enumeration (grep-style AST walk, like the
+  time-discipline lint) — the registry cannot silently fork back into
+  parallel tables.
+"""
+
+import ast
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from csmom_tpu.chaos import invariants as inv
+from csmom_tpu.registry import (
+    EngineSpec,
+    ServeSurface,
+    engine_specs,
+    get_engine,
+    register_engine,
+    serve_endpoints,
+    serve_surface,
+    unregister_engine,
+    workload_kinds,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _panel(n_assets: int, months: int, seed: int = 0):
+    r = np.random.default_rng(seed)
+    v = 100.0 * np.exp(np.cumsum(r.normal(0, 0.03, (n_assets, months)),
+                                 axis=1)).astype(np.float32)
+    return v, np.ones((n_assets, months), bool)
+
+
+# ------------------------------------------------------------ registry -----
+
+def test_builtin_endpoint_set_is_the_five_engine_registry():
+    kinds = serve_endpoints()
+    # the three r10 endpoints plus the two previously research-only
+    # strategies ISSUE 9 ships as live endpoints
+    assert set(kinds) >= {"momentum", "turnover", "backtest",
+                          "low_volatility", "zscore_combo"}
+    assert tuple(workload_kinds()) == tuple(kinds)
+
+
+def test_every_surface_declares_its_panel_family_and_output():
+    for kind in serve_endpoints():
+        s = serve_surface(kind)
+        assert s.panel_family in ("price", "volume")
+        if s.output == "summary":
+            assert s.summary_fields
+
+
+def test_duplicate_registration_within_a_kind_refuses():
+    spec = get_engine("momentum", kind="serve")
+    clone = EngineSpec(name="momentum", kind="serve", serve=spec.serve,
+                       description="not the same spec")
+    with pytest.raises(ValueError, match="already registered"):
+        register_engine(clone)
+    # same name in ANOTHER kind is fine (namespaced): the strategy zoo
+    # holds a 'momentum' row next to the serve endpoint
+    from csmom_tpu.registry import strategies
+
+    assert "momentum" in strategies()  # importing the zoo registers it
+    assert get_engine("momentum", kind="strategy").strategy_cls is not None
+
+
+def test_sharded_hook_is_declared_but_stubbed():
+    for spec in engine_specs("serve") + engine_specs("compile"):
+        if spec.sharded_fn is None:
+            with pytest.raises(NotImplementedError, match="ROADMAP item 1"):
+                spec.sharded()
+
+
+# -------------------------------------------------- completeness (tier-1) --
+
+def test_manifest_covers_every_registered_endpoint():
+    from csmom_tpu.compile.manifest import build_manifest
+    from csmom_tpu.serve.buckets import bucket_spec
+
+    for profile in ("serve", "serve-smoke"):
+        spec = bucket_spec(profile)
+        entries = build_manifest(profile)
+        names = {e.name for e in entries}
+        assert len(names) == len(entries)
+        for kind in serve_endpoints():
+            for B, A, M in spec.shapes():
+                assert f"serve.{kind}.b{B}@{A}x{M}" in names, (
+                    f"endpoint {kind!r} missing its {B}x{A}x{M} manifest "
+                    "entry: registration did not buy surface (a)")
+        for e in entries:
+            e.validate()
+
+
+@pytest.mark.parametrize("engine", ["stub", "jax"])
+def test_every_registered_endpoint_round_trips_through_dispatch(engine):
+    """manifest -> warm -> dispatch, driven only by the registry: the
+    loop body never names an endpoint."""
+    from csmom_tpu.serve.service import ServeConfig, SignalService
+
+    svc = SignalService(ServeConfig(profile="serve-smoke", engine=engine,
+                                    max_wait_s=0.005)).start()
+    months = svc.spec.months
+    try:
+        warm = svc.warm_report
+        assert list(warm["endpoints"]) == list(serve_endpoints())
+        reqs = {k: svc.submit(k, *_panel(5, months, seed=i))
+                for i, k in enumerate(serve_endpoints())}
+        for kind, r in reqs.items():
+            assert r.wait(30.0) and r.state == "served", (
+                kind, r.state, r.error)
+            s = serve_surface(kind)
+            if s.output == "summary":
+                assert set(r.result) == set(s.summary_fields)
+            else:
+                assert np.asarray(r.result).shape == (5,)
+    finally:
+        svc.stop()
+    assert svc.invariant_violations() == []
+    fresh = svc.fresh_compiles()
+    assert fresh == 0, f"in-window fresh compiles: {fresh}"
+
+
+# ------------------------------------------------------- the toy engine ----
+
+def _toy_batch(params):
+    import jax.numpy as jnp
+
+    def one(values, mask):
+        return jnp.where(mask[:, -1], values[:, -1], jnp.nan)
+
+    return one
+
+
+def _toy_stub(params):
+    def fn(values, mask):
+        return np.where(mask[:, :, -1], values[:, :, -1], np.nan)
+
+    return fn
+
+
+@pytest.fixture
+def toy_engine():
+    name = "toy_last_price"
+    spec = register_engine(
+        name=name, kind="serve",
+        description="last observed price (test-only toy engine)",
+        axes="values f[B,A,M], mask bool[B,A,M] -> f[B,A]",
+        serve=ServeSurface(batch_fn=_toy_batch, stub_fn=_toy_stub,
+                           panel_family="price"),
+    )
+    try:
+        yield spec
+    finally:
+        unregister_engine(name, kind="serve")
+
+
+def test_toy_engine_gets_all_five_surfaces(toy_engine, tmp_path):
+    """Register once in-test; every production surface appears with no
+    other file edited — the tentpole's acceptance property."""
+    from csmom_tpu.compile.manifest import build_manifest
+    from csmom_tpu.serve.buckets import bucket_spec
+    from csmom_tpu.serve.loadgen import (
+        LoadConfig,
+        run_loadgen,
+        write_artifact,
+    )
+    from csmom_tpu.serve.service import ServeConfig, SignalService
+
+    name = toy_engine.name
+    spec = bucket_spec("serve-smoke")
+
+    # (a) manifest entries, bound against the live jitted signature
+    entries = [e for e in build_manifest("serve-smoke")
+               if e.name.startswith(f"serve.{name}.")]
+    assert len(entries) == len(spec.shapes())
+    for e in entries:
+        e.validate()
+
+    # (b) a donated-buffer jit variant that computes the same thing
+    v = np.asarray(_panel(3, spec.months, seed=7)[0])
+    m = np.ones((3, spec.months), bool)
+    plain = np.asarray(toy_engine.serve.batch_fn(
+        dict(lookback=12, skip=1, n_bins=10, mode="rank"))(v, m))
+    donated = toy_engine.donated(lookback=12, skip=1, n_bins=10,
+                                 mode="rank")
+    out = np.asarray(donated(v[None].copy(), m[None].copy()))
+    np.testing.assert_allclose(out[0], plain)
+
+    # (c) a live serve endpoint (stub engine keeps this test fast; the
+    # jax dispatch path is pinned by the round-trip test above)
+    svc = SignalService(ServeConfig(profile="serve-smoke", engine="stub",
+                                    max_wait_s=0.005)).start()
+    try:
+        req = svc.submit(name, *_panel(4, spec.months))
+        assert req.wait(5.0) and req.state == "served", (req.state,
+                                                         req.error)
+        assert np.asarray(req.result).shape == (4,)
+    finally:
+        svc.stop()
+
+    # (d) a loadgen workload leg that lands per-endpoint ledger rows
+    assert name in workload_kinds()
+    svc = SignalService(ServeConfig(profile="serve-smoke", engine="stub",
+                                    max_wait_s=0.005)).start()
+    art = run_loadgen(svc, LoadConfig(schedule="0.4x80", seed=3,
+                                      run_id="r98"))
+    assert inv.validate(art, "serve") == []
+    assert name in art["endpoints"]
+    assert art["endpoints"][name]["submitted"] > 0, (
+        "the toy engine never entered the load mix")
+    path = write_artifact(str(tmp_path), art)
+    from csmom_tpu.obs import ledger
+
+    rows = ledger.load(str(tmp_path)).rows
+    assert any(r.metric == f"serve_ep_{name}_p99_ms" for r in rows), (
+        "no per-endpoint ledger row for the toy engine")
+    assert os.path.basename(path) == "SERVE_r98.json"
+
+    # (e) the sharded hook is declared (stub until ROADMAP item 1)
+    with pytest.raises(NotImplementedError, match="ROADMAP item 1"):
+        toy_engine.sharded()
+
+
+def test_unregistered_endpoint_rejected_at_every_door():
+    from csmom_tpu.serve.service import ServeConfig, SignalService
+
+    svc = SignalService(ServeConfig(profile="serve-smoke", engine="stub",
+                                    max_wait_s=0.005)).start()
+    try:
+        req = svc.submit("no_such_engine", *_panel(3, svc.spec.months))
+        assert req.state == "rejected"
+        assert "unknown endpoint" in (req.error or "")
+    finally:
+        svc.stop()
+
+
+# ------------------------------------------- schema v3 registry validation -
+
+def _v3_artifact(**over):
+    """A minimal well-formed serve v3 artifact to doctor."""
+    from csmom_tpu.serve.loadgen import LoadConfig, run_loadgen
+    from csmom_tpu.serve.service import ServeConfig, SignalService
+
+    svc = SignalService(ServeConfig(profile="serve-smoke", engine="stub",
+                                    max_wait_s=0.005)).start()
+    art = run_loadgen(svc, LoadConfig(schedule="0.3x60", seed=1,
+                                      run_id="doctored"))
+    art.update(over)
+    return art
+
+
+def test_serve_v3_artifact_validates_and_is_registry_checked():
+    art = _v3_artifact()
+    assert art["schema_version"] == 3
+    assert inv.validate(art, "serve") == []
+
+    # an endpoint name no registered engine implements is invalid
+    bad = json.loads(json.dumps(art))
+    bad["endpoints"]["phantom_engine"] = {
+        "submitted": 0, "served": 0, "rejected": 0, "expired": 0,
+        "latency_ms": {"p50": None, "p95": None, "p99": None}}
+    viols = inv.validate(bad, "serve")
+    assert any("not a registered engine" in v for v in viols), viols
+
+    # an offered mix naming an unregistered endpoint is invalid
+    bad2 = json.loads(json.dumps(art))
+    bad2["offered"]["kinds"] = list(bad2["offered"]["kinds"]) + ["phantom"]
+    viols = inv.validate(bad2, "serve")
+    assert any("unregistered endpoints" in v for v in viols), viols
+
+    # endpoint books must sum to the global served book
+    bad3 = json.loads(json.dumps(art))
+    k = next(iter(bad3["endpoints"]))
+    bad3["endpoints"][k]["served"] += 1
+    bad3["endpoints"][k]["submitted"] += 1
+    viols = inv.validate(bad3, "serve")
+    assert any("endpoint books do not sum" in v for v in viols), viols
+
+
+def test_loadgen_default_mix_is_the_registry():
+    from csmom_tpu.serve.loadgen import LoadConfig, synth_panel
+
+    assert LoadConfig().resolved_kinds() == workload_kinds()
+    # the synthetic panel family is the surface's declaration
+    rng = random.Random(0)
+    v, m = synth_panel(rng, 4, 24, "turnover")
+    assert np.nanmax(v) > 1e3  # volume-family magnitudes
+    v, m = synth_panel(rng, 4, 24, "low_volatility")
+    assert np.nanmax(v) < 1e3  # price-family random walk
+
+
+# ---------------------------------------------------- enumeration lint -----
+
+# module-level names that read as an engine/endpoint/workload/entry
+# enumeration.  Matching ASSIGNMENTS outside csmom_tpu/registry/ is the
+# drift this lint exists to refuse: the registry must stay the only
+# table (docstring mentions and loop variables don't match an AST
+# module-level assignment, so prose stays free).
+_BANNED = ("ENDPOINTS", "ENTRIES", "WORKLOADS", "STRATEGIES")
+
+
+def _banned_name(name: str) -> bool:
+    up = name.upper().lstrip("_")
+    return any(up == b or up.endswith("_" + b) for b in _BANNED)
+
+
+def _lint_sources():
+    files = [os.path.join(_REPO, "bench.py")]
+    for root in ("csmom_tpu", "benchmarks"):
+        for dirpath, _, names in os.walk(os.path.join(_REPO, root)):
+            if "__pycache__" in dirpath:
+                continue
+            files += [os.path.join(dirpath, n) for n in names
+                      if n.endswith(".py")]
+    return sorted(files)
+
+
+def test_no_endpoint_entry_or_workload_lists_outside_the_registry():
+    """The enumeration-drift lint (ISSUE 9 satellite): a module outside
+    ``csmom_tpu/registry/`` that assigns an ENDPOINTS/…_ENTRIES/
+    WORKLOADS/…_STRATEGIES enumeration at module level is forking the
+    registry back into a parallel table — exactly the four-list world
+    the tentpole deleted."""
+    offenders = []
+    for path in _lint_sources():
+        rel = os.path.relpath(path, _REPO)
+        if rel.startswith(os.path.join("csmom_tpu", "registry")):
+            continue  # the registry IS the table
+        with open(path, encoding="utf-8") as f:
+            try:
+                tree = ast.parse(f.read(), filename=rel)
+            except SyntaxError as e:  # pragma: no cover
+                offenders.append(f"{rel}: unparseable ({e})")
+                continue
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets
+                           if isinstance(t, ast.Name)]
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name):
+                targets = [node.target]
+            for t in targets:
+                if _banned_name(t.id):
+                    offenders.append(f"{rel}:{node.lineno}: {t.id}")
+    assert offenders == [], (
+        "endpoint/entry/workload enumerations outside csmom_tpu/registry/: "
+        f"{offenders} — register engines instead of growing a parallel "
+        "list (ISSUE 9's lint)"
+    )
+
+
+def test_lint_actually_catches_an_enumeration():
+    """The lint's own regression test: the pre-ISSUE-9 buckets.py line
+    would be flagged."""
+    src = 'ENDPOINTS = ("momentum", "turnover", "backtest")\n'
+    tree = ast.parse(src)
+    node = tree.body[0]
+    assert isinstance(node, ast.Assign)
+    assert _banned_name(node.targets[0].id)
+    # and the allowed spellings stay allowed
+    for ok in ("GRID_JS", "NAMED_SCHEDULES", "PROFILES", "OUTCOMES"):
+        assert not _banned_name(ok)
+
+
+def test_reregistration_rebuilds_the_jitted_scorer():
+    """The jit cache keys on the SURFACE, not the endpoint name: a name
+    re-registered with a new surface must serve the new scorer, never a
+    stale compiled one (the runtime-registration flow's correctness)."""
+    from csmom_tpu.serve.engine import serve_entry_fn
+
+    name = "toy_reregister"
+
+    def batch_v1(params):
+        import jax.numpy as jnp
+
+        return lambda v, m: jnp.where(m[:, -1], v[:, -1], jnp.nan)
+
+    def batch_v2(params):
+        import jax.numpy as jnp
+
+        return lambda v, m: jnp.where(m[:, -1], 2.0 * v[:, -1], jnp.nan)
+
+    stub = _toy_stub
+    v = np.ones((1, 2, 4), np.float32) * 3.0
+    m = np.ones((1, 2, 4), bool)
+    try:
+        register_engine(name=name, kind="serve",
+                        serve=ServeSurface(batch_fn=batch_v1, stub_fn=stub))
+        out1 = np.asarray(serve_entry_fn(name, 12, 1, 10, "rank")(v, m))
+        unregister_engine(name, kind="serve")
+        register_engine(name=name, kind="serve",
+                        serve=ServeSurface(batch_fn=batch_v2, stub_fn=stub))
+        out2 = np.asarray(serve_entry_fn(name, 12, 1, 10, "rank")(v, m))
+        np.testing.assert_allclose(out1, 3.0)
+        np.testing.assert_allclose(out2, 6.0), (
+            "re-registered endpoint served the STALE compiled scorer")
+    finally:
+        unregister_engine(name, kind="serve")

@@ -285,3 +285,29 @@ def test_manifest_stream_profiles_validate():
             assert f"x{bars}" in e.name
         kinds = {e.name.split(".")[1].split("@")[0] for e in entries}
         assert kinds == {"momentum", "turn_avg"}
+
+
+def test_replay_default_capacity_wraps_the_ring(clean_art):
+    """ISSUE 9 satellite: run_replay no longer masks the wrap-around
+    reconcile defect by pinning capacity == bars — the default ring is
+    smaller than the log, so every replay evicts, re-anchors, and must
+    still report zero drift."""
+    cfg = ReplayConfig()
+    assert cfg.resolved_capacity() < cfg.bars
+    panel = clean_art["panel"]
+    assert panel["capacity"] < panel["bars_appended"]
+    assert panel["evictions"] > 0
+    rec = clean_art["reconcile"]
+    assert rec["reanchors"] > 0, (
+        "the window never slid past the prefix anchor — the wrap path "
+        "went unexercised")
+    assert rec["drift_events"] == 0
+
+
+def test_replay_capacity_must_hold_a_serve_window():
+    with pytest.raises(ValueError, match="capacity"):
+        ReplayConfig(capacity=8).validate()
+    # explicit capacity == bars restores the r12 non-evicting behavior
+    cfg = ReplayConfig(capacity=ReplayConfig().bars)
+    cfg.validate()
+    assert cfg.resolved_capacity() == cfg.bars

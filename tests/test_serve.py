@@ -22,7 +22,11 @@ import numpy as np
 import pytest
 
 from csmom_tpu.chaos import invariants as inv
-from csmom_tpu.serve.buckets import ENDPOINTS, bucket_spec
+from csmom_tpu.registry import serve_endpoints
+from csmom_tpu.serve.buckets import bucket_spec
+
+# the registry-era endpoint set (the old buckets.ENDPOINTS literal)
+ENDPOINTS = serve_endpoints()
 from csmom_tpu.serve.queue import AdmissionQueue, Request
 from csmom_tpu.serve.service import ServeConfig, SignalService
 from csmom_tpu.utils.deadline import mono_now_s
@@ -60,10 +64,13 @@ def test_served_request_roundtrip_and_accounting():
     mom = reqs[0].result
     assert mom.shape == (5,)  # unpadded: exactly the request's assets
     assert set(reqs[2].result) == {"mean_spread", "ann_sharpe"}
+    # the registry-shipped strategy endpoints serve per-asset vectors too
+    assert reqs[ENDPOINTS.index("low_volatility")].result.shape == (5,)
+    assert reqs[ENDPOINTS.index("zscore_combo")].result.shape == (5,)
     svc.stop()
     _accounting_closed(svc)
     a = svc.accounting()
-    assert (a["admitted"], a["served"]) == (3, 3)
+    assert (a["admitted"], a["served"]) == (len(ENDPOINTS), len(ENDPOINTS))
 
 
 def test_queue_full_rejects_with_retry_after_hint():

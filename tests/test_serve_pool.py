@@ -110,10 +110,16 @@ def test_cache_version_fingerprints_the_compiled_world():
 
 
 def test_expected_entry_names_match_the_manifest_scheme():
+    from csmom_tpu.registry import serve_endpoints
+
     names = health.expected_entry_names("serve-smoke")
-    # 3 endpoints x 2 batch buckets x 1 asset bucket
-    assert len(names) == 6
+    # every REGISTERED endpoint x 2 batch buckets x 1 asset bucket —
+    # sized by the registry, so a newly registered endpoint widens the
+    # warm contract automatically (ISSUE 9)
+    assert len(names) == len(serve_endpoints()) * 2
     assert "serve.momentum.b1@8x24" in names
+    assert "serve.low_volatility.b1@8x24" in names
+    assert "serve.zscore_combo.b4@8x24" in names
 
 
 def test_cache_readiness_cold_dir_points_at_warmup(tmp_path, monkeypatch):
