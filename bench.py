@@ -604,6 +604,78 @@ def child_main():
             "on this platform"
         )
 
+    # -- mesh leg (ISSUE 10): the full-size grid through the grid-cell x
+    # asset sharded engine, when a mesh is visible.  Its workload key
+    # CARRIES the layout + device count, so the ledger never pairs a
+    # d=1 wall with a d=8 one; the efficiency ratio rides as extra
+    # evidence (info in the ledger — CPU host devices share cores).
+    full_sharded_s = None
+    full_sharded_workload = "see grid16_rank_full_sharded_s for why absent"
+    mesh_efficiency = None
+    ndev = jax.device_count()
+    if on_cpu:
+        ref_wall, spanel, smask = full_rank_s, None, None
+        if isinstance(full_rank_s, float):
+            spanel, smask = fpm, fmm
+            A_s, T_s = A_f, T_f
+    else:
+        ref_wall, spanel, smask = grid_rank_s, pm, mm
+        A_s, T_s = A, T
+    if SMOKE:
+        full_sharded_s = SMOKE_REASON
+    elif ndev < 2:
+        full_sharded_s = (
+            f"skipped: 1 visible device — the sharded leg measures a mesh "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=8 simulates "
+            "one on CPU; a TPU slice provides its own)")
+    elif spanel is None:
+        full_sharded_s = (
+            "skipped: no full-size panel in this child (the single-device "
+            "full leg did not run; see grid16_rank_full_s)")
+    elif _child_left() <= (2 * ref_wall if isinstance(ref_wall, float)
+                           else 0) + 120:
+        full_sharded_s = (
+            "skipped: child budget too small for the sharded full-size "
+            "compile+run after the single-device legs")
+    else:
+        try:
+            import jax.numpy as jnp
+
+            from csmom_tpu.mesh.pinning import shards_for
+            from csmom_tpu.mesh.rules import grid_asset_mesh
+            from csmom_tpu.parallel.collectives import grid_shard_fn
+
+            g_sh = shards_for(len(wl.GRID_JS), ndev)
+            a_sh = shards_for(int(spanel.shape[0]), max(1, ndev // g_sh))
+            smesh = grid_asset_mesh(g_sh, a_sh)
+            sfn = grid_shard_fn(smesh, wl.GRID_SKIP, 10, "rank",
+                                max(wl.GRID_KS), "xla")
+            Js_a = np.asarray(wl.GRID_JS)
+            Ks_a = np.asarray(wl.GRID_KS)
+            M_s = spanel.shape[1]
+
+            def sg():
+                spreads, live = sfn(spanel, smask, Js_a, Ks_a)
+                fetch(jnp.nansum(jnp.where(live, spreads, 0.0)))
+
+            leg = f"mesh.grid16.rank.xla@{A_s}x{M_s}.g{g_sh}a{a_sh}"
+            _compiled_leg(leg, sg)  # compile (or serve from the AOT cache)
+            with obs.span("bench.row", row="grid16.full.sharded"):
+                full_sharded_s, _SAMPLES["grid16_rank_full_sharded_s"] = \
+                    _timed_reps(1, sg)
+            obs_metrics.counter("bench.rows_landed").inc()
+            full_sharded_workload = (
+                f"16 cells, {A_s} stocks x {T_s} days, "
+                f"grid{g_sh}xassets{a_sh} mesh, d{ndev}")
+            if isinstance(ref_wall, float) and full_sharded_s > 0:
+                # efficiency charges the devices the mesh actually
+                # spans (g*a), not every visible one — an 8-device host
+                # running a 4x1 mesh delivered a 4-way split
+                mesh_efficiency = round(
+                    ref_wall / (full_sharded_s * g_sh * a_sh), 4)
+        except Exception as e:  # record, never lose the JSON line
+            full_sharded_s = f"failed: {type(e).__name__}: {e}"[:200]
+
     # simple cost model of the grid's dominant stage (cohort partial sums:
     # nJ x H horizon-shifted masked reductions over the [A, M] panel) so the
     # wall time maps to achieved bandwidth/flops, not vibes
@@ -665,6 +737,13 @@ def child_main():
             "16 cells, 3000 stocks x 15120 days"
             if isinstance(full_rank_s, float)
             else "see grid16_rank_full_s for why the full-size leg is absent"
+        ),
+        "grid16_rank_full_sharded_s": _r4(full_sharded_s),
+        "grid_full_sharded_workload": full_sharded_workload,
+        "mesh_scaling_efficiency": (
+            mesh_efficiency if mesh_efficiency is not None else
+            "not measurable: no (reference wall, sharded wall) pair this "
+            "run — see grid16_rank_full_sharded_s"
         ),
     })
     # AOT warm-start accounting: with the child's persistence floor at 0,

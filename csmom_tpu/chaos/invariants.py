@@ -124,14 +124,15 @@ KNOWN_SERVE_POOL_SCHEMA_VERSIONS = (1,)
 KNOWN_REPLAY_SCHEMA_VERSIONS = (1,)
 
 # only ROUND sidecars are committed evidence: TELEMETRY_r<NN>.json,
-# SERVE_r<NN>.json, and SERVE_POOL_r<NN>.json.  Rehearse/smoke/scratch
+# SERVE_r<NN>.json, SERVE_POOL_r<NN>.json, and SERVE_MESH_r<NN>.json
+# (the multi-device serving family, ISSUE 10).  Rehearse/smoke/scratch
 # files (TELEMETRY_rehearse_*, SERVE_smoke*, SERVE_POOL_rehearse_*,
 # pid-suffixed operator reruns) are regenerated per run and gitignored —
 # one slipped into the tree once, which is why this is a named rule with
 # a tier-1 test behind it instead of a .gitignore comment.
 _REGENERATED_PREFIXES = ("TELEMETRY_", "SERVE_", "REPLAY_")
 _COMMITTED_SIDECAR_RE = re.compile(
-    r"^(?:TELEMETRY|SERVE|SERVE_POOL|REPLAY)_r\d+\.json$")
+    r"^(?:TELEMETRY|SERVE|SERVE_POOL|SERVE_MESH|REPLAY)_r\d+\.json$")
 
 _NUM = (int, float)
 

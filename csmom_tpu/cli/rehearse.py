@@ -482,6 +482,50 @@ def _check_pool_version_skew(r):
     return out
 
 
+def _check_mesh_pinned_worker_kill(r):
+    """ISSUE 10: SIGKILL a device-pinned worker mid-batch — the r11
+    pool-kill scenario on the mesh path.  The replacement must re-pin
+    its predecessor's EXACT device slice (slices are slot-derived, and
+    the spawn events prove the derivation was honored), re-warm from
+    the serialized AOT cache (fresh compiles stay 0 across the fleet),
+    and the pool's cross-process books must still close."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve_pool")
+    pool = art.get("pool") or {}
+    if not pool.get("kills"):
+        out.append("no worker death observed — the injected process kill "
+                   "did not fire")
+    if not pool.get("restarts"):
+        out.append("no restart recorded — the dead pinned worker was "
+                   "never replaced")
+    events = pool.get("events") or []
+    spawns: dict = {}
+    for e in events:
+        if e.get("event") == "spawn":
+            spawns.setdefault(e.get("worker_id"), []).append(
+                e.get("device_slice"))
+    if any(s is None for slices in spawns.values() for s in slices):
+        out.append("a spawn event carries no device_slice — pinning was "
+                   "not plumbed to the worker")
+    respawned = {w: slices for w, slices in spawns.items()
+                 if len(slices) >= 2}
+    if not respawned:
+        out.append("no worker spawned twice — the replacement's re-pin "
+                   "was never exercised")
+    for w, slices in respawned.items():
+        if len(set(slices)) != 1:
+            out.append(f"{w} re-pinned a DIFFERENT slice across spawns "
+                       f"({slices}) — the slot->slice derivation broke")
+    fresh = (art.get("compile") or {}).get("in_window_fresh_compiles")
+    if isinstance(fresh, int) and fresh != 0:
+        out.append(f"in_window_fresh_compiles = {fresh} — a replacement "
+                   "compiled instead of loading the AOT cache")
+    if not (art.get("requests") or {}).get("served"):
+        out.append("nothing served — the pool did not keep serving past "
+                   "the dead pinned worker")
+    return out
+
+
 def _serve_pool_scenarios():
     # chaos hit counters are PER-PROCESS: every worker's own readiness
     # self-probe dispatches once per REGISTERED endpoint before any load
@@ -507,6 +551,23 @@ def _serve_pool_scenarios():
             env={"mode": "kill",
                  "pool": {"n_workers": 2},
                  "load": {"schedule": "0.6x70", "seed": 13,
+                          "deadline_s": 3.0}},
+        ),
+        Scenario(
+            "mesh-pinned-worker-kill", "serve-pool",
+            FaultPlan("mesh-pinned-worker-kill", seed=31, faults=(
+                Fault(point="serve.dispatch", action="kill",
+                      after=probe_dispatches,
+                      max_fires=1, global_once=True),
+            )),
+            _check_mesh_pinned_worker_kill, fast=True,
+            notes="ISSUE 10: SIGKILL a DEVICE-PINNED worker mid-batch: "
+                  "the replacement re-pins its slot's exact device slice "
+                  "(spawn events prove it), re-warms from the AOT cache "
+                  "(0 fresh compiles), and the pool books close",
+            env={"mode": "kill", "wait_respawn": True,
+                 "pool": {"n_workers": 2, "devices_per_worker": 2},
+                 "load": {"schedule": "0.8x70", "seed": 15,
                           "deadline_s": 3.0}},
         ),
         Scenario(
@@ -1141,7 +1202,21 @@ def _run_serve_pool(scenario, box: str) -> dict:
             art = run_pool_loadgen(router, sup, load, concurrent=_roll)
             result["roll"] = roll_box.get("roll")
         else:
-            art = run_pool_loadgen(router, sup, load)
+            conc = None
+            if scenario.env.get("wait_respawn"):
+                # the artifact must be built from a fleet where the
+                # killed worker's replacement already respawned (its
+                # spawn event is the re-pin evidence the check reads) —
+                # run_pool_loadgen's `concurrent` contract settles it
+                def conc():
+                    give_up = time.monotonic() + 15.0
+                    while time.monotonic() < give_up:
+                        if any(h.generation >= 1 and h.state == "ready"
+                               for h in sup.handles):
+                            return
+                        time.sleep(0.05)
+
+            art = run_pool_loadgen(router, sup, load, concurrent=conc)
         if art is not None:
             write_artifact(box, art, prefix="SERVE_POOL")
         result["trailing"] = art

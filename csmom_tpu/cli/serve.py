@@ -44,6 +44,22 @@ import sys
 __all__ = ["cmd_loadgen", "cmd_serve", "register"]
 
 
+def _engine_name(args, engine_default: str = "jax") -> str:
+    mesh = getattr(args, "mesh", False)
+    if args.stub:
+        if mesh:
+            print("warning: --mesh has no effect with --stub (the numpy "
+                  "stub has no devices to shard over)", file=sys.stderr)
+        return "stub"
+    if not mesh and getattr(args, "devices_per_worker", 0) > 0:
+        # pinning is read by the jax-mesh engine only: without --mesh
+        # the slices are derived and exported but nothing meshes them
+        print("warning: --devices-per-worker without --mesh is a no-op "
+              "(only the jax-mesh engine builds its mesh from the "
+              "pinned slice); add --mesh", file=sys.stderr)
+    return "jax-mesh" if mesh else engine_default
+
+
 def _mk_service(args, engine_default: str = "jax"):
     from csmom_tpu.serve.service import ServeConfig, SignalService
 
@@ -51,7 +67,7 @@ def _mk_service(args, engine_default: str = "jax"):
                                else "serve")
     cfg = ServeConfig(
         profile=profile,
-        engine="stub" if args.stub else engine_default,
+        engine=_engine_name(args, engine_default),
         capacity=args.capacity,
         max_wait_s=args.max_wait_ms / 1e3,
         # unset --deadline-ms = the SLO class budgets; 0 = no default
@@ -71,7 +87,20 @@ def _check_cache_honesty(args, profile: str) -> int:
         return 0
     from csmom_tpu.serve.health import cache_readiness
 
-    ready, reason = cache_readiness(profile)
+    mesh_devices = None
+    if getattr(args, "mesh", False):
+        # the mesh engine's warm evidence is the serve-mesh profile's,
+        # keyed by the device count each ENGINE actually meshes: the
+        # per-worker slice when the pool pins devices, else every
+        # visible device (jax is already this command's backend)
+        dpw = getattr(args, "devices_per_worker", 0)
+        if getattr(args, "workers", 0) > 0 and dpw > 0:
+            mesh_devices = dpw
+        else:
+            import jax
+
+            mesh_devices = len(jax.devices())
+    ready, reason = cache_readiness(profile, mesh_devices=mesh_devices)
     if not ready:
         print(f"NOT READY (cold AOT cache): {reason}", file=sys.stderr)
         print("readiness is a demonstrated claim — compiling inside the "
@@ -90,7 +119,7 @@ def _mk_pool(args, run_dir: str):
 
     profile = args.profile or ("serve-smoke" if getattr(args, "smoke", False)
                                else "serve")
-    engine = "stub" if args.stub else "jax"
+    engine = _engine_name(args)
     # the pool wire carries per-request deadlines from the router, so
     # the worker-side default keeps plain float semantics (r10 mode)
     pool_deadline_ms = 500.0 if args.deadline_ms is None else args.deadline_ms
@@ -103,7 +132,8 @@ def _mk_pool(args, run_dir: str):
         capacity=args.capacity,
         max_wait_ms=args.max_wait_ms,
         deadline_ms=pool_deadline_ms,
-        require_warm_cache=(engine == "jax"
+        devices_per_worker=getattr(args, "devices_per_worker", 0),
+        require_warm_cache=(engine.startswith("jax")
                             and not getattr(args, "allow_cold_cache", False)
                             and not getattr(args, "smoke", False)),
     )
@@ -411,6 +441,15 @@ def cmd_loadgen(args) -> int:
     svc = _mk_service(args)
     svc.start()
     _print_ready(svc)
+    # key the mesh branches off the RESOLVED engine, not the flag:
+    # --stub --mesh degrades to the stub with a warning, and a stub run
+    # must never print mesh claims or land in the SERVE_MESH family
+    mesh_engine = svc.engine.name == "jax-mesh"
+    if mesh_engine:
+        mesh = svc.warm_report.get("mesh") or {}
+        print(f"  mesh: {mesh.get('devices')} devices, placements "
+              + ", ".join(f"{k}:{v['axis']}"
+                          for k, v in (mesh.get("endpoints") or {}).items()))
     load = LoadConfig(
         schedule=schedule,
         schedule_kind=schedule_kind,
@@ -427,7 +466,11 @@ def cmd_loadgen(args) -> int:
           ") ...")
     art = run_loadgen(svc, load)
     out_dir = args.out or os.getcwd()
-    path = write_artifact(out_dir, art)
+    # mesh runs land under their own prefix: SERVE_MESH_rNN.json is the
+    # multi-device evidence family (committable like SERVE_rNN.json),
+    # and the name says which serving story the numbers belong to
+    path = write_artifact(out_dir, art,
+                          prefix="SERVE_MESH" if mesh_engine else "SERVE")
 
     req = art["requests"]
     lat = art["latency_ms"]["total"]
@@ -487,6 +530,20 @@ def _common_flags(sp) -> None:
                          "serve-smoke)")
     sp.add_argument("--stub", action="store_true",
                     help="numpy stub engine (no jax): plumbing/chaos runs")
+    sp.add_argument("--mesh", action="store_true",
+                    help="the jax-mesh engine: sharded dispatch over the "
+                         "device mesh (batch-axis across micro-batch "
+                         "rows, asset-axis for per-asset-independent "
+                         "signals — csmom_tpu/mesh partition rules); "
+                         "bitwise-equal outputs, SERVE_MESH_* artifacts; "
+                         "on CPU simulate devices with XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=8")
+    sp.add_argument("--devices-per-worker", dest="devices_per_worker",
+                    type=int, default=0,
+                    help="pool mode: pin each worker to a fixed "
+                         "contiguous slice of this many devices (slot k "
+                         "owns devices [k*N, k*N+N); replacements re-pin "
+                         "the same slice; 0 = no pinning)")
     sp.add_argument("--capacity", type=int, default=64,
                     help="admission-queue bound (backpressure beyond it; "
                          "default 64)")

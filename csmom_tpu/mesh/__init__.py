@@ -1,0 +1,57 @@
+"""csmom_tpu.mesh — sharding as a first-class subsystem (ROADMAP item 1).
+
+Before this package, distribution lived at call sites: ``parallel/``
+had the collectives and the mesh builders, but every consumer that
+wanted a sharded engine had to hand-build a mesh, pick axis placements,
+and wire its own shard/gather calls — which is why, four serving rounds
+in, the serving tier and the north-star grid were still single-device
+while ``registry/core.py`` carried a declared-but-stubbed ``sharded()``
+hook on every engine.
+
+This package is the missing middle layer:
+
+- :mod:`csmom_tpu.mesh.rules` — the partition-rule table (the
+  SNIPPETS [1]/[3] pattern): ``match_partition_rules`` maps named
+  leaves to :class:`~jax.sharding.PartitionSpec` by regex, and the
+  named tables encode the repo's axis placements once (batch-axis for
+  serve micro-batches, asset-axis for per-asset-independent panels,
+  grid-cell x asset for the J x K backtest) against the
+  ``(grid, assets)`` / ``(batch,)`` meshes built by
+  :mod:`csmom_tpu.parallel.mesh`.
+- :mod:`csmom_tpu.mesh.shard` — shard/gather helpers and the
+  ``shard_map``-via-``compat`` wrapper.  A one-device mesh is the
+  degenerate path: collectives become identities and the wrapped
+  program is the single-device program, so parity is by construction,
+  not by tolerance.
+- :mod:`csmom_tpu.mesh.variants` — fills every registry engine's
+  sharded surface (surface (e)): serve endpoints get batch-axis
+  sharding across micro-batch rows and asset-axis sharding for the
+  per-asset-independent signals; the grid backtest gets grid-cell x
+  asset sharding; the stream reconcile signals shard the asset axis.
+  :func:`csmom_tpu.registry.core.EngineSpec.sharded` resolves here
+  when no explicit ``sharded_fn`` was registered.
+- :mod:`csmom_tpu.mesh.pinning` — stdlib-only device-slice bookkeeping
+  for the worker pool (``--devices-per-worker``): slot -> slice
+  mapping, the env contract workers inherit, and the shard-count
+  arithmetic the jax layers share.  Import-safe from the jax-free
+  supervisor/rehearse paths.
+
+jax imports stay inside functions (pinning is stdlib-only; rules/
+shard/variants pay jax only when a mesh is actually built), so the
+registry and the fast rehearse tier can keep querying engine surfaces
+without initializing a backend.
+"""
+
+from csmom_tpu.mesh.pinning import (
+    DEVICE_SLICE_ENV,
+    parse_device_slice,
+    shards_for,
+    slice_for_slot,
+)
+
+__all__ = [
+    "DEVICE_SLICE_ENV",
+    "parse_device_slice",
+    "shards_for",
+    "slice_for_slot",
+]

@@ -1,0 +1,199 @@
+"""The partition-rule table: regex -> PartitionSpec, resolved on a named mesh.
+
+The SNIPPETS [1]/[3] pattern, specialized to this repo's axes: instead
+of every call site hand-placing arrays on a mesh, a RULE TABLE maps
+leaf names to :class:`~jax.sharding.PartitionSpec`\\ s and
+:func:`match_partition_rules` resolves a whole named tree at once.
+Scalars and singletons are never partitioned; a leaf no rule matches is
+a loud error — an array silently replicated by omission is exactly the
+drift this table exists to prevent.
+
+Axis placements (one written-down table, from the
+:mod:`csmom_tpu.parallel.mesh` layout principle: the asset axis is the
+only one with collectives, so it rides ICI; batch rows and grid cells
+are embarrassingly parallel):
+
+==================  =====================  ============================
+table               mesh                   what shards
+==================  =====================  ============================
+serve batch rules   ``("batch",)``         micro-batch rows of
+                                           ``values/mask f[B, A, M]``
+                                           (rows are independent: the
+                                           split is bitwise-neutral)
+serve asset rules   ``("assets",)``        the asset axis of per-asset-
+                                           independent endpoints
+                                           (momentum/turnover): large
+                                           universes split with zero
+                                           communication
+grid rules          ``("grid", "assets")``  J cells across ``grid``
+                                           (no communication), assets
+                                           across ``assets`` (one
+                                           all_gather for the rank +
+                                           psums, the collectives
+                                           engine's pattern)
+panel asset rules   ``("assets",)``        ``[A, ...]`` panels + per-
+                                           asset vectors (stream
+                                           reconcile, histrank, event)
+==================  =====================  ============================
+
+Which PLACEMENT a serve endpoint gets is itself a rule
+(:func:`serve_axis_for`): per-asset-independent signals declare the
+asset axis, everything that reduces across the cross-section (the
+backtest summary, z-scored combos) stays batch-sharded — an asset
+split there would change reduction order and break the bitwise-parity
+contract :mod:`tests.test_mesh` pins.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+__all__ = [
+    "grid_asset_mesh",
+    "match_partition_rules",
+    "named_mesh",
+    "panel_asset_rules",
+    "serve_axis_for",
+    "serve_rules",
+    "grid_rules",
+]
+
+# serve-endpoint placement table: regex on the REGISTERED endpoint name
+# -> mesh axis.  Asset-axis entries must be per-asset independent
+# (bitwise-safe under an asset split); anything unmatched — including a
+# runtime-registered plugin the table has never heard of — falls back
+# to the always-safe batch axis.
+_SERVE_AXIS_RULES = (
+    (r"^(momentum|turnover)$", "assets"),
+    (r".", "batch"),
+)
+
+
+def serve_axis_for(endpoint: str) -> str:
+    """Which mesh axis a serve endpoint's sharded entry splits."""
+    for rule, axis in _SERVE_AXIS_RULES:
+        if re.search(rule, endpoint):
+            return axis
+    return "batch"
+
+
+def _P():
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec
+
+
+def serve_rules(axis: str):
+    """The serve-panel rule table for one placement: ``values``/``mask``
+    are ``f[B, A, M]`` micro-batches; outputs are ``f[B, A]``
+    (per-asset) or ``f[B, k]`` (summary)."""
+    P = _P()
+    if axis == "batch":
+        return (
+            (r"(^|/)(values|mask)$", P("batch", None, None)),
+            (r"(^|/)out_per_asset$", P("batch", None)),
+            (r"(^|/)out_summary$", P("batch", None)),
+        )
+    if axis == "assets":
+        return (
+            (r"(^|/)(values|mask)$", P(None, "assets", None)),
+            (r"(^|/)out_per_asset$", P(None, "assets")),
+        )
+    raise ValueError(f"unknown serve placement {axis!r}: use 'batch' or "
+                     "'assets'")
+
+
+def grid_rules():
+    """The J x K grid table: panels replicated per asset shard, J cells
+    across ``grid``, per-cell planes gathered grid-major."""
+    P = _P()
+    return (
+        (r"(^|/)(prices|mask)$", P("assets", None)),
+        (r"(^|/)Js$", P("grid")),
+        (r"(^|/)Ks$", P()),
+        (r"(^|/)(spreads|spread_valid|net)$", P("grid", None, None)),
+    )
+
+
+def panel_asset_rules():
+    """``[A, ...]`` panels and per-asset vectors, asset-axis sharded
+    (stream reconcile, histrank labels, the event engine's five
+    arrays)."""
+    P = _P()
+    return (
+        (r"(^|/)(prices|values|volumes|price|valid|score|mask)$",
+         P("assets")),
+        (r"(^|/)(shares|adv|vol)$", P("assets")),
+        (r"(^|/)labels$", P("assets")),
+    )
+
+
+def match_partition_rules(rules, tree, sep: str = "/"):
+    """Resolve a named tree of arrays/abstract values to PartitionSpecs.
+
+    ``tree`` is nested dicts/lists/tuples with array-like leaves (real
+    arrays or ``ShapeDtypeStruct``\\ s).  Leaf names join their dict
+    path with ``sep`` (list/tuple indices stringify), and the FIRST
+    rule whose regex searches the name wins — order the tables
+    specific-first.  Scalars and one-element leaves get ``P()``
+    (never partitioned); a non-scalar leaf with no matching rule
+    raises, naming the leaf.
+    """
+    P = _P()
+
+    def spec_for(name, leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if len(shape) == 0 or math.prod(shape) == 1:
+            return P()
+        for rule, ps in rules:
+            if re.search(rule, name):
+                return ps
+        raise ValueError(
+            f"no partition rule matches leaf {name!r} (shape {shape}); "
+            "add a rule to csmom_tpu/mesh/rules.py or pass an explicit "
+            "spec")
+
+    def walk(name, node):
+        if isinstance(node, dict):
+            return {k: walk(f"{name}{sep}{k}" if name else str(k), v)
+                    for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            out = [walk(f"{name}{sep}{i}" if name else str(i), v)
+                   for i, v in enumerate(node)]
+            return type(node)(out) if isinstance(node, tuple) else out
+        return spec_for(name, node)
+
+    return walk("", tree)
+
+
+def named_mesh(axis: str, n_shards: int, devices=None):
+    """A 1-D mesh named ``axis`` over the first ``n_shards`` devices."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    devices = tuple(devices) if devices is not None else tuple(jax.devices())
+    if n_shards > len(devices):
+        raise ValueError(
+            f"{n_shards} shards > {len(devices)} visible devices")
+    return Mesh(np.asarray(devices[:n_shards]), (axis,))
+
+
+def grid_asset_mesh(grid_shards: int, asset_shards: int, devices=None):
+    """The ``(grid, assets)`` mesh for the J x K backtest — the
+    :func:`csmom_tpu.parallel.mesh.make_mesh` placement, sized
+    explicitly (grid cells on the collective-free axis, assets on the
+    ICI axis)."""
+    import jax
+
+    from csmom_tpu.parallel.mesh import make_mesh
+
+    devices = tuple(devices) if devices is not None else tuple(jax.devices())
+    need = grid_shards * asset_shards
+    if need > len(devices):
+        raise ValueError(
+            f"grid {grid_shards} x assets {asset_shards} = {need} devices "
+            f"> {len(devices)} visible")
+    return make_mesh(list(devices[:need]), grid_axis=grid_shards)

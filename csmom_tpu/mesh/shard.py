@@ -1,0 +1,78 @@
+"""Shard/gather helpers + the ``shard_map`` wrapper the variants build on.
+
+Thin by design: the partition DECISIONS live in :mod:`~csmom_tpu.mesh.
+rules`, the ENGINE constructions in :mod:`~csmom_tpu.mesh.variants`;
+this module owns only the mechanical layer — placing host arrays onto a
+mesh per spec, gathering results back, and wrapping a local function
+with :func:`csmom_tpu.parallel.compat.shard_map` (the one import site
+for the jax 0.4/0.6 API split).
+
+Degenerate path: every helper accepts a one-device mesh and produces
+the single-device program — ``shard_map`` over one device makes
+``all_gather``/``psum`` identities and the local slice the whole array,
+and :func:`sharded_call` skips the wrapper entirely when the mesh is
+trivial, so the sharded entry IS the unsharded entry (identical by
+construction, which is what lets the parity tests assert bitwise
+equality instead of tolerances).
+"""
+
+from __future__ import annotations
+
+__all__ = ["gather", "mesh_size", "shard_args", "sharded_call"]
+
+
+def mesh_size(mesh) -> int:
+    import math
+
+    return math.prod(mesh.shape.values())
+
+
+def shard_args(mesh, specs, *arrays):
+    """Place host arrays onto ``mesh`` per their PartitionSpecs (one
+    spec per array, e.g. from :func:`~csmom_tpu.mesh.rules.
+    match_partition_rules`).  Pre-placing inputs keeps a hot loop from
+    re-transferring per call; passing host arrays straight to the
+    compiled fn also works (jit shards them per the program)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    if len(specs) != len(arrays):
+        raise ValueError(f"{len(specs)} specs for {len(arrays)} arrays")
+    return tuple(jax.device_put(a, NamedSharding(mesh, s))
+                 for a, s in zip(arrays, specs))
+
+
+def gather(x):
+    """One fully-replicated/host numpy view of a (possibly sharded)
+    array — the evidence-writing side of the shard/gather pair."""
+    import numpy as np
+
+    import jax
+
+    return np.asarray(jax.device_get(x))
+
+
+def sharded_call(fn, mesh, in_specs, out_specs, *, check_vma: bool = False,
+                 jit: bool = True, collective_free: bool = False):
+    """``shard_map(fn)`` on ``mesh``, jitted.
+
+    With ``collective_free`` (the caller's declaration that ``fn`` uses
+    no ``lax`` collectives or axis queries), a one-device mesh skips
+    the wrapper entirely and returns ``jit(fn)`` — the degenerate-path
+    contract: a 1-device environment runs the LITERAL single-device
+    program, not a 1-shard emulation of it.  A collective-using local
+    fn keeps the wrapper at every size (``all_gather``/``psum`` over
+    one device are identities, so the degeneracy still holds — just
+    inside the mapped program).  ``check_vma=False`` matches the repo's
+    collectives engines (the replication checker predates several of
+    the patterns they use).
+    """
+    import jax
+
+    from csmom_tpu.parallel.compat import shard_map
+
+    if collective_free and mesh_size(mesh) == 1:
+        return jax.jit(fn) if jit else fn
+    wrapped = shard_map(fn, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_vma=check_vma)
+    return jax.jit(wrapped) if jit else wrapped

@@ -91,6 +91,10 @@ _WALL_METRICS = {
     "pack_ingest_s": "grid_workload",
     "grid16_rank_full_s": "grid_full_workload",
     "grid16_rank_matmul_full_s": "grid_full_workload",
+    # the device-mesh leg (ISSUE 10): its workload fingerprint CARRIES
+    # the mesh layout + device count, so a 1-device and an N-device run
+    # are different keys and never gate against each other
+    "grid16_rank_full_sharded_s": "grid_full_sharded_workload",
 }
 
 
@@ -393,6 +397,17 @@ def _serve_rows(obj: dict, run: str, num: int, variant,
         rows.append(Row(metric="serve_in_window_fresh_compiles", value=fc,
                         unit="compiles", direction="lower", flags=flags,
                         **base))
+    # mesh runs (ISSUE 10): the scaling-probe efficiency rides as an
+    # info row — speedup/devices at the largest warmed bucket.  Info,
+    # never gated: CPU host-platform devices share cores, so the number
+    # documents THIS topology's delivery, not a regression axis.
+    mesh = extra.get("mesh")
+    if isinstance(mesh, dict):
+        eff = _num((mesh.get("scaling") or {}).get("scaling_efficiency"))
+        if eff is not None:
+            rows.append(Row(metric="mesh_scaling_efficiency", value=eff,
+                            unit="frac", direction="higher",
+                            flags=_flags(obj, variant, info=True), **base))
     return rows
 
 

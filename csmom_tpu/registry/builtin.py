@@ -563,3 +563,113 @@ REGISTRY.register(EngineSpec(
     profiles=("stream", "stream-smoke"),
     manifest_fn=_stream_manifest,
 ))
+
+
+# ---------------------------------------------------------------------------
+# mesh profiles (ISSUE 10): the SHARDED twins of the serve bucket grid
+# and the bench grid, keyed by the live device topology at call time —
+# `csmom warmup --profiles serve-mesh bench-mesh` AOT-warms and
+# memory-profiles the exact callables the mesh engine / sharded bench
+# leg dispatch, so a mesh serving window keeps in_window_fresh_compiles
+# == 0 like everything else.
+# ---------------------------------------------------------------------------
+
+def mesh_serve_profile_entries(profile: str, dtype=None) -> list:
+    """The sharded serve bucket grid: every (endpoint, batch, assets)
+    shape's mesh entry at the CURRENT device count.  Shard counts ride
+    in the entry name (``.d<n>``) because the compiled world is keyed
+    by them — a warmup on 8 host devices and a worker pinned to 2
+    compile different programs, and the names must say so."""
+    import numpy as np
+
+    from csmom_tpu.compile.manifest import ManifestEntry, sds
+    from csmom_tpu.mesh.variants import sharded_serve_jit_for
+    from csmom_tpu.serve.buckets import bucket_spec
+    from csmom_tpu.serve.service import ServeConfig
+
+    spec = bucket_spec("serve-smoke" if profile.endswith("-smoke")
+                       else "serve")
+    dt = np.dtype(dtype or spec.dtype)
+    cfg = ServeConfig()  # the single source of the service's signal params
+    out = []
+    for kind in REGISTRY.serve_endpoints():
+        for B, A, M in spec.shapes():
+            fn, n = sharded_serve_jit_for(kind, B, A, cfg.lookback,
+                                          cfg.skip, cfg.n_bins, cfg.mode)
+            out.append(ManifestEntry(
+                name=f"mesh.serve.{kind}.b{B}@{A}x{M}.d{n}",
+                fn=fn,
+                args=(sds((B, A, M), dt), sds((B, A, M), bool)),
+            ))
+    # the scaling probe's single-device REFERENCE entry (MeshJaxEngine
+    # warms it before the freshness snapshot): in the profile so a mesh
+    # worker start loads it from the AOT cache like everything else
+    # instead of paying a hidden pre-snapshot compile per process
+    from csmom_tpu.serve.engine import serve_entry_fn
+
+    probe = REGISTRY.serve_endpoints()[0]
+    B, A, M = spec.batch_buckets[-1], spec.asset_buckets[-1], spec.months
+    out.append(ManifestEntry(
+        name=f"mesh.serve.single-probe.{probe}.b{B}@{A}x{M}",
+        fn=serve_entry_fn(probe, cfg.lookback, cfg.skip, cfg.n_bins,
+                          cfg.mode),
+        args=(sds((B, A, M), dt), sds((B, A, M), bool)),
+    ))
+    return out
+
+
+def _mesh_grid_manifest(profile: str, dtype=None) -> list:
+    """The grid-cell x asset sharded J x K entries (reduced + full-size
+    panels, the bench-cpu pair) on the current topology — what
+    ``bench.py``'s sharded full-grid leg dispatches."""
+    import numpy as np
+
+    import jax
+
+    from csmom_tpu.compile import workloads as wl
+    from csmom_tpu.compile.manifest import ManifestEntry, months_of, sds
+    from csmom_tpu.mesh.pinning import shards_for
+    from csmom_tpu.mesh.rules import grid_asset_mesh
+    from csmom_tpu.parallel.collectives import grid_shard_fn
+
+    m = _manifest_mod()
+    dt = _dt(profile, dtype)
+    idx = np.dtype(np.int64 if dt == np.float64 else np.int32)
+    ndev = len(jax.devices())
+    nJ = len(wl.GRID_JS)
+    g = shards_for(nJ, ndev)
+    out = []
+    for A, T in (wl.REDUCED_GRID, wl.NORTH_STAR_GRID):
+        a = shards_for(A, max(1, ndev // g))
+        mesh = grid_asset_mesh(g, a)
+        fn = grid_shard_fn(mesh, wl.GRID_SKIP, 10, "rank",
+                           max(wl.GRID_KS), "xla")
+        M = months_of(T)
+        out.append(ManifestEntry(
+            name=f"mesh.grid.jk16.rank.xla@{A}x{M}.g{g}a{a}",
+            fn=fn,
+            args=(sds((A, M), dt), sds((A, M), bool),
+                  sds((nJ,), idx), sds((len(wl.GRID_KS),), idx)),
+        ))
+    return out
+
+
+REGISTRY.register(EngineSpec(
+    name="mesh.serve", kind="compile",
+    description="the sharded serve bucket grid: batch-/asset-axis "
+                "sharded micro-batch entries per endpoint at the live "
+                "device count (csmom_tpu/mesh partition rules)",
+    axes="values f[B,A,M], mask bool[B,A,M] per endpoint, batch or "
+         "asset axis sharded",
+    profiles=("serve-mesh", "serve-mesh-smoke"),
+    manifest_fn=mesh_serve_profile_entries,
+))
+
+REGISTRY.register(EngineSpec(
+    name="mesh.grid", kind="compile",
+    description="the grid-cell x asset sharded J x K backtest entries "
+                "(reduced + north-star panels) on the live topology",
+    axes="prices f[A,M], mask bool[A,M], Js/Ks grid-sharded",
+    profiles=("bench-mesh",),
+    manifest_fn=_mesh_grid_manifest,
+))

@@ -23,9 +23,11 @@ receives every surface the production stack offers:
     also what enumerates the warm set;
 (d) **a loadgen workload leg** that lands per-endpoint ledger rows
     (``serve/loadgen.py`` resolves its endpoint mix here);
-(e) **a declared sharded-variant hook** (:meth:`EngineSpec.sharded`) —
-    stubbed until ROADMAP item 1 fills in the partition rules, so the
-    sharding round needs no new enumeration pass.
+(e) **a sharded variant** (:meth:`EngineSpec.sharded`) — resolved from
+    the mesh subsystem's partition-rule table
+    (:mod:`csmom_tpu.mesh.variants`): batch/asset-axis sharding for
+    serve endpoints, grid-cell x asset for the J x K engines; an
+    explicit ``sharded_fn`` overrides the rules.
 
 Layering: this module is stdlib-only (no numpy, no jax) so the
 jax-free consumers — ``chaos/invariants.py`` validating an artifact's
@@ -105,10 +107,10 @@ class EngineSpec:
     byte-identical HLO.  ``donated_fn`` is the donated-buffer variant
     factory; serve engines get an auto-derived one from the engine
     layer when none is declared.  ``sharded_fn`` is the mesh-variant
-    hook: None means *declared but not yet implemented* —
-    :meth:`sharded` raises a pointed NotImplementedError instead of
-    silently missing, so ROADMAP item 1 fills in partition rules
-    without another enumeration pass.
+    hook: None means *resolve via the partition-rule table*
+    (:func:`csmom_tpu.mesh.variants.resolve_sharded`); a kind the
+    table has no placement for still raises a pointed
+    NotImplementedError from :meth:`sharded`.
     """
 
     name: str
@@ -155,22 +157,29 @@ class EngineSpec:
             f"engine {self.name!r} declares no donated-buffer variant")
 
     def sharded(self, *args, **kwargs):
-        """The sharded-variant hook (surface (e)).
+        """The sharded-variant hook (surface (e)), filled at r15.
 
-        Declared on every engine; implemented by none of the builtins
-        yet.  ROADMAP item 1 supplies ``sharded_fn`` per engine
-        (``match_partition_rules`` over a named mesh — asset-axis for
-        large universes, batch-axis for serve micro-batches); until
-        then the hook refuses loudly instead of pretending.
+        An explicit ``sharded_fn`` wins; otherwise the mesh subsystem's
+        rule table resolves one (:func:`csmom_tpu.mesh.variants.
+        resolve_sharded` — batch/asset-axis sharding for serve
+        endpoints including runtime-registered ones, grid-cell x asset
+        for the J x K engines, asset/time placements for the rest).  A
+        kind with no rule — a Strategy plugin class has no dispatchable
+        axis of its own — still refuses loudly with the remedy named.
         """
-        if self.sharded_fn is None:
+        if self.sharded_fn is not None:
+            return self.sharded_fn(*args, **kwargs)
+        from csmom_tpu.mesh.variants import resolve_sharded
+
+        fn = resolve_sharded(self)
+        if fn is None:
             raise NotImplementedError(
-                f"engine {self.name!r}: sharded variant is declared but "
-                "not yet implemented — ROADMAP item 1 (device-mesh "
-                "sharding) supplies sharded_fn via the partition-rule "
-                "pattern; register the engine with sharded_fn=... to "
-                "fill it in")
-        return self.sharded_fn(*args, **kwargs)
+                f"{self.kind} engine {self.name!r} has no sharded "
+                "variant: no partition rule in csmom_tpu/mesh/variants "
+                "matches it — add a rule there (or register the engine "
+                "with sharded_fn=...) if this kind has a meaningful "
+                "mesh placement")
+        return fn(*args, **kwargs)
 
 
 class EngineRegistry:

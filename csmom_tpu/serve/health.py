@@ -59,14 +59,22 @@ WARMUP_POINTER = "run `csmom warmup --profiles serve` first"
 
 
 def aot_cache_version(profile: str, *, lookback: int = 12, skip: int = 1,
-                      n_bins: int = 10, mode: str = "rank") -> str:
+                      n_bins: int = 10, mode: str = "rank",
+                      engine: str = "jax",
+                      mesh_devices: int | None = None) -> str:
     """Deterministic fingerprint of the compiled world this pool expects.
 
     Jax-free: the jax version is read from package metadata, not an
     import, so the supervisor can stamp versions without initializing a
     backend.  The token changes iff something that invalidates the AOT
     cache changes — bucket geometry, endpoint set, engine params, or the
-    jax release that serialized the executables.
+    jax release that serialized the executables.  The mesh engine's
+    compiled world is ALSO keyed by its topology (``mesh_devices``, the
+    worker's pinned slice size): a program sharded 8 ways is not the
+    2-way program, so a pool resized without re-warming must read as
+    skew, not compile in-window.  The default (single-device jax)
+    basis is byte-identical to the r11 one — existing version tokens
+    do not churn.
     """
     spec = bucket_spec(profile)
     try:
@@ -86,21 +94,49 @@ def aot_cache_version(profile: str, *, lookback: int = 12, skip: int = 1,
                           "n_bins": n_bins, "mode": mode},
         "jax": jax_ver,
     }
+    if engine != "jax":
+        basis["engine"] = engine
+    if mesh_devices is not None:
+        basis["mesh_devices"] = int(mesh_devices)
     blob = json.dumps(basis, sort_keys=True).encode("utf-8")
     return hashlib.sha256(blob).hexdigest()[:12]
 
 
-def expected_entry_names(profile: str) -> set:
+def expected_entry_names(profile: str,
+                         mesh_devices: int | None = None) -> set:
     """The manifest entry names ``csmom warmup --profiles <profile>``
     must have compiled — derived from bucket geometry alone (the same
     ``serve.{kind}.b{B}@{A}x{M}`` scheme ``compile/manifest.py`` uses),
-    so this check never needs jax."""
+    so this check never needs jax.  With ``mesh_devices`` the names are
+    the MESH profile's (``mesh.serve....d<n>``): shard counts derive
+    from the same divisor arithmetic the variants use
+    (:func:`csmom_tpu.mesh.pinning.shards_for` — stdlib) and the
+    placement rule table (:func:`csmom_tpu.mesh.rules.serve_axis_for`
+    — pure regex), so the check still never needs jax."""
     spec = bucket_spec(profile)
-    return {f"serve.{kind}.b{B}@{A}x{M}"
-            for kind in serve_endpoints() for B, A, M in spec.shapes()}
+    if mesh_devices is None:
+        return {f"serve.{kind}.b{B}@{A}x{M}"
+                for kind in serve_endpoints() for B, A, M in spec.shapes()}
+    from csmom_tpu.mesh.pinning import shards_for
+    from csmom_tpu.mesh.rules import serve_axis_for
+
+    out = set()
+    for kind in serve_endpoints():
+        axis = serve_axis_for(kind)
+        for B, A, M in spec.shapes():
+            n = shards_for(B if axis == "batch" else A, mesh_devices)
+            out.add(f"mesh.serve.{kind}.b{B}@{A}x{M}.d{n}")
+    # the mesh engine's scaling probe warms a single-device reference
+    # entry at the largest bucket; it is part of the profile (same name
+    # scheme as registry.builtin's mesh feeder) so the gate covers it
+    probe = serve_endpoints()[0]
+    out.add(f"mesh.serve.single-probe.{probe}."
+            f"b{spec.batch_buckets[-1]}@{spec.max_assets}x{spec.months}")
+    return out
 
 
-def cache_readiness(profile: str, cache_subdir: str = "bench") -> tuple:
+def cache_readiness(profile: str, cache_subdir: str = "bench",
+                    mesh_devices: int | None = None) -> tuple:
     """``(ready, reason)`` for the on-disk AOT cache of ``profile``.
 
     Ready means: the persistent cache is enabled, its warmup report
@@ -127,20 +163,25 @@ def cache_readiness(profile: str, cache_subdir: str = "bench") -> tuple:
                        f"stale/damaged evidence; {WARMUP_POINTER}")
     warmed = {e.get("name") for e in entries
               if isinstance(e, dict) and "error" not in e}
-    missing = sorted(expected_entry_names(profile) - warmed)
+    expected = expected_entry_names(profile, mesh_devices)
+    pointer = (WARMUP_POINTER.replace("--profiles serve",
+                                      "--profiles serve-mesh")
+               if mesh_devices is not None else WARMUP_POINTER)
+    missing = sorted(expected - warmed)
     if missing:
         return False, (
-            f"AOT cache cold for bucket profile {profile!r}: "
-            f"{len(missing)} of {len(expected_entry_names(profile))} serve "
+            f"AOT cache cold for bucket profile {profile!r}"
+            + (f" on a d{mesh_devices} mesh" if mesh_devices else "") +
+            f": {len(missing)} of {len(expected)} serve "
             f"shapes have no warm evidence (first missing: {missing[0]}) — "
-            f"{WARMUP_POINTER}")
+            f"{pointer}")
     cached = [p for p in glob.glob(os.path.join(d, "*"))
               if os.path.isfile(p) and os.path.basename(p) != REPORT_NAME]
     if not cached:
         return False, (
             f"warmup report present but cache {d} holds no serialized "
-            f"executables (evicted?) — stale evidence; {WARMUP_POINTER}")
-    return True, (f"cache {d}: all {len(expected_entry_names(profile))} "
+            f"executables (evicted?) — stale evidence; {pointer}")
+    return True, (f"cache {d}: all {len(expected)} "
                   f"serve shapes warm, {len(cached)} serialized entries")
 
 

@@ -420,12 +420,21 @@ def build_artifact(service: SignalService, load: LoadConfig,
     spec = service.spec
     sched_label = (load.schedule_kind if load.schedule_kind != "custom"
                    else load.schedule)
+    # the mesh engine's workload fingerprint CARRIES the device count:
+    # throughput/latency on d=1 and d=8 are different experiments and
+    # the ledger must never pair them (the device-count-keyed-rows rule)
+    mesh = None
+    mesh_note = ""
+    if hasattr(service.engine, "mesh_info"):
+        mesh = service.engine.mesh_info(spec)
+        mesh["scaling"] = service.engine.scaling_probe(spec)
+        mesh_note = f", mesh d{mesh['devices']}"
     workload = (
         f"open-loop {sched_label} rps seed {load.seed}, "
         f"{'/'.join(load.resolved_kinds())} mix, buckets "
         f"B({','.join(map(str, spec.batch_buckets))})x"
         f"A({','.join(map(str, spec.asset_buckets))})x{spec.months}m "
-        f"({spec.dtype}, {service.config.engine} engine)"
+        f"({spec.dtype}, {service.config.engine} engine{mesh_note})"
     )
     extra = {
         "platform": _platform(service),
@@ -435,6 +444,8 @@ def build_artifact(service: SignalService, load: LoadConfig,
         "max_wait_ms": round(1e3 * service.config.max_wait_s, 3),
         "warm_report": service.warm_report,
     }
+    if mesh is not None:
+        extra["mesh"] = mesh
     if service.spec.name == "serve-smoke":
         extra["smoke"] = ("smoke-bucket run: pipeline-shaped, workload "
                           "reduced — NOT a performance capture")
@@ -602,12 +613,26 @@ def build_pool_artifact(router, supervisor, load: LoadConfig,
         if isinstance(rep.get("platform"), str):
             platform = rep["platform"]
             break
+    # the mesh pool's workload key carries its topology (same rule as
+    # the single-process path): per-worker device count when pinned,
+    # the named worker slices otherwise — two differently-sized mesh
+    # pools must never pair in the ledger
+    if cfg.engine == "jax-mesh":
+        if cfg.devices_per_worker > 0:
+            mesh_note = f", {cfg.devices_per_worker} dev/worker"
+        else:
+            slices = sorted({h.device_slice for h in supervisor.handles
+                             if h.device_slice} | set())
+            mesh_note = (f", slices {'/'.join(slices)}" if slices
+                         else ", unpinned mesh")
+    else:
+        mesh_note = ""
     workload = (
         f"pool open-loop {load.schedule} rps seed {load.seed}, "
         f"{'/'.join(load.resolved_kinds())} mix, {cfg.n_workers} workers, buckets "
         f"B({','.join(map(str, spec.batch_buckets))})x"
         f"A({','.join(map(str, spec.asset_buckets))})x{spec.months}m "
-        f"({spec.dtype}, {cfg.engine} engine)"
+        f"({spec.dtype}, {cfg.engine} engine{mesh_note})"
     )
     extra = {
         "platform": platform,

@@ -57,6 +57,10 @@ class PoolConfig:
     capacity: int = 64
     max_wait_ms: float = 10.0
     deadline_ms: float = 500.0
+    # >0 pins each slot to a fixed contiguous device slice (slot k owns
+    # devices [k*N, k*N+N)); a replacement worker spawned into the slot
+    # re-pins the SAME slice by construction (csmom_tpu/mesh/pinning)
+    devices_per_worker: int = 0
     cache_subdir: str = "bench"
     require_warm_cache: bool = False
     expect_cache_version: str | None = None  # None = compute from health
@@ -77,6 +81,7 @@ class WorkerHandle:
     slot: int
     worker_id: str
     socket_path: str
+    device_slice: str | None = None
     proc: subprocess.Popen | None = None
     state: str = "starting"   # starting | ready | draining | dead | failed
     generation: int = 0
@@ -96,9 +101,23 @@ class PoolSupervisor:
         self.config = config
         self.run_dir = run_dir
         os.makedirs(run_dir, exist_ok=True)
+        mesh_devices = None
+        if config.engine == "jax-mesh" and not config.expect_cache_version:
+            # the token must match what each worker computes: the pinned
+            # slice size, or — unpinned — every visible device (workers
+            # inherit this process's environment, so the counts agree).
+            # Only the mesh engine pays the jax import here; the stub
+            # rehearse tier and plain-jax pools stay jax-free.
+            mesh_devices = config.devices_per_worker or None
+            if mesh_devices is None:
+                import jax
+
+                mesh_devices = len(jax.devices())
         self.expect_cache_version = (
             config.expect_cache_version
-            or health.aot_cache_version(config.profile))
+            or health.aot_cache_version(
+                config.profile, engine=config.engine,
+                mesh_devices=mesh_devices))
         self.handles: list = []
         self.events: list = []      # [{t_s, event, worker_id, ...}]
         self._lock = threading.Lock()
@@ -132,6 +151,8 @@ class PoolSupervisor:
                 "--deadline-ms", str(c.deadline_ms),
                 "--cache-subdir", c.cache_subdir,
                 "--expect-cache-version", self.expect_cache_version]
+        if h.device_slice:
+            argv += ["--device-slice", h.device_slice]
         if c.require_warm_cache:
             argv.append("--require-warm-cache")
         return argv
@@ -143,6 +164,20 @@ class PoolSupervisor:
         h.log_path = os.path.join(
             self.run_dir, f"{h.worker_id}.g{h.generation}.log")
         env = dict(os.environ)  # fault plans and JAX_PLATFORMS inherit
+        c = self.config
+        if (c.devices_per_worker > 0 and c.engine == "jax-mesh"
+                and env.get("JAX_PLATFORMS", "").startswith("cpu")
+                and "xla_force_host_platform_device_count"
+                not in env.get("XLA_FLAGS", "")):
+            # the CPU recipe: every worker must SEE the whole simulated
+            # topology so its slice indexes the same device list the
+            # supervisor derived slices from (real TPU topologies
+            # provide their own devices and skip this)
+            need = c.n_workers * c.devices_per_worker
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={need}"
+            ).strip()
         log = open(h.log_path, "ab")
         try:
             h.proc = subprocess.Popen(
@@ -154,7 +189,8 @@ class PoolSupervisor:
         h.t_ready_s = None
         h.ready_report = None
         self._event("spawn", h.worker_id, pid=h.proc.pid,
-                    generation=h.generation)
+                    generation=h.generation,
+                    device_slice=h.device_slice)
 
     def _stderr_tail(self, h: WorkerHandle, n: int = 400) -> str:
         try:
@@ -221,10 +257,14 @@ class PoolSupervisor:
         — an empty pool is a dead service, better to fail loudly at
         start; ``require_ready=False`` lets the monitor keep working a
         crash-looping fleet (the backoff rehearsals drive this)."""
+        from csmom_tpu.mesh.pinning import slice_for_slot
+
+        dpw = self.config.devices_per_worker
         for slot in range(self.config.n_workers):
             h = WorkerHandle(
                 slot=slot, worker_id=f"w{slot}",
-                socket_path=os.path.join(self.run_dir, f"w{slot}.sock"))
+                socket_path=os.path.join(self.run_dir, f"w{slot}.sock"),
+                device_slice=slice_for_slot(slot, dpw) if dpw else None)
             self.handles.append(h)
             self._spawn(h)
         for h in self.handles:
@@ -320,6 +360,9 @@ class PoolSupervisor:
                 socket_path=os.path.join(
                     self.run_dir,
                     f"w{slot}.g{old.generation + 1}.sock"),
+                # the slot's slice, not a fresh assignment: a rolled
+                # worker re-pins exactly its predecessor's devices
+                device_slice=old.device_slice,
                 generation=old.generation + 1)
             self._event("roll_start", old.worker_id,
                         from_generation=old.generation,
@@ -413,7 +456,8 @@ class PoolSupervisor:
         out = []
         for h in self.handles:
             rec = {"worker_id": h.worker_id, "state": h.state,
-                   "generation": h.generation, "restarts": h.restarts}
+                   "generation": h.generation, "restarts": h.restarts,
+                   "device_slice": h.device_slice}
             if h.state == "ready":
                 try:
                     obj, _ = proto.request(h.socket_path, {"op": "stats"},

@@ -73,15 +73,18 @@ _NO_DEADLINE_WAIT_S = 30.0
 class WorkerServer:
     """The socket front of one in-process :class:`SignalService`."""
 
-    def __init__(self, socket_path: str, config, worker_id: str = "w0"):
+    def __init__(self, socket_path: str, config, worker_id: str = "w0",
+                 device_slice: str | None = None):
         from csmom_tpu.serve.service import SignalService
 
         self.socket_path = socket_path
         self.worker_id = worker_id
         self.service = SignalService(config)
+        self.device_slice = device_slice
         self._ready_lock = threading.Lock()
         self._ready_report = {"ok": False, "reason": "warming",
-                              "worker_id": worker_id}
+                              "worker_id": worker_id,
+                              "device_slice": device_slice}
         self._draining = False
         self._stop = threading.Event()
         self._listener: socket.socket | None = None
@@ -139,6 +142,10 @@ class WorkerServer:
             "engine": self.service.engine.name,
             "profile": spec.name,
             "cache_version": self.cache_version,
+            # the pinning contract's evidence: the slice this worker's
+            # engine actually built its mesh over (the supervisor's
+            # rehearsal checks a replacement re-pinned its predecessor's)
+            "device_slice": self.device_slice,
             "warm": self.service.warm_report,
             "probes": probes,
             "fresh_compiles": fresh,
@@ -234,6 +241,7 @@ class WorkerServer:
             "ok": True,
             "worker_id": self.worker_id,
             "pid": os.getpid(),
+            "device_slice": self.device_slice,
             "accounting": self.service.accounting(),
             "classes": self.service.class_stats(),
             "cache": self.service.cache_stats(),
@@ -294,7 +302,15 @@ def main(argv=None) -> int:
     ap.add_argument("--socket", required=True, help="unix socket path")
     ap.add_argument("--worker-id", dest="worker_id", default="w0")
     ap.add_argument("--profile", default="serve")
-    ap.add_argument("--engine", default="jax", choices=["jax", "stub"])
+    ap.add_argument("--engine", default="jax",
+                    choices=["jax", "jax-mesh", "stub"])
+    ap.add_argument("--device-slice", dest="device_slice",
+                    help="pin this worker to a contiguous device slice "
+                         "'<start>:<count>' (exported as "
+                         "CSMOM_MESH_DEVICE_SLICE before the engine "
+                         "builds; the jax-mesh engine meshes only these "
+                         "devices — a replacement spawned into the same "
+                         "slot re-pins the same slice)")
     ap.add_argument("--capacity", type=int, default=64)
     ap.add_argument("--max-wait-ms", dest="max_wait_ms", type=float,
                     default=10.0)
@@ -318,7 +334,36 @@ def main(argv=None) -> int:
               "exiting at startup", file=sys.stderr, flush=True)
         return int(fault.split(":", 1)[1] or 1)
 
-    my_version = health.aot_cache_version(args.profile)
+    mesh_devices = None
+    if args.device_slice:
+        from csmom_tpu.mesh.pinning import DEVICE_SLICE_ENV, \
+            parse_device_slice
+
+        try:
+            _, mesh_devices = parse_device_slice(args.device_slice)
+        except ValueError as e:
+            print(f"[worker {args.worker_id}] --device-slice: {e}",
+                  file=sys.stderr, flush=True)
+            return 2
+        # exported BEFORE any engine builds: the mesh variants read the
+        # pinned slice from the environment (the same channel the fault
+        # plans ride), so every entry this process compiles lives on
+        # exactly these devices
+        os.environ[DEVICE_SLICE_ENV] = args.device_slice
+
+    if args.engine == "jax-mesh" and mesh_devices is None:
+        # unpinned mesh worker: its compiled world spans every visible
+        # device, and the VERSION token must say so — a restart on a
+        # resized topology has to read as skew, not share a token.
+        # Counting devices initializes the backend, which the warm path
+        # pays moments later anyway.
+        import jax
+
+        mesh_devices = len(jax.devices())
+
+    my_version = health.aot_cache_version(
+        args.profile, engine=args.engine,
+        mesh_devices=mesh_devices if args.engine == "jax-mesh" else None)
     if (args.expect_cache_version
             and args.expect_cache_version != my_version):
         print(
@@ -332,15 +377,17 @@ def main(argv=None) -> int:
         )
         return RC_VERSION_SKEW
 
-    if args.engine == "jax" and args.require_warm_cache:
-        ready, reason = health.cache_readiness(args.profile,
-                                               args.cache_subdir)
+    if args.engine.startswith("jax") and args.require_warm_cache:
+        ready, reason = health.cache_readiness(
+            args.profile, args.cache_subdir,
+            mesh_devices=mesh_devices if args.engine == "jax-mesh"
+            else None)
         if not ready:
             print(f"[worker {args.worker_id}] NOT READY: {reason}",
                   file=sys.stderr, flush=True)
             return RC_COLD_CACHE
 
-    if args.engine == "jax":
+    if args.engine.startswith("jax"):
         # point jax at the shared serialized-executable cache BEFORE the
         # first trace, so warm() loads what `csmom warmup` compiled
         from csmom_tpu.utils.jit_cache import enable_persistent_cache
@@ -355,7 +402,8 @@ def main(argv=None) -> int:
         default_deadline_s=(None if args.deadline_ms in (None, 0)
                             else args.deadline_ms / 1e3),
     )
-    server = WorkerServer(args.socket, cfg, worker_id=args.worker_id)
+    server = WorkerServer(args.socket, cfg, worker_id=args.worker_id,
+                          device_slice=args.device_slice)
     server.cache_version = my_version
 
     def _term(signum, frame):  # graceful drain on SIGTERM

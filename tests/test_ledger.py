@@ -593,3 +593,68 @@ def test_gate_surfaces_compounding_subtolerance_drift(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0  # each step is inside --mem-tol: accepted per round
     assert "cumulative drift since r01" in out  # ...but never hidden
+
+
+# ------------------------------------------------ roadmap round-update ----
+
+def test_splice_roadmap_replaces_the_marker_span_only():
+    from csmom_tpu.cli.ledger import ROADMAP_BEGIN, ROADMAP_END, \
+        splice_roadmap
+
+    doc = (f"# head\nprose stays\n\n{ROADMAP_BEGIN}\n\nOLD TABLES\n\n"
+           f"{ROADMAP_END}\ntail stays\n")
+    new = splice_roadmap(doc, "#### fresh table")
+    assert "OLD TABLES" not in new
+    assert "#### fresh table" in new
+    assert new.startswith("# head\nprose stays")
+    assert new.endswith(f"{ROADMAP_END}\ntail stays\n")
+    # idempotent: splicing the same tables changes nothing
+    assert splice_roadmap(new, "#### fresh table") == new
+
+
+def test_splice_roadmap_refuses_missing_or_misordered_markers():
+    from csmom_tpu.cli.ledger import ROADMAP_BEGIN, ROADMAP_END, \
+        splice_roadmap
+
+    with pytest.raises(ValueError, match="markers missing"):
+        splice_roadmap("no markers here", "t")
+    with pytest.raises(ValueError, match="markers missing"):
+        splice_roadmap(f"{ROADMAP_END}\n{ROADMAP_BEGIN}", "t")
+
+
+def test_roadmap_rows_filter_keeps_gate_pairable_metrics_only():
+    from csmom_tpu.cli.ledger import roadmap_rows
+    from csmom_tpu.obs.ledger import Row
+
+    def row(metric, flags=()):
+        return Row(run="r01", run_num=1, metric=metric, value=1.0,
+                   unit="s", direction="lower", platform="cpu",
+                   device_kind="cpu", workload="w", source="S_r01.json",
+                   flags=tuple(flags))
+
+    rows = [row("grid16_rank_s"),
+            row("grid16_rank_s", ("variant:watcher",)),  # kept: metric has a live row
+            row("phase.row_s", ("info",)),               # pure info: dropped
+            row("mem_peak_bytes")]                       # per-shape: dropped
+    kept = {(r.metric, r.flags) for r in roadmap_rows(rows)}
+    assert kept == {("grid16_rank_s", ()),
+                    ("grid16_rank_s", ("variant:watcher",))}
+
+
+def test_repo_roadmap_tables_are_generated_and_current():
+    """The round-update flow's standing gate: ROADMAP.md carries the
+    trajectory markers and the span between them matches what `csmom
+    ledger roadmap --write` would regenerate from the committed
+    artifacts — a round that lands evidence without regenerating (or
+    hand-edits inside the span, the r14 failure) goes red here."""
+    from csmom_tpu.cli.ledger import _markdown_tables, roadmap_rows, \
+        splice_roadmap
+    from csmom_tpu.obs import ledger as ld
+
+    path = os.path.join(_REPO, "ROADMAP.md")
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    tables = _markdown_tables(roadmap_rows(ld.load(_REPO).rows))
+    assert splice_roadmap(text, tables) == text, (
+        "ROADMAP.md trajectory tables are stale or hand-edited — run "
+        "`csmom ledger roadmap --write`")

@@ -55,6 +55,9 @@ def test_fast_tier_is_small_and_capture_path_only():
     assert any("worker-kill" in n for n in pool), pool
     assert any("rolling-restart" in n for n in pool), pool
     assert any("version-skew" in n for n in pool), pool
+    # ISSUE 10: the mesh path's kill — a DEVICE-PINNED worker dies
+    # mid-batch and its replacement re-pins the same slice
+    assert any("mesh-pinned" in n for n in pool), pool
     # ISSUE 7: both replay degradation scenarios ride in the fast tier —
     # the tick storm (late/ooo/dup/gap) and the ingest-serve skew gate
     replay = [s.name for s in fast if s.pipeline == "replay"]
