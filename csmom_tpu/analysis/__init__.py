@@ -1,0 +1,36 @@
+"""csmom_tpu.analysis — the static-analysis subsystem (ISSUE 11).
+
+One parse per file, N registered rule visitors, scoped in-file pragmas
+with stale-pragma detection, and a registry-driven rule set: see
+:mod:`csmom_tpu.analysis.core` for the framework and
+:mod:`csmom_tpu.analysis.rules` for the builtin rules (clock-discipline,
+tracer-hygiene, lock-discipline, donation-safety, enumeration-drift).
+
+Entry points:
+
+- :func:`run_lint` — the sweep (what tier-1 and ``csmom rehearse``
+  gate on); returns a :class:`~csmom_tpu.analysis.core.LintReport`;
+- ``csmom lint [--json] [--rule <id>] [--paths ...]`` — the CLI
+  (:mod:`csmom_tpu.cli.lint`).
+
+Stdlib-only and jax-free: the sweep runs on CPU in about a second, which
+is the whole point — a defect caught here never burns a tunnel window.
+"""
+
+from __future__ import annotations
+
+from csmom_tpu.analysis.core import (
+    Finding,
+    LintReport,
+    LintRule,
+    default_sources,
+    run_lint,
+)
+
+__all__ = [
+    "Finding",
+    "LintReport",
+    "LintRule",
+    "default_sources",
+    "run_lint",
+]

@@ -1,0 +1,431 @@
+"""Single-pass, alias-aware AST lint framework (ISSUE 11).
+
+The r3-r14 stack grew its disciplines one regex lint at a time: bare
+wall-clock bans in ``tests/test_time_discipline.py`` (with a documented
+alias hole: ``from time import time as _t; _t()`` passes a
+``time\\.time\\(\\)`` regex), an ad-hoc AST walk for enumeration drift in
+``tests/test_registry.py``, and review for everything else.  This module
+is the shared machinery those checks now run on:
+
+- **one parse per file** — ``ast.parse`` + one ``tokenize`` pass build a
+  :class:`FileContext` (tree, parent links, alias map, comment/string
+  tokens, pragmas); every registered rule then works off that one
+  context, so adding a rule costs a visitor, not another file walk;
+- **alias-aware resolution** — :meth:`FileContext.resolve` follows
+  ``import time as _t``, ``from time import time as t``, simple
+  ``name = time.time`` rebinds, and ``getattr(time, "time")`` dodges
+  down to a canonical dotted origin (``"time.time"``), which is what
+  closes the regex lint's alias holes;
+- **scoped suppressions** — ``# lint: allow[<rule>] <reason>`` pragmas
+  replace the count-based ``_ALLOWLIST`` dicts.  A pragma suppresses
+  findings of its rule on its own line and the line directly below it
+  (so a standalone pragma comment sits above the offending statement).
+  A pragma that suppresses nothing is itself a finding
+  (``stale-pragma``): an unused suppression is a hole the next
+  regression walks through, exactly the failure mode the old stale-
+  allowlist test guarded one dict against;
+- **rules are registry citizens** — rules register as kind-``lint``
+  engines (:mod:`csmom_tpu.registry`); registering one enrolls it in
+  the ``csmom lint`` CLI, the tier-1 sweep, ``csmom registry list``,
+  and the fixture self-test harness with no other file edited.
+
+Layering: stdlib-only (ast/tokenize/re), jax-free, clock-free — the
+sweep must be runnable on CPU before a tunnel window opens, and its
+verdicts must be reproducible from the tree alone.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+
+__all__ = [
+    "FileContext",
+    "Finding",
+    "LintReport",
+    "LintRule",
+    "Pragma",
+    "RunContext",
+    "default_sources",
+    "run_lint",
+]
+
+# the pragma grammar: the ``#`` is optional so a docstring line can carry
+# its own suppression (comments cannot exist inside string literals)
+PRAGMA_RE = re.compile(r"lint:\s*allow\[([A-Za-z0-9_-]+)\]\s*(.*?)\s*$")
+
+STALE_PRAGMA_RULE = "stale-pragma"
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One defect at one source line (repo-relative path)."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+@dataclasses.dataclass
+class Pragma:
+    """One in-file suppression; ``used`` counts the findings it ate."""
+
+    rule: str
+    line: int
+    reason: str
+    used: int = 0
+
+
+class LintRule:
+    """Base class for registered rules.
+
+    Hooks (all optional overrides):
+
+    - ``start_file(ctx)`` — per-file precomputation off the shared parse
+      (rules needing multi-phase context — "which functions are traced"
+      — do their whole analysis here; the tree is already parsed);
+    - ``visit(node, ctx)`` — called once per AST node on the shared
+      walk;
+    - ``finish_file(ctx)`` — per-file wrap-up (token-stream checks);
+    - ``start_run(run)`` / ``finish_run(run)`` — cross-file state
+      (e.g. the checkpoint-vocabulary coverage check).
+
+    Report through ``ctx.report(self.id, line, message)`` (pragma-aware)
+    or ``run.report(...)`` for findings anchored outside the current
+    file.
+    """
+
+    id: str = "?"
+    description: str = ""
+
+    def start_run(self, run: "RunContext") -> None:  # pragma: no cover
+        pass
+
+    def start_file(self, ctx: "FileContext") -> None:
+        pass
+
+    def visit(self, node: ast.AST, ctx: "FileContext") -> None:
+        pass
+
+    def finish_file(self, ctx: "FileContext") -> None:
+        pass
+
+    def finish_run(self, run: "RunContext") -> None:  # pragma: no cover
+        pass
+
+
+class FileContext:
+    """Everything the rules share about one file: ONE parse, one token
+    scan, one alias map — N rule visitors."""
+
+    def __init__(self, path: str, rel: str, src: str, run: "RunContext"):
+        self.path = path
+        self.rel = rel
+        self.src = src
+        self.lines = src.splitlines()
+        self.run = run
+        self.tree = ast.parse(src, filename=rel)
+        self.parents: dict = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.imports = self._build_alias_map(self.tree)
+        self.tokens, self._code_lines = self._scan_tokens(src)
+        self.pragmas = self._scan_pragmas()
+        self._pragma_by_line: dict = {}
+        for p in self.pragmas:
+            # a pragma covers its own line; a STANDALONE pragma (a
+            # comment/prose line carrying no code) also covers the line
+            # below it.  A trailing pragma on an offending line must NOT
+            # leak onto the next line — a second, unjustified defect
+            # there would ship silently.
+            self._pragma_by_line.setdefault((p.rule, p.line), []).append(p)
+            if p.line not in self._code_lines:
+                self._pragma_by_line.setdefault((p.rule, p.line + 1),
+                                                []).append(p)
+
+    # ------------------------------------------------------------ aliases --
+
+    @staticmethod
+    def _build_alias_map(tree: ast.AST) -> dict:
+        """Local name -> dotted origin, from imports at ANY scope plus
+        simple single-target rebinds (``t = time.time``).  Bindings are
+        applied in SOURCE order (``ast.walk`` is breadth-first, which
+        would let an early nested-function rebind beat a later
+        module-level one), so later bindings win the way a reader
+        expects; the map stays deliberately scope-blind beyond that."""
+        amap: dict = {}
+
+        def resolve(node):
+            if isinstance(node, ast.Name):
+                return amap.get(node.id)
+            if isinstance(node, ast.Attribute):
+                base = resolve(node.value)
+                return f"{base}.{node.attr}" if base else None
+            return None
+
+        bindings = sorted(
+            (node for node in ast.walk(tree)
+             if isinstance(node, (ast.Import, ast.ImportFrom, ast.Assign))),
+            key=lambda n: (n.lineno, n.col_offset))
+        for node in bindings:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    amap[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.module:
+                    for a in node.names:
+                        amap[a.asname or a.name] = f"{node.module}.{a.name}"
+            elif (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                origin = resolve(node.value)
+                if origin is not None:
+                    amap[node.targets[0].id] = origin
+                else:
+                    # a later rebind to something untracked retires the
+                    # alias — keeping it would flag the NEW binding's
+                    # calls as the old origin's
+                    amap.pop(node.targets[0].id, None)
+        return amap
+
+    def resolve(self, node) -> str | None:
+        """The dotted origin a name/attribute/getattr-dodge denotes, or
+        None for locals the alias map does not track."""
+        if isinstance(node, ast.Name):
+            return self.imports.get(node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            return f"{base}.{node.attr}" if base else None
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Name) and f.id == "getattr"
+                    and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                base = self.resolve(node.args[0])
+                return f"{base}.{node.args[1].value}" if base else None
+        return None
+
+    def resolve_call(self, call: ast.Call) -> str | None:
+        """What callable a Call invokes (alias- and getattr-aware)."""
+        return self.resolve(call.func)
+
+    # ------------------------------------------------------------- tokens --
+
+    # token types that do not make a line "code" (a pragma on a line
+    # holding only these is standalone and may cover the line below)
+    _NONCODE_TOKENS = frozenset({
+        tokenize.COMMENT, tokenize.STRING, tokenize.NL, tokenize.NEWLINE,
+        tokenize.INDENT, tokenize.DEDENT, tokenize.ENDMARKER,
+    })
+
+    @classmethod
+    def _scan_tokens(cls, src: str) -> tuple:
+        """One tokenize pass: ``(kind, line, text)`` for every comment
+        and string token (the prose layer textual rules scan without
+        re-reading the file), plus the set of line numbers that carry
+        actual code tokens."""
+        out = []
+        code_lines: set = set()
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+                if tok.type == tokenize.COMMENT:
+                    out.append(("comment", tok.start[0], tok.string))
+                elif tok.type == tokenize.STRING:
+                    out.append(("string", tok.start[0], tok.string))
+                elif tok.type not in cls._NONCODE_TOKENS:
+                    code_lines.update(range(tok.start[0], tok.end[0] + 1))
+        except tokenize.TokenError:  # pragma: no cover - ast.parse passed
+            pass
+        return out, code_lines
+
+    def _scan_pragmas(self) -> list:
+        pragmas = []
+        for i, line in enumerate(self.lines, start=1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                pragmas.append(Pragma(rule=m.group(1), line=i,
+                                      reason=m.group(2)))
+        return pragmas
+
+    # -------------------------------------------------------------- report --
+
+    def report(self, rule: str, line: int, message: str) -> None:
+        f = Finding(rule=rule, path=self.rel, line=line, message=message)
+        for p in self._pragma_by_line.get((rule, line), []):
+            p.used += 1
+            self.run.suppressed.append(f)
+            return
+        self.run.findings.append(f)
+
+    def finish(self, known_rules: set, active_rules: set) -> None:
+        """Stale/unknown pragma findings — the framework's own rule.
+
+        Unknown-ness is judged against every REGISTERED rule; staleness
+        only against the rules that actually ran (a ``--rule`` filtered
+        sweep cannot honestly call another rule's pragma unused)."""
+        for p in self.pragmas:
+            if p.rule not in known_rules:
+                self.run.findings.append(Finding(
+                    rule=STALE_PRAGMA_RULE, path=self.rel, line=p.line,
+                    message=f"pragma names unknown rule {p.rule!r} "
+                            f"(registered: {sorted(known_rules)})"))
+            elif p.rule in active_rules and p.used == 0:
+                self.run.findings.append(Finding(
+                    rule=STALE_PRAGMA_RULE, path=self.rel, line=p.line,
+                    message=f"unused suppression: no {p.rule} finding on "
+                            "this line or the next — drop the pragma "
+                            "(a stale allowance is the hole the next "
+                            "regression walks through)"))
+
+
+class RunContext:
+    """Cross-file state for one sweep."""
+
+    def __init__(self, repo: str):
+        self.repo = repo
+        self.findings: list = []
+        self.suppressed: list = []
+        self.scanned: list = []       # repo-relative paths, scan order
+
+    def report(self, rule: str, rel: str, line: int, message: str) -> None:
+        self.findings.append(Finding(rule=rule, path=rel, line=line,
+                                     message=message))
+
+
+@dataclasses.dataclass
+class LintReport:
+    """One sweep's outcome; ``findings`` are the UNSUPPRESSED defects
+    (stale pragmas included — an unused allowance fails the sweep)."""
+
+    findings: list
+    suppressed: list
+    files: int
+    rules: tuple
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> dict:
+        return {
+            "schema_version": 1,
+            "ok": self.ok,
+            "files_scanned": self.files,
+            "rules": list(self.rules),
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+        }
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+
+def default_sources(repo: str | None = None) -> list:
+    """The sweep's default scope: the package, the bench harness, and
+    the benchmark drivers — the same set the regex lints walked."""
+    repo = repo or _REPO
+    files = [os.path.join(repo, "bench.py")]
+    for root in ("csmom_tpu", "benchmarks"):
+        for dirpath, dirnames, names in os.walk(os.path.join(repo, root)):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            files += [os.path.join(dirpath, n) for n in sorted(names)
+                      if n.endswith(".py")]
+    return sorted(p for p in files if os.path.isfile(p))
+
+
+def _expand_paths(paths) -> list:
+    out = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isdir(p):
+            for dirpath, dirnames, names in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                out += [os.path.join(dirpath, n) for n in sorted(names)
+                        if n.endswith(".py")]
+        else:
+            out.append(p)
+    return out
+
+
+def _registered_specs():
+    from csmom_tpu.registry import lint_rules
+
+    return lint_rules()
+
+
+def _registered_rules():
+    return [spec.rule_cls() for spec in _registered_specs()]
+
+
+def run_lint(paths=None, rules=None, rule: str | None = None,
+             repo: str | None = None) -> LintReport:
+    """Run the registered rule set (or ``rules`` instances) over
+    ``paths`` (default: package + bench.py + benchmarks/).
+
+    ``rule`` filters to one rule id; unknown ids raise with the known
+    set named.  Every file is parsed exactly once; rule visitors share
+    the parse (see the module docstring).
+    """
+    repo = repo or _REPO
+    if rules is None:
+        rules = _registered_rules()
+    if rule is not None:
+        known = [r.id for r in rules]
+        rules = [r for r in rules if r.id == rule]
+        if not rules:
+            raise KeyError(f"unknown lint rule {rule!r}; registered rules: "
+                           f"{known}")
+    files = (default_sources(repo) if paths is None
+             else _expand_paths(paths))
+    run = RunContext(repo)
+    active_rules = {r.id for r in rules}
+    known_rules = (active_rules | {STALE_PRAGMA_RULE}
+                   | {s.name for s in _registered_specs()})
+    for r in rules:
+        r.start_run(run)
+    for path in files:
+        rel = (os.path.relpath(path, repo)
+               if os.path.commonpath([os.path.abspath(path), repo]) == repo
+               else path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            ctx = FileContext(path, rel, src, run)
+        except (OSError, SyntaxError, ValueError) as e:
+            run.findings.append(Finding(
+                rule="parse-error", path=rel, line=getattr(e, "lineno", 1)
+                or 1, message=f"unparseable source: {e}"))
+            continue
+        run.scanned.append(rel)
+        for r in rules:
+            r.start_file(ctx)
+        for node in ast.walk(ctx.tree):
+            for r in rules:
+                r.visit(node, ctx)
+        for r in rules:
+            r.finish_file(ctx)
+        ctx.finish(known_rules, active_rules)
+    for r in rules:
+        r.finish_run(run)
+    run.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintReport(findings=run.findings, suppressed=run.suppressed,
+                      files=len(run.scanned),
+                      rules=tuple(r.id for r in rules))

@@ -1,0 +1,806 @@
+"""The builtin lint rule set (ISSUE 11) — registered as kind-``lint``
+engines, so one registration buys the ``csmom lint`` CLI, the tier-1
+sweep, ``csmom registry list``, and the fixture self-test harness.
+
+Five rules, each mechanizing a discipline an earlier round enforced by
+regex or review:
+
+- **clock-discipline** — the r3/r7 time-discipline lints ported to AST
+  with the alias holes closed (``from time import time as _t; _t()``,
+  ``import time as tt; tt.time()``, ``getattr(time, "time")()``, and
+  local rebinds all resolve to the same origin), keeping the per-layer
+  tiers: serve timing is ``mono_now_s``-only, the stream data plane
+  reads NO clock at all (event time only), the ledger is wall-free, and
+  everything else routes legitimate wall needs through
+  ``utils.deadline``.  Prose mentions of the wall-clock idiom (comments
+  / docstrings) must carry a pragma — the old count-based allowlist's
+  two entries became in-file suppressions.
+- **tracer-hygiene** — inside any function handed to ``jax.jit`` /
+  ``shard_map`` (decorator, direct call, or a registry ``batch_fn``
+  factory's inner function), flag host-sync escapes: ``print``, clock
+  reads, ``float()`` / ``.item()`` / ``np.asarray`` on traced
+  parameters, and mutable-global writes.  A host sync inside a traced
+  function is a silent per-dispatch device round trip — the
+  tail-latency-by-variability failure mode a TPU window cannot afford
+  to discover live.
+- **lock-discipline** — ``threading`` locks acquired outside
+  ``with`` / try-finally, and blocking calls (socket send/recv,
+  ``sleep``, engine dispatch) made while a lock is held.  The r13
+  exactly-once terminal transitions serialize on these locks; one
+  blocking call under one of them serializes the whole continuous
+  batcher.
+- **donation-safety** — a buffer passed at a donated position
+  (``donate_argnums`` / a ``*donated*`` entry) must not be read later
+  in the same scope: donation hands XLA the HBM block, and a
+  read-after-donate is garbage on device even though it "works" on CPU
+  (where donation is ignored).
+- **enumeration-drift** — the r14 registry lint migrated in (no
+  module-level ENDPOINTS/…_ENTRIES/WORKLOADS/…_STRATEGIES enumerations
+  outside ``csmom_tpu/registry/``) plus checkpoint-name coverage: every
+  literal ``checkpoint("x")`` call site must appear in
+  ``chaos.plan.KNOWN_POINTS`` and every vocabulary entry must still
+  have a call site — the prose inventory in ``chaos/inject.py`` drifted
+  twice before the vocabulary became code.
+
+Stdlib-only, jax-free (the sweep gates ``csmom rehearse`` on CPU).
+Rule messages spell pragma examples with ``{`` placeholders so this
+module's own source never parses as a pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from csmom_tpu.analysis.core import FileContext, LintRule, RunContext
+
+__all__ = [
+    "ClockDiscipline",
+    "DonationSafety",
+    "EnumerationDrift",
+    "LockDiscipline",
+    "TracerHygiene",
+    "banned_enumeration_name",
+    "register_builtin_rules",
+]
+
+
+def _posix(rel: str) -> str:
+    return rel.replace(os.sep, "/")
+
+
+# --------------------------------------------------------------------------
+# clock-discipline
+# --------------------------------------------------------------------------
+
+class ClockDiscipline(LintRule):
+    """Per-layer clock tiers, alias-aware (the regex lints' successor)."""
+
+    id = "clock-discipline"
+    description = ("wall-clock reads route through utils.deadline; serve "
+                   "timing is mono_now_s-only; the stream data plane reads "
+                   "no clock at all; the ledger is wall-free (alias-aware: "
+                   "closes the from-import/module-alias/getattr holes the "
+                   "old regex lint had)")
+
+    # prose layer: the wall-clock CALL idiom quoted in comments/docstrings
+    # must justify itself with a pragma (the old _ALLOWLIST sites)
+    MENTION_RE = re.compile(
+        r"time\.time\(\)|datetime(?:\.datetime)?\.now\(\s*\)")
+
+    # serve/replay timing: every clock read goes through mono_now_s so the
+    # clock the queue expires on is the clock the artifact measures on
+    MONO_ONLY_FILES = (
+        "csmom_tpu/serve/__init__.py",
+        "csmom_tpu/serve/buckets.py",
+        "csmom_tpu/serve/queue.py",
+        "csmom_tpu/serve/batcher.py",
+        "csmom_tpu/serve/engine.py",
+        "csmom_tpu/serve/service.py",
+        "csmom_tpu/serve/loadgen.py",
+        "csmom_tpu/serve/proto.py",
+        "csmom_tpu/serve/health.py",
+        "csmom_tpu/serve/worker.py",
+        "csmom_tpu/serve/router.py",
+        "csmom_tpu/serve/supervisor.py",
+        "csmom_tpu/serve/slo.py",
+        "csmom_tpu/serve/cache.py",
+        "csmom_tpu/cli/serve.py",
+        "csmom_tpu/stream/replay.py",
+        "csmom_tpu/cli/replay.py",
+    )
+
+    # the stream data plane runs on EVENT TIME: bar stamps and version
+    # counters only — a clock read here is a lateness decision smuggled
+    # off the event-time axis
+    NO_CLOCK_FILES = (
+        "csmom_tpu/stream/__init__.py",
+        "csmom_tpu/stream/ring.py",
+        "csmom_tpu/stream/ingest.py",
+        "csmom_tpu/stream/incremental.py",
+    )
+
+    # ledger verdicts must be reproducible from committed artifacts alone
+    WALL_FREE_FILES = (
+        "csmom_tpu/obs/ledger.py",
+        "csmom_tpu/obs/regress.py",
+        "csmom_tpu/obs/memstats.py",
+        "csmom_tpu/cli/ledger.py",
+    )
+
+    def start_run(self, run: RunContext) -> None:
+        for rel in (self.MONO_ONLY_FILES + self.NO_CLOCK_FILES
+                    + self.WALL_FREE_FILES):
+            path = os.path.join(run.repo, rel)
+            # only meaningful against a tree that HAS the layer (a test
+            # repo with one doctored module must not spam missing-file
+            # findings for every other tier entry)
+            if not os.path.isfile(path) and os.path.isdir(
+                    os.path.dirname(path)):
+                run.report(self.id, rel, 1,
+                           "tier contract names a missing module — update "
+                           "the tier lists in analysis/rules.py")
+
+    def start_file(self, ctx: FileContext) -> None:
+        rel = _posix(ctx.rel)
+        self._mono_only = rel in self.MONO_ONLY_FILES
+        self._no_clock = rel in self.NO_CLOCK_FILES
+        self._contract = (self._mono_only or self._no_clock
+                          or rel in self.WALL_FREE_FILES)
+        if self._contract:
+            # a tier module cannot pragma its way out of its contract —
+            # report around the suppression machinery on purpose
+            for p in ctx.pragmas:
+                if p.rule == self.id:
+                    ctx.run.report(
+                        self.id, ctx.rel, p.line,
+                        "clock tiers are contracts, not defaults: a "
+                        "serve/stream/ledger module must not carry a "
+                        "clock-discipline pragma")
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if self._no_clock and isinstance(node, (ast.Import, ast.ImportFrom)):
+            mod = (node.module if isinstance(node, ast.ImportFrom)
+                   else None)
+            names = [a.name for a in node.names]
+            if mod == "time" or "time" in names:
+                ctx.report(self.id, node.lineno,
+                           "the streaming data plane is event-time only — "
+                           "it must not import the time module")
+            if (mod or "").endswith("deadline") and any(
+                    a.name == "mono_now_s" for a in node.names):
+                ctx.report(self.id, node.lineno,
+                           "the streaming data plane reads NO clock, not "
+                           "even mono_now_s — lateness and ordering come "
+                           "from tick stamps")
+        if (self._no_clock and isinstance(node, ast.Name)
+                and node.id == "mono_now_s"):
+            ctx.report(self.id, node.lineno,
+                       "mono_now_s in the event-time-only data plane")
+        if not isinstance(node, ast.Call):
+            return
+        origin = ctx.resolve_call(node)
+        if origin is None:
+            return
+        if origin == "time.time":
+            ctx.report(self.id, node.lineno,
+                       "bare wall-clock read (resolves to time.time) — "
+                       "use utils.deadline.wall_now_s / file_age_s / "
+                       "marker_fresh, or mono_now_s for durations")
+        elif (origin == "datetime.datetime.now" and not node.args
+                and not node.keywords):
+            ctx.report(self.id, node.lineno,
+                       "argless datetime.now is a wall-clock read — "
+                       "pass a timezone for identity stamps "
+                       "(datetime.now with timezone.utc) or use the "
+                       "utils.deadline helpers")
+        elif self._mono_only and origin == "time.monotonic":
+            ctx.report(self.id, node.lineno,
+                       "inline time.monotonic in a mono_now_s-only "
+                       "module — serve/replay timing goes through "
+                       "utils.deadline.mono_now_s so one clock rules "
+                       "deadlines AND recorded latencies")
+        elif self._no_clock and (origin.startswith("time.")
+                                 or origin.endswith(".mono_now_s")):
+            ctx.report(self.id, node.lineno,
+                       f"clock read ({origin}) in the event-time-only "
+                       "stream data plane")
+
+    def finish_file(self, ctx: FileContext) -> None:
+        for kind, line0, text in ctx.tokens:
+            for m in self.MENTION_RE.finditer(text):
+                line = line0 + text[: m.start()].count("\n")
+                ctx.report(
+                    self.id, line,
+                    f"prose mention of the wall-clock idiom in a {kind} — "
+                    "justify it in place with a pragma "
+                    f"(lint: allow{'[' + self.id + ']'} <why>) or drop it")
+
+
+# --------------------------------------------------------------------------
+# tracer-hygiene
+# --------------------------------------------------------------------------
+
+_JIT_ORIGINS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+
+def _is_jit_origin(origin: str | None, raw_name: str | None) -> bool:
+    if origin is not None:
+        return origin in _JIT_ORIGINS or origin.endswith("shard_map")
+    return raw_name in ("jit", "pjit", "shard_map")
+
+
+def _callable_name(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+class TracerHygiene(LintRule):
+    """Host-sync escapes inside traced (jit/shard_map/registered)
+    functions: each one is a hidden device round trip per dispatch."""
+
+    id = "tracer-hygiene"
+    description = ("no print/clock/float()/.item()/np.asarray-on-params/"
+                   "global-writes inside functions passed to jax.jit, "
+                   "shard_map, or registered as a ServeSurface batch_fn")
+
+    _HOST_MATERIALIZE = {"numpy.asarray", "numpy.array",
+                         "numpy.ascontiguousarray"}
+
+    def start_file(self, ctx: FileContext) -> None:
+        tree = ctx.tree
+        defs_by_name: dict = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        # module-level literal constants, so `static_argnames=_STATICS`
+        # (the repo's idiom for shared jit wrappings) dereferences
+        module_consts: dict = {}
+        for node in tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, (ast.Constant, ast.Tuple,
+                                                ast.List))):
+                module_consts[node.targets[0].id] = node.value
+
+        traced: dict = {}  # def/lambda node -> set of static param names
+
+        def mark(node, static=()):
+            if node is None:
+                return
+            traced.setdefault(node, set()).update(static)
+
+        def static_names(call: ast.Call | None, fn) -> set:
+            """Param names a jit call pins static (literal argnums/names
+            or a module-level literal constant — the honest subset a
+            static pass can know)."""
+            out: set = set()
+            if call is None:
+                return out
+            params = _param_names(fn)
+            for kw in call.keywords:
+                v = kw.value
+                if isinstance(v, ast.Name) and v.id in module_consts:
+                    v = module_consts[v.id]
+                if kw.arg == "static_argnums":
+                    idxs = []
+                    if isinstance(v, ast.Constant) and isinstance(v.value,
+                                                                  int):
+                        idxs = [v.value]
+                    elif isinstance(v, (ast.Tuple, ast.List)):
+                        idxs = [e.value for e in v.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, int)]
+                    out |= {params[i] for i in idxs if 0 <= i < len(params)}
+                elif kw.arg == "static_argnames":
+                    if isinstance(v, ast.Constant) and isinstance(v.value,
+                                                                  str):
+                        out.add(v.value)
+                    elif isinstance(v, (ast.Tuple, ast.List)):
+                        out |= {e.value for e in v.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)}
+            return out
+
+        def unwrap_vmap(node):
+            while (isinstance(node, ast.Call)
+                   and _callable_name(node.func) in ("vmap", "pmap")
+                   and node.args):
+                node = node.args[0]
+            return node
+
+        def targets_of(node, jit_call=None):
+            node = unwrap_vmap(node)
+            if isinstance(node, ast.Lambda):
+                mark(node, static_names(jit_call, node))
+            elif isinstance(node, ast.Name):
+                for d in defs_by_name.get(node.id, []):
+                    mark(d, static_names(jit_call, d))
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_jit_origin(ctx.resolve(dec),
+                                      _callable_name(dec)):
+                        mark(node)
+                    elif isinstance(dec, ast.Call):
+                        origin = ctx.resolve_call(dec)
+                        name = _callable_name(dec.func)
+                        if _is_jit_origin(origin, name):
+                            mark(node, static_names(dec, node))
+                        elif ((origin or "").endswith("partial")
+                                and dec.args
+                                and _is_jit_origin(
+                                    ctx.resolve(dec.args[0]),
+                                    _callable_name(dec.args[0]))):
+                            mark(node, static_names(dec, node))
+            elif isinstance(node, ast.Call):
+                if _is_jit_origin(ctx.resolve_call(node),
+                                  _callable_name(node.func)) and node.args:
+                    targets_of(node.args[0], jit_call=node)
+                for kw in node.keywords:
+                    if kw.arg == "batch_fn" and isinstance(kw.value,
+                                                           ast.Name):
+                        # a registered ServeSurface factory: its INNER
+                        # functions are what jit/vmap ultimately trace
+                        for factory in defs_by_name.get(kw.value.id, []):
+                            for sub in ast.walk(factory):
+                                if sub is not factory and isinstance(
+                                        sub, (ast.FunctionDef,
+                                              ast.Lambda)):
+                                    mark(sub)
+
+        # closure: a def nested inside a traced def is traced too
+        changed = True
+        while changed:
+            changed = False
+            for node in list(traced):
+                for sub in ast.walk(node):
+                    if (sub is not node
+                            and isinstance(sub, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef,
+                                                 ast.Lambda))
+                            and sub not in traced):
+                        traced[sub] = set(traced[node])
+                        changed = True
+
+        reported: set = set()
+
+        def flag(line, msg):
+            if (line, msg) not in reported:
+                reported.add((line, msg))
+                ctx.report(self.id, line, msg)
+
+        for fn, static in traced.items():
+            params = set(_param_names(fn)) - static
+            fname = getattr(fn, "name", "<lambda>")
+            globals_declared: set = set()
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Global):
+                    globals_declared |= set(sub.names)
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                    tgts = (sub.targets if isinstance(sub, ast.Assign)
+                            else [sub.target])
+                    for t in tgts:
+                        if (isinstance(t, ast.Name)
+                                and t.id in globals_declared):
+                            flag(sub.lineno,
+                                 f"traced function {fname!r} writes "
+                                 f"global {t.id!r} — side effects do not "
+                                 "re-run on cached executions and force "
+                                 "host sync under tracing")
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = _callable_name(sub.func)
+                origin = ctx.resolve_call(sub)
+                if isinstance(sub.func, ast.Name) and name == "print":
+                    flag(sub.lineno,
+                         f"print inside traced function {fname!r} — "
+                         "host I/O in a jitted/sharded body (use "
+                         "jax.debug.print if this must stay)")
+                elif origin is not None and (origin.startswith("time.")
+                                             or origin.endswith(
+                                                 ".mono_now_s")):
+                    flag(sub.lineno,
+                         f"clock read ({origin}) inside traced function "
+                         f"{fname!r} — trace-time constant at best, host "
+                         "sync at worst")
+                elif origin in self._HOST_MATERIALIZE and sub.args and (
+                        isinstance(sub.args[0], ast.Name)
+                        and sub.args[0].id in params):
+                    flag(sub.lineno,
+                         f"{origin} on traced parameter "
+                         f"{sub.args[0].id!r} in {fname!r} — host "
+                         "materialization blocks the dispatch (use "
+                         "jnp.asarray)")
+                elif (isinstance(sub.func, ast.Name)
+                        and name == "float" and len(sub.args) == 1
+                        and isinstance(sub.args[0], ast.Name)
+                        and sub.args[0].id in params):
+                    flag(sub.lineno,
+                         f"float() on traced parameter "
+                         f"{sub.args[0].id!r} in {fname!r} — a "
+                         "concretization/host sync inside the trace")
+                elif (isinstance(sub.func, ast.Attribute)
+                        and name == "item" and not sub.args):
+                    root = _root_name(sub.func.value)
+                    if root is not None and root in params:
+                        flag(sub.lineno,
+                             f".item() on traced parameter {root!r} in "
+                             f"{fname!r} — device->host sync per call")
+
+
+def _param_names(fn) -> list:
+    a = fn.args
+    names = [p.arg for p in getattr(a, "posonlyargs", [])]
+    names += [p.arg for p in a.args]
+    names += [p.arg for p in a.kwonlyargs]
+    return names
+
+
+def _root_name(node) -> str | None:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+# --------------------------------------------------------------------------
+# lock-discipline
+# --------------------------------------------------------------------------
+
+class LockDiscipline(LintRule):
+    """Locks leave scope only through with/try-finally, and never guard
+    a blocking call (the r13 exactly-once transitions depend on it)."""
+
+    id = "lock-discipline"
+    description = ("threading locks acquired only via with/try-finally, "
+                   "and no blocking call (socket send/recv, sleep, engine "
+                   "dispatch) while a lock is held")
+
+    BLOCKING = ("sleep", "send", "sendall", "recv", "recv_into",
+                "connect", "accept", "dispatch", "score", "request")
+
+    @staticmethod
+    def _lock_expr(node) -> bool:
+        if isinstance(node, ast.Name):
+            return "lock" in node.id.lower()
+        if isinstance(node, ast.Attribute):
+            return "lock" in node.attr.lower()
+        if isinstance(node, ast.Subscript):
+            s = node.slice
+            return (isinstance(s, ast.Constant) and isinstance(s.value, str)
+                    and "lock" in s.value.lower())
+        return False
+
+    @staticmethod
+    def _recv_text(node) -> str:
+        try:
+            return ast.unparse(node)
+        except Exception:  # pragma: no cover - unparse is total on 3.9+
+            return ast.dump(node)
+
+    def _released_in(self, stmts, receiver: str) -> bool:
+        for s in stmts:
+            for sub in ast.walk(s):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "release"
+                        and self._recv_text(sub.func.value) == receiver):
+                    return True
+        return False
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        # --- bare .acquire() outside with / try-finally -------------------
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+                and self._lock_expr(node.func.value)):
+            receiver = self._recv_text(node.func.value)
+            if not self._acquire_is_disciplined(node, receiver, ctx):
+                ctx.report(self.id, node.lineno,
+                           f"{receiver}.acquire() without with/"
+                           "try-finally — a raise between acquire and "
+                           "release deadlocks every later waiter")
+        # --- blocking call while a lock is held ---------------------------
+        if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                self._lock_expr(i.context_expr) for i in node.items):
+            self._scan_lock_body(node, ctx)
+
+    def _acquire_is_disciplined(self, call, receiver: str,
+                                ctx: FileContext) -> bool:
+        # disciplined iff some enclosing Try releases this receiver in its
+        # finalbody, or the very next sibling statement is such a Try
+        stmt = call
+        while (stmt in ctx.parents
+               and not isinstance(stmt, ast.stmt)):
+            stmt = ctx.parents[stmt]
+        node = stmt
+        while node in ctx.parents:
+            parent = ctx.parents[node]
+            if isinstance(parent, ast.Try) and self._released_in(
+                    parent.finalbody, receiver):
+                return True
+            for field in ("body", "orelse", "finalbody"):
+                body = getattr(parent, field, None)
+                if isinstance(body, list) and node in body:
+                    i = body.index(node)
+                    if (i + 1 < len(body)
+                            and isinstance(body[i + 1], ast.Try)
+                            and self._released_in(body[i + 1].finalbody,
+                                                  receiver)):
+                        return True
+            node = parent
+        return False
+
+    def _scan_lock_body(self, with_node, ctx: FileContext) -> None:
+        def scan(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue  # deferred bodies do not run under the lock
+                if isinstance(child, (ast.With, ast.AsyncWith)) and any(
+                        self._lock_expr(i.context_expr)
+                        for i in child.items):
+                    continue  # a nested lock-with gets its own visit
+                if isinstance(child, ast.Call):
+                    name = _callable_name(child.func)
+                    origin = ctx.resolve_call(child)
+                    if (name in self.BLOCKING
+                            or origin == "time.sleep"):
+                        ctx.report(
+                            self.id, child.lineno,
+                            f"blocking call ({name}) with a lock held — "
+                            "every thread contending this lock "
+                            "serializes behind the wait; move the "
+                            "blocking work outside the critical "
+                            "section")
+                scan(child)
+
+        for stmt in with_node.body:
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                         ast.Call):
+                name = _callable_name(stmt.value.func)
+                if name in self.BLOCKING:
+                    ctx.report(
+                        self.id, stmt.lineno,
+                        f"blocking call ({name}) with a lock held — "
+                        "move it outside the critical section")
+                    continue
+            scan(stmt)
+
+
+# --------------------------------------------------------------------------
+# donation-safety
+# --------------------------------------------------------------------------
+
+class DonationSafety(LintRule):
+    """No read of a buffer after it was passed at a donated position."""
+
+    id = "donation-safety"
+    description = ("a buffer passed to a donate_argnums/donated entry is "
+                   "surrendered to XLA — reading it afterwards in the "
+                   "same scope is garbage on device (CPU ignores "
+                   "donation, which is how this escapes testing)")
+
+    @staticmethod
+    def _donated_indices(call: ast.Call) -> tuple | None:
+        """The donated positional indices a jit call pins, or None when
+        the call donates nothing."""
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                idxs = tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, int))
+                return idxs or None
+        return None
+
+    def start_file(self, ctx: FileContext) -> None:
+        scopes = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                               if isinstance(n, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef))]
+        for scope in scopes:
+            self._scan_scope(scope, ctx)
+
+    @staticmethod
+    def _scope_walk(scope):
+        """Walk one scope, not descending into nested defs (their
+        bindings and execution order are not this scope's)."""
+        stack = (list(scope.body) if hasattr(scope, "body")
+                 else list(ast.iter_child_nodes(scope)))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # a nested def is its own scope
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _scan_scope(self, scope, ctx: FileContext) -> None:
+        donated_fns: dict = {}  # local name -> donated indices | None(=all)
+        donating_calls: list = []  # (call node, indices | None)
+
+        def is_jit(call):
+            return _is_jit_origin(ctx.resolve_call(call),
+                                  _callable_name(call.func))
+
+        for node in self._scope_walk(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                call = node.value
+                name = node.targets[0].id
+                if is_jit(call):
+                    idxs = self._donated_indices(call)
+                    if idxs:
+                        donated_fns[name] = idxs
+                elif "donated" in (_callable_name(call.func) or ""):
+                    donated_fns[name] = None  # every positional donated
+
+        for node in self._scope_walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in donated_fns):
+                donating_calls.append((node, donated_fns[node.func.id]))
+            elif (isinstance(node.func, ast.Name)
+                    and "donated" in node.func.id):
+                # a *_donated entry called directly (e.g. one passed in
+                # as an argument): every positional buffer is donated
+                donating_calls.append((node, None))
+            elif isinstance(node.func, ast.Call) and is_jit(node.func):
+                idxs = self._donated_indices(node.func)
+                if idxs:
+                    donating_calls.append((node, idxs))
+
+        for call, idxs in donating_calls:
+            indices = (range(len(call.args)) if idxs is None else idxs)
+            end = getattr(call, "end_lineno", call.lineno)
+            fn_txt = _callable_name(call.func) or "the donated entry"
+            for i in indices:
+                if i >= len(call.args) or not isinstance(call.args[i],
+                                                         ast.Name):
+                    continue
+                buf = call.args[i].id
+                # a rebind on the call's own line (``v = fn(v, m)``) or
+                # later retires the name — reads past it are a NEW buffer
+                rebound_at = min(
+                    (n.lineno for n in self._scope_walk(scope)
+                     if isinstance(n, ast.Name) and n.id == buf
+                     and isinstance(n.ctx, ast.Store)
+                     and n.lineno >= end), default=float("inf"))
+                for n in self._scope_walk(scope):
+                    if (isinstance(n, ast.Name) and n.id == buf
+                            and isinstance(n.ctx, ast.Load)
+                            and end < n.lineno < rebound_at
+                            and n is not call.args[i]):
+                        ctx.report(
+                            self.id, n.lineno,
+                            f"{buf!r} is read after being donated to "
+                            f"{fn_txt} (line {call.lineno}) — the buffer "
+                            "was surrendered to XLA; copy it first or "
+                            "use the undonated entry")
+
+
+# --------------------------------------------------------------------------
+# enumeration-drift
+# --------------------------------------------------------------------------
+
+_BANNED_ENUMS = ("ENDPOINTS", "ENTRIES", "WORKLOADS", "STRATEGIES")
+
+
+def banned_enumeration_name(name: str) -> bool:
+    """Module-level names that read as an engine/endpoint/workload/entry
+    enumeration — the parallel tables the r14 registry deleted."""
+    up = name.upper().lstrip("_")
+    return any(up == b or up.endswith("_" + b) for b in _BANNED_ENUMS)
+
+
+class EnumerationDrift(LintRule):
+    """The registry stays the only table; the checkpoint vocabulary
+    stays bound to its call sites (both directions)."""
+
+    id = "enumeration-drift"
+    description = ("no ENDPOINTS/…_ENTRIES/WORKLOADS/…_STRATEGIES "
+                   "enumerations outside csmom_tpu/registry/, and every "
+                   "checkpoint(\"x\") literal round-trips with "
+                   "chaos.plan.KNOWN_POINTS")
+
+    def __init__(self):
+        from csmom_tpu.chaos.plan import KNOWN_POINTS
+
+        self._vocab = tuple(KNOWN_POINTS)
+
+    def start_run(self, run: RunContext) -> None:
+        self._points_seen: dict = {}
+        self._vocab_site: tuple | None = None
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        rel = _posix(ctx.rel)
+        if (isinstance(node, (ast.Assign, ast.AnnAssign))
+                and isinstance(ctx.parents.get(node), ast.Module)
+                and not rel.startswith("csmom_tpu/registry/")):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Name) and banned_enumeration_name(
+                        t.id):
+                    ctx.report(
+                        self.id, node.lineno,
+                        f"module-level enumeration {t.id!r} outside "
+                        "csmom_tpu/registry/ — register engines instead "
+                        "of growing a parallel table (the four-list "
+                        "world ISSUE 9 deleted)")
+        if (isinstance(node, ast.Assign) and rel.endswith("chaos/plan.py")
+                and any(isinstance(t, ast.Name) and t.id == "KNOWN_POINTS"
+                        for t in node.targets)):
+            self._vocab_site = (ctx.rel, node.lineno)
+        if isinstance(node, ast.Call):
+            name = _callable_name(node.func)
+            if (name in ("checkpoint", "_chaos") and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                point = node.args[0].value
+                self._points_seen.setdefault(point, (ctx.rel,
+                                                     node.lineno))
+                if "*" not in point and point not in self._vocab:
+                    ctx.report(
+                        self.id, node.lineno,
+                        f"checkpoint point {point!r} is not in "
+                        "chaos.plan.KNOWN_POINTS — add it there (the "
+                        "vocabulary is the checkpoint inventory; an "
+                        "undeclared point is invisible to fault plans "
+                        "and the rehearse matrix)")
+
+    def finish_run(self, run: RunContext) -> None:
+        if self._vocab_site is None:
+            return  # partial sweep that never read the vocabulary home
+        scanned = {_posix(r) for r in run.scanned}
+        if not {"bench.py", "csmom_tpu/chaos/minibench.py"} <= scanned:
+            # a partial sweep (e.g. --paths csmom_tpu/chaos) sees the
+            # vocabulary but not the call-site homes; only a full sweep
+            # can honestly claim an entry is dead
+            return
+        rel, line = self._vocab_site
+        for point in self._vocab:
+            if point not in self._points_seen:
+                run.report(
+                    self.id, rel, line,
+                    f"plan point {point!r} is in KNOWN_POINTS but no "
+                    "checkpoint call site uses it — dead vocabulary "
+                    "drifts exactly like the prose inventory did; drop "
+                    "it or restore the call site")
+
+
+# --------------------------------------------------------------------------
+# registration
+# --------------------------------------------------------------------------
+
+BUILTIN_RULES = (ClockDiscipline, TracerHygiene, LockDiscipline,
+                 DonationSafety, EnumerationDrift)
+
+
+def register_builtin_rules() -> None:
+    """Register the builtin rule set as kind-``lint`` engines — one
+    registration enrolls a rule in the CLI, the tier-1 sweep, the
+    registry listing, and the fixture self-test (import-idempotent)."""
+    from csmom_tpu.registry import REGISTRY, EngineSpec
+
+    for cls in BUILTIN_RULES:
+        REGISTRY.register(
+            EngineSpec(name=cls.id, kind="lint",
+                       description=cls.description, rule_cls=cls),
+            replace=True)
+
+
+register_builtin_rules()

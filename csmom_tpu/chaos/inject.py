@@ -21,30 +21,12 @@ error handling (that handling surviving the error IS the invariant); and
 ``fail`` returns the string ``"fail"`` for control-flow points whose
 failure mode is a *result*, not an exception (e.g. a tunnel probe).
 
-Checkpoint inventory (grep for ``checkpoint(`` to verify):
-
-===================  =========================================  ==========
-name                 site                                       typical faults
-===================  =========================================  ==========
-bench.probe          bench supervisor, before each tunnel probe  fail
-bench.compile        bench child, first call of every leg        kill, sleep
-bench.row            bench child, after each measured leg        trip_deadline, sleep, kill
-bench.finish         bench child, before the trailing JSON       stdout_noise
-bench.land           bench supervisor, inside the record write   raise_oserror
-warmup.entry         aot warmup, before each manifest entry      corrupt_file
-aot.compile          aot_compile, between lower and compile      corrupt_file, truncate_file
-mini.row             chaos.minibench, before each measured row   any (fast tier)
-mini.finish          chaos.minibench, before the trailing JSON   stdout_noise
-serve.admit          serve queue, before admission               sleep
-serve.coalesce       serve batcher, after gathering a batch      sleep
-serve.dispatch       serve worker, before the engine call        fail, sleep, kill
-pool.route           pool router, at request admission           sleep
-pool.hedge           pool router, when a hedge fires             sleep
-pool.spawn           pool supervisor, before spawning a worker   sleep
-stream.tick          replay feed, per generated tick             tick_late, tick_dup, tick_drop
-stream.ingest        stream ingestor, per offered tick           sleep
-stream.serve         replay serve probe, per probe               version_skew, sleep
-===================  =========================================  ==========
+The checkpoint inventory is CODE, not prose: ``chaos.plan.KNOWN_POINTS``
+holds every point name, and the enumeration-drift rule in ``csmom lint``
+cross-checks it against the literal ``checkpoint("...")`` call sites in
+both directions on every sweep.  (A prose table used to live here; by
+ISSUE 11 it had silently lost ``mini.start`` and ``serve.cache`` — the
+drift the vocabulary now makes impossible.)
 
 The ``serve.*`` points run in the signal service's own threads.  In the
 SINGLE-process service, process-fatal actions (kill/exit) take the whole
@@ -241,6 +223,7 @@ def _skew_wall_clock(seconds: float) -> None:
     real_time = time.time
 
     def skewed():
+        # lint: allow[clock-discipline] this wrapper IS the skew under test
         return real_time() + seconds
 
     time.time = skewed

@@ -45,6 +45,24 @@ __all__ = ["Fault", "FaultPlan", "load_active_plan", "PLAN_ENV"]
 
 PLAN_ENV = "CSMOM_FAULT_PLAN"
 
+# The plan-point vocabulary: every literal ``checkpoint("...")`` call
+# site in the package/bench harness, i.e. every point a fault plan can
+# target.  The enumeration-drift lint rule (csmom_tpu/analysis/rules.py)
+# cross-checks BOTH directions on every sweep: a call site whose point
+# is missing here fails `csmom lint`, and an entry here whose call site
+# vanished is dead vocabulary and fails it too — this tuple replaced the
+# prose inventory in chaos/inject.py, which had drifted twice (no
+# mini.start, no serve.cache) by the time the vocabulary became code.
+KNOWN_POINTS = (
+    "bench.probe", "bench.compile", "bench.row", "bench.finish",
+    "bench.land",
+    "warmup.entry", "aot.compile",
+    "mini.start", "mini.row", "mini.finish",
+    "serve.admit", "serve.coalesce", "serve.dispatch", "serve.cache",
+    "pool.route", "pool.hedge", "pool.spawn",
+    "stream.tick", "stream.ingest", "stream.serve",
+)
+
 _ROLES = ("any", "supervisor", "child", "warmup")
 
 
@@ -112,6 +130,7 @@ class Fault:
         "exit",           # os._exit(code) — a crash that skips cleanup
         "sleep",          # hang for `seconds` (tunnel stall)
         "trip_deadline",  # fire the armed deadline guard immediately
+        # lint: allow[clock-discipline] documents what the skew fault perturbs
         "clock_skew",     # jump time.time() by `seconds`; monotonic clocks
                           # must shield every deadline from this
         "corrupt_file",   # seeded byte-flips over files matching `path`

@@ -1,0 +1,83 @@
+"""csmom lint — run the static-analysis sweep (ISSUE 11).
+
+Runs every registered kind-``lint`` rule (clock-discipline,
+tracer-hygiene, lock-discipline, donation-safety, enumeration-drift —
+plus any runtime registration) over the package, ``bench.py``, and
+``benchmarks/`` in a single parse-per-file pass.  Exit 0 means the tree
+is clean (zero unsuppressed findings; a stale pragma counts as a
+finding); exit 1 names every defect as ``path:line: [rule] message``.
+
+``--json`` emits the machine-readable findings report (schema_version
+1) — what tier-1 parses and what CI archives.  ``--rule`` runs one rule;
+``--paths`` narrows the scan; ``--rules`` lists the registered rule set
+with descriptions (the registry is the only rule table).
+
+``csmom rehearse`` refuses to start when this sweep fails: a dirty tree
+must not reach a tunnel window.
+
+Registered via ``register(sub)`` like serve/replay/ledger (the
+cli/main.py split: new subcommands do not grow the monolith).
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["cmd_lint", "register"]
+
+
+def cmd_lint(args) -> int:
+    """Run the registered static-analysis rules over the tree."""
+    from csmom_tpu.analysis import run_lint
+    from csmom_tpu.registry import lint_rules
+
+    if getattr(args, "rules_list", False):
+        specs = lint_rules()
+        for spec in specs:
+            print(f"{spec.name}")
+            print(f"    {spec.description}")
+        print(f"\n{len(specs)} rules registered (kind 'lint') — register "
+              "one more with register_engine(name=..., kind='lint', "
+              "rule_cls=...) and it joins this sweep, tier-1, and the "
+              "fixture self-test with no other file edited")
+        return 0
+    try:
+        report = run_lint(paths=args.paths or None, rule=args.rule)
+    except KeyError as e:
+        print(str(e).strip('"'), file=sys.stderr)
+        return 2
+    if args.json:
+        print(report.to_json())
+        return 0 if report.ok else 1
+    for f in report.findings:
+        print(f)
+    print(f"{len(report.findings)} finding(s) over {report.files} "
+          f"file(s); {len(report.suppressed)} suppressed by pragma "
+          f"({len(report.rules)} rules)")
+    if not report.ok:
+        print("fix the findings or, for a justified exception, add "
+              "`lint: allow" + "[<rule>] <reason>` on (or directly "
+              "above) the offending line — unused pragmas fail the "
+              "sweep too", file=sys.stderr)
+    return 0 if report.ok else 1
+
+
+def register(sub) -> None:
+    """Attach the ``lint`` subparser (called from cli.main)."""
+    sp = sub.add_parser(
+        "lint",
+        help="run the static-analysis sweep: registered AST rules for "
+             "clock/tracer/lock/donation/enumeration discipline "
+             "(tier-1 runs it; rehearse gates on it)",
+    )
+    sp.add_argument("--json", action="store_true",
+                    help="emit the machine-readable findings report "
+                         "(schema_version 1) instead of text")
+    sp.add_argument("--rule", metavar="ID",
+                    help="run only this rule id (see --rules)")
+    sp.add_argument("--paths", nargs="+", metavar="PATH",
+                    help="files or directories to scan (default: the "
+                         "package, bench.py, and benchmarks/)")
+    sp.add_argument("--rules", dest="rules_list", action="store_true",
+                    help="list the registered rules and exit")
+    sp.set_defaults(fn=cmd_lint)

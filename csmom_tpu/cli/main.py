@@ -1668,6 +1668,7 @@ def build_parser() -> argparse.ArgumentParser:
         sp.set_defaults(fn=fn)
 
     from csmom_tpu.cli.ledger import register as register_ledger
+    from csmom_tpu.cli.lint import register as register_lint
     from csmom_tpu.cli.registry import register as register_registry
     from csmom_tpu.cli.rehearse import register as register_rehearse
     from csmom_tpu.cli.replay import register as register_replay
@@ -1680,6 +1681,7 @@ def build_parser() -> argparse.ArgumentParser:
     register_serve(sub)
     register_replay(sub)
     register_registry(sub)
+    register_lint(sub)
     # the epilog is built AFTER every registration hook has run, from the
     # registry itself — a subcommand cannot exist without appearing here
     p.epilog = _registry_epilog(sub)
@@ -1707,7 +1709,7 @@ def _registry_epilog(sub) -> str:
 # probe for these.  ledger pins cpu itself before its bootstrap math, so
 # the probe would only add a failure mode to an offline evidence reader.
 _DEVICE_FREE_COMMANDS = {"fetch", "strategies", "bench", "pack-info",
-                         "rehearse", "timeline", "ledger"}
+                         "rehearse", "timeline", "ledger", "lint"}
 
 
 def _apply_platform(args) -> int:

@@ -22,6 +22,10 @@ __all__ = ["cmd_registry", "register"]
 
 def _surfaces(spec) -> str:
     """One engine's surface summary, compact enough for a table row."""
+    if spec.kind == "lint":
+        # a registered rule's surfaces: the CLI sweep, tier-1, and the
+        # known-bad/clean fixture self-test (ISSUE 11)
+        return "csmom-lint tier-1 self-test"
     out = []
     if spec.profiles:
         out.append(f"manifest({','.join(spec.profiles)})")
@@ -52,7 +56,8 @@ def cmd_registry(args) -> int:
         for name in serve_endpoints():
             print(name)
         return 0
-    kinds = (args.kind,) if args.kind else ("serve", "compile", "strategy")
+    kinds = ((args.kind,) if args.kind
+             else ("serve", "compile", "strategy", "lint"))
     n = 0
     for kind in kinds:
         specs = engine_specs(kind)
@@ -62,6 +67,12 @@ def cmd_registry(args) -> int:
             from csmom_tpu.registry import strategies
 
             strategies()
+            specs = engine_specs(kind)
+        if kind == "lint" and not specs:
+            # lint rules register on analysis.rules import, same deal
+            from csmom_tpu.registry import lint_rules
+
+            lint_rules()
             specs = engine_specs(kind)
         if not specs:
             continue
@@ -75,7 +86,9 @@ def cmd_registry(args) -> int:
     print(f"{n} engines registered — one registration buys: shape-"
           "manifest entries (csmom warmup), a donated-buffer variant, "
           "a serve endpoint on the bucket grid, a loadgen workload leg "
-          "with ledger rows, and the (stubbed) sharded hook")
+          "with ledger rows, and a sharded variant; a kind-'lint' "
+          "registration buys the csmom lint sweep, the tier-1 gate, "
+          "and the fixture self-test")
     return 0
 
 
@@ -88,7 +101,8 @@ def register(sub) -> None:
     )
     sp.add_argument("action", nargs="?", default="list",
                     help="what to do (list: print the registry table)")
-    sp.add_argument("--kind", choices=["serve", "compile", "strategy"],
+    sp.add_argument("--kind", choices=["serve", "compile", "strategy",
+                                       "lint"],
                     help="only this kind of engine")
     sp.add_argument("--endpoints", action="store_true",
                     help="print only the serve endpoint names (one per "

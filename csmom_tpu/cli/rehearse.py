@@ -1356,8 +1356,30 @@ _CUSTOM_CHECKS = {
 }
 
 
+def _lint_gate() -> list:
+    """The static-analysis gate (ISSUE 11): the unsuppressed findings of
+    a full `csmom lint` sweep.  ``cmd_rehearse`` refuses to start on a
+    non-empty result — a defect a CPU AST pass can catch must never
+    reach (let alone burn) a tunnel window."""
+    from csmom_tpu.analysis import run_lint
+
+    return run_lint().findings
+
+
 def cmd_rehearse(args) -> int:
     """Rehearse the capture pipeline under deterministic fault injection."""
+    if not getattr(args, "list", False):
+        findings = _lint_gate()
+        if findings:
+            print(f"refusing to rehearse: `csmom lint` reports "
+                  f"{len(findings)} finding(s) — a dirty tree must not "
+                  "reach a tunnel window", file=sys.stderr)
+            for f in findings[:20]:
+                print(f"  {f}", file=sys.stderr)
+            if len(findings) > 20:
+                print(f"  ... and {len(findings) - 20} more "
+                      "(run `csmom lint`)", file=sys.stderr)
+            return 1
     if getattr(args, "plan", None):
         if args.pipeline not in _CUSTOM_CHECKS:
             print(

@@ -45,6 +45,7 @@ __all__ = [
     "engine_specs",
     "entry_factory",
     "get_engine",
+    "lint_rules",
     "manifest_entries",
     "manifest_profiles",
     "register_engine",
@@ -101,6 +102,17 @@ def strategies() -> dict:
     import csmom_tpu.strategy.builtin  # noqa: F401  (registers the zoo)
 
     return ensure_builtin().strategies()
+
+
+def lint_rules() -> tuple:
+    """Kind-``lint`` specs in registration order; importing the builtin
+    rule module is what registers the shipped set (stdlib-only — the
+    sweep stays jax-free).  A rule registered at runtime (a plugin, a
+    test) appears here immediately, which is what enrolls it in
+    ``csmom lint``, the tier-1 sweep, and the fixture self-test."""
+    import csmom_tpu.analysis.rules  # noqa: F401  (registers the rules)
+
+    return ensure_builtin().specs("lint")
 
 
 def unregister_engine(name: str, kind: str | None = None) -> None:

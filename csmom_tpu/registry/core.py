@@ -52,7 +52,7 @@ __all__ = [
     "register_engine",
 ]
 
-KINDS = ("serve", "compile", "strategy")
+KINDS = ("serve", "compile", "strategy", "lint")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,6 +101,10 @@ class EngineSpec:
       canonical shapes for each profile in ``profiles``.
     - ``"strategy"`` — a :class:`csmom_tpu.strategy.base.Strategy`
       plugin class (``strategy_cls``); the CLI/config layer's zoo.
+    - ``"lint"`` — a static-analysis rule class (``rule_cls``, a
+      :class:`csmom_tpu.analysis.core.LintRule` subclass); registration
+      enrolls it in ``csmom lint``, the tier-1 sweep, this listing, and
+      the fixture self-test harness (ISSUE 11).
 
     ``entry_fn`` is the raw (``lru_cache``-shared) jitted-entry factory
     — what ``bench.py`` fetches so bench and warmup keep lowering
@@ -125,6 +129,7 @@ class EngineSpec:
     sharded_fn: Callable | None = None
     serve: ServeSurface | None = None
     strategy_cls: type | None = None
+    rule_cls: type | None = None    # kind-"lint": the LintRule subclass
     workload: bool = True           # serve engines default into loadgen
 
     def __post_init__(self):
@@ -136,6 +141,8 @@ class EngineSpec:
                              "ServeSurface")
         if self.kind == "strategy" and self.strategy_cls is None:
             raise ValueError(f"strategy {self.name!r} needs strategy_cls")
+        if self.kind == "lint" and self.rule_cls is None:
+            raise ValueError(f"lint rule {self.name!r} needs rule_cls")
 
     def donated(self, **params):
         """The donated-buffer jit variant (surface (b)).

@@ -28,8 +28,10 @@ never-firing guard is the precise failure this module exists to prevent.
 Code that legitimately needs the wall clock (file-mtime TTLs, identity
 stamps) must go through :func:`wall_now_s` / :func:`file_age_s` /
 :func:`marker_fresh` below — the skew-resistant CLOCK_REALTIME readers —
-rather than ``time.time``; the tier-1 time-discipline lint
-(tests/test_time_discipline.py) enforces exactly that.
+rather than ``time.time``; the ``clock-discipline`` rule of ``csmom
+lint`` (csmom_tpu/analysis/rules.py, tier-1) enforces exactly that,
+alias-aware, so rebinding the clock under another name does not dodge
+it.
 
 The reference has no analogue (no benchmarks, no timeouts —
 ``/root/reference/README.md`` is a bare title); this is capture-harness
@@ -215,7 +217,9 @@ def deadline_guard(
                     line = partial_line()
                     break
                 except Exception:
-                    time.sleep(0.02)
+                    # lint: allow[lock-discipline] dying process: the dump
+                    time.sleep(0.02)  # beat retries under the emit lock on
+                    # purpose — once the guard fires, no waiter may print
             if line is None:
                 os._exit(3)  # nothing measured: no artifact-worthy line
             _emit(line, flush_first=False)  # no flush: see _emit

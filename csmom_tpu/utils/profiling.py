@@ -2,9 +2,10 @@
 
 Two layers, both zero-cost when unused:
 
-- ``wall(fn, *args)`` — wall-clock a compiled call correctly: JAX dispatch
-  is async, so a naive ``time.time()`` pair measures only the enqueue;
-  every timing here closes over ``block_until_ready``.
+- ``wall(fn, *args)`` — wall-clock a compiled call correctly
+  (lint: allow[clock-discipline] the warning against the idiom, not a use):
+  JAX dispatch is async, so a naive ``time.time()`` pair measures only the
+  enqueue; every timing here closes over ``block_until_ready``.
 - ``fetch(y)`` / ``measure_rtt()`` — the stricter discipline for
   remote/tunneled backends (this image's 'axon' TPU), where
   ``block_until_ready`` has been observed to return in ~60 us without a

@@ -1,0 +1,18 @@
+"""Known-bad fixture: the historical offenders plus every alias hole the
+regex lint missed (ISSUE 11 satellite).  Never imported — lint-read only."""
+
+import time
+import time as tt
+from datetime import datetime
+from time import time as _t
+
+
+def wall_reads():
+    a = time.time()               # the historical bare form (regex-visible)
+    b = _t()                      # from-import alias: regex-blind
+    c = tt.time()                 # module alias: regex-blind
+    d = getattr(time, "time")()   # getattr dodge: regex-blind
+    indirect = time.time
+    e = indirect()                # attribute-aliased rebind: regex-blind
+    f = datetime.now()            # argless now: wall clock in disguise
+    return a + b + c + d + e, f

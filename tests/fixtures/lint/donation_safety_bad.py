@@ -1,0 +1,16 @@
+"""Known-bad fixture: buffers read after being donated to XLA."""
+
+import jax
+import jax.numpy as jnp
+
+
+def run(values, mask):
+    fn = jax.jit(lambda v, m: jnp.where(m, v, 0.0), donate_argnums=(0,))
+    out = fn(values, mask)
+    return out + values          # `values` was surrendered at the call
+
+
+def run_named_donated(values, mask, entry_donated):
+    out = entry_donated(values, mask)
+    checksum = values.sum()      # read-after-donate via a *_donated entry
+    return out, checksum

@@ -1,0 +1,16 @@
+"""Clean twin: donated buffers are never touched again in scope."""
+
+import jax
+import jax.numpy as jnp
+
+
+def run(values, mask):
+    fn = jax.jit(lambda v, m: jnp.where(m, v, 0.0), donate_argnums=(0,))
+    out = fn(values, mask)
+    return out + mask.sum()      # mask (argnum 1) was not donated
+
+
+def run_rebound(values, mask):
+    fn = jax.jit(lambda v, m: v * 1.0, donate_argnums=(0, 1))
+    values = fn(values, mask)    # rebind: the old buffer is gone by name
+    return values

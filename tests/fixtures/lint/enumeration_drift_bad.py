@@ -1,0 +1,11 @@
+"""Known-bad fixture: a parallel enumeration and an undeclared point."""
+
+from csmom_tpu.chaos.inject import checkpoint
+
+# the pre-ISSUE-9 buckets.py line: a module-level endpoint table outside
+# csmom_tpu/registry/ forks the registry back into parallel lists
+ENDPOINTS = ("momentum", "turnover", "backtest")
+
+
+def probe():
+    checkpoint("serve.not_a_point")   # absent from chaos.plan.KNOWN_POINTS

@@ -1,0 +1,9 @@
+"""Clean twin: registry queries and a declared checkpoint point."""
+
+from csmom_tpu.chaos.inject import checkpoint
+from csmom_tpu.registry import serve_endpoints
+
+
+def probe():
+    for kind in serve_endpoints():    # the registry IS the table
+        checkpoint("serve.dispatch", kind=kind)

@@ -1,0 +1,27 @@
+"""Clean twin: with-blocks and try-finally, blocking work outside."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+_state = {"n": 0}
+
+
+def safe_update():
+    with _lock:
+        _state["n"] += 1
+
+
+def safe_manual():
+    _lock.acquire()
+    try:
+        _state["n"] += 1
+    finally:
+        _lock.release()
+
+
+def slow_path(sock, payload):
+    with _lock:
+        n = _state["n"]
+    time.sleep(0.05)             # the wait happens lock-free
+    sock.sendall(payload + bytes([n % 256]))

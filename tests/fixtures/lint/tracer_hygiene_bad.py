@@ -1,0 +1,22 @@
+"""Known-bad fixture: host-sync escapes inside traced functions."""
+
+import time
+
+import jax
+import numpy as np
+
+_CALLS = 0
+
+
+def score(values, mask):
+    global _CALLS
+    _CALLS = _CALLS + 1          # mutable-global write under tracing
+    print("scoring batch")       # host I/O inside the trace
+    t0 = time.monotonic()        # clock read inside the trace
+    host = np.asarray(values)    # host materialization of a traced value
+    lead = float(mask)           # concretization of a traced value
+    tail = values.item()         # device->host sync
+    return host.sum() + lead + tail + t0
+
+
+scorer = jax.jit(score)

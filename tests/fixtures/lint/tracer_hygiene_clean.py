@@ -1,0 +1,15 @@
+"""Clean twin: a pure traced scorer (static args may concretize)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n_bins",))
+def score(values, mask, n_bins):
+    bins = float(n_bins)         # static arg: concretizing it is fine
+    return jnp.where(mask, values, jnp.nan).sum() / bins
+
+
+batched = jax.jit(jax.vmap(lambda v, m: jnp.where(m, v, 0.0).sum()))

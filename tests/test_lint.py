@@ -1,0 +1,378 @@
+"""ISSUE 11: the unified AST analysis subsystem (`csmom lint`).
+
+Four layers:
+
+- **the tier-1 sweep** — the committed tree is clean (zero unsuppressed
+  findings; a finding here IS a test failure with file:line and rule
+  id), and `csmom lint --json` emits the machine-readable report;
+- **the fixture self-test harness** — every registered rule fires on
+  its known-bad fixture under ``tests/fixtures/lint/`` and stays silent
+  on the clean twin (the lint analogue of the registry completeness
+  test: shipping a rule without proof it fires is shipping nothing);
+- **pragma semantics** — a live ``lint: allow[...]`` pragma suppresses
+  exactly its finding; an unused one is itself a finding; an unknown
+  rule id in a pragma is a finding; clock-tier modules cannot pragma
+  out of their contract;
+- **registry + gate integration** — rules are kind-``lint`` registry
+  citizens (a toy rule registered at runtime joins the sweep with no
+  other file edited), and ``csmom rehearse`` refuses to start on a
+  dirty tree.
+"""
+
+import json
+import os
+
+import pytest
+
+from csmom_tpu.analysis import run_lint
+from csmom_tpu.analysis.core import STALE_PRAGMA_RULE, LintRule
+from csmom_tpu.registry import lint_rules, register_engine, unregister_engine
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIX = os.path.join(_REPO, "tests", "fixtures", "lint")
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(_FIX, name)
+
+
+# ------------------------------------------------------ the tier-1 sweep ---
+
+def test_lint_sweep_is_clean_on_the_committed_tree():
+    """THE gate: zero unsuppressed findings over the package + bench.py
+    + benchmarks/.  A failure here names every offender as
+    path:line: [rule] message — fix it or justify it with an in-file
+    pragma (which must then actually suppress something)."""
+    rep = run_lint()
+    assert rep.findings == [], (
+        "csmom lint found defects on the committed tree:\n  "
+        + "\n  ".join(str(f) for f in rep.findings))
+    assert rep.files > 100, "the sweep lost its default scope"
+    assert set(rep.rules) == {s.name for s in lint_rules()}
+    # the justified suppressions stay visible, never silent
+    assert all(f.rule == "clock-discipline" or f.rule == "lock-discipline"
+               for f in rep.suppressed)
+
+
+def test_cli_lint_json_is_wired_and_clean(capsys):
+    """`csmom lint --json` (what CI archives) exits 0 on the committed
+    tree and emits the schema_version-1 findings report."""
+    from csmom_tpu.cli.main import main
+
+    rc = main(["lint", "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["ok"] is True
+    assert report["schema_version"] == 1
+    assert report["findings"] == []
+    assert report["files_scanned"] > 100
+    assert set(report["rules"]) == {s.name for s in lint_rules()}
+    # suppressed entries carry the machine-readable finding shape
+    for s in report["suppressed"]:
+        assert {"rule", "path", "line", "message"} <= set(s)
+
+
+def test_cli_lint_reports_findings_with_file_line_and_rule(capsys):
+    from csmom_tpu.cli.main import main
+
+    bad = _fixture("lock_discipline_bad.py")
+    rc = main(["lint", "--paths", bad])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "lock_discipline_bad.py:11" in out
+    assert "[lock-discipline]" in out
+
+    rc = main(["lint", "--json", "--paths", bad])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1 and report["ok"] is False
+    f0 = report["findings"][0]
+    assert set(f0) == {"rule", "path", "line", "message"}
+
+
+def test_cli_lint_rule_filter_and_rules_listing(capsys):
+    from csmom_tpu.cli.main import main
+
+    rc = main(["lint", "--rule", "lock-discipline",
+               "--paths", _fixture("clock_discipline_bad.py")])
+    capsys.readouterr()
+    assert rc == 0  # the clock offenses are not lock-discipline's
+
+    rc = main(["lint", "--rule", "no-such-rule"])
+    err = capsys.readouterr().err
+    assert rc == 2 and "no-such-rule" in err
+
+    rc = main(["lint", "--rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for spec in lint_rules():
+        assert spec.name in out
+
+
+# ------------------------------------------- the fixture self-test harness -
+
+@pytest.mark.parametrize("rule_id",
+                         [s.name for s in lint_rules()])
+def test_every_registered_rule_fires_on_bad_and_not_on_clean(rule_id):
+    """The self-test harness (ISSUE 11 satellite): registration enrolls
+    a rule here — each must demonstrably fire on its known-bad fixture
+    and stay silent on the clean twin."""
+    spec = {s.name: s for s in lint_rules()}[rule_id]
+    stem = rule_id.replace("-", "_")
+    bad, clean = _fixture(f"{stem}_bad.py"), _fixture(f"{stem}_clean.py")
+    assert os.path.isfile(bad), (
+        f"rule {rule_id} ships no known-bad fixture at {bad} — a rule "
+        "without proof it fires is not a rule")
+    assert os.path.isfile(clean), f"rule {rule_id} ships no clean twin"
+    rep = run_lint(paths=[bad], rules=[spec.rule_cls()])
+    assert [f for f in rep.findings if f.rule == rule_id], (
+        f"rule {rule_id} stayed SILENT on its known-bad fixture")
+    rep = run_lint(paths=[clean], rules=[spec.rule_cls()])
+    assert [f for f in rep.findings if f.rule == rule_id] == [], (
+        f"rule {rule_id} false-positives on its clean twin: "
+        + "; ".join(str(f) for f in rep.findings))
+
+
+def test_tracer_hygiene_catches_every_escape_family():
+    from csmom_tpu.analysis.rules import TracerHygiene
+
+    rep = run_lint(paths=[_fixture("tracer_hygiene_bad.py")],
+                   rules=[TracerHygiene()])
+    msgs = " | ".join(f.message for f in rep.findings)
+    for marker in ("global", "print", "clock read", "numpy.asarray",
+                   "float()", ".item()"):
+        assert marker in msgs, f"escape family {marker!r} not caught"
+
+
+def test_donation_safety_tracks_indices_and_rebinding():
+    from csmom_tpu.analysis.rules import DonationSafety
+
+    rep = run_lint(paths=[_fixture("donation_safety_bad.py")],
+                   rules=[DonationSafety()])
+    assert len(rep.findings) == 2
+    assert all("read after being donated" in f.message
+               for f in rep.findings)
+    # undonated args and rebound names stay legal (the clean twin)
+    rep = run_lint(paths=[_fixture("donation_safety_clean.py")],
+                   rules=[DonationSafety()])
+    assert rep.findings == []
+
+
+def test_lock_discipline_accepts_try_finally_and_with():
+    from csmom_tpu.analysis.rules import LockDiscipline
+
+    rep = run_lint(paths=[_fixture("lock_discipline_clean.py")],
+                   rules=[LockDiscipline()])
+    assert rep.findings == []
+    rep = run_lint(paths=[_fixture("lock_discipline_bad.py")],
+                   rules=[LockDiscipline()])
+    kinds = sorted(f.message.split("(")[0] for f in rep.findings)
+    assert len(rep.findings) == 3  # bare acquire, sleep, sendall
+    assert any("acquire" in k for k in kinds)
+
+
+# ------------------------------------------------------- pragma semantics --
+
+def test_live_pragma_suppresses_and_is_not_stale():
+    rep = run_lint(paths=[_fixture("pragma_live.py")])
+    assert rep.findings == []
+    assert len(rep.suppressed) == 1
+    assert rep.suppressed[0].rule == "clock-discipline"
+
+
+def test_stale_pragma_is_itself_a_finding():
+    """ISSUE 11 satellite pin: a pragma with no matching finding fails
+    the sweep — the unused-suppression hole the count-based allowlist
+    left open."""
+    rep = run_lint(paths=[_fixture("stale_pragma.py")])
+    assert [f.rule for f in rep.findings] == [STALE_PRAGMA_RULE]
+    assert "unused suppression" in rep.findings[0].message
+
+
+def test_trailing_pragma_does_not_leak_onto_the_next_line(tmp_path):
+    """A pragma on an offending CODE line covers that line only — a
+    second, unjustified defect directly below must still fail the
+    sweep (a standalone comment/prose pragma line covers the line
+    below it, which is the documented above-the-statement form)."""
+    p = tmp_path / "two.py"
+    p.write_text(
+        "import time\n\n\n"
+        "def two():\n"
+        "    a = time.time()  # lint: allow[clock-discipline] this one\n"
+        "    b = time.time()\n"
+        "    return a + b\n")
+    rep = run_lint(paths=[str(p)])
+    assert [f.line for f in rep.findings] == [6], rep.findings
+    assert [s.line for s in rep.suppressed] == [5]
+
+
+def test_alias_map_applies_bindings_in_source_order(tmp_path):
+    """A nested-function clock rebind must not shadow a LATER
+    module-level rebind of the same name (ast.walk is breadth-first;
+    the map sorts bindings by source position and retires aliases on
+    untracked rebinds)."""
+    p = tmp_path / "alias.py"
+    p.write_text(
+        "import time\n\n\n"
+        "def other():\n"
+        "    t = time.time\n"
+        "    return t\n\n\n"
+        "t = len\n"
+        'x = t("abc")\n')
+    rep = run_lint(paths=[str(p)])
+    assert rep.findings == [], rep.findings
+
+
+def test_unknown_rule_in_pragma_is_a_finding(tmp_path):
+    p = tmp_path / "x.py"
+    p.write_text("# lint: allow[no-such-rule] why not\nX = 1\n")
+    rep = run_lint(paths=[str(p)])
+    assert any(f.rule == STALE_PRAGMA_RULE
+               and "unknown rule" in f.message for f in rep.findings)
+
+
+def test_clock_tier_modules_cannot_pragma_out(tmp_path):
+    """A serve/stream/ledger module carrying a clock-discipline pragma
+    is itself a finding: tiers are contracts, not defaults."""
+    ring = tmp_path / "csmom_tpu" / "stream" / "ring.py"
+    ring.parent.mkdir(parents=True)
+    ring.write_text(
+        "# lint: allow[clock-discipline] please let me\n"
+        "from csmom_tpu.utils.deadline import mono_now_s\n")
+    rep = run_lint(paths=[str(ring)], repo=str(tmp_path))
+    assert any("must not carry a clock-discipline pragma" in f.message
+               for f in rep.findings), rep.findings
+    # the pragma'd import finding itself is ALSO still reported via the
+    # contract path or suppressed — but the contract finding cannot be
+    # silenced, so the sweep fails either way
+    assert rep.findings
+
+
+def test_unparseable_source_is_a_finding_not_a_crash(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def broken(:\n")
+    rep = run_lint(paths=[str(p)])
+    assert [f.rule for f in rep.findings] == ["parse-error"]
+
+
+# ------------------------------------------- registry + gate integration ---
+
+def test_builtin_rules_are_registry_citizens():
+    names = [s.name for s in lint_rules()]
+    assert names == ["clock-discipline", "tracer-hygiene",
+                     "lock-discipline", "donation-safety",
+                     "enumeration-drift"]
+    for s in lint_rules():
+        assert s.kind == "lint" and s.rule_cls is not None
+        assert s.description
+
+
+def test_toy_rule_registered_at_runtime_joins_the_sweep(tmp_path, capsys):
+    """The tentpole's acceptance property, lint edition: register once,
+    appear in run_lint, the CLI listing, and `csmom registry list` —
+    no other file edited."""
+
+    class NoTodo(LintRule):
+        id = "no-todo-markers"
+        description = "test-only toy rule: comments must not say TODO"
+
+        def finish_file(self, ctx):
+            for kind, line, text in ctx.tokens:
+                if kind == "comment" and "TODO" in text:
+                    ctx.report(self.id, line, "TODO marker in a comment")
+
+    register_engine(name=NoTodo.id, kind="lint", rule_cls=NoTodo,
+                    description=NoTodo.description)
+    try:
+        assert NoTodo.id in [s.name for s in lint_rules()]
+        p = tmp_path / "t.py"
+        p.write_text("x = 1  # TODO remove\n")
+        rep = run_lint(paths=[str(p)])
+        assert any(f.rule == NoTodo.id for f in rep.findings)
+        # a pragma for the toy rule works immediately too
+        p.write_text("# lint: allow[no-todo-markers] grandfathered\n"
+                     "x = 1  # TODO remove\n")
+        rep = run_lint(paths=[str(p)])
+        assert not [f for f in rep.findings if f.rule == NoTodo.id]
+        # the registry CLI lists it under kind 'lint'
+        from csmom_tpu.cli.main import main
+
+        rc = main(["registry", "list", "--kind", "lint"])
+        out = capsys.readouterr().out
+        assert rc == 0 and NoTodo.id in out
+    finally:
+        unregister_engine(NoTodo.id, kind="lint")
+    assert NoTodo.id not in [s.name for s in lint_rules()]
+
+
+def test_checkpoint_vocabulary_round_trips_on_the_full_sweep():
+    """Both directions of the enumeration-drift vocabulary check: the
+    committed tree round-trips, and a doctored dead entry is caught at
+    the KNOWN_POINTS anchor."""
+    from csmom_tpu.analysis.rules import EnumerationDrift
+
+    rep = run_lint(rules=[EnumerationDrift()])
+    assert rep.findings == []
+
+    ghost = EnumerationDrift()
+    ghost._vocab = ghost._vocab + ("ghost.point",)
+    rep = run_lint(rules=[ghost])
+    assert any("ghost.point" in f.message
+               and f.path.endswith("chaos/plan.py")
+               for f in rep.findings), rep.findings
+
+
+def test_rehearse_refuses_to_start_on_a_dirty_tree(monkeypatch, capsys):
+    """ISSUE 11 satellite: `csmom rehearse` gates on the lint sweep —
+    a dirty tree must not reach a tunnel window."""
+    from csmom_tpu.analysis.core import Finding
+    from csmom_tpu.cli import rehearse as reh
+
+    monkeypatch.setattr(
+        reh, "_lint_gate",
+        lambda: [Finding("clock-discipline", "x.py", 3, "smuggled wall "
+                         "clock")])
+
+    class Args:
+        list = False
+        plan = None
+        fast = True
+        only = None
+        sandbox = None
+        keep = False
+        verbose = False
+
+    rc = reh.cmd_rehearse(Args())
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "refusing to rehearse" in err
+    assert "x.py:3" in err
+
+
+def test_rehearse_list_skips_the_gate(monkeypatch, capsys):
+    from csmom_tpu.cli import rehearse as reh
+
+    def boom():  # pragma: no cover - must not run
+        raise AssertionError("--list must not pay the sweep")
+
+    monkeypatch.setattr(reh, "_lint_gate", boom)
+
+    class Args:
+        list = True
+        plan = None
+        fast = True
+        only = None
+        sandbox = None
+        keep = False
+        verbose = False
+
+    rc = reh.cmd_rehearse(Args())
+    out = capsys.readouterr().out
+    assert rc == 0 and "plan:" in out
+
+
+def test_lint_is_a_device_free_subcommand():
+    """The sweep must run on a box with no accelerator and no probe —
+    it gates rehearse, which gates windows."""
+    from csmom_tpu.cli.main import _DEVICE_FREE_COMMANDS
+
+    assert "lint" in _DEVICE_FREE_COMMANDS

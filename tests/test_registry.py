@@ -316,76 +316,46 @@ def test_loadgen_default_mix_is_the_registry():
 
 # ---------------------------------------------------- enumeration lint -----
 
-# module-level names that read as an engine/endpoint/workload/entry
-# enumeration.  Matching ASSIGNMENTS outside csmom_tpu/registry/ is the
-# drift this lint exists to refuse: the registry must stay the only
-# table (docstring mentions and loop variables don't match an AST
-# module-level assignment, so prose stays free).
-_BANNED = ("ENDPOINTS", "ENTRIES", "WORKLOADS", "STRATEGIES")
+# r14's inline AST walk became the registered ``enumeration-drift`` rule
+# (ISSUE 11): the tree sweep lives in tests/test_lint.py / `csmom lint`;
+# what stays here are the thin regression pins on the migrated behavior.
 
+def test_enumeration_lint_is_a_registered_rule_covering_the_tree():
+    """The registry lint is now itself a registry citizen (kind 'lint'),
+    and the committed tree stays clean under it — including the new
+    checkpoint-vocabulary coverage both ways."""
+    from csmom_tpu.analysis import run_lint
+    from csmom_tpu.registry import lint_rules
 
-def _banned_name(name: str) -> bool:
-    up = name.upper().lstrip("_")
-    return any(up == b or up.endswith("_" + b) for b in _BANNED)
-
-
-def _lint_sources():
-    files = [os.path.join(_REPO, "bench.py")]
-    for root in ("csmom_tpu", "benchmarks"):
-        for dirpath, _, names in os.walk(os.path.join(_REPO, root)):
-            if "__pycache__" in dirpath:
-                continue
-            files += [os.path.join(dirpath, n) for n in names
-                      if n.endswith(".py")]
-    return sorted(files)
-
-
-def test_no_endpoint_entry_or_workload_lists_outside_the_registry():
-    """The enumeration-drift lint (ISSUE 9 satellite): a module outside
-    ``csmom_tpu/registry/`` that assigns an ENDPOINTS/…_ENTRIES/
-    WORKLOADS/…_STRATEGIES enumeration at module level is forking the
-    registry back into a parallel table — exactly the four-list world
-    the tentpole deleted."""
-    offenders = []
-    for path in _lint_sources():
-        rel = os.path.relpath(path, _REPO)
-        if rel.startswith(os.path.join("csmom_tpu", "registry")):
-            continue  # the registry IS the table
-        with open(path, encoding="utf-8") as f:
-            try:
-                tree = ast.parse(f.read(), filename=rel)
-            except SyntaxError as e:  # pragma: no cover
-                offenders.append(f"{rel}: unparseable ({e})")
-                continue
-        for node in tree.body:
-            targets = []
-            if isinstance(node, ast.Assign):
-                targets = [t for t in node.targets
-                           if isinstance(t, ast.Name)]
-            elif isinstance(node, ast.AnnAssign) and isinstance(
-                    node.target, ast.Name):
-                targets = [node.target]
-            for t in targets:
-                if _banned_name(t.id):
-                    offenders.append(f"{rel}:{node.lineno}: {t.id}")
-    assert offenders == [], (
-        "endpoint/entry/workload enumerations outside csmom_tpu/registry/: "
-        f"{offenders} — register engines instead of growing a parallel "
-        "list (ISSUE 9's lint)"
-    )
+    specs = {s.name: s for s in lint_rules()}
+    assert "enumeration-drift" in specs
+    rep = run_lint(rules=[specs["enumeration-drift"].rule_cls()])
+    assert rep.findings == [], [str(f) for f in rep.findings]
 
 
 def test_lint_actually_catches_an_enumeration():
     """The lint's own regression test: the pre-ISSUE-9 buckets.py line
-    would be flagged."""
+    (kept verbatim in the known-bad fixture) is flagged by the rule."""
+    from csmom_tpu.analysis import run_lint
+    from csmom_tpu.analysis.rules import (
+        EnumerationDrift,
+        banned_enumeration_name,
+    )
+
     src = 'ENDPOINTS = ("momentum", "turnover", "backtest")\n'
-    tree = ast.parse(src)
-    node = tree.body[0]
+    node = ast.parse(src).body[0]
     assert isinstance(node, ast.Assign)
-    assert _banned_name(node.targets[0].id)
+    assert banned_enumeration_name(node.targets[0].id)
     # and the allowed spellings stay allowed
-    for ok in ("GRID_JS", "NAMED_SCHEDULES", "PROFILES", "OUTCOMES"):
-        assert not _banned_name(ok)
+    for ok in ("GRID_JS", "NAMED_SCHEDULES", "PROFILES", "OUTCOMES",
+               "KNOWN_POINTS"):
+        assert not banned_enumeration_name(ok)
+    fixture = os.path.join(_REPO, "tests", "fixtures", "lint",
+                           "enumeration_drift_bad.py")
+    rep = run_lint(paths=[fixture], rules=[EnumerationDrift()])
+    msgs = [f.message for f in rep.findings]
+    assert any("'ENDPOINTS'" in m for m in msgs), msgs
+    assert any("serve.not_a_point" in m for m in msgs), msgs
 
 
 def test_reregistration_rebuilds_the_jitted_scorer():

@@ -1,90 +1,124 @@
-"""Tier-1 time-discipline lint + telemetry artifact validation.
+"""Time-discipline regression pins + telemetry artifact validation.
 
-The r7 skew-proofing made ``utils.deadline`` monotonic-only, and the
-chaos ``clock_skew`` fault exists to catch wall-clock timing sneaking
-back in — but the ban was enforced by review, not by a test, and one
-call site (the CLI probe-marker TTL) survived it until this round.  This
-lint makes the discipline mechanical: no bare ``time.time()`` and no
-argless ``datetime.now()`` anywhere in the package, the bench harness,
-or the capture scripts, outside a documented allowlist.
+The r3-r7 regex lints that lived here (bare ``time.time()`` bans with a
+count-based ``_ALLOWLIST`` dict, per-module monotonic pins, the
+event-time-only stream sweep) are now the AST ``clock-discipline`` rule
+in :mod:`csmom_tpu.analysis.rules`, run by the tier-1 sweep in
+``tests/test_lint.py`` and by ``csmom lint``.  What remains here are the
+THIN PINS (ISSUE 11):
 
-Legitimate wall-clock needs go through the skew-resistant helpers in
-``utils.deadline`` (``wall_now_s`` / ``file_age_s`` / ``marker_fresh``)
-or take an explicit timezone (identity stamps:
-``datetime.now(timezone.utc)`` — argful, so not matched here).
+- the historical regex really does have the alias hole the issue names
+  (``from time import time as _t; _t()`` passes it), and the AST rule
+  really does close it — proven on a known-bad fixture;
+- the old ``_ALLOWLIST`` sites carry in-file pragmas now, and those
+  pragmas are live (suppressing exactly one finding each);
+- the per-layer tier lists still cover the historical modules;
+- the committed telemetry/serve sidecar rules (unchanged from r4-r7).
 """
 
 import glob
 import os
 import re
 
+from csmom_tpu.analysis import run_lint
 from csmom_tpu.chaos import invariants as inv
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURE = os.path.join(_REPO, "tests", "fixtures", "lint",
+                        "clock_discipline_bad.py")
 
-# a bare wall-clock read; the suffix form also catches aliased imports
-# like ``_time.time()``
+# THE HISTORICAL REGEX, verbatim from the r3 lint: kept only to prove
+# what it misses (its successor is the AST rule)
 _WALL_CLOCK = re.compile(r"time\.time\(\)")
 _ARGLESS_NOW = re.compile(r"datetime(?:\.datetime)?\.now\(\s*\)")
 
-# path (repo-relative) -> max allowed matches, each one justified.  These
-# are MENTIONS in prose, not executed timing calls; anything new must
-# either use the deadline helpers or argue its way in here.
-_ALLOWLIST = {
-    # module docstring explaining why naive wall-clock pairs mis-measure
-    # async dispatch — the warning against the pattern, not a use of it
-    "csmom_tpu/utils/profiling.py": 1,
-    # comment documenting what the clock_skew fault perturbs
-    "csmom_tpu/chaos/plan.py": 1,
-}
+
+def _clock_rule():
+    from csmom_tpu.analysis.rules import ClockDiscipline
+
+    return ClockDiscipline()
 
 
-def _timing_sources():
-    files = [os.path.join(_REPO, "bench.py")]
-    for root in ("csmom_tpu", "benchmarks"):
-        for dirpath, _, names in os.walk(os.path.join(_REPO, root)):
-            files += [os.path.join(dirpath, n) for n in names
-                      if n.endswith(".py")]
-    return sorted(files)
+def test_regex_alias_hole_is_real_and_the_ast_rule_closes_it():
+    """ISSUE 11 satellite: the known-bad fixture holds one bare
+    ``time.time()`` (regex-visible) plus four aliased forms — a
+    from-import alias, a module alias, a getattr dodge, and an
+    attribute-aliased rebind — that the regex is PROVABLY blind to and
+    the AST rule catches, plus an argless ``datetime.now()``."""
+    with open(_FIXTURE, encoding="utf-8") as f:
+        src = f.read()
+    lines = src.splitlines()
+
+    def line_of(snippet):
+        return next(i for i, ln in enumerate(lines, 1) if snippet in ln)
+
+    # the regex sees exactly the one historical bare form...
+    assert len(_WALL_CLOCK.findall(src)) == 1
+    assert len(_ARGLESS_NOW.findall(src)) == 1
+    aliased = [line_of("_t()"), line_of("tt.time()"),
+               line_of('getattr(time, "time")()'), line_of("indirect()")]
+    for ln in aliased:  # ...and is blind on every aliased line
+        assert not _WALL_CLOCK.search(lines[ln - 1]), (
+            f"line {ln} matches the regex — the fixture no longer "
+            "demonstrates the hole")
+
+    rep = run_lint(paths=[_FIXTURE], rules=[_clock_rule()])
+    flagged = {f.line for f in rep.findings}
+    assert set(aliased) <= flagged, (
+        f"the AST rule missed aliased wall-clock reads: "
+        f"{sorted(set(aliased) - flagged)}")
+    assert line_of("time.time()") in flagged  # the bare form too
+    assert line_of("datetime.now()") in flagged
 
 
-def test_no_bare_wall_clock_in_timing_paths():
-    offenders = {}
-    for path in _timing_sources():
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        n = len(_WALL_CLOCK.findall(src)) + len(_ARGLESS_NOW.findall(src))
-        rel = os.path.relpath(path, _REPO)
-        if n > _ALLOWLIST.get(rel, 0):
-            offenders[rel] = n
-    assert offenders == {}, (
-        f"bare time.time()/argless datetime.now() in timing paths: "
-        f"{offenders} — use utils.deadline.wall_now_s/file_age_s/"
-        "marker_fresh (or datetime.now(timezone.utc) for identity "
-        "stamps), or extend the documented allowlist"
-    )
-
-
-def test_allowlist_entries_are_not_stale():
-    """An allowlisted file that no longer contains its mention must lose
-    the entry — a stale allowlist is a hole the next regression walks
-    through."""
-    for rel, allowed in _ALLOWLIST.items():
+def test_allowlist_sites_migrated_to_live_in_file_pragmas():
+    """ISSUE 11 satellite: the two prose-mention sites the old
+    ``_ALLOWLIST`` dict covered by count now carry scoped pragmas, each
+    suppressing exactly one clock-discipline finding — and the sweep
+    would fail if the pragma went stale (tests/test_lint.py pins the
+    stale-pragma behavior itself)."""
+    for rel in ("csmom_tpu/utils/profiling.py", "csmom_tpu/chaos/plan.py"):
         path = os.path.join(_REPO, rel)
-        assert os.path.exists(path), f"allowlisted file {rel} is gone"
         with open(path, encoding="utf-8") as f:
             src = f.read()
-        n = len(_WALL_CLOCK.findall(src)) + len(_ARGLESS_NOW.findall(src))
-        assert 0 < n <= allowed, (
-            f"{rel}: {n} matches vs allowlisted {allowed} — update or "
-            "drop the entry"
-        )
+        assert "lint: allow[clock-discipline]" in src, (
+            f"{rel}: the in-file pragma is gone")
+        rep = run_lint(paths=[path], rules=[_clock_rule()])
+        assert [f for f in rep.findings
+                if f.rule == "clock-discipline"] == [], (
+            f"{rel}: unsuppressed clock findings: {rep.findings}")
+        assert len([s for s in rep.suppressed
+                    if s.rule == "clock-discipline"]) == 1, (
+            f"{rel}: the pragma should suppress exactly one mention")
+
+
+def test_tier_lists_still_cover_the_historical_modules():
+    """The per-layer contracts the old per-module tests spelled out,
+    now data on the rule: serve+replay mono-only, stream data plane
+    clock-free, ledger wall-free."""
+    from csmom_tpu.analysis.rules import ClockDiscipline as CD
+
+    for rel in ("csmom_tpu/serve/queue.py", "csmom_tpu/serve/batcher.py",
+                "csmom_tpu/serve/slo.py", "csmom_tpu/serve/cache.py",
+                "csmom_tpu/serve/router.py", "csmom_tpu/cli/serve.py",
+                "csmom_tpu/stream/replay.py", "csmom_tpu/cli/replay.py"):
+        assert rel in CD.MONO_ONLY_FILES, rel
+    for rel in ("csmom_tpu/stream/ring.py", "csmom_tpu/stream/ingest.py",
+                "csmom_tpu/stream/incremental.py"):
+        assert rel in CD.NO_CLOCK_FILES, rel
+    for rel in ("csmom_tpu/obs/ledger.py", "csmom_tpu/obs/regress.py",
+                "csmom_tpu/obs/memstats.py", "csmom_tpu/cli/ledger.py"):
+        assert rel in CD.WALL_FREE_FILES, rel
+    # every tier file still exists (a rename must update the contract)
+    for rel in CD.MONO_ONLY_FILES + CD.NO_CLOCK_FILES + CD.WALL_FREE_FILES:
+        assert os.path.isfile(os.path.join(_REPO, rel)), rel
 
 
 def test_deadline_helpers_are_the_documented_wall_clock_home():
     from csmom_tpu.utils import deadline
 
-    for helper in ("wall_now_s", "file_age_s", "marker_fresh"):
+    for helper in ("wall_now_s", "file_age_s", "marker_fresh",
+                   "mono_now_s"):
         assert hasattr(deadline, helper)
 
 
@@ -119,7 +153,6 @@ def test_only_round_sidecars_are_committed():
     (tier-1 rehearse/loadgen runs regenerate them in cwd) never
     false-positives."""
     import subprocess
-    import sys
 
     try:
         p = subprocess.run(
@@ -164,134 +197,3 @@ def test_only_round_sidecars_are_committed():
     assert not inv.committable_sidecar("REPLAY_r12-7.json")
     # other families are not this rule's business
     assert inv.committable_sidecar("BENCH_r04.json")
-
-
-def test_serve_modules_route_all_timing_through_deadline_helpers():
-    """ISSUE 5 satellite: the serve layer's deadlines/latencies must be
-    monotonic AND single-sourced — no bare wall clock (the global lint
-    covers that) and no inline ``time.monotonic()`` either: every clock
-    read goes through utils.deadline.mono_now_s, so the clock the queue
-    expires on is the clock the artifact's latencies are measured on, by
-    construction.  engine.py is exempt from the monotonic pin only where
-    it has no timing at all (checked: zero matches required there too)."""
-    mono = re.compile(r"time\.monotonic\(\)")
-    serve_modules = (
-        "csmom_tpu/serve/__init__.py",
-        "csmom_tpu/serve/buckets.py",
-        "csmom_tpu/serve/queue.py",
-        "csmom_tpu/serve/batcher.py",
-        "csmom_tpu/serve/engine.py",
-        "csmom_tpu/serve/service.py",
-        "csmom_tpu/serve/loadgen.py",
-        "csmom_tpu/cli/serve.py",
-        # the ISSUE 6 pool tier rides under the same pin: deadlines the
-        # router hedges on and the walls the artifact records must be
-        # the same clock the single-process service uses
-        "csmom_tpu/serve/proto.py",
-        "csmom_tpu/serve/health.py",
-        "csmom_tpu/serve/worker.py",
-        "csmom_tpu/serve/router.py",
-        "csmom_tpu/serve/supervisor.py",
-        # the ISSUE 8 adaptive-dispatch tier rides under the same pin:
-        # SLO deadline budgets and token-bucket refills are mono-only
-        # (the bucket never even reads a clock — callers pass now_s from
-        # mono_now_s), and the result cache reads NO clock at all (LRU
-        # order is recency, version floors are counters)
-        "csmom_tpu/serve/slo.py",
-        "csmom_tpu/serve/cache.py",
-    )
-    for rel in serve_modules:
-        path = os.path.join(_REPO, rel)
-        assert os.path.exists(path), rel
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        n_wall = len(_WALL_CLOCK.findall(src)) + len(_ARGLESS_NOW.findall(src))
-        assert n_wall == 0, f"{rel}: {n_wall} bare wall-clock call(s)"
-        assert rel not in _ALLOWLIST, (
-            f"{rel} must not be allowlisted: serve deadlines are "
-            "monotonic by contract"
-        )
-        n_mono = len(mono.findall(src))
-        assert n_mono == 0, (
-            f"{rel}: {n_mono} inline time.monotonic() call(s) — serve "
-            "timing goes through utils.deadline.mono_now_s"
-        )
-    from csmom_tpu.utils.deadline import mono_now_s
-
-    assert mono_now_s() <= mono_now_s()  # monotone, and the helper exists
-
-
-def test_stream_modules_are_event_time_only():
-    """ISSUE 7 satellite: the streaming data plane runs on EVENT TIME —
-    bar stamps from the tick log, versions from counters.  The ring,
-    ingestor, and incremental updaters may read NO clock of any kind
-    (wall, monotonic, or the deadline helpers): a clock read in the
-    data plane is a lateness decision smuggled off the event-time axis.
-    The replay harness and its CLI may read the wall only through
-    ``mono_now_s`` (throughput reporting), never inline."""
-    mono = re.compile(r"time\.monotonic\(\)")
-    any_time_import = re.compile(r"^\s*import time\b|^\s*from time import",
-                                 re.MULTILINE)
-
-    event_time_only = (
-        "csmom_tpu/stream/__init__.py",
-        "csmom_tpu/stream/ring.py",
-        "csmom_tpu/stream/ingest.py",
-        "csmom_tpu/stream/incremental.py",
-    )
-    for rel in event_time_only:
-        path = os.path.join(_REPO, rel)
-        assert os.path.exists(path), rel
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        assert not _WALL_CLOCK.findall(src), f"{rel}: bare wall clock"
-        assert not _ARGLESS_NOW.findall(src), f"{rel}: argless now()"
-        assert not mono.findall(src), f"{rel}: inline monotonic read"
-        assert not any_time_import.findall(src), (
-            f"{rel}: imports the time module — the streaming data plane "
-            "is event-time only")
-        assert "mono_now_s" not in src, (
-            f"{rel}: reads the clock via mono_now_s — lateness and "
-            "ordering decisions must come from tick stamps")
-
-    wall_via_helper_only = (
-        "csmom_tpu/stream/replay.py",
-        "csmom_tpu/cli/replay.py",
-    )
-    for rel in wall_via_helper_only:
-        path = os.path.join(_REPO, rel)
-        assert os.path.exists(path), rel
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        n_wall = len(_WALL_CLOCK.findall(src)) + len(_ARGLESS_NOW.findall(src))
-        assert n_wall == 0, f"{rel}: {n_wall} bare wall-clock call(s)"
-        assert not mono.findall(src), (
-            f"{rel}: inline time.monotonic() — replay timing goes "
-            "through utils.deadline.mono_now_s")
-        assert rel not in _ALLOWLIST, (
-            f"{rel} must not be allowlisted: replay walls are "
-            "monotonic-helper-only by contract")
-
-
-def test_perf_ledger_modules_stay_wall_clock_free():
-    """The ledger/regress/memstats layer reads evidence and must never
-    read the wall clock (its verdicts have to be reproducible from the
-    committed artifacts alone): zero bare wall-clock matches AND no
-    allowlist entry pleading one in."""
-    new_modules = (
-        "csmom_tpu/obs/ledger.py",
-        "csmom_tpu/obs/regress.py",
-        "csmom_tpu/obs/memstats.py",
-        "csmom_tpu/cli/ledger.py",
-    )
-    for rel in new_modules:
-        path = os.path.join(_REPO, rel)
-        assert os.path.exists(path), rel
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        n = len(_WALL_CLOCK.findall(src)) + len(_ARGLESS_NOW.findall(src))
-        assert n == 0, f"{rel}: {n} bare wall-clock call(s) in the ledger"
-        assert rel not in _ALLOWLIST, (
-            f"{rel} must not be allowlisted: ledger verdicts are "
-            "reproducible-from-artifacts by contract"
-        )
