@@ -1,20 +1,27 @@
-"""csmom_tpu.analysis — the static-analysis subsystem (ISSUE 11).
+"""csmom_tpu.analysis — the static-analysis subsystem (ISSUE 11 + 12).
 
 One parse per file, N registered rule visitors, scoped in-file pragmas
 with stale-pragma detection, and a registry-driven rule set: see
-:mod:`csmom_tpu.analysis.core` for the framework and
-:mod:`csmom_tpu.analysis.rules` for the builtin rules (clock-discipline,
-tracer-hygiene, lock-discipline, donation-safety, enumeration-drift).
+:mod:`csmom_tpu.analysis.core` for the framework,
+:mod:`csmom_tpu.analysis.rules` for the per-file builtins
+(clock-discipline, tracer-hygiene, lock-discipline, donation-safety,
+enumeration-drift), :mod:`csmom_tpu.analysis.callgraph` for the
+whole-program layer (alias-aware project call graph, per-object lock
+identities), and :mod:`csmom_tpu.analysis.project_rules` for the
+project-scope rules (lock-order, helper-hygiene, compile-surface).
 
 Entry points:
 
 - :func:`run_lint` — the sweep (what tier-1 and ``csmom rehearse``
-  gate on); returns a :class:`~csmom_tpu.analysis.core.LintReport`;
-- ``csmom lint [--json] [--rule <id>] [--paths ...]`` — the CLI
-  (:mod:`csmom_tpu.cli.lint`).
+  gate on, at project scope); returns a
+  :class:`~csmom_tpu.analysis.core.LintReport`;
+- ``csmom lint [--project] [--format text|json|github] [--no-cache]
+  [--rule <id>] [--paths ...]`` — the CLI (:mod:`csmom_tpu.cli.lint`).
 
-Stdlib-only and jax-free: the sweep runs on CPU in about a second, which
-is the whole point — a defect caught here never burns a tunnel window.
+Stdlib-only and jax-free: the sweep runs on CPU in seconds cold and
+tens of milliseconds warm (the content-digest incremental cache,
+:mod:`csmom_tpu.analysis.cache`), which is the whole point — a defect
+caught here never burns a tunnel window.
 """
 
 from __future__ import annotations
@@ -23,6 +30,7 @@ from csmom_tpu.analysis.core import (
     Finding,
     LintReport,
     LintRule,
+    ProjectRule,
     default_sources,
     run_lint,
 )
@@ -31,6 +39,7 @@ __all__ = [
     "Finding",
     "LintReport",
     "LintRule",
+    "ProjectRule",
     "default_sources",
     "run_lint",
 ]

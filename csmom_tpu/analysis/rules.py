@@ -721,9 +721,36 @@ class EnumerationDrift(LintRule):
 
         self._vocab = tuple(KNOWN_POINTS)
 
+    def cache_salt(self) -> str:
+        """Verdicts depend on the live checkpoint vocabulary, not just
+        the scanned sources — changing KNOWN_POINTS must invalidate
+        every cached not-in-vocab verdict, both directions."""
+        return repr(self._vocab)
+
     def start_run(self, run: RunContext) -> None:
         self._points_seen: dict = {}
         self._vocab_site: tuple | None = None
+
+    def start_file(self, ctx: FileContext) -> None:
+        self._cur_points: dict = {}
+        self._cur_vocab: int | None = None
+
+    def file_facts(self, ctx: FileContext):
+        """The cross-file state this file contributes (cache contract):
+        its checkpoint call sites and — for chaos/plan.py — the
+        KNOWN_POINTS anchor line.  Cached with the findings so a
+        cache-replayed file still feeds the whole-run vocabulary
+        round-trip."""
+        if not self._cur_points and self._cur_vocab is None:
+            return None
+        return {"points": dict(self._cur_points),
+                "vocab_line": self._cur_vocab}
+
+    def absorb_facts(self, rel: str, facts, run: RunContext) -> None:
+        for point, line in (facts.get("points") or {}).items():
+            self._points_seen.setdefault(point, (rel, line))
+        if facts.get("vocab_line") is not None:
+            self._vocab_site = (rel, facts["vocab_line"])
 
     def visit(self, node: ast.AST, ctx: FileContext) -> None:
         rel = _posix(ctx.rel)
@@ -744,15 +771,14 @@ class EnumerationDrift(LintRule):
         if (isinstance(node, ast.Assign) and rel.endswith("chaos/plan.py")
                 and any(isinstance(t, ast.Name) and t.id == "KNOWN_POINTS"
                         for t in node.targets)):
-            self._vocab_site = (ctx.rel, node.lineno)
+            self._cur_vocab = node.lineno
         if isinstance(node, ast.Call):
             name = _callable_name(node.func)
             if (name in ("checkpoint", "_chaos") and node.args
                     and isinstance(node.args[0], ast.Constant)
                     and isinstance(node.args[0].value, str)):
                 point = node.args[0].value
-                self._points_seen.setdefault(point, (ctx.rel,
-                                                     node.lineno))
+                self._cur_points.setdefault(point, node.lineno)
                 if "*" not in point and point not in self._vocab:
                     ctx.report(
                         self.id, node.lineno,

@@ -123,6 +123,20 @@ KNOWN_SERVE_POOL_SCHEMA_VERSIONS = (1,)
 # streaming harness) — closed-world like the rest
 KNOWN_REPLAY_SCHEMA_VERSIONS = (1,)
 
+# lint report schema versions (`csmom lint --format json`) — v1 was the
+# r16 per-file report; v2 (ISSUE 12) adds the project flag, per-finding
+# call chains, cache stats, and per-rule timings.  Closed-world both
+# ways: unknown versions fail, and a v2 report carrying keys outside the
+# declared set fails (the CI archiver must never half-parse a report
+# from a different era of the code).
+KNOWN_LINT_SCHEMA_VERSIONS = (1, 2)
+_LINT_V2_KEYS = frozenset({
+    "schema_version", "ok", "files_scanned", "rules", "project",
+    "findings", "suppressed", "cache", "rule_timings_s",
+})
+_LINT_FINDING_KEYS = frozenset({"rule", "path", "line", "message",
+                                "chain"})
+
 # only ROUND sidecars are committed evidence: TELEMETRY_r<NN>.json,
 # SERVE_r<NN>.json, SERVE_POOL_r<NN>.json, and SERVE_MESH_r<NN>.json
 # (the multi-device serving family, ISSUE 10).  Rehearse/smoke/scratch
@@ -178,6 +192,8 @@ def detect_kind(obj: dict) -> str | None:
     if obj.get("kind") == "telemetry" or {"run_id", "wall_s",
                                           "phases"} <= set(obj):
         return "telemetry"
+    if {"files_scanned", "rules", "findings"} <= set(obj):
+        return "lint"
     if {"captured_utc", "record"} <= set(obj):
         return "tpu_cache"
     if {"n_devices", "ok"} <= set(obj):
@@ -991,8 +1007,57 @@ def _validate_replay(obj: dict) -> list:
     return out
 
 
+def _validate_lint(obj: dict) -> list:
+    """The lint report contract (`csmom lint --format json`): known
+    schema version, the closed v2 key world, coherent findings shape,
+    and ``ok`` actually meaning zero findings."""
+    out: list = []
+    ver = _require(obj, "schema_version", int, "lint", out)
+    if ver is not None and ver not in KNOWN_LINT_SCHEMA_VERSIONS:
+        out.append(
+            f"lint: unknown schema_version {ver} (this checker "
+            f"understands {list(KNOWN_LINT_SCHEMA_VERSIONS)}) — the "
+            "report is from a different era of the code; do not "
+            "half-parse it")
+        return out
+    _require(obj, "ok", bool, "lint", out)
+    _require(obj, "files_scanned", int, "lint", out)
+    _require(obj, "rules", list, "lint", out)
+    findings = _require(obj, "findings", list, "lint", out)
+    _require(obj, "suppressed", list, "lint", out)
+    if ver == 2:
+        unknown = sorted(set(obj) - _LINT_V2_KEYS)
+        if unknown:
+            out.append(f"lint: unknown v2 keys {unknown} — the report "
+                       "key world is closed; bump the schema version "
+                       "for new fields")
+        _require(obj, "project", bool, "lint", out)
+        cache = _require(obj, "cache", dict, "lint", out)
+        if cache is not None and not isinstance(cache.get("enabled"),
+                                                bool):
+            out.append("lint: cache.enabled must be a bool")
+        _require(obj, "rule_timings_s", dict, "lint", out)
+    if findings is not None:
+        for i, f in enumerate(findings):
+            if not isinstance(f, dict):
+                out.append(f"lint: findings[{i}] must be an object")
+                continue
+            missing = {"rule", "path", "line", "message"} - set(f)
+            if missing:
+                out.append(f"lint: findings[{i}] missing {sorted(missing)}")
+            if ver == 2 and not set(f) <= _LINT_FINDING_KEYS:
+                out.append(f"lint: findings[{i}] carries unknown keys "
+                           f"{sorted(set(f) - _LINT_FINDING_KEYS)}")
+        if isinstance(obj.get("ok"), bool) and obj["ok"] != (
+                len(findings) == 0):
+            out.append("lint: ok flag disagrees with the findings list "
+                       "(ok means ZERO unsuppressed findings)")
+    return out
+
+
 _VALIDATORS = {
     "record": _validate_record,
+    "lint": _validate_lint,
     "replay": _validate_replay,
     "serve": _validate_serve,
     "serve_pool": _validate_serve_pool,
@@ -1012,8 +1077,8 @@ def validate(obj, kind: str | None = None) -> list:
     if kind is None:
         return ["unrecognized artifact shape: none of the known key "
                 "signatures (record / driver_capture / multichip / phases "
-                "/ tpu_cache / telemetry / serve / serve_pool / replay) "
-                "match"]
+                "/ tpu_cache / telemetry / serve / serve_pool / replay / "
+                "lint) match"]
     if kind not in _VALIDATORS:
         return [f"unknown artifact kind {kind!r}"]
     return _VALIDATORS[kind](obj)

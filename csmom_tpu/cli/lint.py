@@ -1,19 +1,30 @@
-"""csmom lint — run the static-analysis sweep (ISSUE 11).
+"""csmom lint — run the static-analysis sweep (ISSUE 11 + 12).
 
-Runs every registered kind-``lint`` rule (clock-discipline,
-tracer-hygiene, lock-discipline, donation-safety, enumeration-drift —
-plus any runtime registration) over the package, ``bench.py``, and
-``benchmarks/`` in a single parse-per-file pass.  Exit 0 means the tree
-is clean (zero unsuppressed findings; a stale pragma counts as a
-finding); exit 1 names every defect as ``path:line: [rule] message``.
+Runs every registered kind-``lint`` rule over the package, ``bench.py``,
+and ``benchmarks/`` in a single parse-per-file pass; ``--project`` adds
+the whole-program rules (lock-order, helper-hygiene, compile-surface)
+on the alias-aware project call graph.  Exit 0 means the tree is clean
+(zero unsuppressed findings; a stale pragma counts as a finding); exit
+1 names every defect as ``path:line: [rule] message``.
 
-``--json`` emits the machine-readable findings report (schema_version
-1) — what tier-1 parses and what CI archives.  ``--rule`` runs one rule;
-``--paths`` narrows the scan; ``--rules`` lists the registered rule set
-with descriptions (the registry is the only rule table).
+``--format`` selects the output:
 
-``csmom rehearse`` refuses to start when this sweep fails: a dirty tree
-must not reach a tunnel window.
+- ``text`` (default) — human-readable findings + a per-rule timing
+  footer;
+- ``json`` — the machine-readable findings report (schema_version 2:
+  project flag, per-finding call chains, cache stats, rule timings) —
+  what tier-1 parses and what CI archives.  ``--json`` stays as an
+  alias;
+- ``github`` — ``::error file=...,line=...`` workflow annotations so CI
+  surfaces findings inline on the PR diff.
+
+The incremental cache (``.csmom_lint_cache/``, content-digest keyed)
+makes an unchanged-tree re-sweep nearly free; ``--no-cache`` bypasses
+it.  The sweep wall time lands on the ``lint.sweep_s`` gauge
+(:mod:`csmom_tpu.obs.metrics`) when telemetry is armed.
+
+``csmom rehearse`` refuses to start when this sweep (project scope
+included) fails: a dirty tree must not reach a tunnel window.
 
 Registered via ``register(sub)`` like serve/replay/ledger (the
 cli/main.py split: new subcommands do not grow the monolith).
@@ -26,34 +37,73 @@ import sys
 __all__ = ["cmd_lint", "register"]
 
 
+def _print_github(report) -> None:
+    for f in report.findings:
+        # one line per finding; newlines would break the annotation
+        msg = f.message.replace("\n", " ")
+        print(f"::error file={f.path},line={f.line},"
+              f"title=lint:{f.rule}::{msg}")
+    print(f"{len(report.findings)} finding(s) over {report.files} "
+          f"file(s)")
+
+
 def cmd_lint(args) -> int:
     """Run the registered static-analysis rules over the tree."""
     from csmom_tpu.analysis import run_lint
+    from csmom_tpu.obs import metrics
     from csmom_tpu.registry import lint_rules
+    from csmom_tpu.utils.deadline import mono_now_s
 
     if getattr(args, "rules_list", False):
         specs = lint_rules()
         for spec in specs:
-            print(f"{spec.name}")
+            scope = getattr(spec.rule_cls, "scope", "file")
+            print(f"{spec.name}" + ("  [project]"
+                                    if scope == "project" else ""))
             print(f"    {spec.description}")
         print(f"\n{len(specs)} rules registered (kind 'lint') — register "
               "one more with register_engine(name=..., kind='lint', "
               "rule_cls=...) and it joins this sweep, tier-1, and the "
               "fixture self-test with no other file edited")
         return 0
+    # an explicit --format always wins; --json is only a default-filler
+    # alias (``--format github --json`` must not silently emit JSON)
+    fmt = (getattr(args, "format", None)
+           or ("json" if getattr(args, "json", False) else "text"))
+    t0 = mono_now_s()
     try:
-        report = run_lint(paths=args.paths or None, rule=args.rule)
+        report = run_lint(paths=args.paths or None, rule=args.rule,
+                          project=getattr(args, "project", False),
+                          cache=not getattr(args, "no_cache", False),
+                          timer=mono_now_s)
     except KeyError as e:
         print(str(e).strip('"'), file=sys.stderr)
         return 2
-    if args.json:
+    sweep_s = mono_now_s() - t0
+    metrics.gauge("lint.sweep_s").set(round(sweep_s, 6))
+    if fmt == "json":
         print(report.to_json())
+        return 0 if report.ok else 1
+    if fmt == "github":
+        _print_github(report)
         return 0 if report.ok else 1
     for f in report.findings:
         print(f)
+    cache = report.cache
+    cache_txt = (
+        f"cache {cache['hits']} hit/{cache['misses']} miss"
+        + ("+project" if cache.get("project_hit") else "")
+        if cache.get("enabled") else "cache off")
     print(f"{len(report.findings)} finding(s) over {report.files} "
           f"file(s); {len(report.suppressed)} suppressed by pragma "
-          f"({len(report.rules)} rules)")
+          f"({len(report.rules)} rules"
+          + (", project scope" if report.project else "")
+          + f"; {cache_txt}; {sweep_s:.2f}s)")
+    if report.rule_timings_s:
+        slowest = sorted(report.rule_timings_s.items(),
+                         key=lambda kv: -kv[1])
+        print("per-rule: " + ", ".join(
+            f"{rid} {s * 1000:.0f}ms" for rid, s in slowest))
     if not report.ok:
         print("fix the findings or, for a justified exception, add "
               "`lint: allow" + "[<rule>] <reason>` on (or directly "
@@ -67,12 +117,24 @@ def register(sub) -> None:
     sp = sub.add_parser(
         "lint",
         help="run the static-analysis sweep: registered AST rules for "
-             "clock/tracer/lock/donation/enumeration discipline "
-             "(tier-1 runs it; rehearse gates on it)",
+             "clock/tracer/lock/donation/enumeration discipline, plus "
+             "whole-program lock-order/helper-hygiene/compile-surface "
+             "with --project (tier-1 runs it; rehearse gates on it)",
     )
+    sp.add_argument("--format", choices=("text", "json", "github"),
+                    help="output format: human text (default), the "
+                         "schema_version-2 JSON report, or GitHub "
+                         "workflow annotations (::error file=...)")
     sp.add_argument("--json", action="store_true",
-                    help="emit the machine-readable findings report "
-                         "(schema_version 1) instead of text")
+                    help="alias for --format json (kept for r16 "
+                         "compatibility)")
+    sp.add_argument("--project", action="store_true",
+                    help="add the whole-program rules (lock-order, "
+                         "helper-hygiene, compile-surface) on the "
+                         "project call graph")
+    sp.add_argument("--no-cache", action="store_true",
+                    help="bypass the incremental sweep cache "
+                         "(.csmom_lint_cache/)")
     sp.add_argument("--rule", metavar="ID",
                     help="run only this rule id (see --rules)")
     sp.add_argument("--paths", nargs="+", metavar="PATH",

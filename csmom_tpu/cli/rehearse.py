@@ -1357,13 +1357,16 @@ _CUSTOM_CHECKS = {
 
 
 def _lint_gate() -> list:
-    """The static-analysis gate (ISSUE 11): the unsuppressed findings of
-    a full `csmom lint` sweep.  ``cmd_rehearse`` refuses to start on a
-    non-empty result — a defect a CPU AST pass can catch must never
-    reach (let alone burn) a tunnel window."""
+    """The static-analysis gate (ISSUE 11 + 12): the unsuppressed
+    findings of a full `csmom lint --project` sweep — per-file rules AND
+    the whole-program set (lock-order cycles, helper-hidden blocking
+    calls, compile-surface coverage).  ``cmd_rehearse`` refuses to start
+    on a non-empty result — a deadlock or an unwarmed dispatchable shape
+    a CPU AST pass can catch must never reach (let alone burn) a tunnel
+    window.  The incremental cache makes the repeat gate nearly free."""
     from csmom_tpu.analysis import run_lint
 
-    return run_lint().findings
+    return run_lint(project=True).findings
 
 
 def cmd_rehearse(args) -> int:

@@ -47,6 +47,7 @@ __all__ = [
     "get_engine",
     "lint_rules",
     "manifest_entries",
+    "manifest_entry_names",
     "manifest_profiles",
     "register_engine",
     "serve_endpoints",
@@ -75,6 +76,13 @@ def manifest_profiles() -> tuple:
 
 def manifest_entries(profile: str, dtype=None) -> list:
     return ensure_builtin().manifest_entries(profile, dtype)
+
+
+def manifest_entry_names(profile: str) -> set:
+    """The jax-free warm-coverage declaration: entry names the
+    profile's feeders will compile (see ``EngineSpec.manifest_names_fn``
+    — what the compile-surface lint rule audits)."""
+    return ensure_builtin().manifest_entry_names(profile)
 
 
 def get_engine(name: str, kind: str | None = None) -> EngineSpec:
@@ -106,11 +114,13 @@ def strategies() -> dict:
 
 def lint_rules() -> tuple:
     """Kind-``lint`` specs in registration order; importing the builtin
-    rule module is what registers the shipped set (stdlib-only — the
-    sweep stays jax-free).  A rule registered at runtime (a plugin, a
-    test) appears here immediately, which is what enrolls it in
-    ``csmom lint``, the tier-1 sweep, and the fixture self-test."""
+    rule modules is what registers the shipped set (stdlib-only — the
+    sweep stays jax-free): the per-file rules AND the project-scope
+    whole-program rules (ISSUE 12).  A rule registered at runtime (a
+    plugin, a test) appears here immediately, which is what enrolls it
+    in ``csmom lint``, the tier-1 sweep, and the fixture self-test."""
     import csmom_tpu.analysis.rules  # noqa: F401  (registers the rules)
+    import csmom_tpu.analysis.project_rules  # noqa: F401  (project set)
 
     return ensure_builtin().specs("lint")
 

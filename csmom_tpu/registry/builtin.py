@@ -544,6 +544,22 @@ def _stream_manifest(profile: str, dtype=None) -> list:
     return out
 
 
+def serve_profile_entry_names(profile: str) -> set:
+    """The jax-free twin of :func:`serve_profile_entries`: the entry
+    NAMES the feeder will compile, from bucket geometry + the registry's
+    serve endpoints alone.  This is the warm-coverage declaration the
+    compile-surface lint rule (ISSUE 12) cross-checks against
+    ``health.expected_entry_names`` — the two sides derive the same
+    world through different code paths, so a feeder that drifts (or is
+    deregistered) fails the sweep instead of compiling in-window."""
+    from csmom_tpu.serve.buckets import bucket_spec
+
+    spec = bucket_spec(profile)
+    return {f"serve.{kind}.b{B}@{A}x{M}"
+            for kind in REGISTRY.serve_endpoints()
+            for B, A, M in spec.shapes()}
+
+
 REGISTRY.register(EngineSpec(
     name="serve.buckets", kind="compile",
     description="the serving tier's closed shape world: every "
@@ -552,6 +568,7 @@ REGISTRY.register(EngineSpec(
     axes="values f[B,A,M], mask bool[B,A,M] per endpoint",
     profiles=("serve", "serve-smoke"),
     manifest_fn=serve_profile_entries,
+    manifest_names_fn=serve_profile_entry_names,
 ))
 
 REGISTRY.register(EngineSpec(

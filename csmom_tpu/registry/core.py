@@ -124,6 +124,13 @@ class EngineSpec:
     axes: str | None = None         # axis semantics, e.g. "f[B,A,M] panels"
     profiles: tuple = ()            # warmup profiles this engine feeds
     manifest_fn: Callable | None = None
+    # jax-free twin of manifest_fn: ``manifest_names_fn(profile) ->
+    # set[str]`` declares the entry NAMES the feeder will compile,
+    # without paying the jax import the entries themselves need.  This
+    # is what the compile-surface lint rule (ISSUE 12) cross-checks
+    # against ``health.expected_entry_names`` so "every dispatchable
+    # shape is warmed" is a static fact, not a ledger row.
+    manifest_names_fn: Callable | None = None
     entry_fn: Callable | None = None
     donated_fn: Callable | None = None
     sharded_fn: Callable | None = None
@@ -291,6 +298,18 @@ class EngineRegistry:
                 if p not in out:
                     out.append(p)
         return tuple(out)
+
+    def manifest_entry_names(self, profile: str) -> set:
+        """The entry NAMES the profile's feeders declare they will warm
+        — the jax-free aggregation of ``manifest_names_fn`` (empty for
+        a profile whose feeders declare no names).  The compile-surface
+        lint rule compares this against the serving tier's dispatchable
+        world (``health.expected_entry_names``)."""
+        out: set = set()
+        for spec in self._snapshot():
+            if profile in spec.profiles and spec.manifest_names_fn:
+                out |= set(spec.manifest_names_fn(profile))
+        return out
 
     def manifest_entries(self, profile: str, dtype=None) -> list:
         """Surface (a): the profile's manifest, aggregated across every

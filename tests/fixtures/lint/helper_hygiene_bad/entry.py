@@ -1,0 +1,18 @@
+"""Known-bad fixture: traced functions whose host-sync escapes hide one
+call hop away — the per-file tracer-hygiene rule stays silent on THIS
+file (every escape lives in util.py/donated.py)."""
+
+import jax
+
+from .util import log_panel, refresh_state
+
+
+@jax.jit
+def score(panel):
+    log_panel(panel)
+    return panel * 2.0
+
+
+@jax.jit
+def step(state):
+    return refresh_state(state)

@@ -1,0 +1,15 @@
+"""Clean twin: the traced functions call a pure helper."""
+
+import jax
+
+from .util import scale_panel
+
+
+@jax.jit
+def score(panel):
+    return scale_panel(panel)
+
+
+@jax.jit
+def step(state):
+    return scale_panel(state)

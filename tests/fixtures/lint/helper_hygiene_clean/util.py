@@ -1,0 +1,6 @@
+"""A pure helper: no host I/O, no clocks, no donation — safe to reach
+from a traced body."""
+
+
+def scale_panel(panel):
+    return panel * 2.0

@@ -1,26 +1,32 @@
-"""ISSUE 11: the unified AST analysis subsystem (`csmom lint`).
+"""ISSUE 11 + 12: the unified AST analysis subsystem (`csmom lint`).
 
-Four layers:
+Five layers:
 
-- **the tier-1 sweep** — the committed tree is clean (zero unsuppressed
-  findings; a finding here IS a test failure with file:line and rule
-  id), and `csmom lint --json` emits the machine-readable report;
+- **the tier-1 sweep** — the committed tree is clean at PROJECT scope
+  (per-file rules plus lock-order / helper-hygiene / compile-surface on
+  the whole-program call graph; a finding here IS a test failure with
+  file:line and rule id), and `csmom lint --format json` emits the
+  machine-readable schema_version-2 report;
 - **the fixture self-test harness** — every registered rule fires on
-  its known-bad fixture under ``tests/fixtures/lint/`` and stays silent
-  on the clean twin (the lint analogue of the registry completeness
-  test: shipping a rule without proof it fires is shipping nothing);
+  its known-bad fixture under ``tests/fixtures/lint/`` (a FILE for
+  per-file rules, a multi-file PACKAGE for project rules) and stays
+  silent on the clean twin;
 - **pragma semantics** — a live ``lint: allow[...]`` pragma suppresses
-  exactly its finding; an unused one is itself a finding; an unknown
-  rule id in a pragma is a finding; clock-tier modules cannot pragma
-  out of their contract;
+  exactly its finding (project findings included); an unused one is
+  itself a finding; an unknown rule id in a pragma is a finding;
+  clock-tier modules cannot pragma out of their contract;
+- **the incremental cache** — byte-identical findings on a warm
+  re-sweep, >= 5x faster on an unchanged tree, invalidated by content
+  changes, bypassed by ``--no-cache``;
 - **registry + gate integration** — rules are kind-``lint`` registry
   citizens (a toy rule registered at runtime joins the sweep with no
   other file edited), and ``csmom rehearse`` refuses to start on a
-  dirty tree.
+  dirty tree — project findings included.
 """
 
 import json
 import os
+import time
 
 import pytest
 
@@ -36,18 +42,34 @@ def _fixture(name: str) -> str:
     return os.path.join(_FIX, name)
 
 
+def _rule_fixture_pair(rule_id: str) -> tuple:
+    """(bad, clean) fixture paths: ``<stem>_bad.py`` single files for
+    per-file rules, ``<stem>_bad/`` packages for project rules."""
+    stem = rule_id.replace("-", "_")
+    for suffix in ("", ".py"):
+        bad = _fixture(f"{stem}_bad{suffix}")
+        clean = _fixture(f"{stem}_clean{suffix}")
+        if os.path.exists(bad) or os.path.exists(clean):
+            return bad, clean
+    return _fixture(f"{stem}_bad.py"), _fixture(f"{stem}_clean.py")
+
+
 # ------------------------------------------------------ the tier-1 sweep ---
 
 def test_lint_sweep_is_clean_on_the_committed_tree():
     """THE gate: zero unsuppressed findings over the package + bench.py
-    + benchmarks/.  A failure here names every offender as
-    path:line: [rule] message — fix it or justify it with an in-file
-    pragma (which must then actually suppress something)."""
-    rep = run_lint()
+    + benchmarks/ at PROJECT scope — the whole-program rules (lock
+    acquisition order, helper-hidden blocking/tracer escapes, compile-
+    surface coverage) run here, not just the per-file set.  A failure
+    names every offender as path:line: [rule] message — fix it or
+    justify it with an in-file pragma (which must then actually
+    suppress something)."""
+    rep = run_lint(project=True)
     assert rep.findings == [], (
         "csmom lint found defects on the committed tree:\n  "
         + "\n  ".join(str(f) for f in rep.findings))
     assert rep.files > 100, "the sweep lost its default scope"
+    assert rep.project is True
     assert set(rep.rules) == {s.name for s in lint_rules()}
     # the justified suppressions stay visible, never silent
     assert all(f.rule == "clock-discipline" or f.rule == "lock-discipline"
@@ -55,21 +77,74 @@ def test_lint_sweep_is_clean_on_the_committed_tree():
 
 
 def test_cli_lint_json_is_wired_and_clean(capsys):
-    """`csmom lint --json` (what CI archives) exits 0 on the committed
-    tree and emits the schema_version-1 findings report."""
+    """`csmom lint --project --format json` (what CI archives) exits 0
+    on the committed tree and emits the schema_version-2 findings
+    report — which the artifact validator accepts closed-world."""
+    from csmom_tpu.chaos import invariants as inv
+    from csmom_tpu.cli.main import main
+
+    rc = main(["lint", "--project", "--format", "json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["ok"] is True
+    assert report["schema_version"] == 2
+    assert report["project"] is True
+    assert report["findings"] == []
+    assert report["files_scanned"] > 100
+    assert set(report["rules"]) == {s.name for s in lint_rules()}
+    assert report["cache"]["enabled"] in (True, False)
+    # suppressed entries carry the machine-readable finding shape
+    for s in report["suppressed"]:
+        assert {"rule", "path", "line", "message", "chain"} <= set(s)
+    # the validator recognizes and accepts the report (closed world)
+    assert inv.detect_kind(report) == "lint"
+    assert inv.validate(report) == []
+    # ... and rejects a key outside the v2 world or a lying ok flag
+    assert any("unknown v2 keys" in v for v in inv.validate(
+        {**report, "surprise": 1}))
+    assert any("disagrees" in v for v in inv.validate(
+        {**report, "ok": False}))
+
+
+def test_cli_lint_json_alias_still_works(capsys):
+    """``--json`` remains an alias for ``--format json`` (r16 callers)."""
     from csmom_tpu.cli.main import main
 
     rc = main(["lint", "--json"])
     report = json.loads(capsys.readouterr().out)
-    assert rc == 0
-    assert report["ok"] is True
-    assert report["schema_version"] == 1
-    assert report["findings"] == []
-    assert report["files_scanned"] > 100
-    assert set(report["rules"]) == {s.name for s in lint_rules()}
-    # suppressed entries carry the machine-readable finding shape
-    for s in report["suppressed"]:
-        assert {"rule", "path", "line", "message"} <= set(s)
+    assert rc == 0 and report["schema_version"] == 2
+
+
+def test_cli_lint_explicit_format_beats_the_json_alias(capsys):
+    """A wrapper script still appending ``--json`` unconditionally must
+    not silently suppress an explicitly requested ``--format``."""
+    from csmom_tpu.cli.main import main
+
+    bad = _fixture("clock_discipline_bad.py")
+    rc = main(["lint", "--format", "github", "--json", "--paths", bad,
+               "--no-cache"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "::error file=" in out
+
+
+def test_cli_lint_github_format_emits_workflow_annotations(capsys):
+    """``--format github`` prints ::error annotations CI surfaces inline
+    on the PR diff, one per finding, and keeps the exit contract."""
+    from csmom_tpu.cli.main import main
+
+    bad = _fixture("lock_discipline_bad.py")
+    rc = main(["lint", "--format", "github", "--paths", bad])
+    out = capsys.readouterr().out
+    assert rc == 1
+    lines = [ln for ln in out.splitlines() if ln.startswith("::error ")]
+    assert lines, out
+    assert any("file=" in ln and "line=11" in ln
+               and "title=lint:lock-discipline" in ln for ln in lines)
+
+    rc = main(["lint", "--format", "github", "--paths",
+               _fixture("lock_discipline_clean.py")])
+    out = capsys.readouterr().out
+    assert rc == 0 and "::error" not in out
 
 
 def test_cli_lint_reports_findings_with_file_line_and_rule(capsys):
@@ -86,7 +161,7 @@ def test_cli_lint_reports_findings_with_file_line_and_rule(capsys):
     report = json.loads(capsys.readouterr().out)
     assert rc == 1 and report["ok"] is False
     f0 = report["findings"][0]
-    assert set(f0) == {"rule", "path", "line", "message"}
+    assert set(f0) == {"rule", "path", "line", "message", "chain"}
 
 
 def test_cli_lint_rule_filter_and_rules_listing(capsys):
@@ -113,16 +188,24 @@ def test_cli_lint_rule_filter_and_rules_listing(capsys):
 @pytest.mark.parametrize("rule_id",
                          [s.name for s in lint_rules()])
 def test_every_registered_rule_fires_on_bad_and_not_on_clean(rule_id):
-    """The self-test harness (ISSUE 11 satellite): registration enrolls
-    a rule here — each must demonstrably fire on its known-bad fixture
-    and stay silent on the clean twin."""
+    """The self-test harness (ISSUE 11 satellite, extended to project
+    scope in ISSUE 12): registration enrolls a rule here — each must
+    demonstrably fire on its known-bad fixture and stay silent on the
+    clean twin.  Per-file rules ship a single-file pair; project rules
+    ship MULTI-FILE packages (their defects are cross-file by
+    definition)."""
     spec = {s.name: s for s in lint_rules()}[rule_id]
-    stem = rule_id.replace("-", "_")
-    bad, clean = _fixture(f"{stem}_bad.py"), _fixture(f"{stem}_clean.py")
-    assert os.path.isfile(bad), (
+    bad, clean = _rule_fixture_pair(rule_id)
+    assert os.path.exists(bad), (
         f"rule {rule_id} ships no known-bad fixture at {bad} — a rule "
         "without proof it fires is not a rule")
-    assert os.path.isfile(clean), f"rule {rule_id} ships no clean twin"
+    assert os.path.exists(clean), f"rule {rule_id} ships no clean twin"
+    if getattr(spec.rule_cls, "scope", "file") == "project":
+        assert os.path.isdir(bad), (
+            f"project rule {rule_id} must prove itself on a MULTI-FILE "
+            "fixture package — a single file cannot demonstrate a "
+            "cross-file defect")
+        assert len([n for n in os.listdir(bad) if n.endswith(".py")]) >= 2
     rep = run_lint(paths=[bad], rules=[spec.rule_cls()])
     assert [f for f in rep.findings if f.rule == rule_id], (
         f"rule {rule_id} stayed SILENT on its known-bad fixture")
@@ -168,6 +251,531 @@ def test_lock_discipline_accepts_try_finally_and_with():
     kinds = sorted(f.message.split("(")[0] for f in rep.findings)
     assert len(rep.findings) == 3  # bare acquire, sleep, sendall
     assert any("acquire" in k for k in kinds)
+
+
+# ---------------------------------------------- the whole-program rules ---
+
+def test_lock_order_catches_what_the_per_file_rule_cannot():
+    """The tentpole's acceptance pin: the bad package's lock-order cycle
+    AND its helper-hidden blocking call are invisible to the r16
+    per-file lock-discipline rule (every function is locally
+    disciplined) — and both are caught at project scope."""
+    from csmom_tpu.analysis.project_rules import LockOrder
+    from csmom_tpu.analysis.rules import LockDiscipline
+
+    bad = _fixture("lock_order_bad")
+    per_file = run_lint(paths=[bad], rules=[LockDiscipline()])
+    assert per_file.findings == [], (
+        "the fixture must be per-file clean (otherwise it proves "
+        "nothing about whole-program scope): " + str(per_file.findings))
+    rep = run_lint(paths=[bad], rules=[LockOrder()])
+    msgs = " | ".join(f.message for f in rep.findings)
+    assert "acquisition-order cycle" in msgs
+    assert "blocking call (time.sleep)" in msgs and "slow_push" in msgs
+    # findings carry the evidence chain (the schema v2 project field)
+    assert any(len(f.chain) >= 2 for f in rep.findings)
+
+
+def test_lock_order_flags_reacquisition_through_a_chain(tmp_path):
+    """Re-acquiring a non-reentrant lock through a call chain is the
+    one-lock deadlock; the same shape through an RLock is legal."""
+    from csmom_tpu.analysis.project_rules import LockOrder
+
+    p = tmp_path / "re.py"
+    p.write_text(
+        "import threading\n\n\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self.inner()\n\n"
+        "    def inner(self):\n"
+        "        with self._lock:\n"
+        "            return 1\n")
+    rep = run_lint(paths=[str(p)], rules=[LockOrder()])
+    assert any("re-acquired" in f.message for f in rep.findings)
+
+    p.write_text(p.read_text().replace("threading.Lock()",
+                                       "threading.RLock()"))
+    rep = run_lint(paths=[str(p)], rules=[LockOrder()])
+    assert rep.findings == [], rep.findings
+
+
+def test_lock_order_covers_anonymous_local_locks(tmp_path):
+    """A locally-created lock (the router's per-request state-dict
+    pattern) has no order-graph node, but a helper-hidden blocking call
+    under it still serializes its waiters — and is still flagged."""
+    from csmom_tpu.analysis.project_rules import LockOrder
+
+    (tmp_path / "a.py").write_text(
+        "import threading\n\n"
+        "from .b import push\n\n\n"
+        "def drive():\n"
+        "    state = {'lock': threading.Lock()}\n"
+        "    with state['lock']:\n"
+        "        push(state)\n")
+    (tmp_path / "b.py").write_text(
+        "import time\n\n\ndef push(state):\n    time.sleep(0.01)\n")
+    rep = run_lint(paths=[str(tmp_path)], rules=[LockOrder()])
+    assert any("locally-scoped lock" in f.message
+               and "time.sleep" in f.message for f in rep.findings), (
+        rep.findings)
+
+
+def test_lock_order_multi_item_with_orders_left_to_right(tmp_path):
+    """``with a, b:`` acquires left-to-right — opposite-order nesting
+    elsewhere closes the cycle, and a directly nested re-acquisition of
+    the same lock is the self-deadlock (both review findings)."""
+    from csmom_tpu.analysis.project_rules import LockOrder
+
+    p = tmp_path / "multi.py"
+    p.write_text(
+        "import threading\n\n\n"
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n\n"
+        "    def fwd(self):\n"
+        "        with self._a_lock, self._b_lock:\n"
+        "            return 1\n\n"
+        "    def rev(self):\n"
+        "        with self._b_lock:\n"
+        "            with self._a_lock:\n"
+        "                return 2\n")
+    rep = run_lint(paths=[str(p)], rules=[LockOrder()])
+    assert any("acquisition-order cycle" in f.message
+               for f in rep.findings), rep.findings
+
+    p2 = tmp_path / "self.py"
+    p2.write_text(
+        "import threading\n\n\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def broken(self):\n"
+        "        with self._lock:\n"
+        "            with self._lock:\n"
+        "                return 1\n")
+    rep = run_lint(paths=[str(p2)], rules=[LockOrder()])
+    assert any("re-acquired inside its own with-block" in f.message
+               for f in rep.findings), rep.findings
+
+
+def test_helper_hygiene_partial_decorator_must_wrap_jit(tmp_path):
+    """``@partial(jax.jit, ...)`` is a traced root; ``@partial`` over an
+    ordinary decorator is NOT (the review's false-positive trap: a
+    non-jit partial whose helper prints must stay silent)."""
+    from csmom_tpu.analysis.project_rules import HelperHygiene
+
+    (tmp_path / "helpers.py").write_text(
+        "def log_it(x):\n    print(x)\n    return x\n")
+    p = tmp_path / "m.py"
+    p.write_text(
+        "from functools import partial\n\n"
+        "from .helpers import log_it\n\n\n"
+        "def retry(fn, attempts=1):\n"
+        "    return fn\n\n\n"
+        "@partial(retry, attempts=3)\n"
+        "def ordinary(x):\n"
+        "    return log_it(x)\n")
+    rep = run_lint(paths=[str(tmp_path)], rules=[HelperHygiene()])
+    assert rep.findings == [], rep.findings
+
+    p.write_text(
+        "from functools import partial\n\n"
+        "import jax\n\n"
+        "from .helpers import log_it\n\n\n"
+        "@partial(jax.jit, static_argnums=1)\n"
+        "def traced(x, n):\n"
+        "    return log_it(x)\n")
+    rep = run_lint(paths=[str(tmp_path)], rules=[HelperHygiene()])
+    assert any("print" in f.message for f in rep.findings), rep.findings
+
+
+def test_cache_coexists_across_rule_filtered_sweeps(tmp_path):
+    """A ``--rule`` filtered sweep must not evict the full gate's warm
+    entries (the review's thrash finding): full, filtered, full again —
+    the third sweep still hits every file."""
+    cache_dir = str(tmp_path / "c")
+    run_lint(project=True, cache_dir=cache_dir)              # warm full
+    run_lint(rule="clock-discipline", cache_dir=cache_dir)   # filtered
+    again = run_lint(project=True, cache_dir=cache_dir)
+    assert again.cache["hits"] == again.files, again.cache
+    assert again.cache["project_hit"] is True
+
+
+def test_compile_surface_anchor_is_identical_warm_and_cold(tmp_path):
+    """The finding anchor (and so any pragma match) must not depend on
+    cache temperature: a doctored feeder reports at the PROFILES line
+    on a cold sweep AND on a fully warm one (CachedSlot, no parse)."""
+    import dataclasses
+
+    from csmom_tpu.registry import ensure_builtin
+
+    reg = ensure_builtin()
+    spec = reg.get("serve.buckets", kind="compile")
+    orig_names = spec.manifest_names_fn
+    cache_dir = str(tmp_path / "c")
+    try:
+        reg.register(dataclasses.replace(
+            spec, manifest_names_fn=lambda p: set(
+                sorted(orig_names(p))[:-1])), replace=True)
+        cold = run_lint(project=True, rule="compile-surface",
+                        cache_dir=cache_dir)
+        warm = run_lint(project=True, rule="compile-surface",
+                        cache_dir=cache_dir)
+        assert warm.cache["hits"] == warm.files
+        assert cold.findings and warm.findings
+        assert ([(f.path, f.line) for f in cold.findings]
+                == [(f.path, f.line) for f in warm.findings])
+        assert cold.findings[0].line > 1   # the real PROFILES line
+    finally:
+        reg.register(spec, replace=True)
+
+
+def test_bare_condition_is_rlock_backed_and_reentrant(tmp_path):
+    """``threading.Condition()`` with no lock wraps an RLock (CPython
+    default) — re-acquiring it through a chain is LEGAL and must not be
+    called a self-deadlock (review finding)."""
+    from csmom_tpu.analysis.project_rules import LockOrder
+
+    p = tmp_path / "cv.py"
+    p.write_text(
+        "import threading\n\n\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._cv_lock = threading.Condition()\n\n"
+        "    def outer(self):\n"
+        "        with self._cv_lock:\n"
+        "            self.inner()\n\n"
+        "    def inner(self):\n"
+        "        with self._cv_lock:\n"
+        "            return 1\n")
+    rep = run_lint(paths=[str(p)], rules=[LockOrder()])
+    assert rep.findings == [], rep.findings
+
+
+def test_fully_warm_project_sweep_does_not_rewrite_the_cache(tmp_path):
+    """A 100%-hit sweep must be I/O-free on the cache file (the dirty
+    flag's whole job — review finding)."""
+    cache_dir = str(tmp_path / "c")
+    run_lint(project=True, cache_dir=cache_dir)
+    path = os.path.join(cache_dir, "sweep.json")
+    before = os.stat(path).st_mtime_ns
+    warm = run_lint(project=True, cache_dir=cache_dir)
+    assert warm.cache["hits"] == warm.files
+    assert os.stat(path).st_mtime_ns == before, (
+        "warm sweep rewrote sweep.json")
+
+
+def test_condition_aliases_the_lock_it_wraps(tmp_path):
+    """``threading.Condition(self._lock)``: holding the condition IS
+    holding the lock — acquiring one inside the other is flagged as
+    re-acquisition, not treated as two independent locks."""
+    from csmom_tpu.analysis.project_rules import LockOrder
+
+    p = tmp_path / "cond.py"
+    p.write_text(
+        "import threading\n\n\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._nonempty = threading.Condition(self._lock)\n\n"
+        "    def broken(self):\n"
+        "        with self._lock:\n"
+        "            self.wake()\n\n"
+        "    def wake(self):\n"
+        "        with self._nonempty:\n"
+        "            return 1\n")
+    rep = run_lint(paths=[str(p)], rules=[LockOrder()])
+    assert any("re-acquired" in f.message for f in rep.findings), (
+        rep.findings)
+
+
+def test_helper_hygiene_catches_what_tracer_hygiene_cannot():
+    """The per-file tracer-hygiene rule is silent on the bad package's
+    entry file (every escape hides one hop away); the project rule
+    flags all three escape families at the traced call sites."""
+    from csmom_tpu.analysis.project_rules import HelperHygiene
+    from csmom_tpu.analysis.rules import TracerHygiene
+
+    bad = _fixture("helper_hygiene_bad")
+    per_file = run_lint(paths=[os.path.join(bad, "entry.py")],
+                        rules=[TracerHygiene()])
+    assert per_file.findings == [], (
+        "the traced entry file must be per-file clean: "
+        + str(per_file.findings))
+    rep = run_lint(paths=[bad], rules=[HelperHygiene()])
+    msgs = " | ".join(f.message for f in rep.findings)
+    for marker in ("print", "clock read", "donated-buffer entry"):
+        assert marker in msgs, f"escape family {marker!r} not caught"
+    assert all(f.path.endswith("entry.py") for f in rep.findings), (
+        "findings anchor at the traced CALL SITE, not the helper")
+
+
+def test_compile_surface_fails_when_a_manifest_entry_is_deregistered():
+    """The acceptance pin: the committed registry passes; re-registering
+    the serve feeder with one entry name dropped (the static equivalent
+    of deregistering one manifest entry for a registered endpoint
+    bucket) fails the sweep; dropping the feeder's coverage declaration
+    entirely fails with the no-feeder message."""
+    import dataclasses
+
+    from csmom_tpu.registry import ensure_builtin
+
+    reg = ensure_builtin()
+    spec = reg.get("serve.buckets", kind="compile")
+    orig_names = spec.manifest_names_fn
+
+    rep = run_lint(project=True, rule="compile-surface")
+    assert rep.findings == [], rep.findings
+
+    try:
+        reg.register(dataclasses.replace(
+            spec, manifest_names_fn=lambda p: set(
+                sorted(orig_names(p))[:-1])), replace=True)
+        rep = run_lint(project=True, rule="compile-surface")
+        assert any("no warmed manifest entry" in f.message
+                   and f.path == "csmom_tpu/serve/buckets.py"
+                   for f in rep.findings), rep.findings
+
+        reg.register(dataclasses.replace(spec, manifest_names_fn=None),
+                     replace=True)
+        rep = run_lint(project=True, rule="compile-surface")
+        assert any("no registered manifest feeder" in f.message
+                   for f in rep.findings), rep.findings
+    finally:
+        reg.register(spec, replace=True)
+    rep = run_lint(project=True, rule="compile-surface")
+    assert rep.findings == []
+
+
+def test_compile_surface_registry_and_health_agree():
+    """The two independent derivations of the warm world (the feeder's
+    jax-free names declaration vs health's geometry walk) are equal on
+    the committed tree — the drift either side would introduce is what
+    the rule exists to catch."""
+    from csmom_tpu.registry import manifest_entry_names
+    from csmom_tpu.serve.health import expected_entry_names
+
+    for profile in ("serve", "serve-smoke"):
+        declared = manifest_entry_names(profile)
+        expected = expected_entry_names(profile)
+        assert expected <= declared, (
+            f"profile {profile}: dispatchable shapes missing warm "
+            f"coverage: {sorted(expected - declared)[:3]}")
+
+
+def test_project_findings_respect_pragmas(tmp_path):
+    """A ``lint: allow[lock-order]`` pragma suppresses the project
+    finding on its line — and an unused one is stale, like any rule."""
+    a = tmp_path / "a.py"
+    a.write_text(
+        "import threading\n\n"
+        "from .b import helper\n\n\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def run(self):\n"
+        "        with self._lock:\n"
+        "            # lint: allow[lock-order] startup only, no traffic\n"
+        "            helper()\n")
+    (tmp_path / "b.py").write_text(
+        "import time\n\n\ndef helper():\n    time.sleep(0.01)\n")
+    from csmom_tpu.analysis.project_rules import LockOrder
+
+    rep = run_lint(paths=[str(tmp_path)], rules=[LockOrder()])
+    assert rep.findings == [], rep.findings
+    assert [s.rule for s in rep.suppressed] == ["lock-order"]
+
+
+def test_committed_tree_lock_audit_is_pinned():
+    """The ISSUE 12 audit, mechanized: on the committed tree (a) the
+    router's per-request hedging state lock and its book lock NEVER
+    nest (no order edge touches Router._lock — ``_terminate`` and
+    ``_conclude_attempt`` are called sequentially, never one inside the
+    other), (b) the supervisor's restart path spawns/probes OUTSIDE its
+    event lock, and (c) the only cross-lock acquisition order in the
+    serve tier is AdmissionQueue._lock -> obs.metrics._LOCK (the
+    counter increments inside admission), which is one-directional.  A
+    new edge here is not automatically a bug — but it IS a new global
+    ordering constraint, and this test makes adding one a deliberate
+    act."""
+    import os as _os
+
+    from csmom_tpu.analysis.callgraph import ProjectContext
+    from csmom_tpu.analysis.core import FileContext, RunContext
+    from csmom_tpu.analysis.core import default_sources
+
+    run = RunContext(_REPO)
+    slots = {}
+    for p in default_sources():
+        rel = _os.path.relpath(p, _REPO)
+        with open(p, encoding="utf-8") as f:
+            slots[rel] = FileContext(p, rel, f.read(), run)
+    pc = ProjectContext(slots, _REPO)
+    pc.run = run
+    pc.build()
+    edges = set()
+    for info in pc.functions.values():
+        for outer, inner, _line in info.order_pairs:
+            edges.add((outer, inner))
+        for s in info.calls:
+            if s.held and s.callee in pc.functions:
+                for lock in pc.acquired_closure(s.callee):
+                    for h in s.held:
+                        if h != lock:
+                            edges.add((h, lock))
+    assert edges == {("csmom_tpu.serve.queue.AdmissionQueue._lock",
+                      "csmom_tpu.obs.metrics._LOCK")}, sorted(edges)
+    router_lock = "csmom_tpu.serve.router.Router._lock"
+    assert not any(router_lock in e for e in edges)
+    # the supervisor restart path: _restart/_spawn (Popen, file opens)
+    # and _probe_until_ready (sleep-polling) acquire nothing and are
+    # never called with the supervisor lock held
+    sup = "csmom_tpu.serve.supervisor.PoolSupervisor"
+    for fn in ("_restart", "_spawn", "_probe_until_ready"):
+        # the path may briefly take its own event lock plus the chaos
+        # checkpoint and metrics locks — all leaf locks that acquire
+        # nothing else (the closure proves exactly that)
+        assert pc.acquired_closure(f"{sup}.{fn}").keys() <= {
+            f"{sup}._lock", "csmom_tpu.chaos.inject._STATE_LOCK",
+            "csmom_tpu.obs.metrics._LOCK"}
+    for info in pc.functions.values():
+        for s in info.calls:
+            if s.callee in (f"{sup}._spawn", f"{sup}._probe_until_ready"):
+                assert not s.held, (info.qname, s.line)
+
+
+# ---------------------------------------------- the incremental cache ------
+
+def test_cache_second_sweep_is_faster_and_byte_identical(tmp_path):
+    """The CI satellite pin: on an unchanged tree the warm project
+    sweep is >= 5x faster than the cold one (it skips every parse), and
+    the reports agree finding-for-finding."""
+    cache_dir = str(tmp_path / "lintcache")
+    t0 = time.monotonic()
+    cold = run_lint(project=True, cache_dir=cache_dir)
+    t1 = time.monotonic()
+    warm = run_lint(project=True, cache_dir=cache_dir)
+    t2 = time.monotonic()
+    assert cold.cache["misses"] > 100 and cold.cache["hits"] == 0
+    assert warm.cache["hits"] == warm.files and warm.cache["misses"] == 0
+    assert warm.cache["project_hit"] is True
+    assert [str(f) for f in cold.findings] == [str(f)
+                                               for f in warm.findings]
+    assert ([str(s) for s in cold.suppressed]
+            == [str(s) for s in warm.suppressed])
+    cold_s, warm_s = t1 - t0, max(t2 - t1, 1e-9)
+    assert cold_s / warm_s >= 5.0, (
+        f"cache speedup only {cold_s / warm_s:.1f}x "
+        f"({cold_s:.3f}s -> {warm_s:.3f}s)")
+
+
+def test_cache_invalidates_on_content_change_and_honors_no_cache(tmp_path):
+    """A content change re-sweeps exactly the changed file (findings
+    change accordingly); ``cache=False`` (the --no-cache path) never
+    reads or writes the cache."""
+    repo = tmp_path / "repo"
+    (repo / "csmom_tpu").mkdir(parents=True)
+    mod = repo / "csmom_tpu" / "m.py"
+    mod.write_text("X = 1\n")
+    cache_dir = str(tmp_path / "c")
+    r1 = run_lint(paths=[str(mod)], repo=str(repo), cache_dir=cache_dir)
+    assert r1.findings == []
+    # out-of-repo rels are absolute and deliberately uncached; in-repo
+    # files key by relative path + digest
+    r2 = run_lint(paths=[str(mod)], repo=str(repo), cache_dir=cache_dir)
+    assert r2.cache["hits"] == 1
+    mod.write_text("import time\nX = time.time()\n")
+    r3 = run_lint(paths=[str(mod)], repo=str(repo), cache_dir=cache_dir)
+    assert r3.cache["hits"] == 0 and r3.cache["misses"] == 1
+    assert [f.rule for f in r3.findings] == ["clock-discipline"]
+    r4 = run_lint(paths=[str(mod)], repo=str(repo), cache_dir=cache_dir,
+                  cache=False)
+    assert r4.cache == {"enabled": False}
+    assert [f.rule for f in r4.findings] == ["clock-discipline"]
+
+
+def test_cached_sweep_replays_suppressions_and_cross_file_facts():
+    """A warm sweep must not lose (a) pragma suppressions or (b) the
+    cross-file enumeration-drift vocabulary state — both replay from
+    the cache record, and a stale cache entry can never change a
+    verdict (content-digest keyed)."""
+    rep = run_lint(project=True)   # warm or cold, either way
+    rep2 = run_lint(project=True)
+    assert len(rep2.suppressed) == len(rep.suppressed) > 0
+    assert rep2.findings == rep.findings == []
+
+
+def test_vocabulary_change_invalidates_cached_enumeration_verdicts(
+        tmp_path, monkeypatch):
+    """enumeration-drift verdicts depend on the LIVE checkpoint
+    vocabulary, not just the scanned sources — changing KNOWN_POINTS
+    must invalidate cached per-file verdicts in BOTH directions
+    (review finding: the cache key now folds the rule's cache_salt)."""
+    import csmom_tpu.chaos.plan as plan
+
+    repo = tmp_path / "repo"
+    (repo / "csmom_tpu").mkdir(parents=True)
+    mod = repo / "csmom_tpu" / "m.py"
+    mod.write_text('def f(checkpoint):\n    checkpoint("zzz.bogus")\n')
+    cache_dir = str(tmp_path / "c")
+    r1 = run_lint(paths=[str(mod)], repo=str(repo), cache_dir=cache_dir)
+    assert [f.rule for f in r1.findings] == ["enumeration-drift"]
+    monkeypatch.setattr(plan, "KNOWN_POINTS",
+                        tuple(plan.KNOWN_POINTS) + ("zzz.bogus",))
+    r2 = run_lint(paths=[str(mod)], repo=str(repo), cache_dir=cache_dir)
+    assert r2.findings == [], (
+        "a stale cached verdict replayed past a vocabulary change: "
+        + str(r2.findings))
+
+
+def test_compile_surface_toy_check_is_identical_warm_and_cold(tmp_path):
+    """The toy LINT_SURFACE check must see parse-free warm slots too
+    (review finding): the bad fixture package reports its missing entry
+    on the cold sweep AND on the fully-warm repeat."""
+    cache_dir = str(tmp_path / "c")
+    bad = _fixture("compile_surface_bad")
+    cold = run_lint(paths=[bad], project=True, cache_dir=cache_dir)
+    warm = run_lint(paths=[bad], project=True, cache_dir=cache_dir)
+    for rep in (cold, warm):
+        assert any(f.rule == "compile-surface"
+                   and "no warmed manifest entry" in f.message
+                   for f in rep.findings), rep.findings
+    assert ([(f.path, f.line) for f in cold.findings]
+            == [(f.path, f.line) for f in warm.findings])
+
+
+def test_cli_no_cache_flag_is_wired(capsys):
+    from csmom_tpu.cli.main import main
+
+    rc = main(["lint", "--no-cache", "--format", "json",
+               "--paths", _fixture("lock_discipline_clean.py")])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0 and report["cache"] == {"enabled": False}
+
+
+def test_cli_records_sweep_seconds_on_the_metrics_gauge(capsys):
+    """ISSUE 12 satellite: the sweep wall time lands on the
+    ``lint.sweep_s`` gauge when telemetry is armed (and, per the
+    zero-cost-unarmed contract, nowhere otherwise)."""
+    from csmom_tpu import obs
+    from csmom_tpu.cli.main import main
+    from csmom_tpu.obs import metrics
+
+    obs.arm(None, run_id="lint-unit", proc="t")
+    try:
+        rc = main(["lint", "--paths",
+                   _fixture("lock_discipline_clean.py")])
+        capsys.readouterr()
+        assert rc == 0
+        v = metrics.gauge("lint.sweep_s").value
+        assert isinstance(v, float) and v > 0.0
+    finally:
+        obs.disarm()
+        metrics.reset()
 
 
 # ------------------------------------------------------- pragma semantics --
@@ -254,16 +862,136 @@ def test_unparseable_source_is_a_finding_not_a_crash(tmp_path):
     assert [f.rule for f in rep.findings] == ["parse-error"]
 
 
+def test_non_utf8_source_is_a_finding_not_a_crash(tmp_path):
+    """A latin-1 byte in a scanned file must degrade to a parse-error
+    finding, not abort the sweep (UnicodeDecodeError is a ValueError
+    the read path has to absorb like any other unparseable source)."""
+    p = tmp_path / "latin.py"
+    p.write_bytes(b"# caf\xe9\nX = 1\n")
+    rep = run_lint(paths=[str(p)])
+    assert [f.rule for f in rep.findings] == ["parse-error"]
+
+
+def test_damaged_cache_with_valid_marker_reads_as_cold(tmp_path):
+    """The cache contract: a sweep.json that carries the right format
+    marker but alien inner structure (truncated, hand-edited, written
+    by a future version reusing the marker) is treated as EMPTY — the
+    cache may only ever change the sweep's speed, never crash it."""
+    import json
+
+    from csmom_tpu.analysis.cache import SweepCache
+
+    repo = tmp_path / "repo"
+    (repo / "csmom_tpu").mkdir(parents=True)
+    mod = repo / "csmom_tpu" / "m.py"
+    mod.write_text("X = 1\n")
+    cache_dir = tmp_path / "c"
+    cache_dir.mkdir()
+    for alien in (
+            {"format": 2, "files": {"a.py": [1]}, "project": [1]},
+            {"format": 2, "files": {"a.py": {"sig": {"digest": "d",
+             "raw": [{"line": 1}], "pragmas": [], "facts": {}}}},
+             "project": {}},
+            {"format": 2, "files": {}, "project": {"k": {"r": [None]}}},
+    ):
+        (cache_dir / "sweep.json").write_text(json.dumps(alien))
+        sc = SweepCache(str(repo), ["clock-discipline"],
+                        directory=str(cache_dir))
+        assert sc.lookup("a.py", "d") is None
+        assert sc.lookup_project("k") is None
+        rep = run_lint(paths=[str(mod)], repo=str(repo),
+                       cache_dir=str(cache_dir))
+        assert rep.findings == [] and rep.cache["hits"] == 0
+
+
+def test_editing_a_plugin_rule_source_invalidates_its_cached_verdicts(
+        tmp_path):
+    """The invalidation signature must cover rule sources OUTSIDE the
+    analysis package too: a runtime-registered plugin rule whose file
+    changes is a different sweep, so its cached verdicts cannot be
+    replayed."""
+    import importlib.util
+    import sys
+
+    plug = tmp_path / "plug_rule.py"
+    plug.write_text(
+        "from csmom_tpu.analysis.core import LintRule\n\n\n"
+        "class PlugRule(LintRule):\n"
+        "    id = 'plug-rule'\n"
+        "    description = 'test-only plugin rule'\n\n"
+        "    def finish_file(self, ctx):\n"
+        "        pass\n")
+    spec = importlib.util.spec_from_file_location("plug_rule", str(plug))
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["plug_rule"] = module
+    spec.loader.exec_module(module)
+    register_engine(name="plug-rule", kind="lint",
+                    rule_cls=module.PlugRule,
+                    description="test-only plugin rule")
+    try:
+        repo = tmp_path / "repo"
+        (repo / "csmom_tpu").mkdir(parents=True)
+        mod = repo / "csmom_tpu" / "m.py"
+        mod.write_text("X = 1\n")
+        cache_dir = str(tmp_path / "c")
+        run_lint(paths=[str(mod)], repo=str(repo), cache_dir=cache_dir)
+        warm = run_lint(paths=[str(mod)], repo=str(repo),
+                        cache_dir=cache_dir)
+        assert warm.cache["hits"] == 1
+        # a behavioral edit to the plugin file (its content is what the
+        # signature hashes) must read as a different sweep
+        plug.write_text(plug.read_text() + "# tightened\n")
+        cold = run_lint(paths=[str(mod)], repo=str(repo),
+                        cache_dir=cache_dir)
+        assert cold.cache["hits"] == 0 and cold.cache["misses"] == 1
+    finally:
+        unregister_engine("plug-rule", kind="lint")
+        sys.modules.pop("plug_rule", None)
+
+
 # ------------------------------------------- registry + gate integration ---
 
 def test_builtin_rules_are_registry_citizens():
     names = [s.name for s in lint_rules()]
     assert names == ["clock-discipline", "tracer-hygiene",
                      "lock-discipline", "donation-safety",
-                     "enumeration-drift"]
+                     "enumeration-drift", "lock-order",
+                     "helper-hygiene", "compile-surface"]
     for s in lint_rules():
         assert s.kind == "lint" and s.rule_cls is not None
         assert s.description
+    scopes = {s.name: getattr(s.rule_cls, "scope", "file")
+              for s in lint_rules()}
+    assert {n for n, sc in scopes.items() if sc == "project"} == {
+        "lock-order", "helper-hygiene", "compile-surface"}
+
+
+def test_project_rules_join_only_project_sweeps():
+    """A plain ``run_lint()`` stays the per-file sweep (same cost as
+    r16); ``project=True`` adds the whole-program set; naming a project
+    rule explicitly runs it regardless of the flag."""
+    plain = run_lint(paths=[_fixture("lock_discipline_clean.py")])
+    assert set(plain.rules) == {"clock-discipline", "tracer-hygiene",
+                                "lock-discipline", "donation-safety",
+                                "enumeration-drift"}
+    assert plain.project is False
+    via_flag = run_lint(paths=[_fixture("lock_discipline_clean.py")],
+                        project=True)
+    assert "lock-order" in via_flag.rules and via_flag.project is True
+    via_rule = run_lint(paths=[_fixture("lock_order_bad")],
+                        rule="lock-order")
+    assert via_rule.project is True
+    assert [f for f in via_rule.findings if f.rule == "lock-order"]
+
+
+def test_cli_rules_listing_marks_project_scope(capsys):
+    from csmom_tpu.cli.main import main
+
+    rc = main(["lint", "--rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "lock-order  [project]" in out
+    assert "compile-surface  [project]" in out
 
 
 def test_toy_rule_registered_at_runtime_joins_the_sweep(tmp_path, capsys):
@@ -346,6 +1074,26 @@ def test_rehearse_refuses_to_start_on_a_dirty_tree(monkeypatch, capsys):
     assert rc == 1
     assert "refusing to rehearse" in err
     assert "x.py:3" in err
+
+
+def test_rehearse_gate_runs_at_project_scope(monkeypatch):
+    """ISSUE 12 satellite: the rehearse refusal extends to project
+    findings — the gate sweeps with project=True, so a lock-order cycle
+    or an unwarmed dispatchable shape blocks the tunnel window too."""
+    import csmom_tpu.analysis as analysis
+    from csmom_tpu.cli import rehearse as reh
+
+    seen = {}
+    real = analysis.run_lint
+
+    def spy(*a, **kw):
+        seen.update(kw)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(analysis, "run_lint", spy)
+    findings = reh._lint_gate()
+    assert seen.get("project") is True
+    assert findings == []
 
 
 def test_rehearse_list_skips_the_gate(monkeypatch, capsys):
