@@ -109,6 +109,10 @@ class ClockDiscipline(LintRule):
         "csmom_tpu/cli/serve.py",
         "csmom_tpu/stream/replay.py",
         "csmom_tpu/cli/replay.py",
+        # the request-tracing tier (ISSUE 13): the stage clocks must be
+        # the SAME clock the queue expires on and the artifact measures
+        # on, or the decomposition could not be subtracted from the p99
+        "csmom_tpu/obs/trace.py",
     )
 
     # the stream data plane runs on EVENT TIME: bar stamps and version
@@ -127,6 +131,9 @@ class ClockDiscipline(LintRule):
         "csmom_tpu/obs/regress.py",
         "csmom_tpu/obs/memstats.py",
         "csmom_tpu/cli/ledger.py",
+        # renders committed TRACE evidence: verdict-reproducible, so
+        # clock-free like the rest of the ledger tier
+        "csmom_tpu/cli/trace.py",
     )
 
     def start_run(self, run: RunContext) -> None:

@@ -71,6 +71,15 @@ Artifact kinds (detected from keys, see :func:`detect_kind`):
     (``serve_max <= ingest_final``), and skew refusals must reconcile
     with the serve book's ``rejected_version_skew`` counter.
 
+``trace``
+    A request-path trace record (``TRACE_*.json``,
+    :mod:`csmom_tpu.obs.trace`): CLOSED trace books (every opened trace
+    ends complete or reasoned-partial; the ledger must balance), orphan
+    halves closed with reasons, per-stage walls that telescope to each
+    request wall within epsilon (the ``reconcile`` block), and
+    reconciliation against the driven serve run's request book
+    (``complete == served``, ``partial == rejected + expired``).
+
 Partial rules: a partial artifact carries ``extra.partial`` (non-empty
 string saying *what* is missing); a partial with a measurement list
 (``rows``/``phases``) is sized by it, and upgrades must be monotone —
@@ -112,8 +121,16 @@ KNOWN_TELEMETRY_SCHEMA_VERSIONS = (1,)
 # offered-load record; v3 (ISSUE 9, engine registry) adds per-ENDPOINT
 # books whose name set must be registered engines — the artifact's
 # endpoint world is validated against the registry, not a literal.
-# v1/v2 artifacts (SERVE_r10.json / SERVE_r13.json) stay valid as-is.
-KNOWN_SERVE_SCHEMA_VERSIONS = (1, 2, 3)
+# v4 (ISSUE 13, request tracing) adds per-class SLO error-budget burn
+# accounting (violations + budget_burn) and bounded per-request latency
+# samples in extra.samples (the CI backing for serve p99 gate rows).
+# v1/v2/v3 artifacts (SERVE_r10/r13/r14, SERVE_MESH_r15) stay valid.
+KNOWN_SERVE_SCHEMA_VERSIONS = (1, 2, 3, 4)
+
+# trace artifact schema versions (TRACE_*.json, the request-path
+# decomposition family — obs.trace): closed trace books + telescoping
+# stage reconciliation, enforced by schema like every other kind
+KNOWN_TRACE_SCHEMA_VERSIONS = (1,)
 
 # serve-pool artifact schema versions (SERVE_POOL_*.json, the
 # multi-process tier) — closed-world like the rest
@@ -144,9 +161,9 @@ _LINT_FINDING_KEYS = frozenset({"rule", "path", "line", "message",
 # pid-suffixed operator reruns) are regenerated per run and gitignored —
 # one slipped into the tree once, which is why this is a named rule with
 # a tier-1 test behind it instead of a .gitignore comment.
-_REGENERATED_PREFIXES = ("TELEMETRY_", "SERVE_", "REPLAY_")
+_REGENERATED_PREFIXES = ("TELEMETRY_", "SERVE_", "REPLAY_", "TRACE_")
 _COMMITTED_SIDECAR_RE = re.compile(
-    r"^(?:TELEMETRY|SERVE|SERVE_POOL|SERVE_MESH|REPLAY)_r\d+\.json$")
+    r"^(?:TELEMETRY|SERVE|SERVE_POOL|SERVE_MESH|REPLAY|TRACE)_r\d+\.json$")
 
 _NUM = (int, float)
 
@@ -178,8 +195,11 @@ def trailing_json(text: str):
 def detect_kind(obj: dict) -> str | None:
     if not isinstance(obj, dict):
         return None
-    # replay before pool, pool before serve, serve before record: each
-    # carries the previous kind's key signature plus its own
+    # trace/replay before pool, pool before serve, serve before record:
+    # each carries the previous kind's key signature plus its own
+    if obj.get("kind") == "trace" or {"books", "stages",
+                                      "reconcile"} <= set(obj):
+        return "trace"
     if obj.get("kind") == "replay" or {"ticks", "panel",
                                        "reconcile"} <= set(obj):
         return "replay"
@@ -515,6 +535,57 @@ def _validate_serve(obj: dict) -> list:
         out += _validate_serve_v2(obj, req)
     if isinstance(ver, int) and ver >= 3:
         out += _validate_serve_v3(obj, req)
+    if isinstance(ver, int) and ver >= 4:
+        out += _validate_serve_v4(obj)
+    return out
+
+
+def _validate_serve_v4(obj: dict) -> list:
+    """The ISSUE 13 additions: per-class SLO error-budget burn
+    accounting (``violations``/``budget_burn`` in every class book) and
+    bounded per-request latency samples in ``extra.samples`` — the CI
+    backing behind the serve p99 gate rows.  Both are schema rules so
+    neither can silently vanish from committed evidence."""
+    out: list = []
+    classes = obj.get("classes")
+    if isinstance(classes, dict):
+        for name, book in classes.items():
+            if not isinstance(book, dict):
+                continue  # already reported by the v2 rules
+            v = book.get("violations")
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"serve: classes[{name!r}].violations must be "
+                           "a non-negative int (v4 burn accounting)")
+            elif isinstance(book.get("served"), int) and v > book["served"]:
+                out.append(f"serve: classes[{name!r}].violations {v} > "
+                           f"served {book['served']}")
+            burn = book.get("budget_burn")
+            if burn is not None and (not isinstance(burn, _NUM)
+                                     or isinstance(burn, bool)
+                                     or burn < 0):
+                out.append(f"serve: classes[{name!r}].budget_burn must "
+                           "be a non-negative number or null")
+            if (burn is None and isinstance(book.get("served"), int)
+                    and book["served"] > 0
+                    and book.get("budget_ms") is not None):
+                out.append(f"serve: classes[{name!r}] served requests "
+                           "against a budget but budget_burn is null — "
+                           "the burn was computable, record it")
+    samples = (obj.get("extra") or {}).get("samples")
+    if not isinstance(samples, dict) or "serve_total_ms" not in samples:
+        out.append("serve: v4 artifacts must carry extra.samples with a "
+                   "serve_total_ms list (the bootstrap-CI backing for "
+                   "the p99 gate rows)")
+    req = obj.get("requests")
+    if (isinstance(samples, dict)
+            and isinstance(samples.get("serve_total_ms"), list)
+            and isinstance(req, dict)
+            and isinstance(req.get("served"), int)):
+        n = len(samples["serve_total_ms"])
+        if req["served"] and not n:
+            out.append("serve: requests were served but "
+                       "extra.samples.serve_total_ms is empty — the "
+                       "latencies were measured, persist them")
     return out
 
 
@@ -1007,6 +1078,191 @@ def _validate_replay(obj: dict) -> list:
     return out
 
 
+def _validate_trace(obj: dict) -> list:
+    """The trace artifact contract (``TRACE_*.json``, obs.trace): CLOSED
+    trace books (every opened trace ends complete or reasoned-partial),
+    telescoping stage reconciliation under epsilon, per-class burn
+    arithmetic, and reconciliation against the driven serve run's
+    request book (``complete == served``, ``partial == rejected +
+    expired``) — the decomposition is only evidence if it covers every
+    request the serve books admitted."""
+    out: list = []
+    _require(obj, "run_id", str, "trace", out)
+    ver = _require(obj, "schema_version", int, "trace", out)
+    if ver is not None and ver not in KNOWN_TRACE_SCHEMA_VERSIONS:
+        out.append(
+            f"trace: unknown schema_version {ver} (this checker "
+            f"understands {list(KNOWN_TRACE_SCHEMA_VERSIONS)}) — the "
+            "artifact is from a different era of the code; do not "
+            "half-parse it")
+        return out
+    out += _validate_record(obj, kind="trace")
+
+    books = _require(obj, "books", dict, "trace", out)
+    if books is not None:
+        for k in ("opened", "complete", "partial"):
+            v = books.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"trace: books.{k} must be a non-negative int "
+                           "(the closed trace books are the contract)")
+                books = None
+                break
+    if books is not None:
+        if books["complete"] + books["partial"] != books["opened"]:
+            out.append(
+                f"trace: books broken — complete {books['complete']} + "
+                f"partial {books['partial']} = "
+                f"{books['complete'] + books['partial']} != opened "
+                f"{books['opened']} (a request's trace never closed)")
+        reasons = books.get("partial_reasons")
+        if not isinstance(reasons, dict):
+            out.append("trace: books.partial_reasons must be a dict of "
+                       "reason -> count")
+        elif books["partial"] and sum(reasons.values()) != books["partial"]:
+            out.append(
+                f"trace: partial_reasons sum to {sum(reasons.values())} "
+                f"but partial is {books['partial']} — a partial trace "
+                "closed without a reason")
+
+    orphans = _require(obj, "orphans", dict, "trace", out)
+    if isinstance(orphans, dict):
+        oc = orphans.get("count")
+        if not isinstance(oc, int) or isinstance(oc, bool) or oc < 0:
+            out.append("trace: orphans.count must be a non-negative int")
+        reasons = orphans.get("reasons")
+        if not isinstance(reasons, dict):
+            out.append("trace: orphans.reasons must be a dict of "
+                       "reason -> count")
+        elif isinstance(oc, int) and sum(reasons.values()) != oc:
+            out.append(
+                f"trace: orphan reasons sum to {sum(reasons.values())} "
+                f"but count is {oc} — an orphan half was closed without "
+                "its reason")
+
+    stages = _require(obj, "stages", dict, "trace", out)
+    if isinstance(stages, dict):
+        if not stages and books and books.get("complete"):
+            out.append("trace: complete traces exist but the stage "
+                       "decomposition is empty")
+        for name, s in stages.items():
+            if not isinstance(s, dict):
+                out.append(f"trace: stages[{name!r}] must be a dict")
+                continue
+            c = s.get("count")
+            if not isinstance(c, int) or isinstance(c, bool) or c < 0:
+                out.append(f"trace: stages[{name!r}].count must be a "
+                           "non-negative int")
+            _validate_latency_side(
+                {q: s.get(q) for q in ("p50", "p95", "p99")},
+                f"stages.{name}", "trace", out)
+
+    rec = _require(obj, "reconcile", dict, "trace", out)
+    if isinstance(rec, dict):
+        for k in ("checked", "violations"):
+            v = rec.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"trace: reconcile.{k} must be a non-negative "
+                           "int")
+        eps = rec.get("epsilon_ms")
+        res = rec.get("max_abs_residual_ms")
+        for name, v in (("epsilon_ms", eps), ("max_abs_residual_ms", res)):
+            if not isinstance(v, _NUM) or isinstance(v, bool) or v < 0:
+                out.append(f"trace: reconcile.{name} must be a "
+                           "non-negative number")
+        if rec.get("violations"):
+            out.append(
+                f"trace: {rec['violations']} trace(s) whose stage walls "
+                "do not sum to the request wall within epsilon — the "
+                "decomposition lost track of where the time went; "
+                "invalid evidence, full stop")
+        if (isinstance(eps, _NUM) and isinstance(res, _NUM)
+                and not isinstance(eps, bool) and res > eps):
+            out.append(
+                f"trace: reconcile.max_abs_residual_ms {res} exceeds "
+                f"epsilon_ms {eps} but violations claims none — the "
+                "reconcile block disagrees with itself")
+
+    slowest = _require(obj, "slowest", list, "trace", out)
+    if isinstance(slowest, list) and isinstance(rec, dict):
+        eps = rec.get("epsilon_ms")
+        for i, e in enumerate(slowest):
+            if not isinstance(e, dict) or not isinstance(
+                    e.get("stages"), dict):
+                out.append(f"trace: slowest[{i}] must be a dict with a "
+                           "stages breakdown")
+                continue
+            wall = e.get("wall_ms")
+            if not isinstance(wall, _NUM) or isinstance(wall, bool):
+                out.append(f"trace: slowest[{i}].wall_ms must be a number")
+                continue
+            ssum = sum(v for v in e["stages"].values()
+                       if isinstance(v, _NUM) and not isinstance(v, bool))
+            if isinstance(eps, _NUM) and abs(ssum - wall) > eps:
+                out.append(
+                    f"trace: slowest[{i}] stage walls sum to {ssum:.3f} "
+                    f"ms but wall_ms is {wall:.3f} (off by more than "
+                    f"epsilon {eps} ms) — the critical path does not "
+                    "reconcile")
+
+    classes = _require(obj, "classes", dict, "trace", out)
+    if isinstance(classes, dict):
+        for name, book in classes.items():
+            if not isinstance(book, dict):
+                out.append(f"trace: classes[{name!r}] must be a dict")
+                continue
+            for k in ("count", "served", "violations"):
+                v = book.get(k)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    out.append(f"trace: classes[{name!r}].{k} must be a "
+                               "non-negative int")
+                    break
+            else:
+                if book["violations"] > book["served"]:
+                    out.append(f"trace: classes[{name!r}].violations "
+                               f"{book['violations']} > served "
+                               f"{book['served']}")
+                burn = book.get("budget_burn")
+                if burn is not None and (not isinstance(burn, _NUM)
+                                         or isinstance(burn, bool)
+                                         or burn < 0):
+                    out.append(f"trace: classes[{name!r}].budget_burn "
+                               "must be a non-negative number or null")
+
+    req = _require(obj, "requests", dict, "trace", out)
+    if isinstance(req, dict):
+        ok = True
+        for k in ("admitted", "served", "rejected", "expired"):
+            v = req.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"trace: requests.{k} must be a non-negative "
+                           "int (the serve book this trace run must "
+                           "reconcile against)")
+                ok = False
+        if ok and books is not None:
+            if books["complete"] != req["served"]:
+                out.append(
+                    f"trace: books.complete {books['complete']} != "
+                    f"requests.served {req['served']} — a served request "
+                    "has no complete trace (or a trace claims a serve "
+                    "that never happened)")
+            if books["partial"] != req["rejected"] + req["expired"]:
+                out.append(
+                    f"trace: books.partial {books['partial']} != "
+                    f"rejected {req['rejected']} + expired "
+                    f"{req['expired']} — the partial ledger does not "
+                    "cover every non-served request")
+
+    comp = obj.get("compile")
+    if comp is not None and not isinstance(comp, dict):
+        out.append("trace: compile must be a dict when present")
+    elif isinstance(comp, dict):
+        fc = comp.get("in_window_fresh_compiles")
+        if fc is not None and not isinstance(fc, (int, str)):
+            out.append("trace: compile.in_window_fresh_compiles must be "
+                       "an int count or a reason string")
+    return out
+
+
 def _validate_lint(obj: dict) -> list:
     """The lint report contract (`csmom lint --format json`): known
     schema version, the closed v2 key world, coherent findings shape,
@@ -1058,6 +1314,7 @@ def _validate_lint(obj: dict) -> list:
 _VALIDATORS = {
     "record": _validate_record,
     "lint": _validate_lint,
+    "trace": _validate_trace,
     "replay": _validate_replay,
     "serve": _validate_serve,
     "serve_pool": _validate_serve_pool,
@@ -1078,7 +1335,7 @@ def validate(obj, kind: str | None = None) -> list:
         return ["unrecognized artifact shape: none of the known key "
                 "signatures (record / driver_capture / multichip / phases "
                 "/ tpu_cache / telemetry / serve / serve_pool / replay / "
-                "lint) match"]
+                "trace / lint) match"]
     if kind not in _VALIDATORS:
         return [f"unknown artifact kind {kind!r}"]
     return _VALIDATORS[kind](obj)
@@ -1148,7 +1405,8 @@ def validate_tree(root: str, patterns=("BENCH_*.json", "MULTICHIP_*.json",
                                        "MULTIHOST_*.json", "HISTRANK_*.json",
                                        "PHASES_*.json", "TELEMETRY_*.json",
                                        "SERVE_*.json",
-                                       "REPLAY_*.json")) -> dict:
+                                       "REPLAY_*.json",
+                                       "TRACE_*.json")) -> dict:
     """``{relative_path: violations}`` for every committed artifact under
     ``root`` matching ``patterns`` (non-recursive: round artifacts land at
     the repo root by contract).  Paths with no violations are included

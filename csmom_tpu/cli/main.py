@@ -1674,9 +1674,11 @@ def build_parser() -> argparse.ArgumentParser:
     from csmom_tpu.cli.replay import register as register_replay
     from csmom_tpu.cli.serve import register as register_serve
     from csmom_tpu.cli.timeline import register as register_timeline
+    from csmom_tpu.cli.trace import register as register_trace
 
     register_rehearse(sub)
     register_timeline(sub)
+    register_trace(sub)
     register_ledger(sub)
     register_serve(sub)
     register_replay(sub)

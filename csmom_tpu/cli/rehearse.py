@@ -428,6 +428,42 @@ def _check_pool_worker_kill(r):
     return out
 
 
+def _check_trace_stitch_worker_kill(r):
+    """ISSUE 13: cross-process trace stitching under a mid-batch worker
+    SIGKILL.  The landed TRACE artifact must be schema-valid (closed
+    trace books, stage sums reconciling within epsilon, orphan reasons
+    summing to the orphan count), the killed worker's unstitchable
+    dispatches must appear as reason-closed ORPHAN halves, and the
+    surviving complete traces must carry BOTH halves of the stitch
+    (router-side transport + worker-side queue_wait/dispatch stages)."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve_pool")
+    tart = r.get("trace_artifact") or {}
+    out += [f"trace: {v}" for v in inv.validate(tart, "trace")]
+    out += list(r.get("trace_book_violations") or [])
+    orphans = tart.get("orphans") or {}
+    if not orphans.get("count"):
+        out.append("no orphan half closed — the SIGKILLed worker's "
+                   "in-flight dispatch left no reason-closed orphan "
+                   "(the kill missed, or the orphan leaked)")
+    elif not any("connection" in reason or "closed" in reason
+                 for reason in (orphans.get("reasons") or {})):
+        out.append(f"orphan reasons {list(orphans.get('reasons') or {})} "
+                   "never name the connection failure — the reason was "
+                   "lost in the close")
+    stages = tart.get("stages") or {}
+    for want in ("transport", "queue_wait", "dispatch"):
+        if want not in stages:
+            out.append(f"no {want!r} stage in the stitched decomposition "
+                       "— the worker half (or the router half) was "
+                       "never stitched in")
+    books = tart.get("books") or {}
+    if not books.get("complete"):
+        out.append("no complete trace — failover served nothing the "
+                   "trace layer could stitch")
+    return out
+
+
 def _check_pool_rolling_restart(r):
     """ISSUE 6: a rolling restart under load replaces every worker with
     zero in-window fresh compiles (warm-before-ready via the AOT cache)
@@ -568,6 +604,25 @@ def _serve_pool_scenarios():
             env={"mode": "kill", "wait_respawn": True,
                  "pool": {"n_workers": 2, "devices_per_worker": 2},
                  "load": {"schedule": "0.8x70", "seed": 15,
+                          "deadline_s": 3.0}},
+        ),
+        Scenario(
+            "trace-stitch-worker-kill", "serve-pool",
+            FaultPlan("trace-stitch-worker-kill", seed=32, faults=(
+                Fault(point="serve.dispatch", action="kill",
+                      after=probe_dispatches,
+                      max_fires=1, global_once=True),
+            )),
+            _check_trace_stitch_worker_kill, fast=True,
+            notes="ISSUE 13: the pool kill with request tracing ARMED — "
+                  "complete traces carry both stitched halves (router "
+                  "transport + worker stages), the dead worker's "
+                  "dispatches close as reason-carrying orphan halves, "
+                  "trace books balance and stage sums reconcile (trace "
+                  "schema)",
+            env={"mode": "kill", "trace": True, "wait_respawn": True,
+                 "pool": {"n_workers": 2},
+                 "load": {"schedule": "0.8x70", "seed": 16,
                           "deadline_s": 3.0}},
         ),
         Scenario(
@@ -1137,6 +1192,7 @@ def _run_serve_pool(scenario, box: str) -> dict:
     LoadConfig overrides.
     """
     from csmom_tpu.chaos import inject
+    from csmom_tpu.obs import trace as obs_trace
     from csmom_tpu.serve.loadgen import (
         LoadConfig,
         run_pool_loadgen,
@@ -1148,6 +1204,9 @@ def _run_serve_pool(scenario, box: str) -> dict:
     mode = scenario.env.get("mode", "load")
     saved = {k: os.environ.get(k) for k in (PLAN_ENV, "CSMOM_FAULT_STATE")}
     sup = None
+    trace_book = (obs_trace.arm_tracing(seed=scenario.plan.seed
+                                        if scenario.plan else 0)
+                  if scenario.env.get("trace") else None)
     result: dict = {"rc": 0, "stdout": "", "stderr": "",
                     "trailing": None, "headline_violations": [],
                     "sidecar_rows": 0}
@@ -1219,10 +1278,30 @@ def _run_serve_pool(scenario, box: str) -> dict:
             art = run_pool_loadgen(router, sup, load, concurrent=conc)
         if art is not None:
             write_artifact(box, art, prefix="SERVE_POOL")
+            if trace_book is not None:
+                # land the stitched trace evidence next to the pool
+                # artifact, the same reconciliation the committed
+                # TRACE_rNN.json family carries
+                result["trace_book_violations"] = \
+                    trace_book.invariant_violations()
+                tart = obs_trace.build_artifact(
+                    trace_book, load.run_id,
+                    requests={k: art["requests"][k]
+                              for k in ("admitted", "served", "rejected",
+                                        "expired")},
+                    fresh_compiles=(art.get("compile") or {}).get(
+                        "in_window_fresh_compiles"),
+                    platform=(art.get("extra") or {}).get("platform"),
+                    workload=(art.get("extra") or {}).get("workload"),
+                )
+                write_artifact(box, tart, prefix="TRACE")
+                result["trace_artifact"] = tart
         result["trailing"] = art
         result["artifact"] = art
         return result
     finally:
+        if trace_book is not None:
+            obs_trace.disarm_tracing()
         if sup is not None:
             sup.stop()
         for k, v in saved.items():
@@ -1484,12 +1563,20 @@ def cmd_rehearse(args) -> int:
                       result["stderr"][-400:].replace("\n", "\n           "))
 
     if telemetry_on:
+        # scratch sidecars land in the run-scoped scratch directory, not
+        # the cwd: a rehearse run launched from the repo root must never
+        # strew TELEMETRY_rehearse*.json next to committed round
+        # evidence (three once sat there).  `csmom timeline` searches
+        # the scratch dir, so the render pointer below still resolves.
+        out_dir = obs_tl.scratch_dir()
         sidecar = obs_tl.finish_and_write(
-            os.environ.get("CSMOM_TELEMETRY_DIR") or os.getcwd(),
+            out_dir,
             fallback_metrics=obs_metrics.snapshot(),
             overwrite=not operator_armed,
         )
-        print(f"\ntelemetry: {sidecar} (render with `csmom timeline "
+        loc = (os.path.join(out_dir, sidecar)
+               if sidecar.endswith(".json") else sidecar)
+        print(f"\ntelemetry: {loc} (render with `csmom timeline "
               f"{run_id}`)")
 
     print(f"\n{len(matrix) - failures}/{len(matrix)} scenarios green")

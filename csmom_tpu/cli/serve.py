@@ -319,6 +319,51 @@ def cmd_serve(args) -> int:
     return 1 if viols else 0
 
 
+def _arm_trace(args):
+    """Arm the request-trace book when --trace was asked for (obs.trace;
+    the disarmed path costs nothing, so this is the ONLY place the flag
+    is consulted)."""
+    if not getattr(args, "trace", False):
+        return None
+    from csmom_tpu.obs import trace as obs_trace
+
+    return obs_trace.arm_tracing(seed=args.seed)
+
+
+def _land_trace(args, book, run_id: str, art: dict, out_dir: str) -> int:
+    """Build, validate, and land TRACE_<run>.json from an armed book +
+    the serve artifact it must reconcile with.  Returns nonzero when the
+    trace books are broken — unbalanced tracing is invalid evidence."""
+    from csmom_tpu.chaos import invariants as inv
+    from csmom_tpu.obs import trace as obs_trace
+    from csmom_tpu.serve.loadgen import write_artifact
+
+    viols = book.invariant_violations()
+    trace_art = obs_trace.build_artifact(
+        book, run_id,
+        requests={k: art["requests"][k]
+                  for k in ("admitted", "served", "rejected", "expired")},
+        fresh_compiles=art["compile"]["in_window_fresh_compiles"],
+        platform=art["extra"].get("platform"),
+        workload=art["extra"].get("workload"),
+    )
+    path = write_artifact(out_dir, trace_art, prefix="TRACE")
+    books = trace_art["books"]
+    print(f"\ntrace books: opened {books['opened']} = complete "
+          f"{books['complete']} + partial {books['partial']}; orphan "
+          f"halves {trace_art['orphans']['count']}; max stage-sum "
+          f"residual {trace_art['reconcile']['max_abs_residual_ms']} ms")
+    print(f"trace artifact: {path} (render with `csmom trace {run_id}`)")
+    obs_trace.disarm_tracing()
+    schema = inv.validate_file(path)
+    if viols or schema:
+        print("TRACE INVALID:", file=sys.stderr)
+        for v in viols + schema:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _cmd_loadgen_pool(args, schedule: str, run_id: str,
                       schedule_kind: str = "custom",
                       preset: dict | None = None) -> int:
@@ -363,9 +408,38 @@ def _cmd_loadgen_pool(args, schedule: str, run_id: str,
                         else args.deadline_ms / 1e3),
             run_id=run_id,
         )
+        trace_book = _arm_trace(args)
+        concurrent = None
+        kill_after = getattr(args, "kill_worker_after", 0.0) or 0.0
+        if kill_after > 0:
+            # the mid-run worker SIGKILL (the trace round's rehearsed
+            # fault, on demand): kill one worker, then wait for its
+            # replacement to demonstrate ready so the artifact is built
+            # from a settled fleet — run_pool_loadgen's `concurrent`
+            # contract
+            import time as _time
+
+            from csmom_tpu.utils.deadline import mono_now_s
+
+            def concurrent():
+                _time.sleep(kill_after)
+                victim = sup.handles[0].worker_id
+                print(f"  [chaos] SIGKILL worker {victim} "
+                      f"({kill_after:g}s into the run)")
+                sup.kill_worker(victim)
+                give_up = mono_now_s() + 60.0
+                while mono_now_s() < give_up:
+                    if any(h.generation >= 1 and h.state == "ready"
+                           for h in sup.handles):
+                        return
+                    _time.sleep(0.05)
+
         print(f"offering (pool): schedule {schedule} (seed {load.seed}, "
-              f"deadline {load.deadline_s}s) ...")
-        art = run_pool_loadgen(router, sup, load)
+              f"deadline {load.deadline_s}s"
+              + (", trace armed" if trace_book is not None else "")
+              + (f", worker kill @{kill_after:g}s" if kill_after else "")
+              + ") ...")
+        art = run_pool_loadgen(router, sup, load, concurrent=concurrent)
     finally:
         # a Ctrl-C or a loadgen failure must not leak N live worker
         # processes — they are independent of this CLI's lifetime
@@ -395,6 +469,9 @@ def _cmd_loadgen_pool(args, schedule: str, run_id: str,
           f"{art['compile']['in_window_fresh_compiles']!r}")
     print(f"artifact: {path}")
 
+    rc = 0
+    if trace_book is not None:
+        rc = _land_trace(args, trace_book, run_id, art, out_dir)
     viols = inv.validate_file(path)
     if viols:
         print("ARTIFACT INVALID:", file=sys.stderr)
@@ -408,7 +485,7 @@ def _cmd_loadgen_pool(args, schedule: str, run_id: str,
               "the AOT cache; rerun with --allow-fresh-compiles to land "
               "anyway", file=sys.stderr)
         return 1
-    return 0
+    return rc
 
 
 def cmd_loadgen(args) -> int:
@@ -460,6 +537,7 @@ def cmd_loadgen(args) -> int:
         run_id=run_id,
         **preset,
     )
+    trace_book = _arm_trace(args)
     print(f"offering: schedule {schedule_kind} = {schedule} (seed "
           f"{load.seed}, deadline "
           f"{'class budgets' if load.use_class_deadlines else load.deadline_s}"
@@ -504,6 +582,9 @@ def cmd_loadgen(args) -> int:
           f"{art['compile']['in_window_fresh_compiles']}")
     print(f"artifact: {path}")
 
+    rc = 0
+    if trace_book is not None:
+        rc = _land_trace(args, trace_book, run_id, art, out_dir)
     viols = inv.validate_file(path)
     if viols:
         print("ARTIFACT INVALID:", file=sys.stderr)
@@ -517,7 +598,7 @@ def cmd_loadgen(args) -> int:
               "warmup bug); rerun with --allow-fresh-compiles to land "
               "anyway", file=sys.stderr)
         return 1
-    return 0
+    return rc
 
 
 def _common_flags(sp) -> None:
@@ -617,6 +698,20 @@ def register(sub) -> None:
                          "evidence must be rNN; anything else is "
                          "scratch and gitignored)")
     lg.add_argument("--out", help="artifact directory (default: cwd)")
+    lg.add_argument("--trace", action="store_true",
+                    help="arm per-request tracing (obs.trace) and land "
+                         "TRACE_<run-id>.json next to the serve artifact: "
+                         "telescoping per-stage walls, closed trace "
+                         "books, orphan halves reason-closed; render "
+                         "with `csmom trace <run-id>`")
+    lg.add_argument("--kill-worker-after", dest="kill_worker_after",
+                    type=float, default=0.0, metavar="SEC",
+                    help="pool mode: SIGKILL one worker SEC seconds into "
+                         "the run (the rehearsed mid-batch death, on "
+                         "demand — the router fails over, the trace "
+                         "book closes the orphan halves with reason, "
+                         "and the artifact is built only after the "
+                         "replacement is ready; 0 = no kill)")
     lg.add_argument("--allow-fresh-compiles", dest="allow_fresh_compiles",
                     action="store_true",
                     help="land the artifact even when the serving window "

@@ -24,16 +24,18 @@ import sys
 from csmom_tpu.chaos import invariants as inv
 from csmom_tpu.obs import timeline as tl
 
-_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-
 
 def _locate(run: str) -> str | None:
-    """Resolve a run argument to a sidecar/event-stream path."""
+    """Resolve a run argument to a sidecar/event-stream path.  Search
+    order is ``obs.timeline.sidecar_search_roots`` — the one list shared
+    with ``csmom trace``: CSMOM_TELEMETRY_DIR override, then cwd and
+    repo root (committed round sidecars), each with its
+    ``.csmom_scratch`` scratch directory (regenerated rehearse/smoke
+    sidecars land there — see ``obs.timeline.scratch_dir``)."""
     if os.path.isfile(run):
         return run
     hits: list = []
-    for root in (os.getcwd(), _REPO):
+    for root in tl.sidecar_search_roots():
         hits += sorted(glob.glob(os.path.join(root, f"TELEMETRY_*{run}*.json")))
         hits += sorted(glob.glob(os.path.join(root, f"TELEMETRY_{run}")))
     return hits[0] if hits else None

@@ -21,6 +21,13 @@ pipeline instead of an RPC fleet:
   compile, row, land, other) that ``chaos.invariants`` schema-validates
   like every other committed artifact, and renders it as a text flame
   summary (``csmom timeline <run>``).
+- :mod:`~csmom_tpu.obs.trace` — PER-REQUEST tracing across the serving
+  fabric: a trace context minted at admission and threaded through the
+  queue, batcher, engine dispatch, and across the router→worker process
+  boundary (stitchable span halves over ``serve/proto.py``); telescoping
+  stage clocks whose sum reconciles with the request wall by schema;
+  closed trace books landing as ``TRACE_<run>.json`` (``csmom trace``
+  renders the decomposition).
 - :mod:`~csmom_tpu.obs.memstats` — the device-memory axis: per-shape
   ``compiled.memory_analysis()`` bytes captured during the AOT pass,
   folded into metrics snapshots (hence the sidecar) and the warmup
@@ -49,7 +56,15 @@ and an armed one pays the ~1 s package import once, before its first
 probe — never inside a measured interval.
 """
 
-from csmom_tpu.obs import ledger, memstats, metrics, regress, spans, timeline
+from csmom_tpu.obs import (
+    ledger,
+    memstats,
+    metrics,
+    regress,
+    spans,
+    timeline,
+    trace,
+)
 from csmom_tpu.obs.spans import (
     arm,
     arm_from_env,
@@ -74,4 +89,5 @@ __all__ = [
     "span",
     "spans",
     "timeline",
+    "trace",
 ]

@@ -55,6 +55,7 @@ DEFAULT_PATTERNS = (
     "TELEMETRY_*.json",
     "SERVE_*.json",
     "REPLAY_*.json",
+    "TRACE_*.json",
 )
 
 _RUN_RE = re.compile(r"_r(\d+)")
@@ -71,7 +72,7 @@ def _scratch_note(basename: str) -> str | None:
     still ingests — flagged as a variant, never gate-eligible."""
     if basename == "BENCH_TPU_LAST.json":
         return "per-machine TPU session cache, not round evidence: skipped"
-    if (basename.startswith(("TELEMETRY_", "SERVE_", "REPLAY_"))
+    if (basename.startswith(("TELEMETRY_", "SERVE_", "REPLAY_", "TRACE_"))
             and not inv.committable_sidecar(basename)
             and run_of(basename)[0] is None):
         return ("scratch sidecar (uncommittable name, no round id), not "
@@ -207,28 +208,19 @@ def _bench_rows(obj: dict, run: str, num: int, variant, source: str) -> list:
     # platform string is the honest default, not a fabricated kind
     device_kind = extra.get("device_kind") or platform
     flags = _flags(obj, variant)
-    samples = extra.get("samples") if isinstance(extra.get("samples"),
-                                                 dict) else {}
+    samples = _sample_map(extra)
     rows = []
 
     def add(metric, value, unit, direction, workload_field):
         v = _num(value)
         if v is None:
             return  # unmeasured legs carry reason strings, not numbers
-        raw = samples.get(metric)
-        # numeric entries only (same defense as _num): a damaged record
-        # smuggling null/strings into a sample list must degrade to
-        # fewer samples, never take ingest_file's no-raise contract down
-        clean = tuple(
-            float(s) for s in raw
-            if isinstance(s, (int, float)) and not isinstance(s, bool)
-        ) if isinstance(raw, list) else ()
         rows.append(Row(
             run=run, run_num=num, metric=metric, value=v, unit=unit,
             direction=direction, platform=platform,
             device_kind=device_kind,
             workload=extra.get(workload_field), source=source,
-            samples=clean,
+            samples=samples.get(metric, ()),
             flags=flags,
         ))
 
@@ -296,6 +288,22 @@ def _telemetry_rows(obj: dict, run: str, num: int, variant,
     return rows
 
 
+def _sample_map(extra: dict) -> dict:
+    """``extra.samples`` as {key: tuple-of-floats}, numeric entries only
+    (the same defense as bench's sample ingestion: a damaged list
+    degrades to fewer samples, never a raise)."""
+    raw = extra.get("samples")
+    if not isinstance(raw, dict):
+        return {}
+    out = {}
+    for key, vals in raw.items():
+        if isinstance(vals, list):
+            out[key] = tuple(
+                float(v) for v in vals
+                if isinstance(v, (int, float)) and not isinstance(v, bool))
+    return out
+
+
 def _serve_rows(obj: dict, run: str, num: int, variant,
                 source: str) -> list:
     """Rows from a SERVE artifact: the online workload's trajectory.
@@ -324,6 +332,8 @@ def _serve_rows(obj: dict, run: str, num: int, variant,
     flags = _flags(obj, variant)
     base = dict(run=run, run_num=num, source=source, platform=platform,
                 device_kind=device_kind, workload=workload)
+    samples = _sample_map(extra)
+    total_samples = samples.get("serve_total_ms", ())
     rows = []
     v = _num(obj.get("value"))
     if v is not None:
@@ -344,7 +354,8 @@ def _serve_rows(obj: dict, run: str, num: int, variant,
             pv = _num(total.get(q))
             if pv is not None:
                 rows.append(Row(metric=f"serve_{q}_ms", value=pv, unit="ms",
-                                direction="lower", flags=flags, **base))
+                                direction="lower", flags=flags,
+                                samples=total_samples, **base))
         if (obj.get("offered") or {}).get("schedule_kind") == "bursty":
             pv = _num(total.get("p99"))
             if pv is not None:
@@ -353,7 +364,8 @@ def _serve_rows(obj: dict, run: str, num: int, variant,
                 # what it is — tail latency under bursty load
                 rows.append(Row(metric="serve_p99_under_burst_ms",
                                 value=pv, unit="ms", direction="lower",
-                                flags=flags, **base))
+                                flags=flags, samples=total_samples,
+                                **base))
     cache = obj.get("cache")
     if isinstance(cache, dict) and cache.get("enabled", True):
         hr = _num(cache.get("hit_rate"))
@@ -370,6 +382,7 @@ def _serve_rows(obj: dict, run: str, num: int, variant,
             if pv is not None:
                 rows.append(Row(metric=f"serve_{name}_p99_ms", value=pv,
                                 unit="ms", direction="lower", flags=flags,
+                                samples=samples.get(f"class:{name}", ()),
                                 **base))
     # v3 (ISSUE 9): per-ENDPOINT rows.  Metric keys derive from the
     # artifact's endpoint names — which the schema validator pins to the
@@ -385,6 +398,7 @@ def _serve_rows(obj: dict, run: str, num: int, variant,
             if pv is not None:
                 rows.append(Row(metric=f"serve_ep_{name}_p99_ms", value=pv,
                                 unit="ms", direction="lower", flags=flags,
+                                samples=samples.get(f"ep:{name}", ()),
                                 **base))
             sv = _num(book.get("served"))
             if sv is not None:
@@ -446,13 +460,15 @@ def _serve_pool_rows(obj: dict, run: str, num: int, variant,
                         unit="req/s", direction="higher",
                         **dict(base, flags=_flags(obj, variant,
                                                   info=True))))
+    pool_samples = _sample_map(extra).get("serve_pool_total_ms", ())
     total = (obj.get("latency_ms") or {}).get("total")
     if isinstance(total, dict):
         for q in ("p50", "p95", "p99"):
             pv = _num(total.get(q))
             if pv is not None:
                 rows.append(Row(metric=f"serve_pool_{q}_ms", value=pv,
-                                unit="ms", direction="lower", **base))
+                                unit="ms", direction="lower",
+                                **dict(base, samples=pool_samples)))
     av = _num(obj.get("availability"))
     if av is not None:
         rows.append(Row(metric="serve_pool_availability", value=av,
@@ -465,6 +481,69 @@ def _serve_pool_rows(obj: dict, run: str, num: int, variant,
     if fc is not None:
         rows.append(Row(metric="serve_pool_in_window_fresh_compiles",
                         value=fc, unit="compiles", direction="lower",
+                        **base))
+    return rows
+
+
+def _trace_rows(obj: dict, run: str, num: int, variant,
+                source: str) -> list:
+    """Rows from a TRACE artifact: the request-path decomposition's
+    trajectory.
+
+    Per-stage p99s (``trace_stage_<stage>_p99_ms``, lower) are the gate
+    axes — a regression in ONE stage names its layer (queue_wait = the
+    admission tier, dispatch = the engine, transport = the wire) instead
+    of smearing across an end-to-end p99.  Per-class SLO error-budget
+    burn rates (``serve_<class>_budget_burn``, lower — obs.metrics.
+    budget_burn) gate too: a class burning its error budget faster fails
+    the PR, not the postmortem.  Books/orphan totals ride as info (their
+    counts track the workload, not code quality)."""
+    extra = obj.get("extra") or {}
+    platform = extra.get("platform")
+    device_kind = extra.get("device_kind") or platform
+    workload = extra.get("workload")
+    flags = _flags(obj, variant)
+    samples = _sample_map(extra)
+    base = dict(run=run, run_num=num, source=source, platform=platform,
+                device_kind=device_kind, workload=workload)
+    rows = []
+    stages = obj.get("stages")
+    if isinstance(stages, dict):
+        for stage, s in sorted(stages.items()):
+            if not isinstance(s, dict):
+                continue
+            pv = _num(s.get("p99"))
+            if pv is not None:
+                metric = f"trace_stage_{stage}_p99_ms"
+                rows.append(Row(metric=metric, value=pv, unit="ms",
+                                direction="lower", flags=flags,
+                                samples=samples.get(metric, ()), **base))
+    classes = obj.get("classes")
+    if isinstance(classes, dict):
+        for name, book in sorted(classes.items()):
+            if not isinstance(book, dict):
+                continue
+            burn = _num(book.get("budget_burn"))
+            if burn is not None:
+                rows.append(Row(metric=f"serve_{name}_budget_burn",
+                                value=burn, unit="burn",
+                                direction="lower", flags=flags, **base))
+    books = obj.get("books")
+    if isinstance(books, dict):
+        cv = _num(books.get("complete"))
+        if cv is not None:
+            rows.append(Row(metric="trace_complete_traces", value=cv,
+                            unit="traces", direction="higher",
+                            flags=_flags(obj, variant, info=True), **base))
+    oc = _num((obj.get("orphans") or {}).get("count"))
+    if oc is not None:
+        rows.append(Row(metric="trace_orphan_halves", value=oc,
+                        unit="halves", direction="lower",
+                        flags=_flags(obj, variant, info=True), **base))
+    fc = _num((obj.get("compile") or {}).get("in_window_fresh_compiles"))
+    if fc is not None:
+        rows.append(Row(metric="trace_in_window_fresh_compiles", value=fc,
+                        unit="compiles", direction="lower", flags=flags,
                         **base))
     return rows
 
@@ -589,6 +668,15 @@ def ingest_file(path: str, have_full_runs=frozenset()) -> tuple:
         return [], [{"source": source,
                      "note": "record artifact with no numeric value axis: "
                              "present but contributes no trajectory rows"}]
+    if kind == "trace":
+        ver = obj.get("schema_version")
+        if ver not in inv.KNOWN_TRACE_SCHEMA_VERSIONS:
+            return [], [{"source": source,
+                         "note": f"unknown trace schema_version {ver!r} "
+                                 f"(reader understands "
+                                 f"{list(inv.KNOWN_TRACE_SCHEMA_VERSIONS)}"
+                                 "): not half-parsed into rows"}]
+        return _trace_rows(obj, run, num, variant, source), []
     if kind == "replay":
         ver = obj.get("schema_version")
         if ver not in inv.KNOWN_REPLAY_SCHEMA_VERSIONS:

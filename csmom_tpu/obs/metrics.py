@@ -19,12 +19,14 @@ jax.monitoring listener state, both read lazily so a jax-free process
 
 from __future__ import annotations
 
+import math
 import sys
 import threading
 
 from csmom_tpu.obs import spans as _spans
 
-__all__ = ["counter", "gauge", "histogram", "snapshot", "reset"]
+__all__ = ["budget_burn", "counter", "gauge", "histogram", "snapshot",
+           "reset"]
 
 _LOCK = threading.Lock()
 _REGISTRY: dict = {}  # name -> metric handle
@@ -63,9 +65,28 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming summary of observations: count / sum / min / max."""
+    """Streaming summary of observations with bounded log-bucket
+    quantile estimation — p50/p95/p99 with NO per-sample storage.
 
-    __slots__ = ("name", "count", "total", "min", "max")
+    Buckets are geometric with ratio ``2**0.25`` (four per doubling)
+    spanning [2^-20, 2^20) ≈ [1 µs, 1 M] in whatever unit the caller
+    observes, with one underflow and one overflow bucket — 162 ints,
+    allocated ONCE at registration.  A quantile answer is the geometric
+    midpoint of the bucket holding that rank, so the relative error is
+    bounded by the bucket ratio (≈ ±9%) — tight enough for a live tail
+    snapshot; the artifact pipeline keeps exact reservoirs where a gate
+    needs them.  The disarmed fast path is unchanged: one global load,
+    one compare, return.
+    """
+
+    # four buckets per doubling across 2^[-20, 20): index 0 = underflow
+    # (v < 2^-20, incl. zero/negative), index -1 = overflow
+    _LOG_MIN = -20
+    _LOG_MAX = 20
+    _PER_DOUBLING = 4
+    _N_BUCKETS = (_LOG_MAX - _LOG_MIN) * _PER_DOUBLING + 2
+
+    __slots__ = ("name", "count", "total", "min", "max", "buckets")
 
     def __init__(self, name: str):
         self.name = name
@@ -73,6 +94,24 @@ class Histogram:
         self.total = 0.0
         self.min = None
         self.max = None
+        self.buckets = [0] * self._N_BUCKETS
+
+    def _index(self, v: float) -> int:
+        if v < 2.0 ** self._LOG_MIN:
+            return 0
+        i = int((math.log2(v) - self._LOG_MIN) * self._PER_DOUBLING) + 1
+        return min(i, self._N_BUCKETS - 1)
+
+    def _bucket_value(self, i: int) -> float:
+        """The geometric midpoint of bucket ``i`` (edges for the under/
+        overflow buckets — an out-of-range estimate must not extrapolate
+        past what was observable)."""
+        if i <= 0:
+            return 2.0 ** self._LOG_MIN
+        if i >= self._N_BUCKETS - 1:
+            return 2.0 ** self._LOG_MAX
+        lo = self._LOG_MIN + (i - 1) / self._PER_DOUBLING
+        return 2.0 ** (lo + 0.5 / self._PER_DOUBLING)
 
     def observe(self, v: float) -> None:
         if _spans._COLLECTOR is None:
@@ -82,15 +121,40 @@ class Histogram:
             self.total += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
+            self.buckets[self._index(v)] += 1
+
+    def quantile(self, q: float) -> float | None:
+        """Nearest-rank quantile estimate from the log buckets (None
+        until something was observed).  Clamped into [min, max] so a
+        one-sample histogram answers that sample, not a bucket edge.
+
+        Lock-free read, like ``summary()`` always was: ``snapshot()``
+        calls this while holding the registry lock (which is NOT
+        reentrant), and a torn read costs one snapshot a stale count,
+        never a wrong bucket."""
+        if not self.count:
+            return None
+        rank = max(1, math.ceil(q * self.count))
+        acc = 0
+        for i, n in enumerate(self.buckets):
+            acc += n
+            if acc >= rank:
+                est = self._bucket_value(i)
+                return max(self.min, min(self.max, est))
+        return self.max
 
     def summary(self) -> dict:
-        return {
+        out = {
             "count": self.count,
             "sum": round(self.total, 6),
             "min": self.min,
             "max": self.max,
             "mean": round(self.total / self.count, 6) if self.count else None,
         }
+        for name, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            v = self.quantile(q)
+            out[name] = None if v is None else round(v, 6)
+        return out
 
 
 def _get(name: str, cls):
@@ -116,6 +180,33 @@ def gauge(name: str) -> Gauge:
 
 def histogram(name: str) -> Histogram:
     return _get(name, Histogram)
+
+
+def budget_burn(n_served: int, n_violations: int,
+                slo_target: float = 0.99) -> float | None:
+    """Per-class SLO error-budget burn rate.
+
+    The class's budget promise is an SLO: ``slo_target`` of served
+    requests finish inside the class deadline budget.  The error budget
+    is the allowed violation fraction (``1 - slo_target``), and the burn
+    rate is observed violations over allowance::
+
+        burn = (n_violations / n_served) / (1 - slo_target)
+
+    1.0 means the run consumed its error budget exactly; under 1.0 is
+    headroom; over 1.0 is an SLO breach scaled by how hard (burn 2.0 =
+    violating at twice the allowed rate).  The ledger ingests these as
+    ``serve_<class>_budget_burn`` rows (lower is better), so a class
+    that starts burning its budget fails the PR gate, not the
+    postmortem.  None when nothing was served — "no traffic" must never
+    be spelled "no burn".
+    """
+    if n_served <= 0:
+        return None
+    allowed = 1.0 - float(slo_target)
+    if allowed <= 0:
+        raise ValueError(f"slo_target must be < 1, got {slo_target}")
+    return round((n_violations / n_served) / allowed, 4)
 
 
 def reset() -> None:

@@ -40,9 +40,16 @@ __all__ = [
     "phase_of",
     "read_events",
     "render",
+    "scratch_dir",
     "sidecar_name",
     "write_sidecar",
 ]
+
+# regenerated (uncommittable) sidecars land here instead of the cwd —
+# three TELEMETRY_rehearse*.json once sat at the repo root because every
+# rehearse run dropped its sidecar wherever it was launched from.  The
+# directory is gitignored as a whole; `csmom timeline` searches it.
+SCRATCH_DIRNAME = ".csmom_scratch"
 
 SCHEMA_VERSION = 1
 
@@ -222,6 +229,40 @@ def assemble(events: list, run_id: str | None = None,
         "metrics": metrics if metrics is not None else
         "not captured: no metrics snapshot in this run's event stream",
     }
+
+
+def sidecar_search_roots(explicit_root: str | None = None) -> list:
+    """Sidecar resolution order shared by ``csmom timeline`` and
+    ``csmom trace`` (one list, so the two commands can never drift): an
+    explicit ``--root`` wins outright; otherwise the
+    ``CSMOM_TELEMETRY_DIR`` override first, then the cwd and the repo
+    checkout (committed round sidecars), each followed by its
+    ``.csmom_scratch`` scratch directory (regenerated rehearse/smoke
+    sidecars — see :func:`scratch_dir`)."""
+    if explicit_root:
+        return [explicit_root]
+    roots: list = []
+    env_dir = os.environ.get("CSMOM_TELEMETRY_DIR")
+    if env_dir:
+        roots.append(env_dir)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for base in (os.getcwd(), repo):
+        roots += [base, os.path.join(base, SCRATCH_DIRNAME)]
+    return roots
+
+
+def scratch_dir(base: str | None = None) -> str:
+    """The run-scoped scratch directory for regenerated sidecars
+    (rehearse/smoke runs — anything ``invariants.committable_sidecar``
+    refuses).  ``CSMOM_TELEMETRY_DIR`` overrides; the default is
+    ``<base or cwd>/.csmom_scratch``, created on demand.  Committed
+    round evidence (``*_rNN.json``) still lands at the repo root by
+    contract — this directory is for everything that must NOT."""
+    d = (os.environ.get("CSMOM_TELEMETRY_DIR")
+         or os.path.join(base or os.getcwd(), SCRATCH_DIRNAME))
+    os.makedirs(d, exist_ok=True)
+    return d
 
 
 def sidecar_name(run_id: str) -> str:

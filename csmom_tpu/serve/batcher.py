@@ -152,9 +152,19 @@ class Batcher:
             self.fire_reasons[reason] = self.fire_reasons.get(reason, 0) + 1
         checkpoint("serve.coalesce", kind=reqs[0].kind, n=len(reqs),
                    fire=reason)
+        for r in reqs:
+            # stage boundary: taken off the queue -> batch formed (the
+            # coalesce bookkeeping); padding time gets its own clock next
+            if r.trace is not None:
+                r.trace.mark("coalesce").set(fire_reason=reason,
+                                             batch_n=len(reqs))
         try:
             mb = self.pad(reqs)
             mb.fire_reason = reason
+            for r in reqs:
+                if r.trace is not None:
+                    r.trace.mark("pad").set(
+                        bucket=f"{mb.batch_bucket}x{mb.asset_bucket}")
             return mb
         except Exception as e:
             metrics.counter("serve.pad_failures").inc()

@@ -206,6 +206,19 @@ class MeshJaxEngine(JaxEngine):
             kind, self.lookback, self.skip, self.n_bins, self.mode,
             devices=self._devices)
 
+    def dispatch_shards(self, kind: str, batch_bucket: int,
+                        asset_bucket: int) -> tuple:
+        """``(devices, shards)`` for one bucket dispatch — the trace
+        layer's per-dispatch mesh attribution (obs.trace).  XLA executes
+        a sharded dispatch as ONE program, so the shard count is an
+        attribute of the dispatch stage, not a separable wall; recording
+        it per trace is what lets the decomposition CLI say which tails
+        rode a partial split (a bucket axis that only divides 4 ways on
+        8 devices)."""
+        entry = self._fn(kind)
+        return entry.n_devices, entry.shards_for_shape(batch_bucket,
+                                                       asset_bucket)
+
     def mesh_info(self, spec=None) -> dict:
         """The topology evidence the SERVE artifact records: device
         count + each endpoint's axis placement and per-bucket shard

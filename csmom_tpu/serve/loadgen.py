@@ -25,7 +25,7 @@ result cache sees repeats), and bump the panel version mid-run (so
 cache invalidation is demonstrated inside the same artifact, with zero
 stale hits as a schema rule).
 
-The run lands as ``SERVE_<run>.json`` (schema v3): throughput headline
+The run lands as ``SERVE_<run>.json`` (schema v4): throughput headline
 PLUS ``offered_rps`` (so an offered-load-limited run is never misread
 as a saturation ceiling — the r11 footnote, now a field), request
 accounting globally, per SLO class AND per ENDPOINT (all closed by
@@ -34,12 +34,17 @@ name set must be registered engines — ISSUE 9), per-class latency
 percentiles against each class's budget, the cache book (hit rate,
 zero stale hits), p50/p95/p99 queue / service / total latency, the
 batch-size histogram with padding overhead and fire reasons, and the
-in-window fresh-compile count.  :mod:`csmom_tpu.obs.ledger` ingests
-these rows (``serve_throughput_rps``, ``serve_p99_ms``,
-``serve_cache_hit_rate``, per-class p99s, per-endpoint
-``serve_ep_<name>_p99_ms``, ``serve_p99_under_burst_ms`` for bursty
-runs), so serve performance joins the cross-run regression gate like
-every bench wall.
+in-window fresh-compile count.  v4 adds the SLO error-budget burn
+accounting (``classes.<name>.violations`` / ``budget_burn``, via
+:func:`csmom_tpu.obs.metrics.budget_burn`) and bounded per-request
+latency samples in ``extra.samples`` — both schema rules.
+:mod:`csmom_tpu.obs.ledger` ingests these rows
+(``serve_throughput_rps``, ``serve_p99_ms``, ``serve_cache_hit_rate``,
+per-class p99s, per-endpoint ``serve_ep_<name>_p99_ms``,
+``serve_p99_under_burst_ms`` for bursty runs — the p99 rows now carry
+their sample lists, so :mod:`csmom_tpu.obs.regress` backs verdicts with
+bootstrap CIs instead of degrading to point-delta), so serve
+performance joins the cross-run regression gate like every bench wall.
 
 Naming rule (the TELEMETRY rule, extended): only round artifacts
 (``SERVE_rNN.json``) are committable evidence; ``SERVE_smoke*.json`` /
@@ -67,8 +72,12 @@ __all__ = ["LoadConfig", "NAMED_SCHEDULES", "arrival_offsets",
            "synth_panel", "write_artifact"]
 
 # schema v3 (ISSUE 9): per-endpoint books + latency, endpoint set
-# validated against the engine registry by chaos/invariants
-SCHEMA_VERSION = 3
+# validated against the engine registry by chaos/invariants.  v4 (ISSUE
+# 13): per-class SLO error-budget burn accounting (violations +
+# budget_burn per class book) and bounded per-request latency samples in
+# extra.samples, both schema rules so the burn rows and the CI backing
+# can never silently vanish from committed evidence.
+SCHEMA_VERSION = 4
 POOL_SCHEMA_VERSION = 1
 
 # the r10/r11 default mixes, expressed as an SLO-class mix
@@ -257,6 +266,46 @@ def _boundary_sizes(spec, max_assets: int) -> list:
     return sorted(sizes) or [max_assets]
 
 
+# bounded per-request latency sample lists persisted into the artifact
+# (extra.samples): enough for obs.regress's block bootstrap to put a CI
+# behind every serve p99 row, small enough that a committed artifact
+# stays reviewable.  Deterministic: seeded index sample, chronological
+# order kept (the block bootstrap assumes consecutive samples share
+# state, exactly like bench reps).
+SAMPLE_CAP = 512
+CLASS_SAMPLE_CAP = 256
+
+
+def _bounded_samples(values_ms: list, cap: int, seed: int) -> list:
+    if len(values_ms) <= cap:
+        return [round(v, 4) for v in values_ms]
+    idx = sorted(random.Random(seed).sample(range(len(values_ms)), cap))
+    return [round(values_ms[i], 4) for i in idx]
+
+
+def _latency_samples(load: "LoadConfig", requests: list,
+                     scope_prefixes: bool = True) -> dict:
+    """``extra.samples`` for a serve artifact: total-latency ms per
+    request, globally plus per SLO class and per endpoint (scope-keyed,
+    so the ledger attaches each row its OWN distribution)."""
+    served = [r for r in requests
+              if r.state == "served" and r.total_s is not None]
+    out = {"serve_total_ms": _bounded_samples(
+        [1e3 * r.total_s for r in served], SAMPLE_CAP, load.seed)}
+    if not scope_prefixes:
+        return out
+    for name in sorted({r.priority for r in served}):
+        out[f"class:{name}"] = _bounded_samples(
+            [1e3 * r.total_s for r in served if r.priority == name],
+            CLASS_SAMPLE_CAP, load.seed + 1)
+    for kind in load.resolved_kinds():
+        mine = [1e3 * r.total_s for r in served if r.kind == kind]
+        if mine:
+            out[f"ep:{kind}"] = _bounded_samples(mine, CLASS_SAMPLE_CAP,
+                                                 load.seed + 2)
+    return out
+
+
 def _percentiles(samples: list) -> dict:
     """Nearest-rank p50/p95/p99 in milliseconds (None when unobserved).
 
@@ -354,6 +403,8 @@ def _class_blocks(service: SignalService, requests: list) -> dict:
     """The per-class books + measured latency vs budget.  ``within_budget``
     is the class's p99 promise judged against measurement: True/False
     once the class served anything, None when it never did."""
+    from csmom_tpu.obs.metrics import budget_burn
+
     stats = service.class_stats()
     out = {}
     for name, book in stats.items():
@@ -363,6 +414,9 @@ def _class_blocks(service: SignalService, requests: list) -> dict:
                             if r.total_s is not None])
         p99 = lat["p99"]
         budget = book.get("budget_ms")
+        violations = (sum(1 for r in served if r.total_s is not None
+                          and 1e3 * r.total_s > budget)
+                      if budget is not None else 0)
         out[name] = {
             **{k: book[k] for k in ("admitted", "served", "rejected",
                                     "expired", "rejected_quota")},
@@ -373,6 +427,12 @@ def _class_blocks(service: SignalService, requests: list) -> dict:
             "latency_ms": lat,
             "within_budget": (None if p99 is None or budget is None
                               else bool(p99 <= budget)),
+            # SLO error-budget accounting (obs.metrics.budget_burn):
+            # observed violation rate over the allowed rate at the 99%
+            # target — the serve_<class>_budget_burn ledger row's source
+            "violations": violations,
+            "budget_burn": (None if budget is None
+                            else budget_burn(len(served), violations)),
         }
     return out
 
@@ -443,6 +503,10 @@ def build_artifact(service: SignalService, load: LoadConfig,
         "capacity": service.config.capacity,
         "max_wait_ms": round(1e3 * service.config.max_wait_s, 3),
         "warm_report": service.warm_report,
+        # bounded per-request latency samples (chronological), scope-
+        # keyed: the ledger attaches these to the p99 rows so the gate
+        # gets bootstrap CIs instead of point-delta/suspect verdicts
+        "samples": _latency_samples(load, requests),
     }
     if mesh is not None:
         extra["mesh"] = mesh
@@ -644,6 +708,11 @@ def build_pool_artifact(router, supervisor, load: LoadConfig,
             "max_attempts": router.config.max_attempts,
         },
         "cache_version": summary["expect_cache_version"],
+        # same CI backing as the single-process artifact: bounded
+        # per-request total-latency samples for the pool p99 rows
+        "samples": {"serve_pool_total_ms": _bounded_samples(
+            [1e3 * r.total_s for r in served if r.total_s is not None],
+            SAMPLE_CAP, load.seed)},
     }
     if spec.name == "serve-smoke":
         extra["smoke"] = ("smoke-bucket pool run: pipeline-shaped, "

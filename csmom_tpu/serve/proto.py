@@ -25,6 +25,15 @@ Design constraints this encodes:
   monitor loop must stay importable in processes that never touch a
   device (the same split as ``serve/buckets.py``).
 
+Request tracing rides the header, not the framing: a ``score`` frame may
+carry a ``trace`` entry (trace id, endpoint, SLO class, panel version —
+identity only, never timestamps, so each process keeps its own clock and
+stitching works on durations), and the worker's reply then carries a
+``trace_half`` entry with its server-side stage chain.  The protocol
+itself is unchanged — untraced deployments serialize not one extra byte,
+and an old worker simply ignores the field (see
+:mod:`csmom_tpu.obs.trace` for the stitching contract).
+
 Ops the worker answers (see :mod:`csmom_tpu.serve.worker`):
 
 =========  ==================================================

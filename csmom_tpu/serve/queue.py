@@ -115,6 +115,12 @@ class Request:
     t_submit_s: float = 0.0
     t_dispatch_s: float | None = None
     t_done_s: float | None = None
+    # the request's trace context (obs.trace): None when tracing is off
+    # AND the request was built outside a service; the shared no-op
+    # singleton when a service minted it disarmed.  Call sites guard on
+    # None so bare test Requests cost nothing.
+    trace: object = dataclasses.field(default=None, repr=False,
+                                      compare=False)
     followers: list = dataclasses.field(default_factory=list, repr=False,
                                         compare=False)
     _done: threading.Event = dataclasses.field(
@@ -262,6 +268,8 @@ class AdmissionQueue:
                 metrics.counter("serve.rejected_quota").inc()
                 return req
             self._queues[cls.name].append(req)
+            if req.trace is not None:
+                req.trace.mark("admit")
             metrics.gauge("serve.queue_depth").set(self._depth_locked())
             self._nonempty.notify()
         return req
@@ -449,6 +457,8 @@ class AdmissionQueue:
             while q:
                 r = q.popleft()
                 if r.kind == kind and len(out) < max_n:
+                    if r.trace is not None:
+                        r.trace.mark("queue_wait")
                     out.append(r)
                 else:
                     keep.append(r)
@@ -483,6 +493,13 @@ class AdmissionQueue:
             self.expired += 1
         else:
             self.rejected += 1
+        if req.trace is not None:
+            # the trace closes inside the SAME exactly-once guard as the
+            # request: one complete (served) or one reasoned partial per
+            # admitted request — the closed-trace-books contract.  The
+            # residual auto-labels as the stage after the last mark
+            # (queued -> queue_wait, post-dispatch -> serialize).
+            req.trace.close(state, reason=req.error)
         req._done.set()
         # coalesced followers ride the leader's fate: served with the
         # same result, or rejected with the leader's outcome as reason.
@@ -525,6 +542,11 @@ class AdmissionQueue:
                     self.rejected += 1
                     self.rejected_coalesced += 1
                     self._bump_class_locked(f.priority, "rejected")
+                if f.trace is not None:
+                    # a follower never queued or dispatched: its whole
+                    # wall is the shared wait, labeled coalesce
+                    f.trace.set(coalesced=True).close(
+                        f.state, reason=f.error, stage="coalesce")
                 f.t_done_s = req.t_done_s
                 f._done.set()
         return True

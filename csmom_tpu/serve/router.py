@@ -84,6 +84,11 @@ class PoolRequest:
     attempts: int = 0
     t_submit_s: float = 0.0
     t_done_s: float | None = None
+    # the request's trace context (obs.trace; None = untraced).  The
+    # router owns the CLIENT half: route/transport/finalize stages plus
+    # whatever worker half the winning attempt brought home.
+    trace: object = dataclasses.field(default=None, repr=False,
+                                      compare=False)
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False, compare=False)
 
@@ -144,6 +149,7 @@ class Router:
         default)."""
         from csmom_tpu.chaos.inject import checkpoint
         from csmom_tpu.obs import metrics
+        from csmom_tpu.obs import trace as obs_trace
 
         values = np.asarray(values)
         mask = np.asarray(mask, dtype=bool)
@@ -155,10 +161,19 @@ class Router:
             priority = self.policy.resolve_name(priority)
         except ValueError:
             pass  # the worker's own door rejects unknown classes
+        budget_ms = None
+        try:
+            budget_ms = round(1e3 * self.policy.resolve(priority).deadline_s,
+                              3)
+        except ValueError:
+            pass
         req = PoolRequest(
             kind=kind, n_assets=n_assets, priority=priority,
             deadline_s=None if rel is None else now + rel, t_submit_s=now,
-            panel_version=panel_version)
+            panel_version=panel_version,
+            trace=obs_trace.begin(kind, priority,
+                                  panel_version=panel_version,
+                                  budget_ms=budget_ms))
         with self._lock:
             self.admitted += 1
             if priority in self.by_class:
@@ -338,26 +353,40 @@ class Router:
         wait_budget = rem if rem is not None else _NO_DEADLINE_ATTEMPT_S
         timeout = (self.config.connect_timeout_s + wait_budget
                    + _TERMINAL_GRACE_S)
+        header = {"op": "score", "kind": req.kind,
+                  "req_id": req.req_id, "priority": req.priority,
+                  "deadline_rel_s": rem,
+                  "panel_version": req.panel_version}
+        wire_trace = (req.trace.to_wire() if req.trace is not None
+                      else None)
+        if wire_trace is not None:
+            # the trace context crosses the process boundary in the
+            # frame header (identity only, never timestamps): the worker
+            # answers with its half, and the two stitch here
+            header["trace"] = wire_trace
+        t_attempt0 = mono_now_s()
         try:
             with span("pool.attempt", phase="row", kind=req.kind,
                       worker=worker.worker_id, hedge=is_hedge):
                 obj, arrays = proto.request(
-                    worker.socket_path,
-                    {"op": "score", "kind": req.kind,
-                     "req_id": req.req_id, "priority": req.priority,
-                     "deadline_rel_s": rem,
-                     "panel_version": req.panel_version},
+                    worker.socket_path, header,
                     arrays={"values": values, "mask": mask},
                     timeout_s=timeout)
         except (OSError, proto.ProtocolError) as e:
             with self._lock:
                 self.worker_conn_failures += 1
             metrics.counter("serve_pool.worker_conn_failures").inc()
-            failures.append(
-                f"{worker.worker_id}: connection failed "
-                f"({type(e).__name__}: {e})"[:160])
+            reason = (f"connection failed "
+                      f"({type(e).__name__}: {e})")[:160]
+            if req.trace is not None:
+                # a dispatch that will never report back: the worker died
+                # (the rehearsed SIGKILL) or reset — its half is an
+                # ORPHAN, closed here with the reason instead of leaking
+                req.trace.note_orphan(worker.worker_id, reason)
+            failures.append(f"{worker.worker_id}: {reason}")
             self._conclude_attempt(state)
             return
+        t_attempt1 = mono_now_s()
         resp_state = obj.get("state")
         if resp_state == "served":
             result = (obj.get("result_obj") if "result_obj" in obj
@@ -366,7 +395,10 @@ class Router:
                 result = np.asarray(result)[:req.n_assets]
             won = self._terminate(req, "served", result=result,
                                   worker_id=obj.get("worker_id"),
-                                  hedge_win=is_hedge)
+                                  hedge_win=is_hedge,
+                                  trace_half=obj.get("trace_half"),
+                                  attempt_window=(t_attempt0, t_attempt1,
+                                                  worker.worker_id))
             if won:
                 metrics.counter("serve_pool.served").inc()
             self._conclude_attempt(state)
@@ -389,7 +421,8 @@ class Router:
     def _terminate(self, req: PoolRequest, state: str, result=None,
                    error: str | None = None, worker_id: str | None = None,
                    infra: bool = False, unserveable: bool = False,
-                   hedge_win: bool = False) -> bool:
+                   hedge_win: bool = False, trace_half: dict | None = None,
+                   attempt_window: tuple | None = None) -> bool:
         """Exactly-once terminal transition; returns True iff this call
         won.  A losing ``served`` (the hedge pair both answered) counts
         ``duplicates_suppressed`` — the duplicate is EXPECTED under
@@ -429,6 +462,17 @@ class Router:
                     self.rejected_unserveable += 1
             if req.priority in self.by_class:
                 self.by_class[req.priority][state] += 1
+            if req.trace is not None:
+                # stitch + close inside the same exactly-once guard as
+                # the request: only the WINNING attempt's half and window
+                # reach the absorbed chain — a hedge loser's half can
+                # never corrupt the telescoping sum
+                if trace_half is not None and attempt_window is not None:
+                    t0a, t1a, wid = attempt_window
+                    req.trace.absorb_remote(trace_half, t0a, t1a,
+                                            worker_id=wid)
+                req.trace.close_routed(state, req.t_done_s,
+                                       reason=error)
             req._done.set()
         return True
 

@@ -197,7 +197,7 @@ class SignalService:
     def submit(self, kind: str, values, mask, priority: str = "interactive",
                deadline_s: float | None = None,
                panel_version: int | None = None,
-               cacheable: bool = True) -> Request:
+               cacheable: bool = True, trace_ctx=None) -> Request:
         """Submit one scoring request (panel ``[A, months]``).
 
         ``deadline_s`` is RELATIVE seconds from now (None = the SLO
@@ -207,8 +207,12 @@ class SignalService:
         door — terminal immediately, counted, never queued behind work
         it can only fail.  ``cacheable=False`` opts one request out of
         the result cache and coalescing (its dispatch is forced).
+        ``trace_ctx`` carries a wire-propagated trace context (the pool
+        worker path); without one, a context is minted here iff this
+        process's trace book is armed (obs.trace, zero-cost disarmed).
         """
         from csmom_tpu.obs import metrics
+        from csmom_tpu.obs import trace as obs_trace
 
         values = np.asarray(values)
         mask = np.asarray(mask, dtype=bool)
@@ -218,7 +222,9 @@ class SignalService:
         except ValueError as e:
             req = Request(kind=kind, values=values, mask=mask,
                           n_assets=n_assets,
-                          priority=self.policy.names()[0])
+                          priority=self.policy.names()[0],
+                          trace=trace_ctx if trace_ctx is not None
+                          else obs_trace.begin(kind, str(priority)))
             self.queue.reject_at_door(req, str(e))
             return req
         if deadline_s is not None:
@@ -232,6 +238,11 @@ class SignalService:
             priority=cls.name,
             deadline_s=None if rel is None else mono_now_s() + rel,
             panel_version=panel_version,
+            # minted BEFORE the door checks so a rejection is a reasoned
+            # partial trace, never a request that vanished untraced
+            trace=trace_ctx if trace_ctx is not None else obs_trace.begin(
+                kind, cls.name, panel_version=panel_version,
+                budget_ms=round(1e3 * cls.deadline_s, 3)),
         )
         if self._live_version_fn is not None and panel_version is not None:
             live = int(self._live_version_fn())
@@ -371,6 +382,25 @@ class SignalService:
                       b=mb.batch_bucket, a=mb.asset_bucket) as sp:
                 out = self.engine.score(mb.kind, mb.values, mb.mask)
                 sp.set(n=len(live))
+            # stamp the engine-wall boundary for every request BEFORE the
+            # fan-out loop, so one request's unpack/cache time is never
+            # attributed to a batchmate's dispatch stage.  Shard lookup
+            # and mark/set run only for LIVE contexts (`t.live` is False
+            # on the disarmed no-op singleton): a disarmed batch pays no
+            # registry resolution and allocates nothing here
+            shards = unresolved = object()
+            for _, r in live:
+                t = r.trace
+                if t is None or not t.live:
+                    continue
+                if shards is unresolved:
+                    shards = (self.engine.dispatch_shards(
+                        mb.kind, mb.batch_bucket, mb.asset_bucket)
+                        if hasattr(self.engine, "dispatch_shards")
+                        else None)
+                t.mark("dispatch")
+                if shards is not None:
+                    t.set(mesh_devices=shards[0], mesh_shards=shards[1])
             for b, r in live:
                 # per-asset vs summary unpacking is the registered
                 # engine's declaration, not a name special-case here
@@ -397,14 +427,19 @@ class SignalService:
                 self.queue.finish_rejected(r, reason, worker_crash=True)
                 self._release_key(r)
         finally:
+            from csmom_tpu.obs import trace as obs_trace
+
             self.batcher.note_service_wall(mono_now_s() - t_engine)
             used = sum(r.n_assets for _, r in live)
+            pad = mb.batch_bucket * mb.asset_bucket - used
             with self._state_lock:
                 self.n_batches += 1
                 k = str(len(live))
                 self.batch_size_hist[k] = self.batch_size_hist.get(k, 0) + 1
                 self._used_lanes += used
-                self._pad_lanes += mb.batch_bucket * mb.asset_bucket - used
+                self._pad_lanes += pad
+            obs_trace.note_batch(mb.kind, mb.batch_bucket, mb.asset_bucket,
+                                 used, pad, mb.fire_reason)
             metrics.histogram("serve.batch_size").observe(len(live))
 
     # ------------------------------------------------------------ reporting

@@ -260,11 +260,22 @@ class WorkerServer:
                     "worker_id": self.worker_id}, None
         rel = obj.get("deadline_rel_s")
         pv = obj.get("panel_version")
+        # a wire-carried trace context means the ROUTER is tracing this
+        # request: rebuild the server half here (even with no local book
+        # armed — the sampling decision propagates with the request, the
+        # Dapper way) so the reply can carry a stitchable stage chain
+        trace_ctx = None
+        wire_trace = obj.get("trace")
+        if isinstance(wire_trace, dict):
+            from csmom_tpu.obs.trace import TraceContext
+
+            trace_ctx = TraceContext.from_wire(wire_trace)
         req = self.service.submit(
             str(obj.get("kind")), arrays["values"], arrays["mask"],
             priority=str(obj.get("priority", "interactive")),
             deadline_s=float(rel) if rel is not None else None,
             panel_version=int(pv) if pv is not None else None,
+            trace_ctx=trace_ctx,
         )
         wait_s = (float(rel) + _TERMINAL_GRACE_S if rel is not None
                   else _NO_DEADLINE_WAIT_S)
@@ -285,6 +296,12 @@ class WorkerServer:
             # panel version every response was computed from
             "panel_version": req.panel_version,
         }
+        if trace_ctx is not None:
+            # the server half of the stitched trace: this worker's stage
+            # chain (closed by the service's terminal transition), sent
+            # back as plain JSON — a SIGKILL before this line is exactly
+            # the orphan half the router closes with reason
+            reply["trace_half"] = trace_ctx.half_record()
         out_arrays = None
         if req.state == "served":
             if isinstance(req.result, dict):

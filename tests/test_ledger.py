@@ -471,11 +471,12 @@ def test_histrank_multihost_records_are_info_never_gated():
     never the gate's default candidate.  SERVE and REPLAY rows are the
     deliberate exceptions: those families have their own schemas + known
     directions (throughput up, latency/staleness down), so their
-    unflagged rows DO gate."""
+    unflagged rows DO gate.  TRACE joins them in r17: per-stage p99s and
+    budget-burn rows are first-class gate rows by design."""
     L = ld.load(_REPO)
     other = [r for r in L.rows
              if not r.source.startswith(("BENCH", "TELEMETRY", "SERVE",
-                                         "REPLAY"))]
+                                         "REPLAY", "TRACE"))]
     assert other, "committed HISTRANK/MULTIHOST should yield info rows"
     assert all("info" in r.flags and not r.gate_eligible() for r in other)
     replay = [r for r in L.rows if r.source.startswith("REPLAY")]

@@ -1,0 +1,469 @@
+"""Request-path tracing (ISSUE 13): stage clocks, stitched halves,
+closed trace books, the TRACE artifact family, and the burn/quantile
+satellites.
+
+The contracts pinned here:
+
+- **zero-cost disarmed** (the obs/spans discipline): ``begin()`` returns
+  one shared no-op singleton, and the whole mint/mark/close path does no
+  allocation-visible work while no book is armed;
+- **telescoping stage clocks**: per-stage walls sum to each request wall
+  exactly (the artifact epsilon is rounding headroom, not slack);
+- **closed trace books**: every admitted request — served, rejected,
+  expired, cache-hit, coalesced — ends as exactly one complete trace or
+  one reasoned partial, reconciling with the serve request books;
+- **cross-process stitching under SIGKILL**: a real pool with a worker
+  killed mid-run still closes its books, the dead dispatches appear as
+  reason-carrying ORPHAN halves, and surviving traces carry both halves
+  (router transport + worker stages);
+- the ``trace`` artifact schema, its committable-sidecar naming rule,
+  and the ledger rows (per-stage p99s with CI-backing samples,
+  per-class budget-burn).
+"""
+
+import gc
+import json
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from csmom_tpu.chaos import invariants as inv
+from csmom_tpu.obs import metrics
+from csmom_tpu.obs import trace as obs_trace
+from csmom_tpu.serve.loadgen import (
+    LoadConfig,
+    run_loadgen,
+    run_pool_loadgen,
+    write_artifact,
+)
+from csmom_tpu.serve.service import ServeConfig, SignalService
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_book():
+    obs_trace.disarm_tracing()
+    yield
+    obs_trace.disarm_tracing()
+
+
+def _run_traced_loadgen(**load_kw):
+    book = obs_trace.arm_tracing(seed=1)
+    svc = SignalService(ServeConfig(profile="serve-smoke",
+                                    engine="stub")).start()
+    load = LoadConfig(run_id="trace_unit", **load_kw)
+    art = run_loadgen(svc, load)
+    obs_trace.disarm_tracing()
+    return book, art
+
+
+# ------------------------------------------------- disarmed = zero cost ----
+
+def test_disarmed_begin_is_a_shared_noop_singleton():
+    t1 = obs_trace.begin("momentum", "interactive")
+    t2 = obs_trace.begin("turnover", "bulk", panel_version=3)
+    assert t1 is t2  # no per-call object
+    # every method chains and does nothing
+    assert t1.mark("admit").set(x=1).close("served") is t1
+    assert t1.to_wire() is None
+    assert t1.half_record() is None
+    assert not obs_trace.tracing_armed()
+
+
+def test_disarmed_trace_calls_do_no_allocation_visible_work():
+    for _ in range(2000):  # warm every code path first
+        t = obs_trace.begin("momentum", "interactive")
+        t.mark("admit")
+        t.close("served")
+        obs_trace.note_batch("momentum", 4, 32, 10, 118, "window")
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(5000):
+        t = obs_trace.begin("momentum", "interactive")
+        t.mark("admit")
+        t.close("served")
+        obs_trace.note_batch("momentum", 4, 32, 10, 118, "window")
+    gc.collect()
+    grown = sys.getallocatedblocks() - before
+    assert grown < 50, (
+        f"disarmed trace calls allocated {grown} blocks over 5000 "
+        "iterations — the unarmed fast path must be allocation-free")
+
+
+# -------------------------------------------------- telescoping clocks ----
+
+def test_stage_walls_telescope_to_the_request_wall_exactly():
+    book = obs_trace.arm_tracing()
+    t = obs_trace.begin("momentum", "interactive", budget_ms=500.0)
+    t.mark("admit")
+    time.sleep(0.002)
+    t.mark("queue_wait")
+    t.mark("coalesce")
+    t.mark("pad")
+    time.sleep(0.001)
+    t.mark("dispatch")
+    t.close("served")
+    assert t.outcome == "served"
+    assert abs(sum(t.stage_durs_s.values()) - t.wall_s) < 1e-9, (
+        "telescoping marks must sum to the wall exactly — the epsilon "
+        "in the artifact is rounding headroom, not slack")
+    # the residual after the last mark auto-labels as the NEXT stage
+    assert "serialize" in t.stage_durs_s
+    assert book.complete == 1 and book.opened == 1
+    assert book.invariant_violations() == []
+
+
+def test_close_is_exactly_once_and_partials_need_reasons():
+    book = obs_trace.arm_tracing()
+    t = obs_trace.begin("momentum", "bulk")
+    t.close("rejected", reason="queue full")
+    t.close("served")  # must not move a terminal trace
+    assert t.outcome == "rejected"
+    assert book.partial == 1 and book.complete == 0
+    snap = book.snapshot()
+    assert snap["books"]["partial_reasons"] == {"queue full": 1}
+
+
+# ----------------------------------------------- in-process closed books ----
+
+def test_loadgen_trace_books_close_and_reconcile_with_serve_books():
+    """Every admitted request — including cache hits, coalesced
+    followers, quota rejections, expiries — yields exactly one closed
+    trace, and the trace books reconcile with the serve request books
+    (complete == served, partial == rejected + expired)."""
+    book, art = _run_traced_loadgen(
+        schedule="0.5x150", seed=7, deadline_s=0.05,
+        reuse_fraction=0.5, version_bumps=1)
+    req = art["requests"]
+    assert book.invariant_violations() == []
+    assert book.opened == req["admitted"]
+    assert book.complete == req["served"]
+    assert book.partial == req["rejected"] + req["expired"]
+    snap = book.snapshot()
+    assert snap["reconcile"]["violations"] == 0
+    assert snap["reconcile"]["max_abs_residual_ms"] <= obs_trace.EPSILON_MS
+    if book.partial:
+        assert sum(snap["books"]["partial_reasons"].values()) == book.partial
+
+
+def test_trace_artifact_validates_and_renders(tmp_path, capsys):
+    book, art = _run_traced_loadgen(schedule="0.4x80", seed=3,
+                                    deadline_s=2.0)
+    tart = obs_trace.build_artifact(
+        book, "trace_unit",
+        requests={k: art["requests"][k]
+                  for k in ("admitted", "served", "rejected", "expired")},
+        fresh_compiles=0, platform="stub", workload="unit")
+    assert inv.detect_kind(tart) == "trace"
+    assert inv.validate(tart) == []
+    path = write_artifact(str(tmp_path), tart, prefix="TRACE")
+    assert os.path.basename(path) == "TRACE_trace_unit.json"
+
+    # stage decomposition covers the whole in-process chain
+    for stage in ("admit", "queue_wait", "coalesce", "pad", "dispatch",
+                  "serialize"):
+        assert stage in tart["stages"], f"missing stage {stage}"
+    # per-stage CI backing rides in extra.samples, ledger-metric-keyed
+    assert tart["extra"]["samples"]["trace_stage_dispatch_p99_ms"]
+    # padding goodput is per (endpoint, bucket)
+    assert tart["padding"]
+    for bucket in tart["padding"].values():
+        assert bucket["batches"] >= 1 and bucket["fire_reasons"]
+
+    # the CLI renders it without violations
+    from csmom_tpu.cli.main import main
+
+    rc = main(["trace", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "per-stage decomposition" in out
+    assert "critical path" in out
+    assert "budget-burn" in out or "budget" in out
+
+
+def test_trace_validator_rejects_broken_books_and_residuals():
+    base = {
+        "kind": "trace", "schema_version": 1, "run_id": "x",
+        "metric": "trace_complete_traces", "value": 2, "unit": "traces",
+        "vs_baseline": 1.0,
+        "books": {"opened": 3, "complete": 2, "partial": 0,
+                  "partial_reasons": {}},
+        "orphans": {"count": 0, "reasons": {}},
+        "stages": {"dispatch": {"count": 2, "p50": 1.0, "p95": 2.0,
+                                "p99": 2.0, "max_ms": 2.0,
+                                "total_s": 0.003}},
+        "classes": {}, "slowest": [],
+        "reconcile": {"checked": 3, "violations": 0,
+                      "max_abs_residual_ms": 0.0, "epsilon_ms": 2.0},
+        "requests": {"admitted": 3, "served": 2, "rejected": 1,
+                     "expired": 0},
+    }
+    # books don't close: opened != complete + partial
+    viols = inv.validate(base, "trace")
+    assert any("books broken" in v for v in viols)
+    # fixed books but partial ledger does not cover rejected+expired
+    ok = dict(base, books={"opened": 3, "complete": 2, "partial": 1,
+                           "partial_reasons": {"queue full": 1}})
+    assert inv.validate(ok, "trace") == []
+    bad_req = dict(ok, requests={"admitted": 3, "served": 1,
+                                 "rejected": 2, "expired": 0})
+    assert any("complete" in v for v in inv.validate(bad_req, "trace"))
+    # a slowest entry whose stages don't reconcile with its wall
+    bad_slow = dict(ok, slowest=[{"trace_id": "t", "wall_ms": 50.0,
+                                  "stages": {"dispatch": 1.0}}])
+    assert any("critical path does not reconcile" in v
+               for v in inv.validate(bad_slow, "trace"))
+    # reconcile violations are invalid evidence, full stop
+    bad_rec = dict(ok)
+    bad_rec["reconcile"] = dict(ok["reconcile"], violations=2)
+    assert any("full stop" in v for v in inv.validate(bad_rec, "trace"))
+
+
+def test_trace_committable_sidecar_naming():
+    assert inv.committable_sidecar("TRACE_r17.json")
+    assert not inv.committable_sidecar("TRACE_smoke.json")
+    assert not inv.committable_sidecar("TRACE_rehearse_x.json")
+    assert not inv.committable_sidecar("TRACE_r17-999.json")
+
+
+# ------------------------------------------- cross-process SIGKILL stitch ----
+
+def test_pool_trace_stitching_under_mid_run_worker_sigkill(tmp_path):
+    """ISSUE 13 satellite: a REAL worker process SIGKILLed mid-run.  The
+    router closes the dead dispatches as reason-carrying orphan halves,
+    the surviving traces carry both stitched halves, the books balance
+    against the router's request books, and every stage sum reconciles."""
+    from csmom_tpu.serve.router import Router, RouterConfig
+    from csmom_tpu.serve.supervisor import PoolConfig, PoolSupervisor
+
+    book = obs_trace.arm_tracing(seed=2)
+    sup = PoolSupervisor(
+        PoolConfig(profile="serve-smoke", engine="stub", n_workers=2,
+                   backoff_base_s=0.05, ready_timeout_s=30.0),
+        str(tmp_path))
+    sup.start()
+    router = Router(sup.ready_workers,
+                    RouterConfig(profile="serve-smoke",
+                                 default_deadline_s=3.0))
+
+    def kill_one():
+        time.sleep(0.25)
+        os.kill(sup.handles[0].proc.pid, signal.SIGKILL)
+
+    try:
+        art = run_pool_loadgen(
+            router, sup,
+            LoadConfig(schedule="1.0x80", seed=5, deadline_s=3.0,
+                       run_id="trace_kill"),
+            concurrent=kill_one)
+    finally:
+        sup.stop()
+    obs_trace.disarm_tracing()
+
+    req = art["requests"]
+    assert book.invariant_violations() == []
+    assert book.opened == req["admitted"]
+    assert book.complete == req["served"]
+    assert book.partial == req["rejected"] + req["expired"]
+
+    snap = book.snapshot()
+    # the SIGKILLed worker's in-flight dispatches are orphan halves,
+    # closed WITH the connection failure as the reason
+    assert snap["orphans"]["count"] > 0, (
+        "the kill left no orphan half — nothing was in flight, or the "
+        "orphan leaked unclosed")
+    assert all(("connection" in r or "closed" in r)
+               for r in snap["orphans"]["reasons"]), snap["orphans"]
+    # stitched traces carry both halves: router-side transport and the
+    # worker-side queue/dispatch stages
+    for stage in ("route", "transport", "queue_wait", "dispatch",
+                  "serialize", "finalize"):
+        assert stage in snap["stages"], f"missing stitched stage {stage}"
+    assert snap["reconcile"]["violations"] == 0
+
+    tart = obs_trace.build_artifact(
+        book, "trace_kill",
+        requests={k: req[k]
+                  for k in ("admitted", "served", "rejected", "expired")},
+        fresh_compiles=0, platform="stub", workload="unit pool kill")
+    assert inv.validate(tart) == []
+
+
+def test_wire_roundtrip_preserves_identity_and_half_records():
+    obs_trace.arm_tracing()
+    t = obs_trace.begin("momentum", "interactive", panel_version=4,
+                        budget_ms=500.0)
+    wire = t.to_wire()
+    assert wire["trace_id"] == t.trace_id
+    half_ctx = obs_trace.TraceContext.from_wire(wire)
+    assert half_ctx.trace_id == t.trace_id
+    assert half_ctx.panel_version == 4
+    assert half_ctx.half_record() is None  # not closed yet: no half
+    half_ctx.mark("admit")
+    half_ctx.close("served")
+    half = half_ctx.half_record()
+    assert half["trace_id"] == t.trace_id
+    assert abs(sum(half["stages"].values()) - half["wall_s"]) < 1e-5
+    # stitch: the absorbed half + attempt window telescope to the wall
+    t0 = t.t0_s
+    t.absorb_remote(half, t0 + 0.010, t0 + 0.030, worker_id="w1")
+    t.close_routed("served", t0 + 0.040)
+    assert abs(sum(t.stage_durs_s.values()) - t.wall_s) < 1e-9
+    assert t.stage_durs_s["route"] == pytest.approx(0.010)
+    assert t.stage_durs_s["finalize"] == pytest.approx(0.010)
+    assert t.attrs["worker"] == "w1"
+
+
+# ------------------------------------------------------ ledger ingestion ----
+
+def test_ledger_ingests_trace_rows_with_samples_and_burn(tmp_path):
+    book, art = _run_traced_loadgen(schedule="0.4x80", seed=3,
+                                    deadline_s=2.0)
+    tart = obs_trace.build_artifact(
+        book, "r90",
+        requests={k: art["requests"][k]
+                  for k in ("admitted", "served", "rejected", "expired")},
+        fresh_compiles=0, platform="stub", workload="unit")
+    with open(tmp_path / "TRACE_r90.json", "w") as f:
+        json.dump(tart, f)
+    from csmom_tpu.obs import ledger as ld
+
+    L = ld.load(str(tmp_path))
+    by_metric = {}
+    for r in L.rows:
+        by_metric.setdefault(r.metric, []).append(r)
+    disp = by_metric["trace_stage_dispatch_p99_ms"][0]
+    assert disp.direction == "lower" and disp.gate_eligible()
+    assert disp.samples, "per-stage rows must carry their CI backing"
+    burn_rows = [m for m in by_metric if m.endswith("_budget_burn")]
+    assert burn_rows, "per-class budget-burn rows must land"
+    for m in burn_rows:
+        assert by_metric[m][0].gate_eligible()
+    assert "trace_complete_traces" in by_metric
+    assert not by_metric["trace_complete_traces"][0].gate_eligible()
+
+
+def test_ledger_attaches_serve_latency_samples_to_p99_rows(tmp_path):
+    _, art = _run_traced_loadgen(schedule="0.4x80", seed=3,
+                                 deadline_s=2.0)
+    with open(tmp_path / "SERVE_r91.json", "w") as f:
+        json.dump(dict(art, run_id="r91"), f)
+    from csmom_tpu.obs import ledger as ld
+
+    L = ld.load(str(tmp_path))
+    rows = {r.metric: r for r in L.rows}
+    assert rows["serve_p99_ms"].samples, (
+        "serve p99 rows must carry the persisted per-request samples — "
+        "the whole point of the satellite is CI-backed gate verdicts")
+    cls_rows = [r for m, r in rows.items()
+                if m.endswith("_p99_ms") and m.startswith("serve_")
+                and not m.startswith(("serve_p", "serve_ep_"))]
+    assert any(r.samples for r in cls_rows), "class p99 rows lost samples"
+    ep_rows = [r for m, r in rows.items() if m.startswith("serve_ep_")
+               and m.endswith("_p99_ms")]
+    assert any(r.samples for r in ep_rows), "endpoint p99 rows lost samples"
+    # and the artifact is v4-valid (burn + samples are schema rules)
+    assert inv.validate(art, "serve") == []
+
+
+def test_serve_v4_schema_requires_burn_and_samples():
+    _, art = _run_traced_loadgen(schedule="0.3x60", seed=3,
+                                 deadline_s=2.0)
+    damaged = json.loads(json.dumps(art))
+    del damaged["extra"]["samples"]
+    assert any("serve_total_ms" in v for v in inv.validate(damaged, "serve"))
+    damaged2 = json.loads(json.dumps(art))
+    for book in damaged2["classes"].values():
+        book.pop("violations", None)
+    assert any("violations" in v for v in inv.validate(damaged2, "serve"))
+
+
+# ------------------------------------------------- histogram quantiles ----
+
+def test_histogram_log_bucket_quantiles_bounded_relative_error():
+    from csmom_tpu.obs import spans
+
+    spans.arm(None, run_id="hist-unit", proc="t")
+    try:
+        metrics.reset()
+        h = metrics.histogram("unit.lat")
+        import random as _random
+
+        rng = _random.Random(0)
+        vals = sorted(rng.lognormvariate(0.0, 1.0) for _ in range(5000))
+        for v in vals:
+            h.observe(v)
+        import math
+
+        for q in (0.50, 0.95, 0.99):
+            exact = vals[max(0, math.ceil(q * len(vals)) - 1)]
+            est = h.quantile(q)
+            assert est is not None
+            assert abs(est - exact) / exact < 0.12, (
+                f"p{q:.0%} estimate {est} vs exact {exact}: log-bucket "
+                "error must stay inside the bucket ratio (~9%)")
+        s = h.summary()
+        assert s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+        assert s["count"] == 5000
+    finally:
+        spans.disarm()
+        metrics.reset()
+
+
+def test_histogram_quantiles_none_when_empty_and_clamped_single_sample():
+    from csmom_tpu.obs import spans
+
+    spans.arm(None, run_id="hist-unit2", proc="t")
+    try:
+        metrics.reset()
+        h = metrics.histogram("unit.single")
+        assert h.quantile(0.99) is None
+        assert h.summary()["p99"] is None
+        h.observe(0.0371)
+        # a one-sample histogram answers that sample, not a bucket edge
+        assert h.quantile(0.5) == pytest.approx(0.0371)
+        assert h.summary()["p99"] == pytest.approx(0.0371, rel=1e-6)
+    finally:
+        spans.disarm()
+        metrics.reset()
+
+
+def test_budget_burn_arithmetic():
+    assert metrics.budget_burn(0, 0) is None  # no traffic != no burn
+    assert metrics.budget_burn(100, 0) == 0.0
+    assert metrics.budget_burn(100, 1) == 1.0     # exactly on budget
+    assert metrics.budget_burn(100, 3) == 3.0     # burning at 3x
+    assert metrics.budget_burn(200, 1, slo_target=0.995) == 1.0
+    with pytest.raises(ValueError):
+        metrics.budget_burn(10, 1, slo_target=1.0)
+
+
+# ----------------------------------------------------- repo-level rules ----
+
+def test_committed_trace_artifacts_validate():
+    import glob as _glob
+
+    for path in _glob.glob(os.path.join(_REPO, "TRACE_*.json")):
+        base = os.path.basename(path)
+        if not inv.committable_sidecar(base):
+            continue
+        assert inv.validate_file(path) == [], f"{base} fails its schema"
+
+
+def test_no_stray_scratch_sidecars_at_repo_root():
+    """The satellite that motivated scratch_dir: regenerated sidecars
+    (TELEMETRY_rehearse*, TRACE_smoke*, ...) must not sit at the repo
+    root — they land in .csmom_scratch (gitignored as a directory)."""
+    import glob as _glob
+
+    strays = []
+    for pat in ("TELEMETRY_rehearse*.json", "TRACE_rehearse*.json",
+                "TRACE_smoke*.json"):
+        strays += _glob.glob(os.path.join(_REPO, pat))
+    assert strays == [], (
+        f"scratch sidecars at the repo root: {strays} — they belong in "
+        ".csmom_scratch/ (obs.timeline.scratch_dir)")
