@@ -113,6 +113,12 @@ class ClockDiscipline(LintRule):
         # the SAME clock the queue expires on and the artifact measures
         # on, or the decomposition could not be subtracted from the p99
         "csmom_tpu/obs/trace.py",
+        # the horizontal fabric (ISSUE 14): the routes view, the router
+        # supervisor, and the client tier time deadlines/failover on
+        # the same clock the replicas and workers expire on — and the
+        # transport's receive deadlines (proto.py, already pinned)
+        # depend on it end to end
+        "csmom_tpu/serve/fabric.py",
     )
 
     # the stream data plane runs on EVENT TIME: bar stamps and version

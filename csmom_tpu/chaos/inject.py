@@ -205,7 +205,8 @@ def _execute(fault, seed: int, point: str, ctx: dict) -> None:
     elif act == "stdout_noise":
         _start_stdout_noise(fault, seed)
     elif act in ("fail", "tick_late", "tick_dup", "tick_drop",
-                 "version_skew", "cache_poison"):
+                 "version_skew", "cache_poison", "conn_reset",
+                 "net_delay", "partition"):
         pass  # the return value is the fault; the caller interprets it
     else:  # pragma: no cover - plan.validate() bars unknown actions
         raise ValueError(f"unknown fault action {act!r}")

@@ -59,6 +59,16 @@ Artifact kinds (detected from keys, see :func:`detect_kind`):
     pair that both answered counts exactly one terminal state and one
     ``duplicates_suppressed``; suppressed/wins can never exceed hedges)
     and an ``availability`` that reconciles with ``rejected_infra``.
+``serve_fabric``
+    A THREE-TIER horizontal-fabric load record (``SERVE_FABRIC_*.json``,
+    ISSUE 14: loadgen client → supervised router replicas → workers over
+    unix/tcp): the closed-book rule binds at the CLIENT tier — the
+    outermost ledger, the one a SIGKILLed router replica cannot take
+    with it — plus ``transport.routers >= 2`` (replication is the
+    kind's point), a pool-level cache book whose ``pool_hit_rate``
+    reconciles with the client's cache-hit count and whose
+    fleet-aggregated ``stale_hits`` is structurally 0 across
+    rebalances, hedge arithmetic, and per-tier fleet evidence.
 ``replay``
     An event-time replay record (``REPLAY_*.json``,
     :mod:`csmom_tpu.stream.replay`): TWO closed books as schema rules —
@@ -136,6 +146,14 @@ KNOWN_TRACE_SCHEMA_VERSIONS = (1,)
 # multi-process tier) — closed-world like the rest
 KNOWN_SERVE_POOL_SCHEMA_VERSIONS = (1,)
 
+# serve-fabric artifact schema versions (SERVE_FABRIC_*.json, the
+# THREE-TIER horizontal fabric — ISSUE 14: loadgen client → supervised
+# router replicas → workers, over unix or tcp).  The client tier's books
+# are the outermost ledger (a SIGKILLed replica cannot take them along),
+# so the closed-book rule binds THERE, and the pool-level cache book
+# carries the structural stale_hits == 0 rule across rebalances.
+KNOWN_SERVE_FABRIC_SCHEMA_VERSIONS = (1,)
+
 # replay artifact schema versions (REPLAY_*.json, the event-time
 # streaming harness) — closed-world like the rest
 KNOWN_REPLAY_SCHEMA_VERSIONS = (1,)
@@ -163,7 +181,8 @@ _LINT_FINDING_KEYS = frozenset({"rule", "path", "line", "message",
 # a tier-1 test behind it instead of a .gitignore comment.
 _REGENERATED_PREFIXES = ("TELEMETRY_", "SERVE_", "REPLAY_", "TRACE_")
 _COMMITTED_SIDECAR_RE = re.compile(
-    r"^(?:TELEMETRY|SERVE|SERVE_POOL|SERVE_MESH|REPLAY|TRACE)_r\d+\.json$")
+    r"^(?:TELEMETRY|SERVE|SERVE_POOL|SERVE_MESH|SERVE_FABRIC|REPLAY"
+    r"|TRACE)_r\d+\.json$")
 
 _NUM = (int, float)
 
@@ -203,6 +222,12 @@ def detect_kind(obj: dict) -> str | None:
     if obj.get("kind") == "replay" or {"ticks", "panel",
                                        "reconcile"} <= set(obj):
         return "replay"
+    if obj.get("kind") == "serve_fabric" or {"requests", "availability",
+                                             "routers",
+                                             "transport"} <= set(obj):
+        # fabric before pool: a fabric artifact carries the pool's
+        # requests/availability/hedge signature PLUS its router tier
+        return "serve_fabric"
     if obj.get("kind") == "serve_pool" or {"requests", "availability",
                                            "hedge"} <= set(obj):
         return "serve_pool"
@@ -890,6 +915,174 @@ def _validate_serve_pool(obj: dict) -> list:
     return out
 
 
+def _validate_serve_fabric(obj: dict) -> list:
+    """The three-tier fabric contract (ISSUE 14): closed CLIENT-tier
+    books (the outermost ledger — the one a SIGKILLed router replica
+    cannot take with it), availability reconciling with its own infra
+    counter, a pool-level cache book whose hit rate reconciles with the
+    client's cache-hit count and whose fleet-aggregated ``stale_hits``
+    is structurally zero across rebalances, hedge arithmetic, and at
+    least TWO router replicas (replication is the kind's point)."""
+    out: list = []
+    _require(obj, "run_id", str, "serve_fabric", out)
+    ver = _require(obj, "schema_version", int, "serve_fabric", out)
+    if ver is not None and ver not in KNOWN_SERVE_FABRIC_SCHEMA_VERSIONS:
+        out.append(
+            f"serve_fabric: unknown schema_version {ver} (this checker "
+            f"understands {list(KNOWN_SERVE_FABRIC_SCHEMA_VERSIONS)}) — "
+            "the artifact is from a different era of the code; do not "
+            "half-parse it")
+    _require(obj, "wall_s", _NUM, "serve_fabric", out, "a number")
+    out += _validate_record(obj, kind="serve_fabric")
+
+    trans = _require(obj, "transport", dict, "serve_fabric", out)
+    if isinstance(trans, dict):
+        if trans.get("scheme") not in ("unix", "tcp"):
+            out.append(f"serve_fabric: transport.scheme "
+                       f"{trans.get('scheme')!r} must be 'unix' or 'tcp'")
+        nr = trans.get("routers")
+        if not isinstance(nr, int) or isinstance(nr, bool) or nr < 2:
+            out.append(f"serve_fabric: transport.routers {nr!r} — the "
+                       "fabric requires >= 2 router replicas (one "
+                       "router is the r11 pool, not a fabric)")
+        nw = trans.get("workers")
+        if not isinstance(nw, int) or isinstance(nw, bool) or nw < 1:
+            out.append(f"serve_fabric: transport.workers must be a "
+                       f"positive int, got {nw!r}")
+
+    req = _require(obj, "requests", dict, "serve_fabric", out)
+    if isinstance(req, dict):
+        counters = ("admitted", "served", "rejected", "expired",
+                    "rejected_infra", "served_cache_hits",
+                    "served_hedged", "router_conn_failures", "failovers")
+        ok = True
+        for k in counters:
+            v = req.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"serve_fabric: requests.{k} must be a "
+                           "non-negative int (the client-tier ledger is "
+                           "the contract)")
+                ok = False
+        if not ok:
+            # malformed counters: the availability/cache/hedge reconcile
+            # blocks below divide by these values — a violation must stay
+            # a violation, not become a TypeError out of validate()
+            req = None
+        else:
+            total = req["served"] + req["rejected"] + req["expired"]
+            if total != req["admitted"]:
+                out.append(
+                    f"serve_fabric: client books broken — served "
+                    f"{req['served']} + rejected {req['rejected']} + "
+                    f"expired {req['expired']} = {total} != admitted "
+                    f"{req['admitted']} (a request died with a replica)")
+            if req["rejected_infra"] > req["rejected"]:
+                out.append("serve_fabric: rejected_infra exceeds rejected")
+            if req["served_cache_hits"] > req["served"]:
+                out.append("serve_fabric: served_cache_hits exceeds served")
+            if req["served_hedged"] > req["served"]:
+                out.append("serve_fabric: served_hedged exceeds served")
+
+    avail = _require(obj, "availability", _NUM, "serve_fabric", out,
+                     "a number")
+    if isinstance(avail, _NUM) and not isinstance(avail, bool):
+        if not 0.0 <= avail <= 1.0:
+            out.append(f"serve_fabric: availability {avail} outside [0, 1]")
+        elif isinstance(req, dict) and req.get("admitted"):
+            want = round(1.0 - req.get("rejected_infra", 0)
+                         / req["admitted"], 6)
+            if abs(avail - want) > 1e-6:
+                out.append(
+                    f"serve_fabric: availability {avail} does not "
+                    f"reconcile with 1 - rejected_infra/admitted = {want}")
+
+    cache = _require(obj, "cache", dict, "serve_fabric", out)
+    if isinstance(cache, dict):
+        hr = cache.get("pool_hit_rate")
+        if not isinstance(hr, _NUM) or isinstance(hr, bool) \
+                or not 0.0 <= hr <= 1.0:
+            out.append(f"serve_fabric: cache.pool_hit_rate {hr!r} must "
+                       "be a number in [0, 1]")
+        elif isinstance(req, dict) and req.get("served"):
+            want = round(req.get("served_cache_hits", 0)
+                         / req["served"], 4)
+            if abs(hr - want) > 1e-4:
+                out.append(
+                    f"serve_fabric: cache.pool_hit_rate {hr} does not "
+                    f"reconcile with served_cache_hits/served = {want}")
+        wagg = cache.get("workers")
+        if not isinstance(wagg, dict):
+            out.append("serve_fabric: cache.workers (the fleet-aggregated "
+                       "worker cache book) must be a dict")
+        else:
+            sh = wagg.get("stale_hits")
+            if not isinstance(sh, int) or isinstance(sh, bool):
+                out.append("serve_fabric: cache.workers.stale_hits must "
+                           "be an int")
+            elif sh != 0:
+                out.append(
+                    f"serve_fabric: cache.workers.stale_hits = {sh} — a "
+                    "STALE entry was returned somewhere in the fleet; "
+                    "the version floor must make this structurally "
+                    "impossible, rebalances included")
+
+    hedge = _require(obj, "hedge", dict, "serve_fabric", out)
+    if isinstance(hedge, dict):
+        rate = hedge.get("rate")
+        if not isinstance(rate, _NUM) or isinstance(rate, bool):
+            out.append("serve_fabric: hedge.rate must be a number")
+        elif isinstance(req, dict) and req.get("admitted"):
+            want = round(req.get("served_hedged", 0)
+                         / max(1, req["admitted"]), 4)
+            if abs(rate - want) > 1e-4:
+                out.append(
+                    f"serve_fabric: hedge.rate {rate} does not reconcile "
+                    f"with served_hedged/admitted = {want}")
+        rt = hedge.get("router_tier")
+        if isinstance(rt, dict):
+            if isinstance(rt.get("wins"), int) and \
+                    isinstance(rt.get("hedged"), int) and \
+                    rt["wins"] > rt["hedged"]:
+                out.append(
+                    f"serve_fabric: router_tier hedge_wins {rt['wins']} "
+                    f"> hedged {rt['hedged']} — a hedge cannot win more "
+                    "than it fired")
+
+    lat = _require(obj, "latency_ms", dict, "serve_fabric", out)
+    if isinstance(lat, dict):
+        _validate_latency_side(lat.get("total"), "total", "serve_fabric",
+                               out)
+
+    for tier, id_key in (("routers", "router_id"), ("workers", "worker_id")):
+        block = _require(obj, tier, dict, "serve_fabric", out)
+        if not isinstance(block, dict):
+            continue
+        rows = block.get("replicas" if tier == "routers" else "stats")
+        if not isinstance(rows, list):
+            out.append(f"serve_fabric: {tier} must carry its per-process "
+                       "stats list")
+        else:
+            for i, r in enumerate(rows):
+                if not isinstance(r, dict) or id_key not in r:
+                    out.append(f"serve_fabric: {tier} row {i} must be a "
+                               f"dict with a {id_key}")
+        for k in ("kills", "restarts"):
+            v = block.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"serve_fabric: {tier}.{k} must be a "
+                           "non-negative int")
+
+    comp = obj.get("compile")
+    if comp is not None and not isinstance(comp, dict):
+        out.append("serve_fabric: compile must be a dict when present")
+    elif isinstance(comp, dict):
+        fc = comp.get("in_window_fresh_compiles")
+        if fc is not None and not isinstance(fc, (int, str)):
+            out.append("serve_fabric: compile.in_window_fresh_compiles "
+                       "must be an int count or a reason string")
+    return out
+
+
 def _validate_serve_requests(req: dict, kind: str, out: list) -> dict | None:
     """The single-process balanced-request-book rule, shared by the
     ``serve`` kind and the replay artifact's embedded serve book.  The
@@ -1318,6 +1511,7 @@ _VALIDATORS = {
     "replay": _validate_replay,
     "serve": _validate_serve,
     "serve_pool": _validate_serve_pool,
+    "serve_fabric": _validate_serve_fabric,
     "telemetry": _validate_telemetry,
     "driver_capture": _validate_driver_capture,
     "multichip": _validate_multichip,
@@ -1334,8 +1528,8 @@ def validate(obj, kind: str | None = None) -> list:
     if kind is None:
         return ["unrecognized artifact shape: none of the known key "
                 "signatures (record / driver_capture / multichip / phases "
-                "/ tpu_cache / telemetry / serve / serve_pool / replay / "
-                "trace / lint) match"]
+                "/ tpu_cache / telemetry / serve / serve_pool / "
+                "serve_fabric / replay / trace / lint) match"]
     if kind not in _VALIDATORS:
         return [f"unknown artifact kind {kind!r}"]
     return _VALIDATORS[kind](obj)

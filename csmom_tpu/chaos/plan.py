@@ -59,6 +59,7 @@ KNOWN_POINTS = (
     "warmup.entry", "aot.compile",
     "mini.start", "mini.row", "mini.finish",
     "serve.admit", "serve.coalesce", "serve.dispatch", "serve.cache",
+    "serve.transport",
     "pool.route", "pool.hedge", "pool.spawn",
     "stream.tick", "stream.ingest", "stream.serve",
 )
@@ -153,6 +154,18 @@ class Fault:
         # looked-up key stamped BELOW the version floor; the get path's
         # floor check must refuse it (stale_blocked), never serve it
         "cache_poison",
+        # network faults (ISSUE 14) — caller-interpreted at the
+        # serve.transport checkpoint (serve/proto.py): "conn_reset"
+        # raises a connection reset into the dialing code's failover
+        # handling, "net_delay" stalls the transport by
+        # CSMOM_CHAOS_NET_DELAY_S (an induced straggler for the hedging
+        # policy to route around), and "partition" cuts the firing
+        # process off from the peer address it was dialing for
+        # CSMOM_CHAOS_PARTITION_S (every dial to that peer fails
+        # instantly until the partition heals)
+        "conn_reset",
+        "net_delay",
+        "partition",
     )
 
     def validate(self) -> None:

@@ -648,6 +648,151 @@ def _serve_pool_scenarios():
     ]
 
 
+def _check_fabric_partition(r):
+    """ISSUE 14: a router replica is PARTITIONED from a worker host
+    mid-burst (chaos ``partition`` at serve.transport, fired inside one
+    replica, global-once across the tier).  Every dial to that peer
+    fails instantly until the partition heals; the replica's failover/
+    hedging must route around it, the CLIENT books must stay closed,
+    and availability must reconcile at 1.0 — an admitted request never
+    dies with a partitioned wire."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve_fabric")
+    req = art.get("requests") or {}
+    conn_fails = 0
+    for rep in (art.get("routers") or {}).get("replicas") or []:
+        a = rep.get("accounting")
+        if isinstance(a, dict):
+            conn_fails += a.get("worker_conn_failures", 0) or 0
+    if not conn_fails:
+        out.append("no router→worker connection failure recorded — the "
+                   "partition never fired (or its refusals were hidden)")
+    if not req.get("served"):
+        out.append("nothing served — the fabric did not keep serving "
+                   "through the partition")
+    if (art.get("availability") or 0.0) < 1.0:
+        out.append(f"availability {art.get('availability')} < 1.0 — an "
+                   "admitted request was lost to a healed partition "
+                   "(failover/hedging did not route around it)")
+    return out
+
+
+def _check_fabric_straggler(r):
+    """ISSUE 14: induced stragglers (chaos ``net_delay`` at
+    serve.transport stalls a bounded number of router→worker dials).
+    The hedging policy is what the scenario measures: hedges MUST fire
+    (the straggler was detected) and the hedge rate MUST stay bounded
+    (Tail at Scale's paid-insurance property — a hedge storm would
+    double fleet load exactly when it straggles), with the client books
+    closed and availability 1.0."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve_fabric")
+    hedge = art.get("hedge") or {}
+    rt = hedge.get("router_tier") or {}
+    if not rt.get("hedged"):
+        out.append("no hedge fired — the induced straggler was never "
+                   "detected (or the delay missed every dial)")
+    rate = hedge.get("rate")
+    if isinstance(rate, (int, float)) and rate > 0.5:
+        out.append(f"hedge rate {rate} > 0.5 — hedging went from paid "
+                   "insurance to a load doubler under the straggler")
+    if (art.get("availability") or 0.0) < 1.0:
+        out.append(f"availability {art.get('availability')} < 1.0 — a "
+                   "stalled wire cost an admitted request")
+    if not (art.get("requests") or {}).get("served"):
+        out.append("nothing served under the induced straggler")
+    return out
+
+
+def _check_fabric_router_kill(r):
+    """ISSUE 14: the rehearsed r18 double kill — one ROUTER replica and
+    one WORKER SIGKILLed mid-burst.  The client tier must fail its
+    in-flight requests over to the surviving replica (failovers > 0),
+    both supervisors must respawn their slots, the CLIENT books must
+    close (the outermost ledger survives both corpses), and
+    availability must reconcile at 1.0."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve_fabric")
+    req = art.get("requests") or {}
+    routers = art.get("routers") or {}
+    workers = art.get("workers") or {}
+    if not routers.get("kills"):
+        out.append("no router replica death observed — the SIGKILL "
+                   "missed the router tier")
+    if not workers.get("kills"):
+        out.append("no worker death observed — the SIGKILL missed the "
+                   "worker tier")
+    if not (req.get("failovers") or req.get("router_conn_failures")):
+        out.append("no client-side failover recorded — the router kill "
+                   "hit no in-flight request (nothing was rescued)")
+    if not routers.get("restarts"):
+        out.append("the dead router replica was never replaced")
+    if not workers.get("restarts"):
+        out.append("the dead worker was never replaced")
+    if (art.get("availability") or 0.0) < 1.0:
+        out.append(f"availability {art.get('availability')} < 1.0 — an "
+                   "admitted request died with a corpse; the fabric's "
+                   "whole point is that none can")
+    if not req.get("served"):
+        out.append("nothing served through the double kill")
+    return out
+
+
+def _serve_fabric_scenarios():
+    return [
+        Scenario(
+            "fabric-partition-mid-burst", "serve-fabric",
+            FaultPlan("fabric-partition", seed=33, faults=(
+                Fault(point="serve.transport", action="partition",
+                      after=6, max_fires=1, global_once=True),
+            )),
+            _check_fabric_partition,
+            notes="ISSUE 14: one router replica loses a worker HOST "
+                  "mid-burst (chaos partition at serve.transport, "
+                  "global-once): dials to the peer fail instantly until "
+                  "the partition heals, failover/hedging route around "
+                  "it, client books close, availability 1.0",
+            env={"transport": "tcp",
+                 "pool": {"n_workers": 2},
+                 "chaos_env": {"CSMOM_CHAOS_PARTITION_S": "0.6"},
+                 "load": {"schedule": "1.0x60", "seed": 17,
+                          "deadline_s": 3.0}},
+        ),
+        Scenario(
+            "fabric-induced-straggler", "serve-fabric",
+            FaultPlan("fabric-straggler", seed=34, faults=(
+                Fault(point="serve.transport", action="net_delay",
+                      after=4, max_fires=5),
+            )),
+            _check_fabric_straggler,
+            notes="ISSUE 14: induced stragglers (net_delay stalls a "
+                  "bounded number of router→worker dials): hedges fire "
+                  "(Tail at Scale) but the hedge rate stays bounded "
+                  "<= 0.5, books close, availability 1.0",
+            # the induced delay (0.9 s) must OUTLAST the hedge trigger
+            # (0.25 x the 1.5 s budget ≈ 0.38 s): a delay the primary
+            # absorbs before the hedge timer fires rehearses nothing
+            env={"pool": {"n_workers": 2},
+                 "hedge_fraction": 0.25,
+                 "chaos_env": {"CSMOM_CHAOS_NET_DELAY_S": "0.9"},
+                 "load": {"schedule": "0.8x50", "seed": 18,
+                          "deadline_s": 1.5}},
+        ),
+        Scenario(
+            "fabric-router-kill-mid-burst", "serve-fabric", None,
+            _check_fabric_router_kill,
+            notes="ISSUE 14: the rehearsed r18 double kill — one router "
+                  "replica AND one worker SIGKILLed mid-burst: client "
+                  "failover rescues in-flight requests, both tiers "
+                  "respawn, the outermost books close, availability 1.0",
+            env={"pool": {"n_workers": 2},
+                 "kill": {"router_after": 0.25, "worker_after": 0.45},
+                 "load": {"schedule": "1.4x45", "seed": 19,
+                          "deadline_s": 3.0}},
+        ),
+    ]
+
+
 def _check_replay_tick_storm(r):
     """ISSUE 7: under a storm of late / out-of-order / duplicate / gap
     ticks, the replay must keep BOTH closed books (tick ledger + serve
@@ -906,7 +1051,8 @@ def _check_bench_child_full(r):
 
 def builtin_matrix(fast: bool = False):
     mats = (_mini_scenarios() + _shell_scenarios() + _serve_scenarios()
-            + _serve_pool_scenarios() + _replay_scenarios())
+            + _serve_pool_scenarios() + _serve_fabric_scenarios()
+            + _replay_scenarios())
     if not fast:
         mats += _bench_scenarios()
     else:
@@ -1312,6 +1458,94 @@ def _run_serve_pool(scenario, box: str) -> dict:
         inject.reset()  # the next scenario must not inherit this plan
 
 
+def _run_serve_fabric(scenario, box: str) -> dict:
+    """Drive the THREE-TIER fabric: stub-engine worker processes, real
+    supervised router-replica processes, and the FabricClient in this
+    process (serve-smoke buckets, no jax anywhere).
+
+    Network fault plans arm in the ROUTER TIER ONLY (via the router
+    supervisor's ``extra_env``): the replicas are the processes that
+    dial workers at ``serve.transport``, and the rehearse process's own
+    client dials must not fire the fault.  ``scenario.env`` carries
+    runner kwargs: ``transport`` (unix | tcp), ``routers``, ``pool`` ->
+    worker PoolConfig overrides, ``hedge_fraction``, ``chaos_env`` ->
+    extra router-tier environment (fault duration knobs), ``kill`` ->
+    {router_after, worker_after} mid-burst SIGKILLs, ``load`` ->
+    LoadConfig overrides.
+    """
+    from csmom_tpu.serve.fabric import (
+        build_fabric,
+        kill_mid_burst,
+        stop_fabric,
+    )
+    from csmom_tpu.serve.loadgen import (
+        LoadConfig,
+        run_fabric_loadgen,
+        write_artifact,
+    )
+    from csmom_tpu.serve.supervisor import PoolConfig
+
+    result: dict = {"rc": 0, "stdout": "", "stderr": "",
+                    "trailing": None, "headline_violations": [],
+                    "sidecar_rows": 0}
+    wsup = rsup = publisher = None
+    try:
+        transport = scenario.env.get("transport", "unix")
+        smoke = dict(profile="serve-smoke", engine="stub",
+                     transport=transport, backoff_base_s=0.05,
+                     backoff_cap_s=0.5, ready_timeout_s=30.0)
+        wcfg = PoolConfig(**{**smoke, **scenario.env.get("pool", {})})
+        rcfg = PoolConfig(n_workers=scenario.env.get("routers", 2),
+                          **smoke)
+        load_over = dict(scenario.env.get("load", {}))
+        deadline = load_over.pop("deadline_s", 3.0)
+
+        def arm_router_tier(rsup):
+            # fault plans arm in the ROUTER TIER ONLY: the replicas are
+            # the processes that dial workers at serve.transport
+            if scenario.plan is not None:
+                plan_path = os.path.join(box, "plan.toml")
+                with open(plan_path, "w") as f:
+                    f.write(scenario.plan.to_toml())
+                rsup.extra_env[PLAN_ENV] = plan_path
+                rsup.extra_env["CSMOM_FAULT_STATE"] = os.path.join(
+                    box, "chaos-state")
+            rsup.extra_env.update(scenario.env.get("chaos_env", {}))
+
+        wsup, publisher, rsup, client = build_fabric(
+            wcfg, rcfg, box,
+            deadline_ms=deadline * 1e3,
+            hedge_fraction=scenario.env.get("hedge_fraction", 0.35),
+            client_deadline_s=deadline,
+            configure_router=arm_router_tier)
+        load = LoadConfig(run_id=f"rehearse_{scenario.name}",
+                          deadline_s=deadline, **load_over)
+
+        kill = scenario.env.get("kill") or {}
+        conc = None
+        if kill:
+            def conc():
+                # books are built only from a SETTLED fleet: both
+                # victims' replacements must demonstrate ready first
+                if not kill_mid_burst(
+                        [(kill.get("router_after"), rsup, "router"),
+                         (kill.get("worker_after"), wsup, "worker")],
+                        settle_timeout_s=30.0):
+                    raise RuntimeError(
+                        "a killed tier never re-demonstrated ready — "
+                        "the scenario's books would come from an "
+                        "unsettled fleet")
+
+        art = run_fabric_loadgen(client, rsup, wsup, load,
+                                 concurrent=conc)
+        write_artifact(box, art, prefix="SERVE_FABRIC")
+        result["artifact"] = art
+        result["trailing"] = art
+        return result
+    finally:
+        stop_fabric(publisher, rsup, wsup)
+
+
 def _run_replay(scenario, box: str) -> dict:
     """Drive the event-time replay IN-PROCESS (stub engine, smoke
     buckets, no jax — the fast tier stays jax-free).  The fault plan
@@ -1367,6 +1601,7 @@ _RUNNERS = {
     "warmup": _run_warmup,
     "serve": _run_serve,
     "serve-pool": _run_serve_pool,
+    "serve-fabric": _run_serve_fabric,
     "replay": _run_replay,
 }
 
@@ -1416,6 +1651,12 @@ def _check_serve_pool_generic(r):
     return inv.validate(r.get("artifact") or {}, "serve_pool")
 
 
+def _check_serve_fabric_generic(r):
+    # and one tier further out: the fabric artifact's schema IS the
+    # closed CLIENT-tier book plus replication, cache, and hedge rules
+    return inv.validate(r.get("artifact") or {}, "serve_fabric")
+
+
 def _check_replay_generic(r):
     # whatever the custom fault did, the landed REPLAY artifact must be
     # schema-valid — which INCLUDES the closed tick ledger, the closed
@@ -1431,6 +1672,7 @@ _CUSTOM_CHECKS = {
     "warmup": _check_warmup_healed,
     "serve": _check_serve_generic,
     "serve-pool": _check_serve_pool_generic,
+    "serve-fabric": _check_serve_fabric_generic,
     "replay": _check_replay_generic,
 }
 

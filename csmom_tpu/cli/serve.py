@@ -162,23 +162,26 @@ def _print_pool_ready(sup, router) -> None:
           f"{router.config.max_attempts}")
 
 
-def _pool_self_probe(router) -> list:
-    """One probe request per endpoint THROUGH the router — the pool's
-    demonstrated-ready claim.  Returns the failed probes (empty = ok)."""
+def _pool_self_probe(submitter, spec=None) -> list:
+    """One probe request per endpoint THROUGH ``submitter`` (the pool's
+    router, or a fabric client for the three-tier path) — the tier's
+    demonstrated-ready claim.  Returns the failed probes (empty = ok).
+    ``spec`` defaults to the submitter's own bucket spec (the router
+    carries one; a fabric client does not)."""
     import numpy as np
 
     from csmom_tpu.registry import serve_endpoints
 
-    spec = router.spec
+    spec = spec if spec is not None else submitter.spec
     A = spec.asset_buckets[0]
     rng = np.random.default_rng(0)
     probes = []
     for kind in serve_endpoints():
         v = 100.0 * np.exp(np.cumsum(
             rng.normal(0, 0.03, (A, spec.months)), axis=1))
-        probes.append(router.submit(kind, v.astype(np.float32),
-                                    np.ones((A, spec.months), bool),
-                                    deadline_s=10.0))
+        probes.append(submitter.submit(kind, v.astype(np.float32),
+                                       np.ones((A, spec.months), bool),
+                                       deadline_s=10.0))
     for p in probes:
         p.wait(15.0)
     return [p for p in probes if p.state != "served"]
@@ -488,6 +491,210 @@ def _cmd_loadgen_pool(args, schedule: str, run_id: str,
     return rc
 
 
+def _mk_fabric(args, run_dir: str):
+    """Build the THREE-TIER fabric: worker supervisor + routes publisher
+    + router-replica supervisor + fabric client (ISSUE 14)."""
+    from csmom_tpu.serve.fabric import build_fabric
+    from csmom_tpu.serve.supervisor import PoolConfig
+
+    profile = args.profile or ("serve-smoke" if getattr(args, "smoke", False)
+                               else "serve")
+    engine = _engine_name(args)
+    pool_deadline_ms = 500.0 if args.deadline_ms is None else args.deadline_ms
+    wcfg = PoolConfig(
+        n_workers=args.workers if args.workers > 0 else 2,
+        profile=profile,
+        engine=engine,
+        transport=args.transport,
+        capacity=args.capacity,
+        max_wait_ms=args.max_wait_ms,
+        deadline_ms=pool_deadline_ms,
+        devices_per_worker=getattr(args, "devices_per_worker", 0),
+        require_warm_cache=(engine.startswith("jax")
+                            and not getattr(args, "allow_cold_cache", False)
+                            and not getattr(args, "smoke", False)),
+    )
+    rcfg = PoolConfig(
+        n_workers=max(2, args.routers),   # replication is the point
+        profile=profile,
+        engine="stub",                    # replicas hold no compiled world
+        transport=args.transport,
+    )
+    return build_fabric(
+        wcfg, rcfg, run_dir,
+        deadline_ms=pool_deadline_ms,
+        hedge_fraction=args.hedge_fraction,
+        trace=getattr(args, "trace", False),
+        client_deadline_s=(None if pool_deadline_ms == 0
+                           else pool_deadline_ms / 1e3))
+
+
+def _cmd_loadgen_fabric(args, schedule: str, run_id: str,
+                        schedule_kind: str = "custom",
+                        preset: dict | None = None) -> int:
+    """Fabric-mode loadgen: drive the three-tier fabric, SIGKILL one
+    router and one worker mid-burst when asked, land
+    SERVE_FABRIC_<run>.json."""
+    import tempfile
+
+    from csmom_tpu.chaos import invariants as inv
+    from csmom_tpu.serve.fabric import kill_mid_burst, stop_fabric
+    from csmom_tpu.serve.loadgen import (
+        LoadConfig,
+        run_fabric_loadgen,
+        write_artifact,
+    )
+
+    run_dir = tempfile.mkdtemp(prefix="csmom-fabric-")
+    try:
+        wsup, publisher, rsup, client = _mk_fabric(args, run_dir)
+    except RuntimeError as e:
+        print(f"fabric failed to start: {e}", file=sys.stderr)
+        return 1
+    trace_book = None
+    try:
+        print(f"fabric ready: {len(rsup.ready_workers())} router "
+              f"replicas over {args.transport}, "
+              f"{len(wsup.ready_workers())}/{wsup.config.n_workers} "
+              f"workers (engine {wsup.config.engine}, profile "
+              f"{wsup.config.profile})")
+        for h in rsup.handles:
+            print(f"  {h.worker_id} g{h.generation} [{h.state}] "
+                  f"{h.socket_path}")
+        for h in wsup.handles:
+            print(f"  {h.worker_id} g{h.generation} [{h.state}] "
+                  f"{h.socket_path}")
+        # a demonstrated three-tier ready: one probe per endpoint
+        # through client -> replica -> worker.  Probes go through a
+        # THROWAWAY client and tracing arms only AFTER they pass: the
+        # measured client's books ARE the artifact's request ledger,
+        # and probe traffic would contaminate the committed evidence
+        # (admitted/hit-rate denominators, trace stage samples)
+        from csmom_tpu.serve.buckets import bucket_spec
+        from csmom_tpu.serve.fabric import FabricClient
+
+        probe_client = FabricClient(rsup.ready_workers, client.config)
+        failed = _pool_self_probe(probe_client,
+                                  spec=bucket_spec(wsup.config.profile))
+        print(f"  self-probe: "
+              f"{'all endpoints served' if not failed else 'FAILED'}")
+        if failed:
+            for p in failed:
+                print(f"    {p.kind}: state={p.state} error={p.error}",
+                      file=sys.stderr)
+            return 1
+        trace_book = _arm_trace(args)
+
+        preset = dict(preset or {})
+        class_mix = preset.pop("class_mix", None)
+        preset_reuse = preset.pop("reuse_fraction", 0.0)
+        bumps = preset.pop("version_bumps", 0)
+        preset.pop("use_class_deadlines", None)
+        if preset:
+            print(f"note: named-schedule preset keys {sorted(preset)} "
+                  "apply to the single-process loadgen only")
+        # explicit --reuse-fraction wins; else the named schedule's
+        # preset — the pool-level cache story NEEDS repeats to route
+        reuse = (args.reuse_fraction if args.reuse_fraction is not None
+                 else preset_reuse)
+        load = LoadConfig(
+            schedule=schedule,
+            schedule_kind=schedule_kind,
+            seed=args.seed,
+            class_mix=class_mix,
+            reuse_fraction=reuse,
+            version_bumps=bumps,
+            deadline_s=(None if args.deadline_ms == 0
+                        else 0.5 if args.deadline_ms is None
+                        else args.deadline_ms / 1e3),
+            run_id=run_id,
+        )
+
+        kill_router_after = args.kill_router_after or 0.0
+        kill_worker_after = getattr(args, "kill_worker_after", 0.0) or 0.0
+        concurrent = None
+        if kill_router_after > 0 or kill_worker_after > 0:
+            def concurrent():
+                # the rehearsed r18 double kill: one ROUTER replica and
+                # one WORKER die mid-burst; the client fails over, the
+                # routes view rebalances the ring, and both supervisors
+                # respawn — the artifact is built only after both tiers
+                # settled (run_fabric_loadgen's `concurrent` contract)
+                if not kill_mid_burst(
+                        [(kill_router_after, rsup, "router"),
+                         (kill_worker_after, wsup, "worker")],
+                        announce=lambda tier, victim, at_s: print(
+                            f"  [chaos] SIGKILL {tier} {victim} "
+                            f"({at_s:g}s into the run)")):
+                    raise RuntimeError(
+                        "a killed tier never re-demonstrated ready — "
+                        "refusing to build books from an unsettled "
+                        "fleet (crash loop? check the supervisor logs "
+                        f"under {run_dir})")
+
+        print(f"offering (fabric): schedule {schedule} (seed {load.seed}, "
+              f"deadline {load.deadline_s}s, reuse {load.reuse_fraction}"
+              + (", trace armed" if trace_book is not None else "")
+              + (f", router kill @{kill_router_after:g}s"
+                 if kill_router_after else "")
+              + (f", worker kill @{kill_worker_after:g}s"
+                 if kill_worker_after else "")
+              + ") ...")
+        art = run_fabric_loadgen(client, rsup, wsup, load,
+                                 concurrent=concurrent)
+    finally:
+        # every exit path must stop BOTH process tiers and the publisher
+        stop_fabric(publisher, rsup, wsup)
+    out_dir = args.out or os.getcwd()
+    path = write_artifact(out_dir, art, prefix="SERVE_FABRIC")
+
+    req = art["requests"]
+    lat = art["latency_ms"]["total"]
+    cache = art["cache"]
+    print(f"\nthroughput: {art['value']} req/s achieved vs "
+          f"{art['offered']['offered_rps']} req/s offered over "
+          f"{art['wall_s']}s wall"
+          + (" (offered-load-limited)" if art["offered_limited"] else ""))
+    print(f"requests: admitted {req['admitted']} -> served {req['served']}, "
+          f"rejected {req['rejected']} (infra {req['rejected_infra']}), "
+          f"expired {req['expired']}; failovers {req['failovers']}, "
+          f"router conn failures {req['router_conn_failures']}")
+    print(f"availability: {art['availability']}")
+    print(f"pool cache: hit rate {cache['pool_hit_rate']} "
+          f"({cache['served_cache_hits']}/{cache['served']} served) vs "
+          f"r15 per-worker baseline {cache['per_worker_baseline']}; "
+          f"worker books: stale_hits {cache['workers']['stale_hits']}")
+    print(f"hedge: served hedged {art['hedge']['served_hedged']} "
+          f"(rate {art['hedge']['rate']}), router tier hedged "
+          f"{art['hedge']['router_tier']['hedged']}")
+    print(f"latency total ms: p50 {lat['p50']}  p95 {lat['p95']}  "
+          f"p99 {lat['p99']}")
+    print(f"routers: kills {art['routers']['kills']}, restarts "
+          f"{art['routers']['restarts']}; workers: kills "
+          f"{art['workers']['kills']}, restarts {art['workers']['restarts']}")
+    print(f"in-window fresh compiles: "
+          f"{art['compile']['in_window_fresh_compiles']!r}")
+    print(f"artifact: {path}")
+
+    rc = 0
+    if trace_book is not None:
+        rc = _land_trace(args, trace_book, run_id, art, out_dir)
+    viols = inv.validate_file(path)
+    if viols:
+        print("ARTIFACT INVALID:", file=sys.stderr)
+        for v in viols:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    fresh = art["compile"]["in_window_fresh_compiles"]
+    if isinstance(fresh, int) and fresh > 0 and not args.allow_fresh_compiles:
+        print(f"error: {fresh} fresh compile(s) inside the serving window "
+              "across the fleet — a worker compiled instead of loading "
+              "the AOT cache; rerun with --allow-fresh-compiles to land "
+              "anyway", file=sys.stderr)
+        return 1
+    return rc
+
+
 def cmd_loadgen(args) -> int:
     """Open-loop load generation against an in-process service (or the
     pool with ``--pool``); lands SERVE_<run>.json / SERVE_POOL_<run>.json."""
@@ -512,6 +719,9 @@ def cmd_loadgen(args) -> int:
     except ValueError as e:
         print(f"--schedule: {e}", file=sys.stderr)
         return 2
+    if getattr(args, "fabric", False):
+        return _cmd_loadgen_fabric(args, schedule, run_id, schedule_kind,
+                                   preset)
     if args.pool:
         return _cmd_loadgen_pool(args, schedule, run_id, schedule_kind,
                                  preset)
@@ -681,6 +891,35 @@ def register(sub) -> None:
                     help="drive the multi-process pool (--workers N) "
                          "instead of the in-process service; lands "
                          "SERVE_POOL_<run>.json (kind serve_pool)")
+    lg.add_argument("--fabric", action="store_true",
+                    help="drive the THREE-TIER horizontal fabric: "
+                         "supervised router-replica processes "
+                         "(--routers N) over unix/tcp in front of the "
+                         "worker pool, consistent-hash cache routing, "
+                         "client-side failover; lands "
+                         "SERVE_FABRIC_<run>.json (kind serve_fabric)")
+    lg.add_argument("--routers", type=int, default=2,
+                    help="fabric mode: router replica count (min 2 — "
+                         "replication is the point; default 2)")
+    lg.add_argument("--transport", choices=["unix", "tcp"],
+                    default="unix",
+                    help="fabric mode: wire transport for every hop "
+                         "(unix = one host, tcp = loopback ports today, "
+                         "cross-container by swapping the host; "
+                         "default unix)")
+    lg.add_argument("--reuse-fraction", dest="reuse_fraction",
+                    type=float, default=None, metavar="F",
+                    help="fabric mode: probability a request reuses a "
+                         "recent panel (repeats are what the "
+                         "consistent-hash cache routing compounds; "
+                         "default: the named schedule's preset, else 0)")
+    lg.add_argument("--kill-router-after", dest="kill_router_after",
+                    type=float, default=0.0, metavar="SEC",
+                    help="fabric mode: SIGKILL one router replica SEC "
+                         "seconds into the run (the client fails over "
+                         "to a surviving replica; the artifact is built "
+                         "only after the replacement is ready; "
+                         "0 = no kill)")
     lg.add_argument("--schedule", metavar="DURxRPS|NAME",
                     help="arrival schedule: explicit segments (2x25,3x60) "
                          "or a named traffic shape — bursty (quiet + hard "

@@ -485,6 +485,75 @@ def _serve_pool_rows(obj: dict, run: str, num: int, variant,
     return rows
 
 
+def _serve_fabric_rows(obj: dict, run: str, num: int, variant,
+                       source: str) -> list:
+    """Rows from a SERVE_FABRIC artifact: the three-tier horizontal
+    fabric's trajectory (ISSUE 14).  Throughput (higher), total-latency
+    percentiles (lower, CI-backed by the bounded sample list),
+    availability at the CLIENT tier (higher — the outermost ledger's
+    robustness headline), the POOL-LEVEL cache hit rate (higher — the
+    number consistent-hash routing exists to lift past the per-worker
+    baseline), the client-observed hedge rate (lower — paid straggler
+    insurance), failovers as info (they track the chaos plan, not code
+    quality), and the fleet-summed fresh-compile count (lower)."""
+    extra = obj.get("extra") or {}
+    platform = extra.get("platform")
+    device_kind = extra.get("device_kind") or platform
+    workload = extra.get("workload")
+    flags = _flags(obj, variant)
+    base = dict(run=run, run_num=num, source=source, platform=platform,
+                device_kind=device_kind, workload=workload, flags=flags)
+    rows = []
+    v = _num(obj.get("value"))
+    if v is not None:
+        if obj.get("offered_limited") is True:
+            thr_base = dict(base, flags=flags + ("offered-limited",))
+        else:
+            thr_base = base
+        rows.append(Row(metric="serve_fabric_throughput_rps", value=v,
+                        unit=str(obj.get("unit", "req/s")),
+                        direction="higher", **thr_base))
+    orps = _num((obj.get("offered") or {}).get("offered_rps"))
+    if orps is not None:
+        rows.append(Row(metric="serve_fabric_offered_rps", value=orps,
+                        unit="req/s", direction="higher",
+                        **dict(base, flags=_flags(obj, variant,
+                                                  info=True))))
+    fabric_samples = _sample_map(extra).get("serve_fabric_total_ms", ())
+    total = (obj.get("latency_ms") or {}).get("total")
+    if isinstance(total, dict):
+        for q in ("p50", "p95", "p99"):
+            pv = _num(total.get(q))
+            if pv is not None:
+                rows.append(Row(metric=f"serve_fabric_{q}_ms", value=pv,
+                                unit="ms", direction="lower",
+                                **dict(base, samples=fabric_samples)))
+    av = _num(obj.get("availability"))
+    if av is not None:
+        rows.append(Row(metric="serve_fabric_availability", value=av,
+                        unit="frac", direction="higher", **base))
+    chr_ = _num((obj.get("cache") or {}).get("pool_hit_rate"))
+    if chr_ is not None:
+        rows.append(Row(metric="serve_fabric_cache_hit_rate", value=chr_,
+                        unit="frac", direction="higher", **base))
+    hr = _num((obj.get("hedge") or {}).get("rate"))
+    if hr is not None:
+        rows.append(Row(metric="serve_fabric_hedge_rate", value=hr,
+                        unit="frac", direction="lower", **base))
+    fo = _num((obj.get("requests") or {}).get("failovers"))
+    if fo is not None:
+        rows.append(Row(metric="serve_fabric_failovers", value=fo,
+                        unit="req", direction="lower",
+                        **dict(base, flags=_flags(obj, variant,
+                                                  info=True))))
+    fc = _num((obj.get("compile") or {}).get("in_window_fresh_compiles"))
+    if fc is not None:
+        rows.append(Row(metric="serve_fabric_in_window_fresh_compiles",
+                        value=fc, unit="compiles", direction="lower",
+                        **base))
+    return rows
+
+
 def _trace_rows(obj: dict, run: str, num: int, variant,
                 source: str) -> list:
     """Rows from a TRACE artifact: the request-path decomposition's
@@ -686,6 +755,15 @@ def ingest_file(path: str, have_full_runs=frozenset()) -> tuple:
                                  f"{list(inv.KNOWN_REPLAY_SCHEMA_VERSIONS)}"
                                  "): not half-parsed into rows"}]
         return _replay_rows(obj, run, num, variant, source), []
+    if kind == "serve_fabric":
+        ver = obj.get("schema_version")
+        if ver not in inv.KNOWN_SERVE_FABRIC_SCHEMA_VERSIONS:
+            return [], [{"source": source,
+                         "note": f"unknown serve_fabric schema_version "
+                                 f"{ver!r} (reader understands "
+                                 f"{list(inv.KNOWN_SERVE_FABRIC_SCHEMA_VERSIONS)}"
+                                 "): not half-parsed into rows"}]
+        return _serve_fabric_rows(obj, run, num, variant, source), []
     if kind == "serve_pool":
         ver = obj.get("schema_version")
         if ver not in inv.KNOWN_SERVE_POOL_SCHEMA_VERSIONS:

@@ -67,8 +67,9 @@ from csmom_tpu.serve.service import ServeConfig, SignalService
 from csmom_tpu.utils.deadline import mono_now_s
 
 __all__ = ["LoadConfig", "NAMED_SCHEDULES", "arrival_offsets",
-           "build_artifact", "build_pool_artifact", "parse_schedule",
-           "resolve_schedule", "run_loadgen", "run_pool_loadgen",
+           "build_artifact", "build_fabric_artifact",
+           "build_pool_artifact", "parse_schedule", "resolve_schedule",
+           "run_fabric_loadgen", "run_loadgen", "run_pool_loadgen",
            "synth_panel", "write_artifact"]
 
 # schema v3 (ISSUE 9): per-endpoint books + latency, endpoint set
@@ -79,6 +80,14 @@ __all__ = ["LoadConfig", "NAMED_SCHEDULES", "arrival_offsets",
 # can never silently vanish from committed evidence.
 SCHEMA_VERSION = 4
 POOL_SCHEMA_VERSION = 1
+FABRIC_SCHEMA_VERSION = 1
+
+# the r15 PER-WORKER cache hit rate (SERVE_MESH_r15.json): the number
+# the fabric's consistent-hash routing exists to beat at POOL level —
+# identical requests that round-robin across workers split their
+# repeats across N private caches; landing them on the SAME worker
+# compounds the hit rate instead
+R15_PER_WORKER_HIT_RATE = 0.246
 
 # the r10/r11 default mixes, expressed as an SLO-class mix
 _DEFAULT_MIX = (("interactive", 0.6), ("standard", 0.15), ("bulk", 0.25))
@@ -560,30 +569,20 @@ def build_artifact(service: SignalService, load: LoadConfig,
 
 # ------------------------------------------------------------------ pool ---
 
-def run_pool_loadgen(router, supervisor, load: LoadConfig,
-                     concurrent=None) -> dict:
-    """Drive the multi-process pool with the SAME seeded open-loop
-    schedule as :func:`run_loadgen`, through the router.
-
-    The pool is NOT stopped here (the caller may still want to kill /
-    roll / inspect workers); the books close once every admitted request
-    reaches a terminal state — which the router guarantees per request,
-    so waiting on the handles IS the drain.
-
-    ``concurrent`` (optional callable) runs in a thread alongside the
-    load stream — the chaos lever for "do X UNDER load" scenarios
-    (rolling restart, a mid-run kill).  The artifact is built only after
-    BOTH the load's requests are terminal AND ``concurrent`` returned,
-    so worker stats and fleet events are read from a settled pool."""
+def _open_loop_drive(offsets, submit_arrival, concurrent=None,
+                     drain_give_up_s: float = 60.0,
+                     artifact_label: str = "pool") -> tuple:
+    """The shared open-loop scaffold behind the pool and fabric drives:
+    run ``concurrent`` in a side thread, fire ``submit_arrival(i)`` at
+    each schedule offset (open loop — the schedule's clock rules, not
+    the service's), wait every request terminal within
+    ``drain_give_up_s``, then join the side thread with its OWN
+    generous budget (a roll can outlast the request drain) and refuse
+    to return from a still-mutating fleet rather than let the caller
+    land a mid-roll snapshot as evidence.  A ``concurrent`` exception
+    is surfaced after the join, never lost.  Returns
+    ``(requests, wall_s)``."""
     import threading
-
-    rng = random.Random(load.seed)
-    segments = parse_schedule(load.schedule)
-    offsets = arrival_offsets(segments, rng)
-    spec = router.spec
-    max_assets = min(load.max_assets or spec.max_assets, spec.max_assets)
-    mix = load.mix()
-    kinds = list(load.resolved_kinds())  # hoisted out of the timed loop
 
     side = None
     side_exc: list = []
@@ -600,32 +599,60 @@ def run_pool_loadgen(router, supervisor, load: LoadConfig,
     t_start = mono_now_s()
     if side is not None:
         side.start()
-    for off in offsets:
+    for i, off in enumerate(offsets):
         delay = (t_start + off) - mono_now_s()
         if delay > 0:
             time.sleep(delay)  # open loop: the schedule's clock rules
-        kind = rng.choice(kinds)
-        n_assets = rng.randint(2, max_assets)
-        values, mask = synth_panel(rng, n_assets, spec.months, kind)
-        requests.append(router.submit(kind, values, mask,
-                                      priority=_pick_class(mix, rng),
-                                      deadline_s=load.deadline_s))
-    give_up = mono_now_s() + 60.0
+        requests.append(submit_arrival(i))
+    give_up = mono_now_s() + drain_give_up_s
     for r in requests:
         r.wait(timeout=max(0.0, give_up - mono_now_s()))
     wall_s = mono_now_s() - t_start
     if side is not None:
-        # the artifact's "built after a settled pool" contract: give the
-        # concurrent action its OWN generous budget (a roll can outlast
-        # the request drain), and refuse to build from a still-mutating
-        # fleet rather than land a mid-roll snapshot as evidence
         side.join(timeout=300.0)
         if side.is_alive():
             raise RuntimeError(
-                "concurrent action still running after 300s — refusing "
-                "to build the pool artifact from an unsettled fleet")
+                f"concurrent action still running after 300s — refusing "
+                f"to build the {artifact_label} artifact from an "
+                "unsettled fleet")
         if side_exc:
             raise side_exc[0]
+    return requests, wall_s
+
+
+def run_pool_loadgen(router, supervisor, load: LoadConfig,
+                     concurrent=None) -> dict:
+    """Drive the multi-process pool with the SAME seeded open-loop
+    schedule as :func:`run_loadgen`, through the router.
+
+    The pool is NOT stopped here (the caller may still want to kill /
+    roll / inspect workers); the books close once every admitted request
+    reaches a terminal state — which the router guarantees per request,
+    so waiting on the handles IS the drain.
+
+    ``concurrent`` (optional callable) runs in a thread alongside the
+    load stream — the chaos lever for "do X UNDER load" scenarios
+    (rolling restart, a mid-run kill).  The artifact is built only after
+    BOTH the load's requests are terminal AND ``concurrent`` returned,
+    so worker stats and fleet events are read from a settled pool."""
+    rng = random.Random(load.seed)
+    segments = parse_schedule(load.schedule)
+    offsets = arrival_offsets(segments, rng)
+    spec = router.spec
+    max_assets = min(load.max_assets or spec.max_assets, spec.max_assets)
+    mix = load.mix()
+    kinds = list(load.resolved_kinds())  # hoisted out of the timed loop
+
+    def submit_arrival(_i):
+        kind = rng.choice(kinds)
+        n_assets = rng.randint(2, max_assets)
+        values, mask = synth_panel(rng, n_assets, spec.months, kind)
+        return router.submit(kind, values, mask,
+                             priority=_pick_class(mix, rng),
+                             deadline_s=load.deadline_s)
+
+    requests, wall_s = _open_loop_drive(offsets, submit_arrival,
+                                        concurrent, 60.0, "pool")
     return build_pool_artifact(router, supervisor, load, requests, wall_s)
 
 
@@ -767,6 +794,248 @@ def build_pool_artifact(router, supervisor, load: LoadConfig,
             "deadline_ms": (None if load.deadline_s is None
                             else round(1e3 * load.deadline_s, 3)),
             "class_mix": {name: w for name, w in load.mix()},
+        },
+        "extra": extra,
+    }
+
+
+# ---------------------------------------------------------------- fabric ---
+
+def run_fabric_loadgen(client, router_sup, worker_sup, load: LoadConfig,
+                       concurrent=None) -> dict:
+    """Drive the THREE-TIER fabric (loadgen → router replicas → workers)
+    with the seeded open-loop schedule, through a
+    :class:`~csmom_tpu.serve.fabric.FabricClient`.
+
+    Same determinism contract as :func:`run_loadgen`, plus the pool-level
+    cache shape: ``reuse_fraction`` repeats recent panels per kind, so
+    the consistent-hash routing has identical requests to land on the
+    same worker — the per-worker result cache compounding into a pool
+    cache is exactly what the artifact measures.  ``concurrent`` runs
+    alongside the stream (the chaos lever: a router SIGKILL plus a
+    worker SIGKILL mid-burst is the rehearsed r18 scenario) and the
+    books close only after the requests are terminal AND it returned.
+    """
+    from csmom_tpu.serve.buckets import bucket_spec
+
+    rng = random.Random(load.seed)
+    segments = parse_schedule(load.schedule)
+    offsets = arrival_offsets(segments, rng)
+    spec = bucket_spec(worker_sup.config.profile)
+    max_assets = min(load.max_assets or spec.max_assets, spec.max_assets)
+    mix = load.mix()
+    kinds = list(load.resolved_kinds())
+    recent: dict = {k: [] for k in kinds}
+
+    state = {"epoch": 1 if load.version_bumps > 0 else None}
+    bump_at = sorted(
+        max(1, round(len(offsets) * (k + 1) / (load.version_bumps + 1)))
+        for k in range(load.version_bumps)
+    ) if load.version_bumps > 0 else []
+
+    def submit_arrival(i):
+        if bump_at and i == bump_at[0]:
+            bump_at.pop(0)
+            state["epoch"] += 1
+            # a panel-version bump reaches the workers per request (the
+            # version rides the wire); old-epoch cache entries can only
+            # be refused, never served — the stale_hits == 0 schema rule
+            for pool in recent.values():
+                pool.clear()
+        kind = rng.choice(kinds)
+        pool = recent[kind]
+        if pool and rng.random() < load.reuse_fraction:
+            values, mask = pool[rng.randrange(len(pool))]
+        else:
+            n_assets = rng.randint(2, max_assets)
+            values, mask = synth_panel(rng, n_assets, spec.months, kind)
+            pool.append((values, mask))
+            del pool[:-8]  # a small window of reusable recents per kind
+        return client.submit(
+            kind, values, mask, priority=_pick_class(mix, rng),
+            deadline_s=load.deadline_s, panel_version=state["epoch"])
+
+    # the fabric drain allows 90s (vs the pool's 60): a double kill can
+    # park a request behind TWO tiers' respawns before it settles
+    requests, wall_s = _open_loop_drive(offsets, submit_arrival,
+                                        concurrent, 90.0, "fabric")
+    return build_fabric_artifact(client, router_sup, worker_sup, load,
+                                 requests, wall_s)
+
+
+def _fleet_block(sup, stats: list) -> dict:
+    """One tier's fleet evidence (router or worker supervisor)."""
+    summary = sup.summary()
+    return {
+        "n_slots": sup.config.n_workers,
+        "ready_end": sum(1 for s in stats if s.get("state") == "ready"),
+        "kills": summary["kills"],
+        "restarts": summary["restarts"],
+        "rolls_completed": summary["rolls_completed"],
+        "events": summary["events"][:200],
+    }
+
+
+def _worker_cache_aggregate(worker_stats: list) -> dict:
+    """The fleet-wide worker cache book: sums across every REPORTING
+    worker, with the non-reporting slots NAMED (a corpse's book died
+    with it — the client tier's ``served_cache_hits`` is the count that
+    survives, these sums are the per-worker evidence)."""
+    agg = {k: 0 for k in ("hits", "misses", "lookups", "stale_hits",
+                          "stale_blocked", "stale_put_refused",
+                          "inserts", "evictions", "invalidated")}
+    lost = []
+    reporting = 0
+    for w in worker_stats:
+        cache = w.get("cache")
+        if not isinstance(cache, dict):
+            lost.append(f"{w.get('worker_id')}: {w.get('state')}")
+            continue
+        reporting += 1
+        for k in agg:
+            v = cache.get(k)
+            if isinstance(v, int) and not isinstance(v, bool):
+                agg[k] += v
+    agg["reporting"] = reporting
+    agg["lost"] = lost
+    return agg
+
+
+def build_fabric_artifact(client, router_sup, worker_sup,
+                          load: LoadConfig, requests: list,
+                          wall_s: float) -> dict:
+    """The SERVE_FABRIC artifact: the CLIENT tier's closed books (the
+    outermost ledger — the one a SIGKILLed replica cannot take with it),
+    per-replica router books, the worker fleet, and the pool-level cache
+    rate the consistent-hash routing exists to produce."""
+    acct = client.accounting()
+    served = [r for r in requests if r.state == "served"]
+    throughput = round(acct["served"] / wall_s, 3) if wall_s > 0 else 0.0
+    segments = parse_schedule(load.schedule)
+    duration = schedule_duration_s(segments)
+    offered_rps = round(len(requests) / duration, 3) if duration else 0.0
+    router_stats = router_sup.router_stats()
+    worker_stats = worker_sup.worker_stats()
+    fresh = _pool_fresh_compiles(worker_stats)
+    cache_agg = _worker_cache_aggregate(worker_stats)
+    pool_hit_rate = (round(acct["served_cache_hits"] / acct["served"], 4)
+                     if acct["served"] else 0.0)
+
+    # router-tier hedge sums across the replicas still standing; a dead
+    # replica's books are reported lost, and the hedged SERVED count the
+    # client observed is the number that cannot die with a corpse
+    r_hedged = r_wins = r_suppressed = 0
+    r_lost = []
+    for r in router_stats:
+        a = r.get("accounting")
+        if isinstance(a, dict):
+            r_hedged += a.get("hedged", 0)
+            r_wins += a.get("hedge_wins", 0)
+            r_suppressed += a.get("duplicates_suppressed", 0)
+        else:
+            r_lost.append(f"{r.get('router_id')}: {r.get('state')}")
+    admitted = max(1, acct["admitted"])
+
+    platform = None
+    for h in worker_sup.handles:
+        rep = h.ready_report or {}
+        if isinstance(rep.get("platform"), str):
+            platform = rep["platform"]
+            break
+    from csmom_tpu.serve.buckets import bucket_spec
+
+    wcfg = worker_sup.config
+    spec = bucket_spec(wcfg.profile)
+    scheme = "tcp" if wcfg.transport == "tcp" else "unix"
+    workload = (
+        f"fabric open-loop {load.schedule} rps seed {load.seed}, "
+        f"{'/'.join(load.resolved_kinds())} mix, "
+        f"{router_sup.config.n_workers} routers x {wcfg.n_workers} "
+        f"workers over {scheme}, buckets "
+        f"B({','.join(map(str, spec.batch_buckets))})x"
+        f"A({','.join(map(str, spec.asset_buckets))})x{spec.months}m "
+        f"({spec.dtype}, {wcfg.engine} engine)"
+    )
+    extra = {
+        "platform": platform,
+        "engine": wcfg.engine,
+        "workload": workload,
+        "cache_version": worker_sup.expect_cache_version,
+        "samples": {"serve_fabric_total_ms": _bounded_samples(
+            [1e3 * r.total_s for r in served if r.total_s is not None],
+            SAMPLE_CAP, load.seed)},
+    }
+    if spec.name == "serve-smoke":
+        extra["smoke"] = ("smoke-bucket fabric run: pipeline-shaped, "
+                          "workload reduced — NOT a performance capture")
+    return {
+        "kind": "serve_fabric",
+        "schema_version": FABRIC_SCHEMA_VERSION,
+        "run_id": load.run_id,
+        "metric": "serve_fabric_throughput_rps",
+        "value": throughput,
+        "unit": "req/s",
+        "vs_baseline": 1.0,
+        "wall_s": round(wall_s, 4),
+        "offered_limited": bool(acct["rejected"] == 0
+                                and acct["expired"] == 0),
+        "transport": {
+            "scheme": scheme,
+            "routers": router_sup.config.n_workers,
+            "workers": wcfg.n_workers,
+        },
+        "requests": acct,
+        "availability": client.availability(),
+        "cache": {
+            # the fabric headline: hit rate at POOL level, counted at
+            # the client (a worker corpse cannot take it along), vs the
+            # r15 per-worker baseline the hash routing had to beat
+            "pool_hit_rate": pool_hit_rate,
+            "served_cache_hits": acct["served_cache_hits"],
+            "served": acct["served"],
+            "per_worker_baseline": R15_PER_WORKER_HIT_RATE,
+            "workers": cache_agg,
+        },
+        "hedge": {
+            "served_hedged": acct["served_hedged"],
+            "rate": round(acct["served_hedged"] / admitted, 4),
+            "router_tier": {
+                "hedged": r_hedged,
+                "wins": r_wins,
+                "suppressed": r_suppressed,
+                "books_lost": r_lost,
+            },
+        },
+        "latency_ms": {"total": _percentiles(
+            [r.total_s for r in served if r.total_s is not None])},
+        "routers": {
+            "replicas": router_stats,
+            **_fleet_block(router_sup, router_stats),
+        },
+        "workers": {
+            "stats": worker_stats,
+            **_fleet_block(worker_sup, worker_stats),
+        },
+        "compile": {
+            "in_window_fresh_compiles": fresh,
+            "note": "sum of per-worker backend_compiles deltas since "
+                    "each worker's own warmup snapshot: 0 = no worker "
+                    "compiled inside the serving window (router "
+                    "replicas hold no compiled world at all)",
+        },
+        "offered": {
+            "schedule": load.schedule,
+            "schedule_kind": load.schedule_kind,
+            "seed": load.seed,
+            "n_arrivals": len(requests),
+            "duration_s": round(duration, 4),
+            "offered_rps": offered_rps,
+            "kinds": list(load.resolved_kinds()),
+            "deadline_ms": (None if load.deadline_s is None
+                            else round(1e3 * load.deadline_s, 3)),
+            "class_mix": {name: w for name, w in load.mix()},
+            "reuse_fraction": load.reuse_fraction,
+            "version_bumps": load.version_bumps,
         },
         "extra": extra,
     }

@@ -1,20 +1,43 @@
-"""Wire protocol for the serving pool: framed JSON + raw array payloads.
+"""Wire protocol for the serving fabric: framed JSON + raw array payloads.
 
-The router, the health probes, and the workers speak one tiny protocol
-over a local ``AF_UNIX`` stream socket: a 4-byte big-endian frame
-length, then a length-prefixed JSON header, then the concatenated raw
-bytes of any numpy arrays the header declares (name / dtype / shape /
-nbytes, in order).  Binary payloads because a request panel is up to
-``128 x 60`` float32 — base64-in-JSON would inflate every dispatch by a
-third for nothing; JSON headers because every *control* field stays
-greppable in a socket dump.
+The routers, the health probes, and the workers speak one tiny protocol
+over a stream socket: a 4-byte big-endian frame length, then a
+length-prefixed JSON header, then the concatenated raw bytes of any
+numpy arrays the header declares (name / dtype / shape / nbytes, in
+order).  Binary payloads because a request panel is up to ``128 x 60``
+float32 — base64-in-JSON would inflate every dispatch by a third for
+nothing; JSON headers because every *control* field stays greppable in a
+socket dump.
+
+**Addresses** (the r18 horizontal-fabric round): every connect/listen
+takes an address string —
+
+=====================  ==================================================
+address                meaning
+=====================  ==================================================
+``unix:/path/w0.sock``  an ``AF_UNIX`` stream socket (same host)
+``tcp:host:port``       an ``AF_INET`` stream socket (cross-host)
+``/path/w0.sock``       bare paths stay unix (r11 back-compat)
+=====================  ==================================================
+
+so the same supervisor/router/worker machinery runs one-host pools over
+unix sockets AND multi-container fabrics over TCP by changing nothing
+but the address strings.
 
 Design constraints this encodes:
 
-- **Bounded**: a frame larger than ``MAX_FRAME_BYTES`` is refused at
-  read time (a corrupt length prefix must not allocate gigabytes), and
-  array specs are validated against the declared byte count before a
-  single array is materialized.
+- **Bounded**: a frame larger than ``MAX_FRAME_BYTES`` is refused with a
+  pointed message AT READ TIME, before the payload is allocated (a
+  corrupt or hostile length prefix must never become a gigabyte
+  ``bytearray``), and array specs are validated against the declared
+  byte count before a single array is materialized.
+- **Receive deadlines**: every frame read carries a deadline
+  (``RECV_DEADLINE_S`` default).  ``_recv_exact`` re-arms the socket
+  timeout per read from the REMAINING budget, so a stalled — or
+  byte-trickling — peer raises a pointed :class:`ProtocolError` when the
+  budget runs out instead of resetting a per-read timeout forever.  The
+  r11 ``_recv_exact`` blocked as long as the peer kept the socket alive;
+  a wedged worker could pin a router thread indefinitely.
 - **Connection-per-request**: the router opens one connection per
   dispatch attempt.  That keeps hedging trivial (two attempts are two
   independent sockets; abandoning one cannot corrupt the other's
@@ -25,16 +48,30 @@ Design constraints this encodes:
   monitor loop must stay importable in processes that never touch a
   device (the same split as ``serve/buckets.py``).
 
+**Chaos** (the ``serve.transport`` checkpoint): every ``score``-op
+round trip visits ``serve.transport`` before connecting, so a fault
+plan can break the WIRE instead of a process — ``conn_reset`` raises a
+connection reset into the caller's failover handling, ``net_delay``
+stalls the transport by ``CSMOM_CHAOS_NET_DELAY_S`` (an induced
+straggler: the hedging policy is what the scenario then measures), and
+``partition`` cuts THIS process off from the peer address it was about
+to dial for ``CSMOM_CHAOS_PARTITION_S`` seconds (every connect to that
+peer fails instantly until the partition heals — the router losing a
+worker host mid-burst).  Probe/lifecycle ops do not visit the
+checkpoint, so supervisor probes keep deterministic hit counts.
+
 Request tracing rides the header, not the framing: a ``score`` frame may
 carry a ``trace`` entry (trace id, endpoint, SLO class, panel version —
 identity only, never timestamps, so each process keeps its own clock and
-stitching works on durations), and the worker's reply then carries a
+stitching works on durations), and the peer's reply then carries a
 ``trace_half`` entry with its server-side stage chain.  The protocol
 itself is unchanged — untraced deployments serialize not one extra byte,
 and an old worker simply ignores the field (see
 :mod:`csmom_tpu.obs.trace` for the stitching contract).
 
-Ops the worker answers (see :mod:`csmom_tpu.serve.worker`):
+Ops the worker answers (see :mod:`csmom_tpu.serve.worker`); the router
+replica answers the same lifecycle set (see
+:mod:`csmom_tpu.serve.router`):
 
 =========  ==================================================
 op         meaning
@@ -44,39 +81,195 @@ ready      readiness report (warm + self-probe + cache version)
 score      one scoring request (arrays: values, mask)
 stats      accounting / batch stats / fresh-compile count
 drain      stop admitting, drain the queue, report accounting
-stop       drain, then exit the worker process
+stop       drain, then exit the process
 =========  ==================================================
 """
 
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
+import threading
+import time
 
 import numpy as np
 
-__all__ = ["MAX_FRAME_BYTES", "ProtocolError", "connect", "recv_msg",
-           "request", "send_msg"]
+from csmom_tpu.utils.deadline import mono_now_s
+
+__all__ = ["MAX_FRAME_BYTES", "RECV_DEADLINE_S", "ProtocolError",
+           "connect", "free_tcp_port", "listen", "parse_address",
+           "recv_msg", "request", "send_msg", "unlink_address"]
 
 # largest legal frame: the biggest production micro-panel is ~30 KB, so
 # 32 MB is three orders of magnitude of headroom while still refusing a
 # garbage length prefix before it can exhaust memory
 MAX_FRAME_BYTES = 32 * 1024 * 1024
 
+# total budget for receiving ONE frame (header + payload).  Generous
+# against any honest peer (a full frame is one sendall away), tight
+# against a wedged one: a worker that stops mid-frame costs the router
+# this much wall, never a thread forever.
+RECV_DEADLINE_S = 30.0
+
 _LEN = struct.Struct("!I")
+
+# chaos partition state (the `partition` action at serve.transport):
+# peer address -> monotonic heal time.  Process-local on purpose — a
+# partition separates THIS process from a peer host, not the world.
+_PARTITION_LOCK = threading.Lock()
+_PARTITIONED: dict = {}
+
+# fault-duration knobs (chaos actions are caller-interpreted and the
+# checkpoint returns only the action name, so durations ride the same
+# env channel the plans do)
+PARTITION_ENV = "CSMOM_CHAOS_PARTITION_S"
+NET_DELAY_ENV = "CSMOM_CHAOS_NET_DELAY_S"
+_PARTITION_DEFAULT_S = 1.0
+_NET_DELAY_DEFAULT_S = 0.25
 
 
 class ProtocolError(RuntimeError):
-    """A malformed frame (bad length, truncated payload, spec mismatch)."""
+    """A malformed frame (bad length, truncated payload, spec mismatch,
+    or a receive deadline expiring on a stalled peer)."""
 
 
-def connect(socket_path: str, timeout_s: float) -> socket.socket:
+# ------------------------------------------------------------ addresses ---
+
+def parse_address(address: str) -> tuple:
+    """``("unix", path)`` or ``("tcp", (host, port))`` for an address
+    string.  Bare paths are unix (the r11 spelling); ``tcp:`` needs
+    ``host:port`` with an integer port."""
+    if address.startswith("unix:"):
+        path = address[len("unix:"):]
+        if not path:
+            raise ValueError(f"empty unix socket path in {address!r}")
+        return "unix", path
+    if address.startswith("tcp:"):
+        rest = address[len("tcp:"):]
+        host, sep, port_s = rest.rpartition(":")
+        if not sep or not host:
+            raise ValueError(
+                f"bad tcp address {address!r}: use tcp:host:port")
+        try:
+            port = int(port_s)
+        except ValueError:
+            raise ValueError(
+                f"bad tcp port in {address!r}: {port_s!r} is not an "
+                "integer") from None
+        if not 0 <= port <= 65535:
+            raise ValueError(f"tcp port {port} outside [0, 65535]")
+        return "tcp", (host, port)
+    return "unix", address
+
+
+def free_tcp_port(host: str = "127.0.0.1") -> int:
+    """One currently-free TCP port (bind-to-0 then release).  Classic
+    small race with other port grabbers; fine for the loopback fabrics
+    the supervisor spawns, where it owns the port range in practice."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.bind((host, 0))
+        return int(s.getsockname()[1])
+    finally:
+        s.close()
+
+
+def listen(address: str, backlog: int = 64) -> socket.socket:
+    """A bound, listening server socket for ``address`` (unix or tcp).
+    Unix paths are unlinked first (a crashed predecessor's stale socket
+    file must not block the bind); tcp sets ``SO_REUSEADDR`` for the
+    same reason."""
+    scheme, target = parse_address(address)
+    if scheme == "unix":
+        try:
+            os.unlink(target)
+        except OSError:
+            pass
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(target)
+    else:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(target)
+    srv.listen(backlog)
+    return srv
+
+
+def unlink_address(address: str) -> None:
+    """Remove a unix socket path (no-op for tcp) — shutdown hygiene."""
+    scheme, target = parse_address(address)
+    if scheme == "unix":
+        try:
+            os.unlink(target)
+        except OSError:
+            pass
+
+
+def _partitioned_until(address: str) -> float | None:
+    with _PARTITION_LOCK:
+        heal_at = _PARTITIONED.get(address)
+        if heal_at is None:
+            return None
+        if mono_now_s() >= heal_at:
+            del _PARTITIONED[address]
+            return None
+        return heal_at
+
+
+def _chaos_env_s(env: str, default_s: float) -> float:
+    """A chaos duration knob from the environment, defaulting on a
+    malformed value — a typo'd \"250ms\" must degrade to the default
+    fault, not raise an unhandled ValueError through the dispatch
+    thread and strand its request non-terminal."""
+    raw = os.environ.get(env)
+    if not raw:
+        return default_s
+    try:
+        return float(raw)
+    except ValueError:
+        return default_s
+
+
+def _chaos_transport(address: str, op: str) -> None:
+    """The ``serve.transport`` checkpoint, fired per score-op dial.
+
+    Caller-interpreted actions: ``conn_reset`` raises into the caller's
+    existing connection-failure handling; ``net_delay`` sleeps the
+    configured straggler delay; ``partition`` cuts this process off from
+    ``address`` for the configured window (subsequent dials fail
+    instantly until it heals).  An already-armed partition fails the
+    dial whether or not a fault fires on this visit.
+    """
+    from csmom_tpu.chaos.inject import checkpoint
+
+    fired = checkpoint("serve.transport", addr=address, op=op)
+    if fired == "partition":
+        heal_s = _chaos_env_s(PARTITION_ENV, _PARTITION_DEFAULT_S)
+        with _PARTITION_LOCK:
+            _PARTITIONED[address] = mono_now_s() + heal_s
+    elif fired == "net_delay":
+        time.sleep(_chaos_env_s(NET_DELAY_ENV, _NET_DELAY_DEFAULT_S))
+    elif fired == "conn_reset":
+        raise ConnectionResetError(
+            f"chaos conn_reset injected at serve.transport (peer "
+            f"{address})")
+    if _partitioned_until(address) is not None:
+        raise ConnectionRefusedError(
+            f"chaos partition: this process is partitioned from "
+            f"{address} (heals in <= "
+            f"{os.environ.get(PARTITION_ENV, _PARTITION_DEFAULT_S)}s)")
+
+
+def connect(address: str, timeout_s: float) -> socket.socket:
     """One connected, timeout-armed client socket to a worker/router."""
-    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    scheme, target = parse_address(address)
+    family = socket.AF_UNIX if scheme == "unix" else socket.AF_INET
+    sock = socket.socket(family, socket.SOCK_STREAM)
     sock.settimeout(timeout_s)
     try:
-        sock.connect(socket_path)
+        sock.connect(target)
     except OSError:
         sock.close()
         raise
@@ -108,10 +301,27 @@ def send_msg(sock: socket.socket, obj: dict, arrays: dict | None = None) -> None
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int, give_up_s: float) -> bytes:
+    """Exactly ``n`` bytes from ``sock`` before the ``give_up_s``
+    monotonic deadline.  The socket timeout is re-armed per read from
+    the REMAINING budget — a peer trickling one byte per timeout window
+    used to reset the clock forever; now the total wall is bounded."""
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        remaining = give_up_s - mono_now_s()
+        if remaining <= 0:
+            raise ProtocolError(
+                f"receive deadline expired mid-frame ({len(buf)}/{n} "
+                "bytes read) — the peer stalled; closing rather than "
+                "wedging this thread")
+        sock.settimeout(min(remaining, sock.gettimeout() or remaining))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            raise ProtocolError(
+                f"receive deadline expired mid-frame ({len(buf)}/{n} "
+                "bytes read) — the peer stalled; closing rather than "
+                "wedging this thread") from None
         if not chunk:
             raise ProtocolError(
                 f"connection closed mid-frame ({len(buf)}/{n} bytes read) "
@@ -120,19 +330,37 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def recv_msg(sock: socket.socket) -> tuple:
+def recv_msg(sock: socket.socket,
+             deadline_s: float = RECV_DEADLINE_S) -> tuple:
     """Receive one frame; returns ``(obj, arrays)``.
 
-    Every declared array is rebuilt from the binary tail; a spec whose
-    byte counts do not reconcile with the frame is a protocol error, not
-    a best-effort parse — half a panel must never score.
+    The whole frame (length prefix + header + payload) must arrive
+    within ``deadline_s``.  Every declared array is rebuilt from the
+    binary tail; a spec whose byte counts do not reconcile with the
+    frame is a protocol error, not a best-effort parse — half a panel
+    must never score.  The length prefix is judged against
+    ``MAX_FRAME_BYTES`` BEFORE any payload allocation: a corrupt or
+    hostile prefix costs a pointed refusal, never the allocation it
+    names.
     """
-    (total,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    if total > MAX_FRAME_BYTES:
-        raise ProtocolError(
-            f"declared frame length {total} exceeds MAX_FRAME_BYTES "
-            f"({MAX_FRAME_BYTES}) — corrupt length prefix?")
-    payload = _recv_exact(sock, total)
+    give_up = mono_now_s() + deadline_s
+    # _recv_exact re-arms the socket timeout downward per read; restore
+    # the caller's timeout afterwards so a later send/receive on the
+    # same connection doesn't inherit a near-zero residual budget
+    caller_timeout = sock.gettimeout()
+    try:
+        (total,) = _LEN.unpack(_recv_exact(sock, _LEN.size, give_up))
+        if total > MAX_FRAME_BYTES:
+            raise ProtocolError(
+                f"declared frame length {total} exceeds MAX_FRAME_BYTES "
+                f"({MAX_FRAME_BYTES}) — corrupt length prefix?  Refusing "
+                "before allocating it")
+        payload = _recv_exact(sock, total, give_up)
+    finally:
+        try:
+            sock.settimeout(caller_timeout)
+        except OSError:
+            pass  # the socket may already be closed/reset
     if len(payload) < _LEN.size:
         raise ProtocolError("frame shorter than its header length prefix")
     (hlen,) = _LEN.unpack(payload[:_LEN.size])
@@ -172,13 +400,21 @@ def recv_msg(sock: socket.socket) -> tuple:
     return obj, arrays
 
 
-def request(socket_path: str, obj: dict, arrays: dict | None = None,
+def request(address: str, obj: dict, arrays: dict | None = None,
             timeout_s: float = 5.0) -> tuple:
-    """One-shot round trip: connect, send, receive one reply, close."""
-    sock = connect(socket_path, timeout_s)
+    """One-shot round trip: connect, send, receive one reply, close.
+
+    ``timeout_s`` bounds the connect AND the whole reply receive (the
+    receive-deadline contract), so one call can never outwait its
+    budget no matter how the peer misbehaves.  ``score`` ops visit the
+    ``serve.transport`` chaos checkpoint before dialing.
+    """
+    if obj.get("op") == "score":
+        _chaos_transport(address, "score")
+    sock = connect(address, timeout_s)
     try:
         send_msg(sock, obj, arrays)
-        return recv_msg(sock)
+        return recv_msg(sock, deadline_s=timeout_s)
     finally:
         try:
             sock.close()

@@ -7,6 +7,35 @@ every admitted request reaches exactly one terminal state — ``served`` /
 ``rejected`` / ``expired`` — no matter which worker died, answered late,
 or answered twice.
 
+Since the r18 fabric round the router is also its own SUPERVISED
+PROCESS: ``python -m csmom_tpu.serve.router --listen ADDR --routes
+FILE`` runs a :class:`RouterServer` replica speaking the same framed
+protocol as the workers (``serve/proto.py``, unix or tcp), reading its
+routable worker set from the shared routes file the fabric publishes
+(:mod:`csmom_tpu.serve.fabric`).  Two or more replicas sit behind a
+:class:`~csmom_tpu.serve.fabric.FabricClient` — a replica SIGKILLed
+mid-burst costs its in-flight requests one client-side failover to a
+surviving replica, never a lost request.
+
+**Consistent-hash cache routing** (:class:`HashRing`): a request that
+carries a result-cache identity (endpoint + panel content fingerprint +
+panel version — the same key :mod:`csmom_tpu.serve.cache` uses) is
+routed to the worker its key hashes to, so byte-identical requests land
+on the SAME worker and the per-worker result cache compounds into a
+pool-level cache.  The ring is rebuilt from whatever workers are
+currently ready: a dead worker's arc redistributes, its replacement
+reclaims it (stale hits stay structurally impossible — the version
+floor lives in the worker's cache, not in the routing).  Hedges and
+failovers exclude the tried worker, so affinity degrades to the
+next-best worker instead of stalling.
+
+**Weighted fair dispatch** (:class:`WeightedFairGate`): a bounded
+number of dispatches run concurrently, and when the gate is contended
+the next slot goes to the waiting SLO class with the lowest rank
+(interactive before standard before bulk), weighted-fair within a rank
+by queue share — so class rank is enforced BEFORE a request ever
+reaches a worker's own queue, not only inside it.
+
 **Hedged retries** (Dean & Barroso, *The Tail at Scale*, CACM 2013):
 a request is dispatched to one worker; when a fraction of its deadline
 budget elapses with no response, a second attempt fires against a
@@ -36,6 +65,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 import threading
 
 import numpy as np
@@ -46,7 +76,8 @@ from csmom_tpu.serve.buckets import bucket_spec
 from csmom_tpu.serve.slo import default_policy
 from csmom_tpu.utils.deadline import mono_now_s
 
-__all__ = ["PoolRequest", "Router", "RouterConfig"]
+__all__ = ["HashRing", "PoolRequest", "Router", "RouterConfig",
+           "RouterServer", "WeightedFairGate", "main"]
 
 TERMINAL_STATES = ("served", "rejected", "expired")
 
@@ -64,6 +95,14 @@ class RouterConfig:
     hedge_after_s: float = 0.25    # hedge delay for deadline-less requests
     max_attempts: int = 3          # primary + hedge + one failover
     connect_timeout_s: float = 2.0
+    # weighted fair dispatch: how many dispatches may run concurrently
+    # through this router before waiters queue at the gate in SLO rank
+    # order (0 disables the gate — r11/r17 behavior)
+    fair_slots: int = 16
+    # consistent-hash routing on the result-cache identity: identical
+    # requests land on the same worker, lifting the per-worker result
+    # cache to pool-level hit rates (False = pure round robin)
+    affinity: bool = True
 
 
 @dataclasses.dataclass
@@ -82,6 +121,13 @@ class PoolRequest:
     worker_id: str | None = None         # who served it
     hedged: bool = False
     attempts: int = 0
+    cache_hit: bool = False              # served from the worker's cache
+    affinity: str | None = None          # consistent-hash routing key
+    retry_after_s: float | None = None   # backoff hint on a parked fleet
+    # True iff a rejection was the POOL's failure (dead sockets, parked
+    # fleet), not an honest answer — carried on the wire so the client
+    # tier's availability counts it instead of substring-matching text
+    infra: bool = False
     t_submit_s: float = 0.0
     t_done_s: float | None = None
     # the request's trace context (obs.trace; None = untraced).  The
@@ -105,18 +151,154 @@ class PoolRequest:
                 else self.deadline_s - now_s)
 
 
-class Router:
-    """Admit → dispatch (hedged) → exactly-once terminal accounting."""
+class HashRing:
+    """Consistent-hash ring with virtual nodes (blake2b, seed-free).
 
-    def __init__(self, workers_fn, config: RouterConfig | None = None):
+    Each member id is hashed onto the ring ``vnodes`` times; a key maps
+    to the first vnode clockwise of its hash.  Removing one member moves
+    only that member's arcs (about ``1/n`` of the keyspace) — the cache
+    property the fabric needs: a worker death reshuffles the minimum,
+    and its same-id replacement reclaims exactly its old arcs.
+    """
+
+    def __init__(self, ids, vnodes: int = 64):
+        import bisect
+        import hashlib
+
+        self._bisect = bisect
+        points = []
+        for wid in ids:
+            for v in range(vnodes):
+                h = hashlib.blake2b(f"{wid}#{v}".encode(),
+                                    digest_size=8).digest()
+                points.append((int.from_bytes(h, "big"), str(wid)))
+        points.sort()
+        self._hashes = [p[0] for p in points]
+        self._ids = [p[1] for p in points]
+
+    def pick(self, key: str) -> str | None:
+        """The member ``key`` hashes to (None on an empty ring)."""
+        if not self._hashes:
+            return None
+        import hashlib
+
+        h = int.from_bytes(
+            hashlib.blake2b(key.encode(), digest_size=8).digest(), "big")
+        i = self._bisect.bisect_right(self._hashes, h) % len(self._hashes)
+        return self._ids[i]
+
+
+class WeightedFairGate:
+    """Bounded concurrent dispatch with SLO-rank priority at the gate.
+
+    ``slots`` dispatches may run concurrently.  When the gate is
+    contended, the next free slot goes to the waiting class with the
+    LOWEST rank (interactive first — class rank enforced before the
+    worker, not just inside it); among classes of equal rank the slot
+    rotates weighted-fair by queue share (each class's granted count is
+    normalized by its weight, smallest normalized count wins).  Waiters
+    time out against their own deadline budget and are rejected as
+    honest backpressure, never silently dropped.
+
+    One leaf lock + condition; the wait is ``Condition.wait`` (exempt
+    from the blocking-under-lock audit by design — it RELEASES the lock).
+    """
+
+    def __init__(self, policy, slots: int):
+        self.slots = int(slots)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._in_use = 0
+        self._rank = {}
+        self._weight = {}
+        for c in policy.classes:
+            self._rank[c.name] = c.rank
+            self._weight[c.name] = max(0.05, min(1.0, c.queue_share))
+        self._waiting = {name: [] for name in self._rank}
+        self.granted = {name: 0 for name in self._rank}
+        self.timeouts = {name: 0 for name in self._rank}
+
+    def _grant_next_locked(self) -> None:
+        """Hand free slots to waiters, best class first."""
+        granted_any = False
+        while self._in_use < self.slots:
+            best = None
+            for name, q in self._waiting.items():
+                if not q:
+                    continue
+                score = (self._rank[name],
+                         self.granted[name] / self._weight[name])
+                if best is None or score < best[0]:
+                    best = (score, name)
+            if best is None:
+                break
+            ticket = self._waiting[best[1]].pop(0)
+            ticket["granted"] = True
+            self._in_use += 1
+            self.granted[best[1]] += 1
+            granted_any = True
+        if granted_any:
+            self._cond.notify_all()
+
+    def acquire(self, cls_name: str, timeout_s: float) -> bool:
+        """One dispatch slot for ``cls_name`` (False = timed out)."""
+        name = cls_name if cls_name in self._rank else \
+            min(self._rank, key=lambda n: -self._rank[n])
+        give_up = mono_now_s() + max(0.0, timeout_s)
+        with self._cond:
+            ticket = {"granted": False}
+            self._waiting[name].append(ticket)
+            self._grant_next_locked()
+            while not ticket["granted"]:
+                remaining = give_up - mono_now_s()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+            if ticket["granted"]:
+                return True
+            # timed out: withdraw the ticket.  No grant can race this —
+            # _grant_next_locked only runs under the same lock we hold
+            # continuously from the wait's return through the remove.
+            self._waiting[name].remove(ticket)
+            self.timeouts[name] += 1
+            return False
+
+    def release(self) -> None:
+        with self._cond:
+            self._in_use -= 1
+            self._grant_next_locked()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "slots": self.slots,
+                "in_use": self._in_use,
+                "granted": dict(self.granted),
+                "timeouts": dict(self.timeouts),
+            }
+
+
+class Router:
+    """Admit → fair gate → dispatch (hedged, cache-affine) →
+    exactly-once terminal accounting."""
+
+    def __init__(self, workers_fn, config: RouterConfig | None = None,
+                 retry_after_fn=None):
         """``workers_fn() -> list`` of objects with ``.worker_id`` and
         ``.socket_path`` — the supervisor's current READY set (queried
         per attempt, so a worker that died between attempts is already
-        gone from the menu)."""
+        gone from the menu).  ``retry_after_fn() -> float | None`` is
+        the supervisor's backoff view: when NO worker is ready, door
+        rejections carry this as a retry-after hint instead of burning
+        the caller's deadline."""
         self.config = config or RouterConfig()
         self.spec = bucket_spec(self.config.profile)
         self.policy = default_policy()
         self._workers_fn = workers_fn
+        self._retry_after_fn = retry_after_fn
+        self._fair = (WeightedFairGate(self.policy, self.config.fair_slots)
+                      if self.config.fair_slots > 0 else None)
+        self._ring_cache: tuple = (None, None)   # (ids tuple, HashRing)
         self._lock = threading.Lock()
         self._rr = itertools.count()
         # per-SLO-class books (closed like the global one); the policy
@@ -132,6 +314,10 @@ class Router:
         self.expired = 0
         self.rejected_infra = 0
         self.rejected_unserveable = 0
+        self.rejected_saturated = 0   # fair-gate timeouts (backpressure)
+        self.rejected_no_worker = 0   # parked fleet, retry-after issued
+        self.served_cache_hits = 0    # worker answered from its cache
+        self.affinity_routed = 0      # picks the hash ring decided
         self.hedged = 0
         self.hedge_wins = 0
         self.duplicates_suppressed = 0
@@ -141,12 +327,26 @@ class Router:
 
     # --------------------------------------------------------------- admit
 
+    def retry_after_hint_s(self) -> float | None:
+        """The supervisor's backoff view, rounded for the wire (None
+        when no hint is available)."""
+        if self._retry_after_fn is None:
+            return None
+        try:
+            hint = self._retry_after_fn()
+        except Exception:
+            return None
+        return None if hint is None else round(max(0.05, float(hint)), 3)
+
     def submit(self, kind: str, values, mask, priority: str = "interactive",
                deadline_s: float | None = None,
-               panel_version: int | None = None) -> PoolRequest:
+               panel_version: int | None = None,
+               trace_ctx=None) -> PoolRequest:
         """Admit one request; returns its handle (terminal on door
         rejection).  ``deadline_s`` is RELATIVE seconds (None = config
-        default)."""
+        default).  ``trace_ctx`` carries a wire-propagated trace context
+        (the router-replica path); without one, a context is minted iff
+        this process's trace book is armed."""
         from csmom_tpu.chaos.inject import checkpoint
         from csmom_tpu.obs import metrics
         from csmom_tpu.obs import trace as obs_trace
@@ -171,9 +371,9 @@ class Router:
             kind=kind, n_assets=n_assets, priority=priority,
             deadline_s=None if rel is None else now + rel, t_submit_s=now,
             panel_version=panel_version,
-            trace=obs_trace.begin(kind, priority,
-                                  panel_version=panel_version,
-                                  budget_ms=budget_ms))
+            trace=trace_ctx if trace_ctx is not None else obs_trace.begin(
+                kind, priority, panel_version=panel_version,
+                budget_ms=budget_ms))
         with self._lock:
             self.admitted += 1
             if priority in self.by_class:
@@ -184,6 +384,32 @@ class Router:
             self._terminate(req, "rejected", error=reason, unserveable=True)
             metrics.counter("serve_pool.rejected_unserveable").inc()
             return req
+        if not self._workers_fn():
+            # EVERY worker parked/unreachable: reject AT THE DOOR with a
+            # retry-after hint derived from the supervisor's backoff
+            # state — burning the caller's full deadline per request on
+            # a fleet that cannot answer would amplify the outage
+            hint = self.retry_after_hint_s()
+            req.retry_after_s = hint
+            with self._lock:
+                self.rejected_no_worker += 1
+            self._terminate(
+                req, "rejected", infra=True,
+                error="no ready worker in the pool (all crashed, parked, "
+                      "or draining)"
+                      + (f"; retry after {hint}s" if hint is not None
+                         else ""))
+            metrics.counter("serve_pool.rejected_infra").inc()
+            return req
+        if self.config.affinity:
+            # the result-cache identity (the serve/cache.py key minus the
+            # pool-constant params): byte-identical requests share it, so
+            # the hash ring lands them on the same worker's cache
+            from csmom_tpu.serve.cache import panel_fingerprint
+
+            req.affinity = (f"{kind}|{n_assets}|"
+                            f"{panel_fingerprint(values, mask)}|"
+                            f"{panel_version}")
         t = threading.Thread(
             target=self._drive, args=(req, values, mask),
             name=f"csmom-pool-req-{req.req_id}", daemon=True)
@@ -211,11 +437,33 @@ class Router:
 
     # ------------------------------------------------------------ dispatch
 
-    def _pick_worker(self, exclude: set):
+    def _ring_for(self, ids: tuple) -> HashRing:
+        cached_ids, ring = self._ring_cache
+        if cached_ids != ids:
+            ring = HashRing(ids)
+            self._ring_cache = (ids, ring)
+        return ring
+
+    def _pick_worker(self, exclude: set, affinity: str | None = None):
         workers = [w for w in self._workers_fn()
                    if w.worker_id not in exclude]
         if not workers:
             return None
+        if affinity is not None and len(workers) > 1:
+            # the ring is built over the CURRENT candidates, so a dead
+            # worker's arcs redistribute and a hedge (its target already
+            # in `exclude`) degrades to the next-best worker
+            ids = tuple(sorted(w.worker_id for w in workers))
+            wid = self._ring_for(ids).pick(affinity)
+            for w in workers:
+                if w.worker_id == wid:
+                    with self._lock:
+                        self.affinity_routed += 1
+                    return w
+        elif affinity is not None:
+            with self._lock:
+                self.affinity_routed += 1
+            return workers[0]
         return workers[next(self._rr) % len(workers)]
 
     def _hedge_delay(self, req: PoolRequest, now: float) -> float:
@@ -238,13 +486,38 @@ class Router:
         from csmom_tpu.chaos.inject import checkpoint
         from csmom_tpu.obs import metrics
 
+        if self._fair is not None:
+            # the weighted fair gate: class rank is enforced HERE, before
+            # any worker sees the request.  The wait burns the request's
+            # own budget; a timeout is honest backpressure.
+            now0 = mono_now_s()
+            rem0 = req.remaining_s(now0)
+            gate_wait = rem0 if rem0 is not None else _NO_DEADLINE_ATTEMPT_S
+            if not self._fair.acquire(req.priority, gate_wait):
+                with self._lock:
+                    self.rejected_saturated += 1
+                self._terminate(
+                    req, "rejected",
+                    error="fair-dispatch gate saturated: the request's "
+                          "budget elapsed before a dispatch slot freed "
+                          f"(class {req.priority}); back off and retry")
+                metrics.counter("serve_pool.rejected_saturated").inc()
+                return
+        try:
+            self._drive_attempts(req, values, mask, checkpoint, metrics)
+        finally:
+            if self._fair is not None:
+                self._fair.release()
+
+    def _drive_attempts(self, req: PoolRequest, values, mask,
+                        checkpoint, metrics) -> None:
         tried: set = set()
         failures: list = []
         state: dict = {"done": threading.Event(), "lock": threading.Lock(),
                        "in_flight": 0, "concluded": 0}
 
         def launch(is_hedge: bool) -> bool:
-            worker = self._pick_worker(tried)
+            worker = self._pick_worker(tried, affinity=req.affinity)
             if worker is None:
                 return False
             tried.add(worker.worker_id)
@@ -261,10 +534,16 @@ class Router:
             return True
 
         if not launch(False):
+            hint = self.retry_after_hint_s()
+            req.retry_after_s = hint
+            with self._lock:
+                self.rejected_no_worker += 1
             self._terminate(req, "rejected", infra=True,
                             error="no ready worker in the pool (all "
                                   "crashed, draining, or never became "
-                                  "ready)")
+                                  "ready)"
+                                  + (f"; retry after {hint}s"
+                                     if hint is not None else ""))
             metrics.counter("serve_pool.rejected_infra").inc()
             return
         hedge_at = mono_now_s() + self._hedge_delay(req, mono_now_s())
@@ -396,6 +675,7 @@ class Router:
             won = self._terminate(req, "served", result=result,
                                   worker_id=obj.get("worker_id"),
                                   hedge_win=is_hedge,
+                                  cache_hit=bool(obj.get("cache_hit")),
                                   trace_half=obj.get("trace_half"),
                                   attempt_window=(t_attempt0, t_attempt1,
                                                   worker.worker_id))
@@ -421,7 +701,8 @@ class Router:
     def _terminate(self, req: PoolRequest, state: str, result=None,
                    error: str | None = None, worker_id: str | None = None,
                    infra: bool = False, unserveable: bool = False,
-                   hedge_win: bool = False, trace_half: dict | None = None,
+                   hedge_win: bool = False, cache_hit: bool = False,
+                   trace_half: dict | None = None,
                    attempt_window: tuple | None = None) -> bool:
         """Exactly-once terminal transition; returns True iff this call
         won.  A losing ``served`` (the hedge pair both answered) counts
@@ -452,10 +733,14 @@ class Router:
                 self.served += 1
                 if hedge_win:
                     self.hedge_wins += 1
+                if cache_hit:
+                    req.cache_hit = True
+                    self.served_cache_hits += 1
             elif state == "expired":
                 self.expired += 1
             else:
                 self.rejected += 1
+                req.infra = infra
                 if infra:
                     self.rejected_infra += 1
                 if unserveable:
@@ -487,6 +772,10 @@ class Router:
                 "expired": self.expired,
                 "rejected_infra": self.rejected_infra,
                 "rejected_unserveable": self.rejected_unserveable,
+                "rejected_saturated": self.rejected_saturated,
+                "rejected_no_worker": self.rejected_no_worker,
+                "served_cache_hits": self.served_cache_hits,
+                "affinity_routed": self.affinity_routed,
                 "hedged": self.hedged,
                 "hedge_wins": self.hedge_wins,
                 "duplicates_suppressed": self.duplicates_suppressed,
@@ -515,6 +804,9 @@ class Router:
         """Closed books across the process boundary (empty = holds)."""
         a = self.accounting()
         out = []
+        if a["served_cache_hits"] > a["served"]:
+            out.append(f"served_cache_hits {a['served_cache_hits']} > "
+                       f"served {a['served']}")
         total = a["served"] + a["rejected"] + a["expired"]
         if total != a["admitted"]:
             out.append(
@@ -541,3 +833,315 @@ _LATE_GRACE_S = 1.0
 # attempt wait for deadline-less requests — matches the worker's
 # _NO_DEADLINE_WAIT_S so the two sides give up together
 _NO_DEADLINE_ATTEMPT_S = 30.0
+
+
+def no_deadline_score_give_up_s(connect_timeout_s: float) -> float:
+    """How long :meth:`RouterServer._score` waits for a DEADLINE-LESS
+    request to reach terminal: a full fair-gate wait plus one full
+    dispatch attempt (connect + worker wait + grace) plus its own
+    grace.  The CLIENT tier's per-attempt receive budget is derived
+    FROM this function (fabric.py) so the chain keeps giving up
+    outermost-last — a hand-rolled copy on either side silently breaks
+    it."""
+    return (_NO_DEADLINE_ATTEMPT_S          # fair-gate wait
+            + connect_timeout_s
+            + _NO_DEADLINE_ATTEMPT_S        # worker-side terminal wait
+            + 2 * _TERMINAL_GRACE_S)
+
+
+# ------------------------------------------------------------ the replica ---
+
+class RouterServer:
+    """One supervised router-replica process: a :class:`Router` behind
+    the pool wire protocol (unix or tcp), its worker set read from the
+    fabric's shared routes file.
+
+    The replica is STATELESS beyond its own books: it holds no panels
+    and no queue, so a replica SIGKILLed mid-burst loses only the
+    requests currently transiting it — which the fabric client fails
+    over to a surviving replica.  Lifecycle ops mirror the worker's
+    (``ping`` / ``ready`` / ``score`` / ``stats`` / ``drain`` /
+    ``stop``), so the SAME supervisor machinery (spawn, probe, backoff,
+    crash-loop parking, rolling restart) babysits both tiers.
+
+    Tracing: a ``score`` frame carrying a ``trace`` entry gets its
+    context rebuilt here, opened into this process's armed book (the
+    replica-tier trace ledger), threaded through the router's hedged
+    dispatch (the worker's half stitches in), and the CLOSED context's
+    stage chain rides back in the reply's ``trace_half`` — the client
+    tier stitches the full three-tier chain from it.
+    """
+
+    def __init__(self, listen_addr: str, routes_path: str,
+                 router_id: str = "r0",
+                 config: RouterConfig | None = None,
+                 expect_cache_version: str | None = None):
+        from csmom_tpu.serve.fabric import RoutesView
+
+        self.listen_addr = listen_addr
+        self.router_id = router_id
+        # the WORKER tier's AOT cache version, echoed in stats for fleet
+        # bookkeeping (replicas hold no compiled world of their own)
+        self.expect_cache_version = expect_cache_version
+        self.routes = RoutesView(routes_path)
+        self.router = Router(self.routes.workers, config,
+                             retry_after_fn=self.routes.retry_after_s)
+        self._draining = False
+        self._stop = threading.Event()
+        self._listener = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def bind(self) -> None:
+        from csmom_tpu.serve import proto
+
+        self._listener = proto.listen(self.listen_addr)
+        self._listener.settimeout(0.2)
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"csmom-router-{self.router_id}-accept",
+                             daemon=True)
+        t.start()
+
+    def run_until_stopped(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(0.2)
+        self._shutdown()
+
+    def _shutdown(self) -> None:
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        proto.unlink_address(self.listen_addr)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ------------------------------------------------------------- serving
+
+    def _accept_loop(self) -> None:
+        import socket as _socket
+
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except _socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed under us: shutting down
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn) -> None:
+        conn.settimeout(60.0)
+        try:
+            obj, arrays = proto.recv_msg(conn)
+            reply, reply_arrays = self._handle(obj, arrays)
+            proto.send_msg(conn, reply, reply_arrays)
+            if obj.get("op") == "stop":
+                self.stop()
+        except (OSError, proto.ProtocolError):
+            pass  # the peer vanished or spoke garbage: drop the conn
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, obj: dict, arrays: dict) -> tuple:
+        op = obj.get("op")
+        if op == "ping":
+            return {"ok": True, "worker_id": self.router_id,
+                    "router_id": self.router_id, "pid": os.getpid()}, None
+        if op == "ready":
+            ok, reason = self.routes.status()
+            if self._draining:
+                ok, reason = False, "draining"
+            return {"ok": ok, "reason": None if ok else reason,
+                    "worker_id": self.router_id,
+                    "router_id": self.router_id,
+                    "pid": os.getpid(),
+                    "tier": "router",
+                    "workers": len(self.routes.workers()),
+                    "fresh_compiles": 0}, None
+        if op == "stats":
+            return self._stats(), None
+        if op == "score":
+            return self._score(obj, arrays)
+        if op in ("drain", "stop"):
+            self._draining = True
+            out = self._stats()
+            out["drained"] = True
+            return out, None
+        return {"ok": False, "error": f"unknown op {op!r}"}, None
+
+    def _stats(self) -> dict:
+        from csmom_tpu.obs import trace as obs_trace
+
+        out = {
+            "ok": True,
+            "worker_id": self.router_id,
+            "router_id": self.router_id,
+            "tier": "router",
+            "pid": os.getpid(),
+            "accounting": self.router.accounting(),
+            "classes": self.router.class_accounting(),
+            "availability": self.router.availability(),
+            "invariant_violations": self.router.invariant_violations(),
+            "fair_gate": (self.router._fair.stats()
+                          if self.router._fair is not None else None),
+            "retry_after_s": self.router.retry_after_hint_s(),
+            "expect_cache_version": self.expect_cache_version,
+        }
+        book = obs_trace.current_book()
+        if book is not None:
+            out["trace"] = {
+                "snapshot": book.snapshot(),
+                "invariant_violations": book.invariant_violations(),
+            }
+        return out
+
+    def _score(self, obj: dict, arrays: dict) -> tuple:
+        from csmom_tpu.obs import trace as obs_trace
+
+        if self._draining:
+            return {"state": "rejected", "error": "router draining",
+                    "router_id": self.router_id}, None
+        if "values" not in arrays or "mask" not in arrays:
+            return {"state": "rejected",
+                    "error": "score frame missing values/mask arrays",
+                    "router_id": self.router_id}, None
+        rel = obj.get("deadline_rel_s")
+        pv = obj.get("panel_version")
+        trace_ctx = None
+        wire_trace = obj.get("trace")
+        if isinstance(wire_trace, dict):
+            from csmom_tpu.obs.trace import TraceContext
+
+            trace_ctx = TraceContext.from_wire(wire_trace)
+            book = obs_trace.current_book()
+            if book is not None:
+                # the replica-tier ledger: this process's books must
+                # close over every trace it transited, SIGKILL included
+                book.open_trace(trace_ctx)
+        req = self.router.submit(
+            str(obj.get("kind")), arrays["values"], arrays["mask"],
+            priority=str(obj.get("priority", "interactive")),
+            deadline_s=float(rel) if rel is not None else None,
+            panel_version=int(pv) if pv is not None else None,
+            trace_ctx=trace_ctx,
+        )
+        # a deadline-bounded request terminates within its own budget;
+        # a deadline-less one can spend a full fair-gate wait AND a full
+        # dispatch attempt before terminal — the give-up must cover the
+        # whole pipeline or a healthy slow request is falsely branded a
+        # router defect while the worker later serves it (forked books)
+        wait_s = (float(rel) + _TERMINAL_GRACE_S if rel is not None
+                  else no_deadline_score_give_up_s(
+                      self.router.config.connect_timeout_s))
+        if not req.wait(wait_s):
+            return {"state": "rejected",
+                    "error": "request never reached a terminal state "
+                             f"within {wait_s:.1f}s (router defect)",
+                    "infra": True,
+                    "router_id": self.router_id}, None
+        reply = {
+            "state": req.state,
+            "error": req.error,
+            "infra": req.infra,
+            "router_id": self.router_id,
+            "worker_id": req.worker_id,
+            "cache_hit": req.cache_hit,
+            "hedged": req.hedged,
+            "attempts": req.attempts,
+            "retry_after_s": req.retry_after_s,
+            "panel_version": req.panel_version,
+        }
+        if trace_ctx is not None:
+            # the replica's closed stage chain (its own route/transport
+            # plus the worker's stitched half) for the CLIENT to stitch
+            reply["trace_half"] = trace_ctx.half_record()
+        out_arrays = None
+        if req.state == "served":
+            if isinstance(req.result, dict):
+                reply["result_obj"] = {k: float(v)
+                                       for k, v in req.result.items()}
+            else:
+                out_arrays = {"result": np.asarray(req.result)}
+        return reply, out_arrays
+
+
+def main(argv=None) -> int:
+    """``python -m csmom_tpu.serve.router``: one supervised replica."""
+    import argparse
+    import signal
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="csmom_tpu.serve.router",
+        description="router replica: hedged cache-affine dispatch behind "
+                    "a unix/tcp socket, workers from a shared routes file")
+    ap.add_argument("--listen", required=True,
+                    help="address to serve on (unix:/path or tcp:host:port)")
+    ap.add_argument("--routes", required=True,
+                    help="path to the fabric's routes file (the shared "
+                         "admission view: ready workers + backoff hints)")
+    ap.add_argument("--router-id", dest="router_id", default="r0")
+    ap.add_argument("--profile", default="serve")
+    ap.add_argument("--deadline-ms", dest="deadline_ms", type=float,
+                    default=500.0)
+    ap.add_argument("--hedge-fraction", dest="hedge_fraction", type=float,
+                    default=0.35)
+    ap.add_argument("--max-attempts", dest="max_attempts", type=int,
+                    default=3)
+    ap.add_argument("--fair-slots", dest="fair_slots", type=int, default=16)
+    ap.add_argument("--no-affinity", dest="affinity", action="store_false",
+                    help="disable consistent-hash cache routing "
+                         "(round-robin picks)")
+    ap.add_argument("--trace", action="store_true",
+                    help="arm the replica-tier trace book (obs.trace); "
+                         "its snapshot rides the stats/drain reply")
+    ap.add_argument("--expect-cache-version", dest="expect_cache_version",
+                    help="echoed in stats for fleet bookkeeping (replicas "
+                         "hold no compiled world of their own)")
+    args = ap.parse_args(argv)
+
+    if args.trace:
+        from csmom_tpu.obs import trace as obs_trace
+
+        obs_trace.arm_tracing(seed=0)
+
+    cfg = RouterConfig(
+        profile=args.profile,
+        default_deadline_s=(None if args.deadline_ms in (None, 0)
+                            else args.deadline_ms / 1e3),
+        hedge_fraction=args.hedge_fraction,
+        max_attempts=args.max_attempts,
+        fair_slots=args.fair_slots,
+        affinity=args.affinity,
+    )
+    server = RouterServer(args.listen, args.routes,
+                          router_id=args.router_id, config=cfg,
+                          expect_cache_version=args.expect_cache_version)
+
+    def _term(signum, frame):  # graceful stop on SIGTERM
+        server.stop()
+
+    signal.signal(signal.SIGTERM, _term)
+
+    server.bind()
+    ok, reason = server.routes.status()
+    print(f"[router {args.router_id}] pid {os.getpid()} listening on "
+          f"{args.listen}; routes {'ok' if ok else reason} "
+          f"({len(server.routes.workers())} workers)",
+          file=sys.stderr, flush=True)
+    server.run_until_stopped()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -54,6 +54,10 @@ class PoolConfig:
     n_workers: int = 2
     profile: str = "serve"
     engine: str = "jax"
+    # wire transport for the fleet's sockets: "unix" (one host, the r11
+    # default) or "tcp" (loopback ports today, cross-container by
+    # swapping the host — the r18 fabric's spelling)
+    transport: str = "unix"
     capacity: int = 64
     max_wait_ms: float = 10.0
     deadline_ms: float = 500.0
@@ -95,7 +99,19 @@ class WorkerHandle:
 
 
 class PoolSupervisor:
-    """Spawn and babysit N workers; expose the READY set to the router."""
+    """Spawn and babysit N workers; expose the READY set to the router.
+
+    The machinery is tier-agnostic on purpose (the r18 fabric): what a
+    slot RUNS comes from :meth:`_slot_argv`, and where it listens from
+    :meth:`_slot_address` — the router-replica supervisor
+    (:class:`csmom_tpu.serve.fabric.RouterSupervisor`) overrides exactly
+    those two hooks and inherits spawn, demonstrated-ready probing,
+    exponential-backoff restarts, crash-loop parking, and
+    warm-before-ready rolling restarts unchanged.
+    """
+
+    # worker ids are "<prefix><slot>" — the router tier overrides to "r"
+    slot_prefix = "w"
 
     def __init__(self, config: PoolConfig, run_dir: str):
         self.config = config
@@ -120,6 +136,11 @@ class PoolSupervisor:
                 mesh_devices=mesh_devices))
         self.handles: list = []
         self.events: list = []      # [{t_s, event, worker_id, ...}]
+        # merged into every spawned process's environment AFTER the
+        # inherited os.environ — the fabric uses this to arm chaos plans
+        # in ONE tier (e.g. net_delay in router replicas only) without
+        # polluting its own process
+        self.extra_env: dict = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._monitor: threading.Thread | None = None
@@ -138,6 +159,23 @@ class PoolSupervisor:
             self.events.append(rec)
 
     # --------------------------------------------------------------- spawn
+
+    def _slot_address(self, slot: int, generation: int = 0) -> str:
+        """Where the process in ``slot`` (at ``generation``) listens.
+        Unix sockets are run-dir files; tcp binds a freshly-probed
+        loopback port per (slot, generation) — a rolling replacement
+        must not race its predecessor for the same port."""
+        if self.config.transport == "tcp":
+            from csmom_tpu.serve.proto import free_tcp_port
+
+            return f"tcp:127.0.0.1:{free_tcp_port()}"
+        name = (f"{self.slot_prefix}{slot}.sock" if generation == 0
+                else f"{self.slot_prefix}{slot}.g{generation}.sock")
+        return os.path.join(self.run_dir, name)
+
+    def _slot_argv(self, h: WorkerHandle) -> list:
+        """The command a slot runs (the router tier overrides this)."""
+        return self._worker_argv(h)
 
     def _worker_argv(self, h: WorkerHandle) -> list:
         c = self.config
@@ -164,6 +202,7 @@ class PoolSupervisor:
         h.log_path = os.path.join(
             self.run_dir, f"{h.worker_id}.g{h.generation}.log")
         env = dict(os.environ)  # fault plans and JAX_PLATFORMS inherit
+        env.update(self.extra_env)
         c = self.config
         if (c.devices_per_worker > 0 and c.engine == "jax-mesh"
                 and env.get("JAX_PLATFORMS", "").startswith("cpu")
@@ -181,7 +220,7 @@ class PoolSupervisor:
         log = open(h.log_path, "ab")
         try:
             h.proc = subprocess.Popen(
-                self._worker_argv(h), stdout=log, stderr=log, env=env)
+                self._slot_argv(h), stdout=log, stderr=log, env=env)
         finally:
             log.close()
         h.state = "starting"
@@ -262,8 +301,8 @@ class PoolSupervisor:
         dpw = self.config.devices_per_worker
         for slot in range(self.config.n_workers):
             h = WorkerHandle(
-                slot=slot, worker_id=f"w{slot}",
-                socket_path=os.path.join(self.run_dir, f"w{slot}.sock"),
+                slot=slot, worker_id=f"{self.slot_prefix}{slot}",
+                socket_path=self._slot_address(slot),
                 device_slice=slice_for_slot(slot, dpw) if dpw else None)
             self.handles.append(h)
             self._spawn(h)
@@ -282,6 +321,31 @@ class PoolSupervisor:
 
     def ready_workers(self) -> list:
         return [h for h in self.handles if h.state == "ready"]
+
+    def retry_after_s(self) -> float | None:
+        """The backoff-state retry hint for a fleet with NO ready worker:
+        seconds until the NEXT scheduled restart could plausibly serve
+        (its backoff delay plus the ready timeout's headroom is the
+        caller's problem — the hint is the floor, not a promise).  None
+        while any worker is ready (no hint needed) or when every slot is
+        parked ``failed`` (retrying cannot help; redeploying can —
+        callers should surface the park reason instead)."""
+        now = mono_now_s()
+        best = None
+        for h in self.handles:
+            if h.state == "ready":
+                return None
+            if h.state == "starting":
+                # a spawn in flight: readiness is typically one probe
+                # interval away
+                cand = self.config.poll_interval_s
+            elif h.state == "dead" and h.next_restart_at is not None:
+                cand = max(self.config.poll_interval_s,
+                           h.next_restart_at - now)
+            else:
+                continue  # parked/failed: no restart is coming
+            best = cand if best is None else min(best, cand)
+        return None if best is None else round(best, 3)
 
     def _gauge_ready(self) -> None:
         from csmom_tpu.obs import metrics
@@ -334,6 +398,13 @@ class PoolSupervisor:
 
     def _restart(self, h: WorkerHandle) -> None:
         h.generation += 1
+        if self.config.transport == "tcp":
+            # the crash may have BEEN a lost port race (or the port got
+            # claimed while the slot was down): a replacement probes a
+            # fresh port like a rolling replacement does — retrying the
+            # dead port every backoff cycle can only crash-loop to
+            # parked, even with unlimited free ports available
+            h.socket_path = self._slot_address(h.slot, h.generation)
         with self._lock:
             self.restarts_total += 1
         self._spawn(h)
@@ -357,9 +428,7 @@ class PoolSupervisor:
                 continue
             repl = WorkerHandle(
                 slot=slot, worker_id=old.worker_id,
-                socket_path=os.path.join(
-                    self.run_dir,
-                    f"w{slot}.g{old.generation + 1}.sock"),
+                socket_path=self._slot_address(slot, old.generation + 1),
                 # the slot's slice, not a fresh assignment: a rolled
                 # worker re-pins exactly its predecessor's devices
                 device_slice=old.device_slice,
@@ -465,6 +534,7 @@ class PoolSupervisor:
                     rec.update({
                         "accounting": obj.get("accounting"),
                         "batches": obj.get("batches"),
+                        "cache": obj.get("cache"),
                         "fresh_compiles": obj.get("fresh_compiles"),
                     })
                 except (OSError, proto.ProtocolError) as e:

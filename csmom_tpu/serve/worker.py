@@ -94,14 +94,10 @@ class WorkerServer:
 
     def bind(self) -> None:
         """Bind + listen and start answering (liveness is up from here;
-        readiness stays false until :meth:`warm_and_probe` succeeds)."""
-        try:
-            os.unlink(self.socket_path)
-        except OSError:
-            pass
-        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._listener.bind(self.socket_path)
-        self._listener.listen(64)
+        readiness stays false until :meth:`warm_and_probe` succeeds).
+        The address may be a bare unix path (r11), ``unix:/path``, or
+        ``tcp:host:port`` — the r18 fabric's cross-host spelling."""
+        self._listener = proto.listen(self.socket_path)
         self._listener.settimeout(0.2)
         t = threading.Thread(target=self._accept_loop,
                              name=f"csmom-worker-{self.worker_id}-accept",
@@ -174,10 +170,7 @@ class WorkerServer:
                 self._listener.close()
             except OSError:
                 pass
-        try:
-            os.unlink(self.socket_path)
-        except OSError:
-            pass
+        proto.unlink_address(self.socket_path)
 
     def stop(self) -> None:
         self._stop.set()
@@ -292,6 +285,10 @@ class WorkerServer:
             "worker_id": self.worker_id,
             "queue_wait_s": req.queue_wait_s,
             "service_s": req.service_s,
+            # served straight from this worker's result cache: the
+            # router counts these so the FABRIC's pool-level hit rate
+            # survives a worker corpse (its own cache book dies with it)
+            "cache_hit": bool(req.cache_hit),
             # stamped through so the router's books can reconcile which
             # panel version every response was computed from
             "panel_version": req.panel_version,
@@ -316,7 +313,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="csmom_tpu.serve.worker",
         description="pool worker: SignalService behind a unix socket")
-    ap.add_argument("--socket", required=True, help="unix socket path")
+    ap.add_argument("--socket", required=True,
+                    help="serve address: a unix socket path (bare or "
+                         "unix:/path) or tcp:host:port")
     ap.add_argument("--worker-id", dest="worker_id", default="w0")
     ap.add_argument("--profile", default="serve")
     ap.add_argument("--engine", default="jax",

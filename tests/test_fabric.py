@@ -1,0 +1,607 @@
+"""The r18 horizontal serving fabric: routes view/publisher, router
+replicas as supervised processes, client-tier failover, and the
+SERVE_FABRIC artifact contract.
+
+Coverage mirrors the tier's layers (ISSUE 14):
+
+- routes: the atomically-published admission view every replica reads
+  (roundtrip, torn-file degradation with the reason carried, publisher
+  writes only on change);
+- client tier: failover on a reset/killed replica converges on
+  survivors with CLOSED client books (the fabric's outermost ledger);
+- the three-tier end to end: stub-engine worker PROCESSES + real
+  supervised router-replica PROCESSES over TCP, one router AND one
+  worker SIGKILLed mid-burst — availability 1.0, books closed, the
+  artifact schema-valid, ledger rows ingested;
+- contracts: the ``serve_fabric`` kind's rejections (broken books, one
+  replica, stale hits) and the committable-sidecar naming rule.
+
+No jax in any process (stub engine, serve-smoke buckets) — the fabric's
+control plane is deliberately jax-free.
+"""
+
+import copy
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from csmom_tpu.chaos import invariants as inv
+from csmom_tpu.serve import proto
+from csmom_tpu.serve.fabric import (
+    FabricClient,
+    FabricClientConfig,
+    RoutesPublisher,
+    RoutesView,
+    write_routes,
+)
+from csmom_tpu.serve.loadgen import (
+    LoadConfig,
+    run_fabric_loadgen,
+    write_artifact,
+)
+from csmom_tpu.serve.supervisor import PoolConfig
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SMOKE = dict(profile="serve-smoke", engine="stub", ready_timeout_s=30.0,
+              poll_interval_s=0.05, backoff_base_s=0.05, backoff_cap_s=0.5)
+
+
+def _panel(n_assets: int, months: int, seed: int = 0):
+    r = np.random.default_rng(seed)
+    v = 100.0 * np.exp(np.cumsum(r.normal(0, 0.03, (n_assets, months)),
+                                 axis=1)).astype(np.float32)
+    return v, np.ones((n_assets, months), bool)
+
+
+# ---------------------------------------------------------------- routes ----
+
+def test_routes_roundtrip_and_view(tmp_path):
+    path = str(tmp_path / "routes.json")
+    write_routes(path, [("w0", "unix:/tmp/w0.sock"),
+                        ("w1", "tcp:127.0.0.1:9001")],
+                 retry_after_s=None, cache_version="cv-1")
+    view = RoutesView(path)
+    workers = view.workers()
+    assert [(w.worker_id, w.socket_path) for w in workers] == [
+        ("w0", "unix:/tmp/w0.sock"), ("w1", "tcp:127.0.0.1:9001")]
+    assert view.retry_after_s() is None
+    assert view.cache_version() == "cv-1"
+    ok, reason = view.status()
+    assert ok and reason is None
+    # an empty fleet publishes the backoff hint instead
+    write_routes(path, [], retry_after_s=0.8)
+    assert view.workers() == []
+    assert view.retry_after_s() == 0.8
+
+
+def test_routes_view_degrades_on_garbage_with_reason(tmp_path):
+    path = str(tmp_path / "routes.json")
+    view = RoutesView(path)
+    ok, reason = view.status()
+    assert not ok and "unreadable" in reason
+    with open(path, "w") as f:
+        f.write("{torn")
+    assert view.workers() == []
+    ok, reason = view.status()
+    assert not ok and "unparseable" in reason
+    # a later good write recovers the view
+    write_routes(path, [("w0", "/x.sock")], retry_after_s=None)
+    assert [w.worker_id for w in view.workers()] == ["w0"]
+    assert view.status()[0]
+
+
+class _FakeSup:
+    """Duck-typed supervisor for the publisher: ready set + hint."""
+
+    expect_cache_version = "cv-test"
+
+    def __init__(self):
+        self.ready: list = []
+        self.hint = 1.5
+
+    def ready_workers(self):
+        return list(self.ready)
+
+    def retry_after_s(self):
+        return self.hint
+
+
+class _H:
+    def __init__(self, wid, addr):
+        self.worker_id = wid
+        self.socket_path = addr
+
+
+def test_routes_view_error_clears_hint_and_version(tmp_path):
+    """A broken routes file invalidates the WHOLE view: a retry-after
+    hint or cache version surviving from the last good parse would stamp
+    outdated state onto every no-worker rejection."""
+    path = str(tmp_path / "routes.json")
+    write_routes(path, [], retry_after_s=0.8, cache_version="cv-1")
+    view = RoutesView(path)
+    assert view.retry_after_s() == 0.8
+    assert view.cache_version() == "cv-1"
+    os.unlink(path)
+    assert view.workers() == []
+    assert view.retry_after_s() is None, (
+        "an unreadable routes file must not keep serving the stale hint")
+    assert view.cache_version() is None
+    with open(path, "w") as f:
+        f.write("{torn")
+    assert view.retry_after_s() is None
+    assert view.cache_version() is None
+
+
+def test_routes_publisher_writes_only_on_change(tmp_path):
+    path = str(tmp_path / "routes.json")
+    sup = _FakeSup()
+    sup.ready = [_H("w0", "/a.sock")]
+    pub = RoutesPublisher(sup, path, interval_s=10.0)
+    assert pub.publish_once() is True
+    assert pub.publish_once() is False, "an unchanged fleet must not churn"
+    sup.ready = []
+    assert pub.publish_once() is True
+    view = RoutesView(path)
+    assert view.workers() == []
+    assert view.retry_after_s() == 1.5, (
+        "an empty fleet must publish the backoff hint")
+    sup.ready = [_H("w0", "/a.sock")]
+    assert pub.publish_once() is True
+    assert view.retry_after_s() is None, (
+        "a healthy fleet publishes no hint")
+    assert pub.publishes == 3
+
+
+# ----------------------------------------------------------- client tier ----
+
+class _FakeReplica:
+    """A hand-rolled router replica: serves ``score`` (or resets every
+    connection when ``reset=True``) — the controllable peer the
+    failover tests need."""
+
+    def __init__(self, tmp, rid: str, reset: bool = False):
+        self.worker_id = rid
+        self.socket_path = os.path.join(tmp, f"{rid}.sock")
+        self.reset = reset
+        self.scores = 0
+        self._stop = threading.Event()
+        self._srv = proto.listen(self.socket_path)
+        self._srv.settimeout(0.1)
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        import socket as _socket
+
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except _socket.timeout:
+                continue
+            except OSError:
+                return
+            if self.reset:
+                conn.close()  # the SIGKILLed replica, as seen by a peer
+                continue
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            obj, arrays = proto.recv_msg(conn)
+            if obj.get("op") == "score":
+                self.scores += 1
+                n = arrays["values"].shape[0]
+                proto.send_msg(conn, {"state": "served",
+                                      "router_id": self.worker_id,
+                                      "worker_id": "w0",
+                                      "cache_hit": False,
+                                      "hedged": False},
+                               {"result": np.zeros(n, np.float32)})
+            else:
+                proto.send_msg(conn, {"ok": True})
+        except (OSError, proto.ProtocolError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop.set()
+        self._srv.close()
+
+
+def test_fabric_client_fails_over_on_replica_reset(tmp_path):
+    """A reset replica (the wire face of a SIGKILL) costs each request
+    one failover to the survivor — never a lost request, and the
+    client's books close over every attempt."""
+    dead = _FakeReplica(str(tmp_path), "r0", reset=True)
+    live = _FakeReplica(str(tmp_path), "r1")
+    try:
+        client = FabricClient(lambda: [dead, live], FabricClientConfig(
+            default_deadline_s=5.0))
+        v, m = _panel(4, 24)
+        reqs = [client.submit("momentum", v, m) for _ in range(6)]
+        for r in reqs:
+            assert r.wait(8.0) and r.state == "served", (r.state, r.error)
+        a = client.accounting()
+        assert a["served"] == 6 and a["admitted"] == 6
+        assert a["router_conn_failures"] >= 1, (
+            "the reset replica never registered as a connection failure")
+        assert a["failovers"] >= 1
+        assert all(r.router_id == "r1" for r in reqs)
+        assert client.invariant_violations() == []
+        assert client.availability() == 1.0
+    finally:
+        dead.close()
+        live.close()
+
+
+def test_fabric_client_rejects_infra_when_no_replica_lives(tmp_path):
+    client = FabricClient(lambda: [], FabricClientConfig(
+        default_deadline_s=1.0))
+    v, m = _panel(4, 24)
+    r = client.submit("momentum", v, m)
+    assert r.wait(3.0) and r.state == "rejected"
+    assert "no ready router replica" in (r.error or "")
+    a = client.accounting()
+    assert a["rejected_infra"] == 1
+    assert client.availability() == 0.0
+    assert client.invariant_violations() == []
+
+
+class _RejectingReplica(_FakeReplica):
+    """A replica replying a fixed rejection to every ``score``."""
+
+    def __init__(self, tmp, rid, error, retry_after_s=None, infra=None):
+        self.error = error
+        self.retry_after_s = retry_after_s
+        self.infra = infra
+        super().__init__(tmp, rid)
+
+    def _serve(self, conn):
+        try:
+            obj, _ = proto.recv_msg(conn)
+            if obj.get("op") == "score":
+                self.scores += 1
+                reply = {
+                    "state": "rejected", "router_id": self.worker_id,
+                    "error": self.error,
+                    "retry_after_s": self.retry_after_s}
+                if self.infra is not None:
+                    reply["infra"] = self.infra
+                proto.send_msg(conn, reply)
+            else:
+                proto.send_msg(conn, {"ok": True})
+        except (OSError, proto.ProtocolError):
+            pass
+        finally:
+            conn.close()
+
+
+def test_fabric_client_settles_parked_fleet_rejection_in_one_attempt(
+        tmp_path):
+    """The door's no-ready-worker rejection mentions "draining" — it must
+    settle as rejected_infra on the FIRST replica, not be misread as a
+    draining replica and fanned across the whole fabric mid-outage."""
+    door = ("no ready worker in the pool (all crashed, parked, or "
+            "draining); retry after 0.5s")
+    r0 = _RejectingReplica(str(tmp_path), "r0", door, retry_after_s=0.5)
+    r1 = _RejectingReplica(str(tmp_path), "r1", door, retry_after_s=0.5)
+    try:
+        client = FabricClient(lambda: [r0, r1], FabricClientConfig(
+            default_deadline_s=5.0))
+        v, m = _panel(4, 24)
+        req = client.submit("momentum", v, m)
+        assert req.wait(8.0) and req.state == "rejected"
+        assert req.retry_after_s == 0.5
+        assert r0.scores + r1.scores == 1, (
+            "a parked-fleet door rejection fanned out across replicas")
+        assert client.accounting()["rejected_infra"] == 1
+    finally:
+        r0.close()
+        r1.close()
+
+
+def test_fabric_client_reads_infra_flag_from_the_wire(tmp_path):
+    """A replica whose attempts ALL died on dead wires replies with its
+    infra classification ON the reply — the client must count it into
+    rejected_infra (availability drops) instead of substring-matching
+    error text that doesn't say \"no ready worker\"."""
+    err = "all 3 attempt(s) failed: w0: connection failed (reset)"
+    r0 = _RejectingReplica(str(tmp_path), "r0", err, infra=True)
+    try:
+        client = FabricClient(lambda: [r0], FabricClientConfig(
+            default_deadline_s=5.0))
+        v, m = _panel(4, 24)
+        req = client.submit("momentum", v, m)
+        assert req.wait(8.0) and req.state == "rejected"
+        a = client.accounting()
+        assert a["rejected_infra"] == 1, (
+            "an infra rejection crossed the wire unclassified — "
+            "availability would read 1.0 over lost requests")
+        assert client.availability() == 0.0
+    finally:
+        r0.close()
+
+
+def test_fabric_client_fails_over_a_genuinely_draining_replica(tmp_path):
+    """The replica's OWN drain refusal (rolling restart) is a routing
+    miss: the client must try a survivor and serve."""
+    draining = _RejectingReplica(str(tmp_path), "r0", "router draining")
+    live = _FakeReplica(str(tmp_path), "r1")
+    try:
+        client = FabricClient(lambda: [draining, live],
+                              FabricClientConfig(default_deadline_s=5.0))
+        v, m = _panel(4, 24)
+        reqs = [client.submit("momentum", v, m) for _ in range(4)]
+        for r in reqs:
+            assert r.wait(8.0) and r.state == "served", (r.state, r.error)
+        assert live.scores == 4
+        assert client.accounting()["served"] == 4
+    finally:
+        draining.close()
+        live.close()
+
+
+# ------------------------------------------------------------ end to end ----
+
+def _build_fabric(tmp, n_workers=2, n_routers=2, transport="tcp",
+                  deadline_s=3.0):
+    from csmom_tpu.serve.fabric import build_fabric
+
+    return build_fabric(
+        PoolConfig(n_workers=n_workers, transport=transport, **_SMOKE),
+        PoolConfig(n_workers=n_routers, transport=transport, **_SMOKE),
+        tmp, deadline_ms=deadline_s * 1e3, client_deadline_s=deadline_s)
+
+
+def test_fabric_three_tiers_over_tcp_survive_double_kill(tmp_path):
+    """The r18 acceptance shape in miniature: TCP everywhere, 2 router
+    replicas x 2 workers, one ROUTER and one WORKER SIGKILLed mid-burst
+    — availability 1.0 (no admitted request dies with a corpse), closed
+    client books, a schema-valid SERVE_FABRIC artifact, and ledger rows
+    ingested from it."""
+    wsup, pub, rsup, client = _build_fabric(str(tmp_path))
+    try:
+        load = LoadConfig(schedule="1.4x40", seed=5, deadline_s=3.0,
+                          reuse_fraction=0.5, run_id="r99")
+
+        def double_kill():
+            time.sleep(0.3)
+            rsup.kill_worker(rsup.handles[0].worker_id)
+            time.sleep(0.2)
+            wsup.kill_worker(wsup.handles[0].worker_id)
+            give_up = time.monotonic() + 30.0
+            while time.monotonic() < give_up:
+                if all(any(h.generation >= 1 and h.state == "ready"
+                           for h in sup.handles)
+                       for sup in (rsup, wsup)):
+                    return
+                time.sleep(0.05)
+
+        art = run_fabric_loadgen(client, rsup, wsup, load,
+                                 concurrent=double_kill)
+    finally:
+        pub.stop()
+        rsup.stop()
+        wsup.stop()
+    assert inv.validate(art, "serve_fabric") == []
+    req = art["requests"]
+    assert req["admitted"] == req["served"] + req["rejected"] + \
+        req["expired"]
+    assert art["availability"] == 1.0, (art["availability"], req)
+    assert art["routers"]["kills"] == 1 and art["workers"]["kills"] == 1
+    assert art["routers"]["restarts"] >= 1
+    assert art["workers"]["restarts"] >= 1
+    assert req["served"] > 0
+    assert art["transport"]["scheme"] == "tcp"
+    # repeats exist (reuse 0.5) and affinity lands them on one worker's
+    # cache: the PLUMBING must report pool-level hits (the >0.246 claim
+    # is the committed r18 artifact's, not this smoke burst's)
+    assert req["served_cache_hits"] > 0, (
+        "no pool-level cache hit despite 50% panel reuse — the "
+        "cache_hit flag or the affinity routing broke")
+    assert client.invariant_violations() == []
+
+    # the artifact lands, validates from disk, and feeds the ledger
+    path = write_artifact(str(tmp_path), art, prefix="SERVE_FABRIC")
+    assert os.path.basename(path) == "SERVE_FABRIC_r99.json"
+    assert inv.validate_file(path) == []
+    from csmom_tpu.obs import ledger
+
+    rows, notes = ledger.ingest_file(path)
+    metrics = {r.metric for r in rows}
+    assert {"serve_fabric_throughput_rps", "serve_fabric_availability",
+            "serve_fabric_cache_hit_rate",
+            "serve_fabric_hedge_rate"} <= metrics, metrics
+    p99 = [r for r in rows if r.metric == "serve_fabric_p99_ms"]
+    assert p99 and p99[0].samples, "fabric p99 rows must carry samples"
+
+
+# -------------------------------------------------------------- contracts ----
+
+def _min_fabric_art() -> dict:
+    """A minimal VALID serve_fabric artifact (hand-rolled so the
+    rejection tests mutate known-good ground)."""
+    return {
+        "kind": "serve_fabric",
+        "schema_version": 1,
+        "run_id": "r99",
+        "metric": "serve_fabric_throughput_rps",
+        "value": 50.0,
+        "unit": "req/s",
+        "vs_baseline": 1.0,
+        "wall_s": 2.0,
+        "offered_limited": True,
+        "transport": {"scheme": "tcp", "routers": 2, "workers": 2},
+        "requests": {"admitted": 10, "served": 9, "rejected": 1,
+                     "expired": 0, "rejected_infra": 0,
+                     "served_cache_hits": 3, "served_hedged": 1,
+                     "router_conn_failures": 1, "failovers": 1},
+        "availability": 1.0,
+        "cache": {"pool_hit_rate": round(3 / 9, 4),
+                  "served_cache_hits": 3, "served": 9,
+                  "per_worker_baseline": 0.246,
+                  "workers": {"hits": 3, "misses": 6, "lookups": 9,
+                              "stale_hits": 0, "stale_blocked": 0,
+                              "reporting": 2, "lost": []}},
+        "hedge": {"served_hedged": 1, "rate": 0.1,
+                  "router_tier": {"hedged": 2, "wins": 1,
+                                  "suppressed": 1, "books_lost": []}},
+        "latency_ms": {"total": {"p50": 3.0, "p95": 8.0, "p99": 9.0}},
+        "routers": {"replicas": [{"router_id": "r0"}, {"router_id": "r1"}],
+                    "n_slots": 2, "ready_end": 2, "kills": 1,
+                    "restarts": 1, "rolls_completed": 0, "events": []},
+        "workers": {"stats": [{"worker_id": "w0"}, {"worker_id": "w1"}],
+                    "n_slots": 2, "ready_end": 2, "kills": 1,
+                    "restarts": 1, "rolls_completed": 0, "events": []},
+        "compile": {"in_window_fresh_compiles": 0},
+        "offered": {"schedule": "1x10", "offered_rps": 10.0},
+        "extra": {"platform": "stub", "workload": "test"},
+    }
+
+
+def test_serve_fabric_validator_accepts_minimal():
+    assert inv.validate(_min_fabric_art(), "serve_fabric") == []
+    assert inv.detect_kind(_min_fabric_art()) == "serve_fabric"
+
+
+def test_serve_fabric_validator_rejects_broken_books():
+    art = _min_fabric_art()
+    art["requests"]["served"] = 8  # 8 + 1 + 0 != 10
+    viols = inv.validate(art, "serve_fabric")
+    assert any("client books broken" in v for v in viols), viols
+
+
+def test_serve_fabric_validator_rejects_single_router():
+    art = _min_fabric_art()
+    art["transport"]["routers"] = 1
+    viols = inv.validate(art, "serve_fabric")
+    assert any(">= 2 router replicas" in v for v in viols), viols
+
+
+def test_serve_fabric_validator_rejects_stale_hit_anywhere():
+    art = _min_fabric_art()
+    art["cache"]["workers"]["stale_hits"] = 1
+    viols = inv.validate(art, "serve_fabric")
+    assert any("stale_hits" in v and "structurally" in v
+               for v in viols), viols
+
+
+def test_serve_fabric_validator_rejects_unreconciled_figures():
+    art = _min_fabric_art()
+    art["availability"] = 0.5
+    viols = inv.validate(art, "serve_fabric")
+    assert any("does not reconcile" in v for v in viols), viols
+    art = _min_fabric_art()
+    art["cache"]["pool_hit_rate"] = 0.9
+    viols = inv.validate(art, "serve_fabric")
+    assert any("pool_hit_rate" in v for v in viols), viols
+    art = _min_fabric_art()
+    art["hedge"]["rate"] = 0.9
+    viols = inv.validate(art, "serve_fabric")
+    assert any("hedge.rate" in v for v in viols), viols
+
+
+def test_serve_fabric_validator_reports_malformed_counters():
+    """Malformed request counters must come back as VIOLATIONS, not a
+    TypeError out of validate() — the reconcile blocks divide by them."""
+    for bad in ("10", None, 10.5, True):
+        art = _min_fabric_art()
+        art["requests"]["admitted"] = bad
+        viols = inv.validate(art, "serve_fabric")
+        assert any("requests.admitted" in v for v in viols), (bad, viols)
+
+
+def test_kill_mid_burst_tied_offsets_do_not_crash():
+    """Tied kill offsets used to fall through the tuple sort to
+    comparing unorderable supervisors — the TypeError surfaced only
+    after the whole load burst, losing the artifact."""
+    from csmom_tpu.serve.fabric import kill_mid_burst
+
+    class _Handle:
+        def __init__(self, wid, generation=0):
+            self.worker_id = wid
+            self.generation = generation
+            self.state = "ready"
+
+    class _Sup:
+        def __init__(self, *handles):
+            self.handles = list(handles)
+            self.killed = []
+
+        def kill_worker(self, wid):
+            self.killed.append(wid)
+            self.handles[0].generation += 1  # "replacement" is ready
+
+    r, w = _Sup(_Handle("r0")), _Sup(_Handle("w0"))
+    assert kill_mid_burst([(0.01, r, "router"), (0.01, w, "worker")],
+                          settle_timeout_s=5.0) is True
+    assert r.killed == ["r0"] and w.killed == ["w0"]
+    # falsy offsets are dropped (the single-kill CLI paths)
+    r2 = _Sup(_Handle("r0"))
+    assert kill_mid_burst([(0.0, r2, "router")], settle_timeout_s=1.0)
+    assert r2.killed == []
+
+
+def test_kill_mid_burst_settles_on_the_victims_slot_only():
+    """A previously-flaky NON-victim slot already at generation >= 1
+    must not read as settled while the victim's replacement is still
+    spawning — books are built only from a SETTLED fleet."""
+    from csmom_tpu.serve.fabric import kill_mid_burst
+
+    class _Handle:
+        def __init__(self, wid, generation=0):
+            self.worker_id = wid
+            self.generation = generation
+            self.state = "ready"
+
+    class _Sup:
+        def __init__(self, *handles):
+            self.handles = list(handles)
+
+        def kill_worker(self, wid):
+            pass  # the replacement never arrives
+
+    sup = _Sup(_Handle("w0"), _Handle("w1", generation=1))
+    assert kill_mid_burst([(0.01, sup, "worker")],
+                          settle_timeout_s=0.3,
+                          poll_interval_s=0.02) is False, (
+        "the flaky non-victim slot must not satisfy the settle check")
+
+
+def test_fabric_committable_sidecar_naming():
+    assert inv.committable_sidecar("SERVE_FABRIC_r18.json")
+    assert not inv.committable_sidecar("SERVE_FABRIC_smoke.json")
+    assert not inv.committable_sidecar("SERVE_FABRIC_rehearse_x.json")
+    assert not inv.committable_sidecar("SERVE_FABRIC_loadgen-123.json")
+
+
+def test_ledger_refuses_unknown_serve_fabric_schema(tmp_path):
+    from csmom_tpu.obs import ledger
+
+    art = _min_fabric_art()
+    art["schema_version"] = 99
+    p = tmp_path / "SERVE_FABRIC_r99.json"
+    p.write_text(json.dumps(art))
+    rows, notes = ledger.ingest_file(str(p))
+    assert rows == []
+    assert notes and "unknown serve_fabric schema_version" in \
+        notes[0]["note"]
+
+
+def test_committed_serve_fabric_artifacts_validate():
+    """Every committed SERVE_FABRIC_rNN.json at the repo root must pass
+    its own schema — same rule as every other artifact family."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(_REPO, "SERVE_FABRIC_*.json")))
+    for path in paths:
+        base = os.path.basename(path)
+        assert inv.committable_sidecar(base), (
+            f"{base} is committed but is not a round artifact name")
+        assert inv.validate_file(path) == [], base

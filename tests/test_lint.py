@@ -637,11 +637,15 @@ def test_committed_tree_lock_audit_is_pinned():
     sup = "csmom_tpu.serve.supervisor.PoolSupervisor"
     for fn in ("_restart", "_spawn", "_probe_until_ready"):
         # the path may briefly take its own event lock plus the chaos
-        # checkpoint and metrics locks — all leaf locks that acquire
-        # nothing else (the closure proves exactly that)
+        # checkpoint, metrics, and transport-partition locks — all leaf
+        # locks that acquire nothing else (the closure proves exactly
+        # that).  _PARTITION_LOCK joined at r18: the probe's readiness
+        # request rides proto.request, whose score path consults the
+        # chaos partition table before dialing.
         assert pc.acquired_closure(f"{sup}.{fn}").keys() <= {
             f"{sup}._lock", "csmom_tpu.chaos.inject._STATE_LOCK",
-            "csmom_tpu.obs.metrics._LOCK"}
+            "csmom_tpu.obs.metrics._LOCK",
+            "csmom_tpu.serve.proto._PARTITION_LOCK"}
     for info in pc.functions.values():
         for s in info.calls:
             if s.callee in (f"{sup}._spawn", f"{sup}._probe_until_ready"):

@@ -108,3 +108,22 @@ def test_rehearse_full_matrix_green():
     assert p.returncode == 0, p.stdout + p.stderr
     n = len(builtin_matrix())
     assert f"{n}/{n} scenarios green" in p.stdout
+
+
+def test_fabric_scenarios_ride_the_full_matrix():
+    """ISSUE 14: the partition-mid-burst, induced-straggler, and
+    router-kill scenarios exist in the matrix on the serve-fabric
+    pipeline (they spawn two process tiers, so they ride the FULL
+    matrix — the fast tier stays <= 14 and < 30 s)."""
+    mats = builtin_matrix()
+    fabric = {s.name: s for s in mats if s.pipeline == "serve-fabric"}
+    assert {"fabric-partition-mid-burst", "fabric-induced-straggler",
+            "fabric-router-kill-mid-burst"} <= set(fabric)
+    part = fabric["fabric-partition-mid-burst"]
+    assert any(f.point == "serve.transport" and f.action == "partition"
+               for f in part.plan.faults)
+    strag = fabric["fabric-induced-straggler"]
+    assert any(f.point == "serve.transport" and f.action == "net_delay"
+               for f in strag.plan.faults)
+    assert fabric["fabric-router-kill-mid-burst"].env.get("kill"), (
+        "the double-kill scenario must SIGKILL by plan, not by accident")

@@ -509,3 +509,326 @@ def test_committed_serve_pool_artifacts_validate():
         assert art["availability"] >= 0.99, base
         assert art["compile"]["in_window_fresh_compiles"] == 0, base
         assert art["pool"]["kills"] >= 1, base
+
+
+# ------------------------------------------------- r18 transport bounds ----
+
+def test_proto_recv_deadline_bounds_a_stalled_peer():
+    """ISSUE 14 satellite: a peer that opens a frame and then stalls (or
+    trickles) must cost the reader a pointed ProtocolError within the
+    receive deadline — the r11 _recv_exact blocked for as long as the
+    peer kept the socket alive, wedging a router thread forever."""
+    a, b = socket.socketpair()
+    try:
+        import struct
+
+        a.sendall(struct.pack("!I", 64))  # declares 64 bytes, sends none
+        t0 = time.monotonic()
+        with pytest.raises(proto.ProtocolError, match="deadline"):
+            proto.recv_msg(b, deadline_s=0.4)
+        assert time.monotonic() - t0 < 2.0, (
+            "the receive deadline did not bound the stall")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_proto_recv_deadline_bounds_a_trickling_peer():
+    """A peer trickling one byte per timeout window used to reset the
+    clock forever; the deadline is TOTAL, so the trickle is refused."""
+    import struct
+
+    a, b = socket.socketpair()
+    stop = threading.Event()
+
+    def trickle():
+        a.sendall(struct.pack("!I", 1 << 20))
+        while not stop.is_set():
+            try:
+                a.sendall(b"\x00")
+            except OSError:
+                return
+            stop.wait(0.05)
+
+    t = threading.Thread(target=trickle, daemon=True)
+    t.start()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(proto.ProtocolError, match="deadline"):
+            proto.recv_msg(b, deadline_s=0.4)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        stop.set()
+        a.close()
+        b.close()
+        t.join(timeout=1.0)
+
+
+def test_chaos_env_duration_defaults_on_malformed_value(monkeypatch):
+    """A typo'd chaos duration knob (\"250ms\") must degrade to the
+    default fault, not raise an unhandled ValueError through the
+    dispatch thread and strand its request non-terminal."""
+    monkeypatch.setenv(proto.NET_DELAY_ENV, "250ms")
+    assert proto._chaos_env_s(proto.NET_DELAY_ENV, 1.5) == 1.5
+    monkeypatch.setenv(proto.NET_DELAY_ENV, "0.25")
+    assert proto._chaos_env_s(proto.NET_DELAY_ENV, 1.5) == 0.25
+    monkeypatch.setenv(proto.NET_DELAY_ENV, "")
+    assert proto._chaos_env_s(proto.NET_DELAY_ENV, 1.5) == 1.5
+    monkeypatch.delenv(proto.NET_DELAY_ENV)
+    assert proto._chaos_env_s(proto.NET_DELAY_ENV, 1.5) == 1.5
+
+
+def test_tcp_crash_restart_probes_a_fresh_port(tmp_path):
+    """A tcp slot's crash restart must probe a FRESH port (like a
+    rolling replacement does) — re-spawning onto the dead port every
+    backoff cycle turns a one-off port race into a crash-loop park."""
+    from csmom_tpu.serve.supervisor import (
+        PoolConfig,
+        PoolSupervisor,
+        WorkerHandle,
+    )
+
+    sup = PoolSupervisor(PoolConfig(n_workers=1, transport="tcp",
+                                    engine="stub", profile="serve-smoke"),
+                         str(tmp_path))
+    spawned = []
+    sup._spawn = lambda h: spawned.append(h.socket_path)
+    sup._probe_until_ready = lambda *a, **k: None
+    h = WorkerHandle(slot=0, worker_id="w0",
+                     socket_path="tcp:127.0.0.1:1")
+    sup.handles.append(h)
+    sup._restart(h)
+    assert h.generation == 1
+    assert spawned == [h.socket_path]
+    assert h.socket_path != "tcp:127.0.0.1:1", (
+        "the replacement re-spawned onto the dead port")
+    assert h.socket_path.startswith("tcp:127.0.0.1:")
+
+
+def test_proto_recv_restores_caller_socket_timeout():
+    """_recv_exact re-arms the socket timeout downward per read; the
+    caller's timeout must come back afterwards — a reply send on the
+    same connection inheriting a near-zero residual budget would
+    spuriously time out and drop an already-computed answer."""
+    a, b = socket.socketpair()
+    try:
+        b.settimeout(60.0)
+        proto.send_msg(a, {"op": "ping"})
+        obj, _ = proto.recv_msg(b, deadline_s=5.0)
+        assert obj == {"op": "ping"}
+        assert b.gettimeout() == 60.0, (
+            "recv_msg leaked its dwindling receive budget into the "
+            "caller's socket timeout")
+        # the error path restores it too
+        b.settimeout(60.0)
+        import struct
+
+        a.sendall(struct.pack("!I", 64))
+        with pytest.raises(proto.ProtocolError, match="deadline"):
+            proto.recv_msg(b, deadline_s=0.2)
+        assert b.gettimeout() == 60.0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_proto_frame_bound_refuses_before_allocating():
+    """The refusal must happen on the LENGTH PREFIX, before the payload
+    allocation a hostile prefix names (the pointed-refusal satellite)."""
+    import struct
+
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack("!I", 0xFFFFFFFF))  # a 4 GiB claim
+        with pytest.raises(proto.ProtocolError,
+                           match="Refusing before allocating"):
+            proto.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_parse_address_schemes_and_errors():
+    assert proto.parse_address("/tmp/w0.sock") == ("unix", "/tmp/w0.sock")
+    assert proto.parse_address("unix:/tmp/w0.sock") == ("unix",
+                                                        "/tmp/w0.sock")
+    assert proto.parse_address("tcp:127.0.0.1:9001") == (
+        "tcp", ("127.0.0.1", 9001))
+    for bad in ("unix:", "tcp:nohost", "tcp:h:notaport", "tcp:h:70000"):
+        with pytest.raises(ValueError):
+            proto.parse_address(bad)
+
+
+def test_proto_tcp_roundtrip_with_arrays():
+    """The same framed protocol over AF_INET: one listen + request
+    round trip carrying arrays — the r18 cross-host spelling."""
+    addr = f"tcp:127.0.0.1:{proto.free_tcp_port()}"
+    srv = proto.listen(addr)
+    srv.settimeout(2.0)
+
+    def serve_one():
+        conn, _ = srv.accept()
+        try:
+            obj, arrays = proto.recv_msg(conn)
+            proto.send_msg(conn, {"echo": obj["op"]},
+                           {"values": arrays["values"] * 2})
+        finally:
+            conn.close()
+
+    t = threading.Thread(target=serve_one, daemon=True)
+    t.start()
+    try:
+        v = np.arange(6, dtype=np.float32).reshape(2, 3)
+        obj, arrays = proto.request(addr, {"op": "probe"},
+                                    arrays={"values": v}, timeout_s=5.0)
+        assert obj == {"echo": "probe"}
+        np.testing.assert_array_equal(arrays["values"], v * 2)
+    finally:
+        srv.close()
+        t.join(timeout=2.0)
+
+
+# ----------------------------------------- r18 parked-fleet degradation ----
+
+def test_router_parked_fleet_rejects_fast_with_retry_after():
+    """ISSUE 14 satellite: when ALL workers are parked/unreachable the
+    router rejects AT THE DOOR with a retry-after hint derived from
+    supervisor backoff state, instead of burning the caller's full
+    deadline per request."""
+    router = Router(lambda: [], RouterConfig(profile="serve-smoke",
+                                             default_deadline_s=5.0),
+                    retry_after_fn=lambda: 1.7)
+    v, m = _panel(3, 24)
+    t0 = time.monotonic()
+    r = router.submit("momentum", v, m)
+    assert r.wait(2.0) and r.state == "rejected"
+    assert time.monotonic() - t0 < 1.0, (
+        "a parked-fleet rejection burned the deadline instead of "
+        "failing fast at the door")
+    assert r.retry_after_s == 1.7
+    assert "retry after 1.7s" in (r.error or "")
+    a = router.accounting()
+    assert a["rejected_no_worker"] == 1 and a["rejected_infra"] == 1
+    assert router.invariant_violations() == []
+
+
+def test_supervisor_retry_after_reflects_backoff_state(tmp_path):
+    """The hint is the NEXT plausible restart's floor: None while any
+    worker is ready, the soonest backoff otherwise, and None again when
+    every slot is parked (retrying cannot help a parked fleet)."""
+    from csmom_tpu.serve.supervisor import WorkerHandle
+    from csmom_tpu.utils.deadline import mono_now_s
+
+    cfg = PoolConfig(n_workers=2, **_SMOKE_POOL)
+    sup = PoolSupervisor(cfg, str(tmp_path))
+    h0 = WorkerHandle(slot=0, worker_id="w0", socket_path="x")
+    h1 = WorkerHandle(slot=1, worker_id="w1", socket_path="y")
+    sup.handles = [h0, h1]
+    h0.state, h1.state = "ready", "dead"
+    assert sup.retry_after_s() is None, "a ready worker needs no hint"
+    h0.state = "dead"
+    h0.next_restart_at = mono_now_s() + 3.0
+    h1.next_restart_at = mono_now_s() + 1.2
+    hint = sup.retry_after_s()
+    assert hint is not None and 0.9 <= hint <= 1.3, hint
+    h0.state = h1.state = "failed"
+    h0.next_restart_at = h1.next_restart_at = None
+    assert sup.retry_after_s() is None, (
+        "a fully parked fleet must not promise a retry that cannot come")
+
+
+# ---------------------------------------------- r18 ring and fair gate ----
+
+def test_hash_ring_is_stable_and_moves_minimally():
+    from csmom_tpu.serve.router import HashRing
+
+    ids = ["w0", "w1", "w2", "w3"]
+    ring = HashRing(ids)
+    keys = [f"req-{i}" for i in range(400)]
+    before = {k: ring.pick(k) for k in keys}
+    # deterministic: the same ring answers the same
+    again = HashRing(ids)
+    assert before == {k: again.pick(k) for k in keys}
+    # removing one member moves ONLY that member's keys
+    ring3 = HashRing([i for i in ids if i != "w2"])
+    moved = sum(1 for k in keys
+                if before[k] != "w2" and ring3.pick(k) != before[k])
+    assert moved == 0, (
+        f"{moved} keys moved off SURVIVING workers after one death — "
+        "consistent hashing must only redistribute the dead arcs")
+    # the dead member's keys all land somewhere real
+    assert all(ring3.pick(k) in ("w0", "w1", "w3")
+               for k in keys if before[k] == "w2")
+    assert HashRing([]).pick("anything") is None
+
+
+def test_affinity_routes_identical_requests_to_one_worker(tmp_path):
+    """Byte-identical requests share a cache identity and must land on
+    the SAME worker — the pool-level cache property."""
+    fakes = [_FakeWorker(str(tmp_path), f"w{i}", delay_s=0.0)
+             for i in range(3)]
+    try:
+        router = Router(lambda: fakes, RouterConfig(
+            profile="serve-smoke", default_deadline_s=5.0))
+        v, m = _panel(7, 24, seed=3)
+        reqs = []
+        for _ in range(6):
+            r = router.submit("momentum", v, m)
+            assert r.wait(3.0) and r.state == "served", (r.state, r.error)
+            reqs.append(r)
+        assert len({r.worker_id for r in reqs}) == 1, (
+            [r.worker_id for r in reqs])
+        assert router.accounting()["affinity_routed"] >= 6
+        # a DIFFERENT panel may land elsewhere, same panel sticks
+        v2, m2 = _panel(5, 24, seed=4)
+        r2 = router.submit("momentum", v2, m2)
+        assert r2.wait(3.0) and r2.state == "served"
+    finally:
+        for f in fakes:
+            f.close()
+
+
+def test_weighted_fair_gate_enforces_rank_and_bounds():
+    from csmom_tpu.serve.router import WeightedFairGate
+    from csmom_tpu.serve.slo import default_policy
+
+    gate = WeightedFairGate(default_policy(), slots=1)
+    assert gate.acquire("interactive", 0.5), "an empty gate grants"
+    got = []
+
+    def waiter(cls):
+        if gate.acquire(cls, 2.0):
+            got.append(cls)
+            gate.release()
+
+    # bulk queues first, interactive second — the slot must go to
+    # interactive when it frees (rank order, not FIFO)
+    tb = threading.Thread(target=waiter, args=("bulk",), daemon=True)
+    tb.start()
+    time.sleep(0.05)
+    ti = threading.Thread(target=waiter, args=("interactive",), daemon=True)
+    ti.start()
+    time.sleep(0.05)
+    gate.release()
+    ti.join(3.0)
+    tb.join(3.0)
+    assert got == ["interactive", "bulk"], got
+    s = gate.stats()
+    assert s["slots"] == 1 and s["in_use"] == 0
+    assert s["granted"]["interactive"] >= 2
+
+
+def test_weighted_fair_gate_timeout_is_honest_backpressure():
+    from csmom_tpu.serve.router import WeightedFairGate
+    from csmom_tpu.serve.slo import default_policy
+
+    gate = WeightedFairGate(default_policy(), slots=1)
+    assert gate.acquire("interactive", 0.5)
+    t0 = time.monotonic()
+    assert not gate.acquire("bulk", 0.2), "a full gate must time out"
+    assert 0.15 <= time.monotonic() - t0 < 1.0
+    assert gate.stats()["timeouts"]["bulk"] == 1
+    gate.release()
+    assert gate.acquire("bulk", 0.5), (
+        "the timed-out class must not poison later acquires")
+    gate.release()

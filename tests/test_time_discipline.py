@@ -101,7 +101,10 @@ def test_tier_lists_still_cover_the_historical_modules():
     for rel in ("csmom_tpu/serve/queue.py", "csmom_tpu/serve/batcher.py",
                 "csmom_tpu/serve/slo.py", "csmom_tpu/serve/cache.py",
                 "csmom_tpu/serve/router.py", "csmom_tpu/cli/serve.py",
-                "csmom_tpu/stream/replay.py", "csmom_tpu/cli/replay.py"):
+                "csmom_tpu/stream/replay.py", "csmom_tpu/cli/replay.py",
+                # the r18 fabric tier: transport receive deadlines and
+                # client-side failover time on the serve clock
+                "csmom_tpu/serve/proto.py", "csmom_tpu/serve/fabric.py"):
         assert rel in CD.MONO_ONLY_FILES, rel
     for rel in ("csmom_tpu/stream/ring.py", "csmom_tpu/stream/ingest.py",
                 "csmom_tpu/stream/incremental.py"):

@@ -467,3 +467,108 @@ def test_no_stray_scratch_sidecars_at_repo_root():
     assert strays == [], (
         f"scratch sidecars at the repo root: {strays} — they belong in "
         ".csmom_scratch/ (obs.timeline.scratch_dir)")
+
+
+def test_three_tier_trace_books_close_under_router_replica_sigkill(
+        tmp_path):
+    """ISSUE 14 satellite: the trace books across THREE process tiers
+    (loadgen client → supervised router replicas → workers), with one
+    replica SIGKILLed mid-dispatch.  The client closes the dead
+    replica's unstitchable dispatches as reason-counted orphan halves,
+    surviving complete traces carry the stages of ALL three tiers
+    (client route/transport + replica route/transport + worker
+    queue/dispatch), the client book reconciles with the SERVE_FABRIC
+    request books, and each SURVIVING replica's own books — request and
+    trace — close too."""
+    from csmom_tpu.serve.fabric import (
+        FabricClient,
+        FabricClientConfig,
+        RouterSupervisor,
+        RoutesPublisher,
+    )
+    from csmom_tpu.serve.loadgen import run_fabric_loadgen
+    from csmom_tpu.serve.supervisor import PoolConfig, PoolSupervisor
+
+    smoke = dict(profile="serve-smoke", engine="stub",
+                 ready_timeout_s=30.0, poll_interval_s=0.05,
+                 backoff_base_s=0.05, backoff_cap_s=0.5)
+    book = obs_trace.arm_tracing(seed=3)
+    wdir = os.path.join(str(tmp_path), "workers")
+    os.makedirs(wdir, exist_ok=True)
+    wsup = PoolSupervisor(PoolConfig(n_workers=2, **smoke), wdir)
+    wsup.start()
+    routes = os.path.join(str(tmp_path), "routes.json")
+    pub = RoutesPublisher(wsup, routes, interval_s=0.05).start()
+    rsup = RouterSupervisor(
+        PoolConfig(n_workers=2, expect_cache_version=wsup.expect_cache_version,
+                   **smoke),
+        os.path.join(str(tmp_path), "routers"), routes,
+        deadline_ms=3000.0, trace=True)
+    os.makedirs(rsup.run_dir, exist_ok=True)
+    rsup.start()
+    client = FabricClient(rsup.ready_workers,
+                          FabricClientConfig(default_deadline_s=3.0))
+
+    def kill_replica():
+        time.sleep(0.3)
+        os.kill(rsup.handles[0].proc.pid, signal.SIGKILL)
+        give_up = time.monotonic() + 30.0
+        while time.monotonic() < give_up:
+            if any(h.generation >= 1 and h.state == "ready"
+                   for h in rsup.handles):
+                return
+            time.sleep(0.05)
+
+    try:
+        art = run_fabric_loadgen(
+            client, rsup, wsup,
+            LoadConfig(schedule="1.2x70", seed=7, deadline_s=3.0,
+                       run_id="trace_fabric_kill"),
+            concurrent=kill_replica)
+    finally:
+        pub.stop()
+        rsup.stop()
+        wsup.stop()
+    obs_trace.disarm_tracing()
+
+    # the CLIENT book is the outermost trace ledger: closed, balanced
+    # against the fabric artifact's request books
+    req = art["requests"]
+    assert book.invariant_violations() == []
+    assert book.opened == req["admitted"]
+    assert book.complete == req["served"]
+    assert book.partial == req["rejected"] + req["expired"]
+
+    snap = book.snapshot()
+    assert snap["orphans"]["count"] > 0, (
+        "the replica SIGKILL left no orphan half — nothing was in "
+        "flight, or the orphan leaked unclosed")
+    assert all(("connection" in r or "closed" in r or "reset" in r)
+               for r in snap["orphans"]["reasons"]), snap["orphans"]
+    # three-tier stitching: the client's chain carries its own route/
+    # transport plus the replica's (merged by name) plus the worker's
+    # queue/dispatch stages
+    for stage in ("route", "transport", "queue_wait", "dispatch",
+                  "finalize"):
+        assert stage in snap["stages"], f"missing stitched stage {stage}"
+    assert snap["reconcile"]["violations"] == 0
+
+    # every SURVIVING replica's books — request AND trace — close too;
+    # the dead replica's are reported lost, never faked
+    surviving = [r for r in art["routers"]["replicas"]
+                 if r.get("state") == "ready" and "accounting" in r]
+    assert surviving, "no surviving replica reported stats"
+    for rep in surviving:
+        assert rep.get("invariant_violations") == [], rep
+        tr = rep.get("trace")
+        assert tr is not None, "replica tracing was armed but no book"
+        assert tr["invariant_violations"] == []
+        books = tr["snapshot"]["books"]
+        assert books["opened"] == books["complete"] + books["partial"]
+
+    tart = obs_trace.build_artifact(
+        book, "trace_fabric_kill",
+        requests={k: req[k]
+                  for k in ("admitted", "served", "rejected", "expired")},
+        fresh_compiles=0, platform="stub", workload="unit fabric kill")
+    assert inv.validate(tart) == []
