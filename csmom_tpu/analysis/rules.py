@@ -2,7 +2,7 @@
 engines, so one registration buys the ``csmom lint`` CLI, the tier-1
 sweep, ``csmom registry list``, and the fixture self-test harness.
 
-Five rules, each mechanizing a discipline an earlier round enforced by
+Six rules, each mechanizing a discipline an earlier round enforced by
 regex or review:
 
 - **clock-discipline** — the r3/r7 time-discipline lints ported to AST
@@ -41,6 +41,14 @@ regex or review:
   ``chaos.plan.KNOWN_POINTS`` and every vocabulary entry must still
   have a call site — the prose inventory in ``chaos/inject.py`` drifted
   twice before the vocabulary became code.
+- **dial-discipline** — the r19 persistent-transport contract: the
+  one-shot ``proto.request_once`` (connect per call) is for probes and
+  one-shot admin/lifecycle ops ONLY; a dial-per-call site on a request
+  hot path (router/fabric dispatch) reintroduces exactly the
+  connection-per-request tail the pooled channels erased
+  (``trace_stage_transport_p99_ms`` 742 → 304 ms, p50 295 → 16 ms,
+  in the r19 capture).  Probe/stats/lifecycle functions and the
+  supervisor/health admin modules are allowlisted.
 
 Stdlib-only, jax-free (the sweep gates ``csmom rehearse`` on CPU).
 Rule messages spell pragma examples with ``{`` placeholders so this
@@ -57,6 +65,7 @@ from csmom_tpu.analysis.core import FileContext, LintRule, RunContext
 
 __all__ = [
     "ClockDiscipline",
+    "DialDiscipline",
     "DonationSafety",
     "EnumerationDrift",
     "LockDiscipline",
@@ -527,12 +536,20 @@ class LockDiscipline(LintRule):
 
     def _acquire_is_disciplined(self, call, receiver: str,
                                 ctx: FileContext) -> bool:
-        # disciplined iff some enclosing Try releases this receiver in its
-        # finalbody, or the very next sibling statement is such a Try
+        # disciplined iff some enclosing Try releases this receiver in
+        # its finalbody, the very next sibling statement is such a Try,
+        # or the acquire is the TEST of an ``if lock.acquire(...):``
+        # whose body opens with such a Try — the canonical
+        # try-lock-then-finally-release idiom (the r19 read baton)
         stmt = call
         while (stmt in ctx.parents
                and not isinstance(stmt, ast.stmt)):
             stmt = ctx.parents[stmt]
+        if (isinstance(stmt, ast.If) and stmt.body
+                and any(sub is call for sub in ast.walk(stmt.test))
+                and isinstance(stmt.body[0], ast.Try)
+                and self._released_in(stmt.body[0].finalbody, receiver)):
+            return True
         node = stmt
         while node in ctx.parents:
             parent = ctx.parents[node]
@@ -706,6 +723,87 @@ class DonationSafety(LintRule):
 
 
 # --------------------------------------------------------------------------
+# dial-discipline
+# --------------------------------------------------------------------------
+
+class DialDiscipline(LintRule):
+    """No dial-per-call transport on request hot paths (ISSUE 15).
+
+    The pooled multiplexed channel (``proto.ChannelPool``) is the only
+    legal transport for score dispatch; ``proto.request_once`` (and its
+    back-compat alias ``proto.request``) opens a fresh connection per
+    call — exactly the r18 design whose measured bill was an 11× tail
+    (``trace_stage_transport_p99_ms`` 44 → 742 ms).  One-shot calls
+    stay legal where a fresh connection is the POINT: probes (a probe
+    must measure the peer's ability to accept), lifecycle/admin ops
+    (stats, drain, stop — they must not ride a channel the request
+    path might sever), and the supervisor/health modules that own
+    them.  Alias-aware like every rule here: ``from
+    csmom_tpu.serve.proto import request_once as r; r(...)`` resolves
+    to the same origin."""
+
+    id = "dial-discipline"
+    description = ("proto.request_once (dial-per-call) is for probes "
+                   "and one-shot admin ops only — request hot paths "
+                   "(router/fabric dispatch) must use the pooled "
+                   "multiplexed channels, or the connection-per-request "
+                   "tail comes back")
+
+    # the one-shot origins (``request`` is the pre-r19 alias)
+    ONE_SHOT_ORIGINS = ("csmom_tpu.serve.proto.request_once",
+                        "csmom_tpu.serve.proto.request")
+
+    # admin/probe modules that OWN the one-shot pattern: fresh-dial
+    # probing and lifecycle ops are their job, not a hot path
+    ALLOWED_FILES = (
+        "csmom_tpu/serve/supervisor.py",
+        "csmom_tpu/serve/health.py",
+    )
+
+    # probe/lifecycle functions stay legal anywhere (router_stats on
+    # the fabric supervisor, CLI self-probes, rehearse drivers)
+    ALLOWED_FN_RE = re.compile(
+        r"probe|stats|liveness|readiness|drain|stop|lifecycle|admin",
+        re.IGNORECASE)
+
+    def _enclosing_fn(self, node: ast.AST, ctx: FileContext):
+        cur = node
+        while cur in ctx.parents:
+            cur = ctx.parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+        return None
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        origin = ctx.resolve_call(node)
+        name = _callable_name(node.func)
+        if origin is not None:
+            # a resolved origin is the truth: a foreign helper that
+            # merely SHARES the name request_once is not our transport
+            if origin not in self.ONE_SHOT_ORIGINS:
+                return
+        elif name != "request_once":
+            return
+        rel = _posix(ctx.rel)
+        if rel in self.ALLOWED_FILES:
+            return
+        fn = self._enclosing_fn(node, ctx)
+        if fn is not None and self.ALLOWED_FN_RE.search(fn.name):
+            return
+        where = f" (in {fn.name!r})" if fn is not None else ""
+        ctx.report(
+            self.id, node.lineno,
+            f"dial-per-call transport{where}: request_once opens a "
+            "fresh connection per call — request hot paths dispatch "
+            "over proto.ChannelPool (persistent multiplexed channels); "
+            "if this is genuinely a probe or one-shot admin op, name "
+            "the function for what it is (probe/stats/drain/stop) or "
+            "justify in place with a pragma")
+
+
+# --------------------------------------------------------------------------
 # enumeration-drift
 # --------------------------------------------------------------------------
 
@@ -826,7 +924,7 @@ class EnumerationDrift(LintRule):
 # --------------------------------------------------------------------------
 
 BUILTIN_RULES = (ClockDiscipline, TracerHygiene, LockDiscipline,
-                 DonationSafety, EnumerationDrift)
+                 DonationSafety, EnumerationDrift, DialDiscipline)
 
 
 def register_builtin_rules() -> None:

@@ -156,13 +156,14 @@ class Fault:
         "cache_poison",
         # network faults (ISSUE 14) — caller-interpreted at the
         # serve.transport checkpoint (serve/proto.py): "conn_reset"
-        # raises a connection reset into the dialing code's failover
+        # raises a connection reset into the dispatcher's failover
         # handling, "net_delay" stalls the transport by
         # CSMOM_CHAOS_NET_DELAY_S (an induced straggler for the hedging
         # policy to route around), and "partition" cuts the firing
-        # process off from the peer address it was dialing for
-        # CSMOM_CHAOS_PARTITION_S (every dial to that peer fails
-        # instantly until the partition heals)
+        # process off from the peer address for CSMOM_CHAOS_PARTITION_S.
+        # On the r19 persistent channels a partition SEVERS every live
+        # channel to the peer — in-flight requests reason-close into
+        # failover, not just new dials refused — until it heals
         "conn_reset",
         "net_delay",
         "partition",

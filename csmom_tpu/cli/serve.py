@@ -583,6 +583,9 @@ def _cmd_loadgen_fabric(args, schedule: str, run_id: str,
                 print(f"    {p.kind}: state={p.state} error={p.error}",
                       file=sys.stderr)
             return 1
+        # the throwaway probe client's channels must not linger into
+        # the measured window (its dials are not the run's evidence)
+        probe_client.close()
         trace_book = _arm_trace(args)
 
         preset = dict(preset or {})
@@ -645,6 +648,7 @@ def _cmd_loadgen_fabric(args, schedule: str, run_id: str,
     finally:
         # every exit path must stop BOTH process tiers and the publisher
         stop_fabric(publisher, rsup, wsup)
+        client.close()  # the measured client's persistent channels
     out_dir = args.out or os.getcwd()
     path = write_artifact(out_dir, art, prefix="SERVE_FABRIC")
 

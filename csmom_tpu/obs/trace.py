@@ -68,6 +68,7 @@ __all__ = [
     "EPSILON_MS",
     "SCHEMA_VERSION",
     "STAGES",
+    "TRANSPORT_SUBSTAGES",
     "TraceBook",
     "TraceContext",
     "arm_tracing",
@@ -82,12 +83,29 @@ __all__ = [
 SCHEMA_VERSION = 1
 
 # the canonical stage vocabulary, in request-path order.  The router-side
-# stages (route/transport/finalize) only appear on pool-stitched traces;
-# mesh shard placement rides as trace ATTRS (devices/shards), because XLA
-# executes a sharded dispatch as one program — the per-shard split is an
-# attribute of the dispatch stage, not a separable wall.
+# stages (route/connect/send/recv_wait/finalize) only appear on
+# pool-stitched traces; mesh shard placement rides as trace ATTRS
+# (devices/shards), because XLA executes a sharded dispatch as one
+# program — the per-shard split is an attribute of the dispatch stage,
+# not a separable wall.
+#
+# ``transport`` (ISSUE 15): since the persistent-channel round the wire
+# wall is recorded as three TELESCOPING sub-stages — ``connect`` (channel
+# acquisition: a pool hit is ~0, a dial pays the handshake), ``send``
+# (frame fully written), ``recv_wait`` (reply wall minus the peer's own
+# reported wall) — so the r18 connection-per-request bill is attributable
+# to its component.  The book still aggregates their per-trace SUM under
+# the DERIVED ``transport`` stage (it is not part of any trace's
+# telescoping chain, so stage sums still reconcile with the request wall)
+# to keep the ``trace_stage_transport_p99_ms`` trajectory comparable
+# across r17/r18/r19.  Pre-r19 halves that recorded a flat ``transport``
+# stage still stitch verbatim.
 STAGES = ("admit", "queue_wait", "coalesce", "pad", "dispatch",
-          "serialize", "route", "transport", "finalize")
+          "serialize", "route", "connect", "send", "recv_wait",
+          "transport", "finalize")
+
+# the wire sub-stages whose per-trace sum IS the derived transport wall
+TRANSPORT_SUBSTAGES = ("connect", "send", "recv_wait")
 
 # the auto-label for the residual a close() stamps: the stage that FOLLOWS
 # the last recorded mark (a request rejected while queued closes its
@@ -136,7 +154,8 @@ class _NullTrace:
     def note_orphan(self, worker_id, reason):
         return self
 
-    def absorb_remote(self, half, t_start_s, t_end_s, worker_id=None):
+    def absorb_remote(self, half, t_start_s, t_end_s, worker_id=None,
+                      t_acquired_s=None, t_sent_s=None):
         return self
 
     def close(self, outcome, reason=None, stage=None):
@@ -230,12 +249,19 @@ class TraceContext:
         return self
 
     def absorb_remote(self, half: dict, t_start_s: float, t_end_s: float,
-                      worker_id: str | None = None):
+                      worker_id: str | None = None,
+                      t_acquired_s: float | None = None,
+                      t_sent_s: float | None = None):
         """Attach the worker's reply half (the server-side stage chain)
         plus the client-observed attempt window, for close-time
-        stitching.  Last write wins — only the winning attempt's absorb
-        survives to the terminal transition."""
-        self._remote = (half, t_start_s, t_end_s, worker_id)
+        stitching.  ``t_acquired_s`` / ``t_sent_s`` are the channel
+        layer's marks (channel in hand; frame fully written) — when
+        present, close-time stitching splits the wire wall into
+        connect / send / recv_wait instead of one flat ``transport``.
+        Last write wins — only the winning attempt's absorb survives to
+        the terminal transition."""
+        self._remote = (half, t_start_s, t_end_s, worker_id,
+                        t_acquired_s, t_sent_s)
         return self
 
     # ------------------------------------------------------------- close --
@@ -261,26 +287,52 @@ class TraceContext:
         """The router's stitched close: build the full chain from the
         client-observed window plus the absorbed worker half.
 
-        ``route`` covers submit -> winning-attempt start, ``transport``
-        is the attempt wall minus the worker's own wall, the worker's
-        stages ride verbatim in between, and ``finalize`` covers the
-        reply's fan-back — so the sum telescopes to the router-observed
-        request wall exactly.  Without an absorbed half (every attempt
-        failed, or the request never dispatched) the whole wall lands
-        under ``route`` with the reason.
+        ``route`` covers submit -> winning-attempt start; the wire wall
+        (attempt wall minus the worker's own reported wall) lands as
+        the channel marks allow — split into ``connect`` (channel
+        acquired) / ``send`` (frame written) / ``recv_wait`` (the
+        remainder) when the pooled transport reported its marks, or as
+        one flat ``transport`` stage for a markless (pre-r19) attempt;
+        the worker's stages ride verbatim in between, and ``finalize``
+        covers the reply's fan-back — so the sum telescopes to the
+        router-observed request wall exactly.  Without an absorbed half
+        (every attempt failed, or the request never dispatched) the
+        whole wall lands under ``route`` with the reason.
         """
         if self.outcome is not None:
             return self
         durs: dict = {}
         if self._remote is not None:
-            half, t_start, t_end, worker_id = self._remote
+            half, t_start, t_end, worker_id, t_acq, t_sent = self._remote
             server = dict((half or {}).get("stages") or {})
             server_wall = sum(server.values())
             durs["route"] = max(0.0, t_start - self.t0_s)
-            durs["transport"] = max(0.0, (t_end - t_start) - server_wall)
-            for k, v in server.items():
-                durs[k] = durs.get(k, 0.0) + v
-            durs["finalize"] = max(0.0, t_done_s - t_end)
+            if t_acq is not None and t_sent is not None:
+                # the channel marks split the wire wall (attempt window
+                # minus the peer's own reported wall) into connect /
+                # send / recv_wait.  The wire wall is authoritative;
+                # the marks are stamps from ANOTHER thread's schedule
+                # and can skew a few ms late under load, so connect and
+                # send are clamped INTO the available wire wall (skew
+                # lands in the stage whose stamp drifted, and the sum
+                # still telescopes to the request wall exactly)
+                wire_s = max(0.0, (t_end - t_start) - server_wall)
+                connect_s = min(max(0.0, t_acq - t_start), wire_s)
+                send_s = min(max(0.0, t_sent - t_acq),
+                             wire_s - connect_s)
+                durs["connect"] = connect_s
+                durs["send"] = send_s
+                for k, v in server.items():
+                    durs[k] = durs.get(k, 0.0) + v
+                durs["recv_wait"] = (durs.get("recv_wait", 0.0)
+                                     + (wire_s - connect_s - send_s))
+            else:
+                durs["transport"] = max(0.0,
+                                        (t_end - t_start) - server_wall)
+                for k, v in server.items():
+                    durs[k] = durs.get(k, 0.0) + v
+            durs["finalize"] = durs.get("finalize", 0.0) + max(
+                0.0, t_done_s - t_end)
             if worker_id is not None:
                 self.attrs.setdefault("worker", worker_id)
             for k, v in ((half or {}).get("attrs") or {}).items():
@@ -454,10 +506,20 @@ class TraceBook:
                 "stages": {k: round(v * 1e3, 3) for k, v in durs.items()},
                 "attrs": dict(ctx.attrs),
             }
+        # the DERIVED transport wall (ISSUE 15): the sub-stage sum of a
+        # channel-stitched trace, aggregated under "transport" so the
+        # r17/r18 trajectory row keeps its meaning — derived only, never
+        # written into the trace's own telescoping chain (stage sums
+        # must still reconcile with the request wall)
+        fold = dict(durs)
+        if "transport" not in fold:
+            sub = [fold[k] for k in TRANSPORT_SUBSTAGES if k in fold]
+            if sub:
+                fold["transport"] = sum(sub)
         with self._lock:
             if ctx.outcome == "served":
                 self.complete += 1
-                for stage, d in durs.items():
+                for stage, d in fold.items():
                     res = self._stage_res.get(stage)
                     if res is None:
                         res = self._stage_res[stage] = _Reservoir(

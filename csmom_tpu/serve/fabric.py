@@ -307,13 +307,14 @@ class RouterSupervisor(PoolSupervisor):
                    "addr": h.socket_path}
             if h.state == "ready":
                 try:
-                    obj, _ = proto.request(h.socket_path, {"op": "stats"},
+                    obj, _ = proto.request_once(h.socket_path, {"op": "stats"},
                                            timeout_s=5.0)
                     rec.update({
                         "accounting": obj.get("accounting"),
                         "classes": obj.get("classes"),
                         "availability": obj.get("availability"),
                         "fair_gate": obj.get("fair_gate"),
+                        "channels": obj.get("channels"),
                         "invariant_violations":
                             obj.get("invariant_violations"),
                         "trace": obj.get("trace"),
@@ -521,6 +522,14 @@ class FabricClient:
         self._routers_fn = routers_fn
         self._rr = itertools.count()
         self._lock = threading.Lock()
+        # the persistent multiplexed transport (ISSUE 15): long-lived
+        # channels to the router replicas — requests interleave on one
+        # TCP_NODELAY stream per replica instead of dialing per submit
+        self.channels = proto.ChannelPool(
+            connect_timeout_s=self.config.connect_timeout_s)
+        # shared score-header renderer — one implementation with the
+        # router tier (proto.ScoreHeaderCache), no hand-synced copy
+        self._headers = proto.ScoreHeaderCache()
         self.admitted = 0
         self.served = 0
         self.rejected = 0
@@ -532,6 +541,11 @@ class FabricClient:
         self.failovers = 0
 
     # --------------------------------------------------------------- admit
+
+    def close(self) -> None:
+        """Close the client's channels (teardown hygiene; safe while
+        requests are settling — they reason-close into failover)."""
+        self.channels.close()
 
     def submit(self, kind: str, values, mask,
                priority: str = "interactive",
@@ -597,14 +611,9 @@ class FabricClient:
             if attempt > 0:
                 with self._lock:
                     self.failovers += 1
-            header = {"op": "score", "kind": req.kind,
-                      "req_id": req.req_id, "priority": req.priority,
-                      "deadline_rel_s": rem,
-                      "panel_version": req.panel_version}
-            wire_trace = (req.trace.to_wire() if req.trace is not None
-                          else None)
-            if wire_trace is not None:
-                header["trace"] = wire_trace
+            header = self._headers.render(req.kind, req.priority,
+                                          req.panel_version, req.req_id,
+                                          rem, trace_ctx=req.trace)
             # a deadline-less attempt must outwait the ROUTER's own
             # terminal give-up (gate + dispatch + grace) — derived from
             # the same function _score uses, so the chain keeps giving
@@ -615,11 +624,12 @@ class FabricClient:
             timeout = (self.config.connect_timeout_s + wait_budget
                        + _TERMINAL_GRACE_S)
             t0 = mono_now_s()
+            marks: dict = {}
             try:
-                obj, arrays = proto.request(
+                obj, arrays = self.channels.request(
                     router.socket_path, header,
                     arrays={"values": values, "mask": mask},
-                    timeout_s=timeout)
+                    timeout_s=timeout, marks=marks)
             except (OSError, proto.ProtocolError) as e:
                 # the replica died/reset mid-request (the rehearsed
                 # router SIGKILL): its half of the trace is an orphan,
@@ -634,7 +644,7 @@ class FabricClient:
                 continue
             t1 = mono_now_s()
             if self._settle_reply(req, router, obj, arrays, t0, t1,
-                                  failures):
+                                  failures, marks=marks):
                 return
         self._terminate(
             req, "rejected", infra=True,
@@ -643,9 +653,12 @@ class FabricClient:
 
     def _settle_reply(self, req: FabricRequest, router, obj: dict,
                       arrays: dict, t0: float, t1: float,
-                      failures: list) -> bool:
+                      failures: list, marks: dict | None = None) -> bool:
         """Fold one router reply into the request; False = not settled
         (a draining replica's refusal fails over instead)."""
+        marks = marks or {}
+        window = (t0, t1, obj.get("router_id") or router.worker_id,
+                  marks.get("t_acquired_s"), marks.get("t_sent_s"))
         state = obj.get("state")
         req.router_id = obj.get("router_id") or router.worker_id
         req.worker_id = obj.get("worker_id")
@@ -660,7 +673,7 @@ class FabricClient:
                             cache_hit=bool(obj.get("cache_hit")),
                             hedged=bool(obj.get("hedged")),
                             trace_half=obj.get("trace_half"),
-                            attempt_window=(t0, t1, req.router_id))
+                            attempt_window=window)
             return True
         err = str(obj.get("error") or "")
         if "router draining" in err:
@@ -683,7 +696,7 @@ class FabricClient:
         infra = bool(obj.get("infra")) or "no ready worker" in err
         self._terminate(req, state, error=obj.get("error"), infra=infra,
                         trace_half=obj.get("trace_half"),
-                        attempt_window=(t0, t1, req.router_id))
+                        attempt_window=window)
         return True
 
     # ------------------------------------------------------------ terminal
@@ -717,9 +730,14 @@ class FabricClient:
                     self.rejected_infra += 1
             if req.trace is not None:
                 if trace_half is not None and attempt_window is not None:
-                    ta0, ta1, rid = attempt_window
+                    ta0, ta1, rid = attempt_window[:3]
+                    acq, sent = (attempt_window[3:5]
+                                 if len(attempt_window) >= 5
+                                 else (None, None))
                     req.trace.absorb_remote(trace_half, ta0, ta1,
-                                            worker_id=rid)
+                                            worker_id=rid,
+                                            t_acquired_s=acq,
+                                            t_sent_s=sent)
                 req.trace.close_routed(state, req.t_done_s, reason=error)
             req._done.set()
 

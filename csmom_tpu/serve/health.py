@@ -190,7 +190,7 @@ def cache_readiness(profile: str, cache_subdir: str = "bench",
 def liveness(socket_path: str, timeout_s: float = 2.0) -> tuple:
     """``(alive, reason)``: does the worker process answer a ping?"""
     try:
-        obj, _ = proto.request(socket_path, {"op": "ping"},
+        obj, _ = proto.request_once(socket_path, {"op": "ping"},
                                timeout_s=timeout_s)
     except (OSError, proto.ProtocolError) as e:
         return False, f"{type(e).__name__}: {e}"
@@ -206,7 +206,7 @@ def readiness(socket_path: str, timeout_s: float = 5.0) -> dict:
     evidence behind it (warm shapes, per-endpoint probe states, fresh
     compiles, cache version)."""
     try:
-        obj, _ = proto.request(socket_path, {"op": "ready"},
+        obj, _ = proto.request_once(socket_path, {"op": "ready"},
                                timeout_s=timeout_s)
         return obj
     except (OSError, proto.ProtocolError) as e:

@@ -961,6 +961,12 @@ def build_fabric_artifact(client, router_sup, worker_sup,
         "engine": wcfg.engine,
         "workload": workload,
         "cache_version": worker_sup.expect_cache_version,
+        # the persistent transport's evidence (ISSUE 15): client-tier
+        # channel books — reuses >> dials is what erased the r18
+        # connection-per-request tail; per-replica books ride in the
+        # router stats ("channels" blocks)
+        "client_channels": (client.channels.stats()
+                            if hasattr(client, "channels") else None),
         "samples": {"serve_fabric_total_ms": _bounded_samples(
             [1e3 * r.total_s for r in served if r.total_s is not None],
             SAMPLE_CAP, load.seed)},

@@ -301,6 +301,17 @@ class Router:
         self._ring_cache: tuple = (None, None)   # (ids tuple, HashRing)
         self._lock = threading.Lock()
         self._rr = itertools.count()
+        # the persistent multiplexed transport (ISSUE 15): one bounded
+        # channel pool to the worker tier — dispatches interleave on
+        # long-lived TCP_NODELAY channels instead of paying a fresh
+        # connect + full header encode per attempt.  Probes and admin
+        # ops stay on request_once (the dial-discipline split).
+        self.channels = proto.ChannelPool(
+            connect_timeout_s=self.config.connect_timeout_s)
+        # shared score-header renderer (proto.ScoreHeaderCache): the
+        # same implementation the fabric client uses, so the two
+        # tiers' wire headers cannot drift apart
+        self._headers = proto.ScoreHeaderCache()
         # per-SLO-class books (closed like the global one); the policy
         # resolves legacy names ("batch" -> "bulk") so the wire protocol
         # and the in-process service count the same classes
@@ -620,37 +631,31 @@ class Router:
 
     def _attempt(self, req: PoolRequest, worker, values, mask,
                  is_hedge: bool, state: dict, failures: list) -> None:
-        """One dispatch attempt against one worker (its own socket)."""
+        """One dispatch attempt against one worker, over the pooled
+        multiplexed channel to it (ISSUE 15) — no per-attempt dial."""
         from csmom_tpu.obs import metrics, span
 
         now = mono_now_s()
         rem = req.remaining_s(now)
         # a deadline-less request must outwait the WORKER's own terminal
-        # wait (_NO_DEADLINE_WAIT_S in worker.py) — a shorter socket
+        # wait (_NO_DEADLINE_WAIT_S in worker.py) — a shorter reply
         # timeout here would misread slow-but-successful work as an
         # infra failure and throw the result away
         wait_budget = rem if rem is not None else _NO_DEADLINE_ATTEMPT_S
         timeout = (self.config.connect_timeout_s + wait_budget
                    + _TERMINAL_GRACE_S)
-        header = {"op": "score", "kind": req.kind,
-                  "req_id": req.req_id, "priority": req.priority,
-                  "deadline_rel_s": rem,
-                  "panel_version": req.panel_version}
-        wire_trace = (req.trace.to_wire() if req.trace is not None
-                      else None)
-        if wire_trace is not None:
-            # the trace context crosses the process boundary in the
-            # frame header (identity only, never timestamps): the worker
-            # answers with its half, and the two stitch here
-            header["trace"] = wire_trace
+        header = self._headers.render(req.kind, req.priority,
+                                      req.panel_version, req.req_id,
+                                      rem, trace_ctx=req.trace)
         t_attempt0 = mono_now_s()
+        marks: dict = {}
         try:
             with span("pool.attempt", phase="row", kind=req.kind,
                       worker=worker.worker_id, hedge=is_hedge):
-                obj, arrays = proto.request(
+                obj, arrays = self.channels.request(
                     worker.socket_path, header,
                     arrays={"values": values, "mask": mask},
-                    timeout_s=timeout)
+                    timeout_s=timeout, marks=marks)
         except (OSError, proto.ProtocolError) as e:
             with self._lock:
                 self.worker_conn_failures += 1
@@ -678,7 +683,9 @@ class Router:
                                   cache_hit=bool(obj.get("cache_hit")),
                                   trace_half=obj.get("trace_half"),
                                   attempt_window=(t_attempt0, t_attempt1,
-                                                  worker.worker_id))
+                                                  worker.worker_id,
+                                                  marks.get("t_acquired_s"),
+                                                  marks.get("t_sent_s")))
             if won:
                 metrics.counter("serve_pool.served").inc()
             self._conclude_attempt(state)
@@ -753,9 +760,14 @@ class Router:
                 # reach the absorbed chain — a hedge loser's half can
                 # never corrupt the telescoping sum
                 if trace_half is not None and attempt_window is not None:
-                    t0a, t1a, wid = attempt_window
+                    t0a, t1a, wid = attempt_window[:3]
+                    acq, sent = (attempt_window[3:5]
+                                 if len(attempt_window) >= 5
+                                 else (None, None))
                     req.trace.absorb_remote(trace_half, t0a, t1a,
-                                            worker_id=wid)
+                                            worker_id=wid,
+                                            t_acquired_s=acq,
+                                            t_sent_s=sent)
                 req.trace.close_routed(state, req.t_done_s,
                                        reason=error)
             req._done.set()
@@ -930,25 +942,16 @@ class RouterServer:
                 continue
             except OSError:
                 return  # listener closed under us: shutting down
-            t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True)
+            # one PERSISTENT connection per fabric-client channel: the
+            # serve loop demuxes interleaved score frames off it (each
+            # scored on its own thread through the router's hedged
+            # dispatch) while probes keep their one-shot shape
+            t = threading.Thread(
+                target=proto.serve_connection,
+                args=(conn, self._handle),
+                kwargs={"on_stop": self.stop},
+                daemon=True)
             t.start()
-
-    def _serve_conn(self, conn) -> None:
-        conn.settimeout(60.0)
-        try:
-            obj, arrays = proto.recv_msg(conn)
-            reply, reply_arrays = self._handle(obj, arrays)
-            proto.send_msg(conn, reply, reply_arrays)
-            if obj.get("op") == "stop":
-                self.stop()
-        except (OSError, proto.ProtocolError):
-            pass  # the peer vanished or spoke garbage: drop the conn
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
 
     def _handle(self, obj: dict, arrays: dict) -> tuple:
         op = obj.get("op")
@@ -992,6 +995,9 @@ class RouterServer:
             "invariant_violations": self.router.invariant_violations(),
             "fair_gate": (self.router._fair.stats()
                           if self.router._fair is not None else None),
+            # the persistent transport's evidence: dials vs reuses on
+            # the worker-tier channels (reuses >> dials is the point)
+            "channels": self.router.channels.stats(),
             "retry_after_s": self.router.retry_after_hint_s(),
             "expect_cache_version": self.expect_cache_version,
         }

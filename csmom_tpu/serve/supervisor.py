@@ -467,7 +467,7 @@ class PoolSupervisor:
     def _drain_stop(self, h: WorkerHandle, timeout_s: float = 15.0) -> None:
         stop_acked = False
         try:
-            proto.request(h.socket_path, {"op": "stop"},
+            proto.request_once(h.socket_path, {"op": "stop"},
                           timeout_s=timeout_s)
             stop_acked = True
         except (OSError, proto.ProtocolError):
@@ -529,7 +529,7 @@ class PoolSupervisor:
                    "device_slice": h.device_slice}
             if h.state == "ready":
                 try:
-                    obj, _ = proto.request(h.socket_path, {"op": "stats"},
+                    obj, _ = proto.request_once(h.socket_path, {"op": "stats"},
                                            timeout_s=5.0)
                     rec.update({
                         "accounting": obj.get("accounting"),

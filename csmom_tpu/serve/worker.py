@@ -3,10 +3,12 @@
 ``python -m csmom_tpu.serve.worker --socket PATH ...`` runs the existing
 in-process micro-batching service (:mod:`csmom_tpu.serve.service`)
 wrapped in the pool wire protocol (:mod:`csmom_tpu.serve.proto`): the
-router connects per dispatch attempt, the supervisor connects for
-probes and lifecycle ops.  The process is the isolation unit — a crash,
-a GIL stall, or a restart here takes down ONE worker's queue, and the
-router's hedged retries route around it.
+router holds a PERSISTENT multiplexed channel here (many in-flight
+score frames interleave on it, each handled on its own thread — ISSUE
+15), the supervisor dials one-shot for probes and lifecycle ops.  The
+process is the isolation unit — a crash, a GIL stall, or a restart
+here takes down ONE worker's queue, and the router's hedged retries
+route around it.
 
 Startup discipline (the order is the contract):
 
@@ -185,25 +187,16 @@ class WorkerServer:
                 continue
             except OSError:
                 return  # listener closed under us: shutting down
-            t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True)
+            # one PERSISTENT connection per peer channel (ISSUE 15):
+            # the serve loop demuxes many in-flight requests off it,
+            # scoring each on its own thread, and a one-shot probe
+            # (no _mux, closes after its reply) exits via clean EOF
+            t = threading.Thread(
+                target=proto.serve_connection,
+                args=(conn, self._handle),
+                kwargs={"on_stop": self.stop},
+                daemon=True)
             t.start()
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        conn.settimeout(60.0)
-        try:
-            obj, arrays = proto.recv_msg(conn)
-            reply, reply_arrays = self._handle(obj, arrays)
-            proto.send_msg(conn, reply, reply_arrays)
-            if obj.get("op") == "stop":
-                self.stop()
-        except (OSError, proto.ProtocolError):
-            pass  # the peer vanished or spoke garbage: drop the conn
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
 
     def _handle(self, obj: dict, arrays: dict) -> tuple:
         op = obj.get("op")

@@ -25,3 +25,13 @@ def slow_path(sock, payload):
         n = _state["n"]
     time.sleep(0.05)             # the wait happens lock-free
     sock.sendall(payload + bytes([n % 256]))
+
+
+def try_lock_then_release():
+    # the canonical non-blocking acquire: the if-test acquire whose
+    # body opens with a try releasing in its finally (the r19 baton)
+    if _lock.acquire(blocking=False):
+        try:
+            _state["n"] += 1
+        finally:
+            _lock.release()

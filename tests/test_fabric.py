@@ -160,9 +160,9 @@ def test_routes_publisher_writes_only_on_change(tmp_path):
 # ----------------------------------------------------------- client tier ----
 
 class _FakeReplica:
-    """A hand-rolled router replica: serves ``score`` (or resets every
-    connection when ``reset=True``) — the controllable peer the
-    failover tests need."""
+    """A hand-rolled router replica speaking the persistent-channel
+    serve loop (or resetting every connection when ``reset=True``) —
+    the controllable peer the failover tests need."""
 
     def __init__(self, tmp, rid: str, reset: bool = False):
         self.worker_id = rid
@@ -187,27 +187,19 @@ class _FakeReplica:
             if self.reset:
                 conn.close()  # the SIGKILLed replica, as seen by a peer
                 continue
-            threading.Thread(target=self._serve, args=(conn,),
+            threading.Thread(target=proto.serve_connection,
+                             args=(conn, self._handle),
                              daemon=True).start()
 
-    def _serve(self, conn):
-        try:
-            obj, arrays = proto.recv_msg(conn)
-            if obj.get("op") == "score":
-                self.scores += 1
-                n = arrays["values"].shape[0]
-                proto.send_msg(conn, {"state": "served",
-                                      "router_id": self.worker_id,
-                                      "worker_id": "w0",
-                                      "cache_hit": False,
-                                      "hedged": False},
-                               {"result": np.zeros(n, np.float32)})
-            else:
-                proto.send_msg(conn, {"ok": True})
-        except (OSError, proto.ProtocolError):
-            pass
-        finally:
-            conn.close()
+    def _handle(self, obj, arrays):
+        if obj.get("op") == "score":
+            self.scores += 1
+            n = arrays["values"].shape[0]
+            return ({"state": "served", "router_id": self.worker_id,
+                     "worker_id": "w0", "cache_hit": False,
+                     "hedged": False},
+                    {"result": np.zeros(n, np.float32)})
+        return {"ok": True}, None
 
     def close(self):
         self._stop.set()
@@ -262,24 +254,17 @@ class _RejectingReplica(_FakeReplica):
         self.infra = infra
         super().__init__(tmp, rid)
 
-    def _serve(self, conn):
-        try:
-            obj, _ = proto.recv_msg(conn)
-            if obj.get("op") == "score":
-                self.scores += 1
-                reply = {
-                    "state": "rejected", "router_id": self.worker_id,
-                    "error": self.error,
-                    "retry_after_s": self.retry_after_s}
-                if self.infra is not None:
-                    reply["infra"] = self.infra
-                proto.send_msg(conn, reply)
-            else:
-                proto.send_msg(conn, {"ok": True})
-        except (OSError, proto.ProtocolError):
-            pass
-        finally:
-            conn.close()
+    def _handle(self, obj, arrays):
+        if obj.get("op") == "score":
+            self.scores += 1
+            reply = {
+                "state": "rejected", "router_id": self.worker_id,
+                "error": self.error,
+                "retry_after_s": self.retry_after_s}
+            if self.infra is not None:
+                reply["infra"] = self.infra
+            return reply, None
+        return {"ok": True}, None
 
 
 def test_fabric_client_settles_parked_fleet_rejection_in_one_attempt(

@@ -215,6 +215,25 @@ def test_gate_tolerates_in_band_memory_drift(clean_pair, capsys):
     assert rc == 0
 
 
+def test_gate_warns_on_gate_pairable_rows_without_samples(clean_pair,
+                                                          capsys):
+    """ISSUE 15 satellite: a gate-pairable metric riding without its
+    bootstrap samples array (the r18 serve_fabric_throughput_rps /
+    budget-burn shape) is NAMED by the gate — its verdicts can only
+    ever be point-delta, and that degradation must be said, not
+    silent.  Sampled metrics stay out of the warning."""
+    rc = cli_main(["ledger", "gate", "--offline", "--root",
+                   str(clean_pair)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "sample-coverage gaps" in out
+    gap_block = out.split("sample-coverage gaps")[1]
+    # the headline metric carries no samples entry -> warned
+    assert "intraday_event_backtest_bar_groups_per_sec" in gap_block
+    # the sampled grid wall must NOT be named a gap
+    assert "grid16_rank_s" not in gap_block
+
+
 def test_gate_passes_on_the_committed_artifact_history(capsys):
     """The tier-1 wiring (ISSUE satellite): the ledger gate runs offline
     over the repo's committed artifacts in every PR.  It must pass —

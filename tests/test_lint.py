@@ -71,8 +71,12 @@ def test_lint_sweep_is_clean_on_the_committed_tree():
     assert rep.files > 100, "the sweep lost its default scope"
     assert rep.project is True
     assert set(rep.rules) == {s.name for s in lint_rules()}
-    # the justified suppressions stay visible, never silent
-    assert all(f.rule == "clock-discipline" or f.rule == "lock-discipline"
+    # the justified suppressions stay visible, never silent.  lock-order
+    # joined in r19: the channel writer locks exist to serialize frame
+    # writes on one socket — the one blocking call that IS the lock's
+    # purpose, justified in place at the two proto.py call sites
+    assert all(f.rule in ("clock-discipline", "lock-discipline",
+                          "lock-order")
                for f in rep.suppressed)
 
 
@@ -238,6 +242,30 @@ def test_donation_safety_tracks_indices_and_rebinding():
     rep = run_lint(paths=[_fixture("donation_safety_clean.py")],
                    rules=[DonationSafety()])
     assert rep.findings == []
+
+
+def test_dial_discipline_flags_hot_paths_and_spares_probes():
+    """ISSUE 15 satellite: every one-shot dial family on the bad
+    fixture fires (direct proto.request, aliased request_once import,
+    a dispatch loop), the probe/stats/drain clean twin stays silent,
+    and the COMMITTED serve tier sweeps clean — the pooled transport
+    is pinned as the only hot-path dial."""
+    from csmom_tpu.analysis.rules import DialDiscipline
+
+    rep = run_lint(paths=[_fixture("dial_discipline_bad.py")],
+                   rules=[DialDiscipline()])
+    assert len(rep.findings) == 3, rep.findings
+    assert all("dial-per-call" in f.message for f in rep.findings)
+    rep = run_lint(paths=[_fixture("dial_discipline_clean.py")],
+                   rules=[DialDiscipline()])
+    assert rep.findings == []
+    # the committed request path: router/fabric dispatch is pooled,
+    # probes and admin ops one-shot — zero findings, zero pragmas
+    serve = os.path.join(_REPO, "csmom_tpu", "serve")
+    rep = run_lint(paths=[serve], rules=[DialDiscipline()])
+    assert rep.findings == [], rep.findings
+    assert rep.suppressed == [], (
+        "dial-discipline must hold on the serve tier without pragmas")
 
 
 def test_lock_discipline_accepts_try_finally_and_with():
@@ -959,8 +987,8 @@ def test_builtin_rules_are_registry_citizens():
     names = [s.name for s in lint_rules()]
     assert names == ["clock-discipline", "tracer-hygiene",
                      "lock-discipline", "donation-safety",
-                     "enumeration-drift", "lock-order",
-                     "helper-hygiene", "compile-surface"]
+                     "enumeration-drift", "dial-discipline",
+                     "lock-order", "helper-hygiene", "compile-surface"]
     for s in lint_rules():
         assert s.kind == "lint" and s.rule_cls is not None
         assert s.description
@@ -977,7 +1005,7 @@ def test_project_rules_join_only_project_sweeps():
     plain = run_lint(paths=[_fixture("lock_discipline_clean.py")])
     assert set(plain.rules) == {"clock-discipline", "tracer-hygiene",
                                 "lock-discipline", "donation-safety",
-                                "enumeration-drift"}
+                                "enumeration-drift", "dial-discipline"}
     assert plain.project is False
     via_flag = run_lint(paths=[_fixture("lock_discipline_clean.py")],
                         project=True)

@@ -201,9 +201,10 @@ def test_supervisor_backoff_caps_a_crash_looping_worker(tmp_path,
 
 
 class _FakeWorker:
-    """A hand-rolled protocol speaker: answers ready/score with a
-    configurable delay — the controllable peer the hedging tests need
-    (a real worker's timing is the thing under test, not controllable)."""
+    """A hand-rolled protocol speaker on the persistent-channel serve
+    loop: answers ready/score with a configurable delay — the
+    controllable peer the hedging tests need (a real worker's timing is
+    the thing under test, not controllable)."""
 
     def __init__(self, tmp, worker_id: str, delay_s: float):
         self.worker_id = worker_id
@@ -225,26 +226,18 @@ class _FakeWorker:
                 continue
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,),
+            threading.Thread(target=proto.serve_connection,
+                             args=(conn, self._handle),
                              daemon=True).start()
 
-    def _serve(self, conn):
-        try:
-            obj, arrays = proto.recv_msg(conn)
-            if obj.get("op") == "score":
-                self.scores += 1
-                time.sleep(self.delay_s)
-                n = arrays["values"].shape[0]
-                proto.send_msg(conn, {"state": "served",
-                                      "worker_id": self.worker_id},
-                               {"result": np.zeros(n, np.float32)})
-            else:
-                proto.send_msg(conn, {"ok": True,
-                                      "worker_id": self.worker_id})
-        except (OSError, proto.ProtocolError):
-            pass
-        finally:
-            conn.close()
+    def _handle(self, obj, arrays):
+        if obj.get("op") == "score":
+            self.scores += 1
+            time.sleep(self.delay_s)
+            n = arrays["values"].shape[0]
+            return ({"state": "served", "worker_id": self.worker_id},
+                    {"result": np.zeros(n, np.float32)})
+        return {"ok": True, "worker_id": self.worker_id}, None
 
     def close(self):
         self._stop.set()
