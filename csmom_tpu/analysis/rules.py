@@ -128,6 +128,13 @@ class ClockDiscipline(LintRule):
         # transport's receive deadlines (proto.py, already pinned)
         # depend on it end to end
         "csmom_tpu/serve/fabric.py",
+        # the fleet observatory (ISSUE 19): series timestamps, demand
+        # buckets, and the kill-window capacity account all live on the
+        # one monotonic timeline the supervisors stamp lifecycle events
+        # on — a wall-clock read anywhere here would shear the
+        # cross-process composition the artifact's arithmetic rests on
+        "csmom_tpu/obs/fleet.py",
+        "csmom_tpu/cli/fleet.py",
     )
 
     # the stream data plane runs on EVENT TIME: bar stamps and version

@@ -158,6 +158,14 @@ KNOWN_SERVE_FABRIC_SCHEMA_VERSIONS = (1,)
 # streaming harness) — closed-world like the rest
 KNOWN_REPLAY_SCHEMA_VERSIONS = (1,)
 
+# fleet artifact schema versions (FLEET_*.json, the continuous
+# cross-process metrics observatory — obs/fleet.py): closed stream
+# books (every process's series ends with a REASON — fin or severed,
+# never silence), monotone-by-construction counter series, a demand
+# book that reconciles with the driven serve run's request ledger BY
+# SCHEMA, and the kill-window capacity account
+KNOWN_FLEET_SCHEMA_VERSIONS = (1,)
+
 # lint report schema versions (`csmom lint --format json`) — v1 was the
 # r16 per-file report; v2 (ISSUE 12) adds the project flag, per-finding
 # call chains, cache stats, and per-rule timings.  Closed-world both
@@ -179,10 +187,11 @@ _LINT_FINDING_KEYS = frozenset({"rule", "path", "line", "message",
 # pid-suffixed operator reruns) are regenerated per run and gitignored —
 # one slipped into the tree once, which is why this is a named rule with
 # a tier-1 test behind it instead of a .gitignore comment.
-_REGENERATED_PREFIXES = ("TELEMETRY_", "SERVE_", "REPLAY_", "TRACE_")
+_REGENERATED_PREFIXES = ("TELEMETRY_", "SERVE_", "REPLAY_", "TRACE_",
+                         "FLEET_")
 _COMMITTED_SIDECAR_RE = re.compile(
     r"^(?:TELEMETRY|SERVE|SERVE_POOL|SERVE_MESH|SERVE_FABRIC|REPLAY"
-    r"|TRACE)_r\d+\.json$")
+    r"|TRACE|FLEET)_r\d+\.json$")
 
 _NUM = (int, float)
 
@@ -214,8 +223,13 @@ def trailing_json(text: str):
 def detect_kind(obj: dict) -> str | None:
     if not isinstance(obj, dict):
         return None
-    # trace/replay before pool, pool before serve, serve before record:
-    # each carries the previous kind's key signature plus its own
+    # fleet before trace/fabric (it embeds a requests block and series
+    # books of its own), trace/replay before pool, pool before serve,
+    # serve before record: each carries the previous kind's key
+    # signature plus its own
+    if obj.get("kind") == "fleet" or {"series", "demand",
+                                      "capacity"} <= set(obj):
+        return "fleet"
     if obj.get("kind") == "trace" or {"books", "stages",
                                       "reconcile"} <= set(obj):
         return "trace"
@@ -1504,6 +1518,213 @@ def _validate_lint(obj: dict) -> list:
     return out
 
 
+def _validate_fleet(obj: dict) -> list:
+    """The fleet observatory contract (FLEET_*.json, obs/fleet.py):
+
+    - CLOSED stream books: every process that ever streamed ends with a
+      non-empty close reason (fin on clean drain, ``stream severed`` on
+      SIGKILL) — a series that just stops without a reason is the r4
+      silent-truncation failure wearing a new coat.
+    - No orphan series: every ``points`` entry's proc has a process
+      book (data from a process the aggregator never opened is forged
+      or corrupted).
+    - Counter series are MONOTONE: the aggregator reconstructs counters
+      as ``cum += max(0, delta)``, so a decreasing counter series can
+      only mean the artifact was edited after landing.
+    - Demand reconciles three ways: per-second buckets sum to the class
+      totals, ``admitted <= offered`` per class, and the run totals
+      match the embedded serve request book — BY SCHEMA, not by eye.
+    - Capacity account arithmetic: fractions in [0, 1], available never
+      exceeds nominal, and every kill window's ready stamp is at or
+      after its kill stamp."""
+    out: list = []
+    _require(obj, "run_id", str, "fleet", out)
+    ver = _require(obj, "schema_version", int, "fleet", out)
+    if ver is not None and ver not in KNOWN_FLEET_SCHEMA_VERSIONS:
+        out.append(
+            f"fleet: unknown schema_version {ver} (this checker "
+            f"understands {list(KNOWN_FLEET_SCHEMA_VERSIONS)}) — the "
+            "artifact is from a different era of the code; do not "
+            "half-parse it")
+    _require(obj, "cadence_s", _NUM, "fleet", out, "a number")
+    _require(obj, "window_s", _NUM, "fleet", out, "a number")
+    out += _validate_record(obj, kind="fleet")
+
+    series = _require(obj, "series", dict, "fleet", out)
+    procs: dict = {}
+    if isinstance(series, dict):
+        books = series.get("books")
+        if not isinstance(books, dict):
+            out.append("fleet: series.books (the stream ledger) must be "
+                       "a dict")
+            books = {}
+        for k in ("procs_opened", "procs_closed", "frames",
+                  "frames_malformed", "seq_gaps",
+                  "frames_dropped_by_emitters", "series_count",
+                  "series_dropped"):
+            v = books.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                out.append(f"fleet: series.books.{k} must be a "
+                           "non-negative int")
+        procs = series.get("processes")
+        if not isinstance(procs, dict):
+            out.append("fleet: series.processes must be a dict of "
+                       "per-process stream books")
+            procs = {}
+        for name, book in procs.items():
+            if not isinstance(book, dict):
+                out.append(f"fleet: process book {name!r} must be a dict")
+                continue
+            if not book.get("closed") or not book.get("close_reason"):
+                out.append(
+                    f"fleet: process {name!r} stream is not reason-"
+                    "closed — every series must end with fin or a "
+                    "severed-stream reason, never silence (a SIGKILLed "
+                    "emitter reads as a reason-closed gap, not "
+                    "truncation)")
+        if isinstance(books.get("procs_opened"), int) and \
+                isinstance(books.get("procs_closed"), int) and \
+                books["procs_opened"] != books["procs_closed"]:
+            out.append(
+                f"fleet: series books not closed — procs_opened "
+                f"{books['procs_opened']} != procs_closed "
+                f"{books['procs_closed']}")
+        points = series.get("points")
+        if not isinstance(points, dict):
+            out.append("fleet: series.points must be a dict of series")
+            points = {}
+        for key, s in points.items():
+            if not isinstance(s, dict):
+                out.append(f"fleet: series point {key!r} must be a dict")
+                continue
+            if s.get("proc") not in procs:
+                out.append(
+                    f"fleet: orphan series {key!r} — proc "
+                    f"{s.get('proc')!r} has no process book (data from "
+                    "a stream the aggregator never opened)")
+            ts, vs = s.get("t_s"), s.get("v")
+            if not isinstance(ts, list) or not isinstance(vs, list) \
+                    or len(ts) != len(vs):
+                out.append(f"fleet: series {key!r} t_s/v must be "
+                           "parallel lists")
+                continue
+            if s.get("kind") == "counter":
+                for i in range(1, len(vs)):
+                    if vs[i] < vs[i - 1]:
+                        out.append(
+                            f"fleet: counter series {key!r} decreases "
+                            f"at index {i} ({vs[i - 1]} -> {vs[i]}) — "
+                            "counters are monotone by construction "
+                            "(cum += max(0, delta)); a decrease means "
+                            "the artifact was edited after landing")
+                        break
+
+    req = obj.get("requests")
+    if req is not None and not isinstance(req, dict):
+        out.append("fleet: requests (the driven serve run's book) must "
+                   "be a dict when present")
+        req = None
+    demand = _require(obj, "demand", dict, "fleet", out)
+    if isinstance(demand, dict):
+        classes = demand.get("classes")
+        per_s = demand.get("per_second")
+        if not isinstance(classes, dict):
+            out.append("fleet: demand.classes must be a dict")
+            classes = {}
+        if not isinstance(per_s, list):
+            out.append("fleet: demand.per_second must be a list")
+            per_s = []
+        bucket_sums: dict = {}
+        for row in per_s:
+            if not isinstance(row, dict):
+                out.append("fleet: demand.per_second rows must be dicts")
+                continue
+            for cls, ev in row.items():
+                if cls == "t_s" or not isinstance(ev, dict):
+                    continue
+                b = bucket_sums.setdefault(cls, {})
+                for e, n in ev.items():
+                    b[e] = b.get(e, 0) + (n if isinstance(n, int) else 0)
+        for cls, tot in classes.items():
+            if not isinstance(tot, dict):
+                out.append(f"fleet: demand.classes[{cls!r}] must be a "
+                           "dict")
+                continue
+            if bucket_sums.get(cls, {}) != tot:
+                out.append(
+                    f"fleet: demand per-second buckets for {cls!r} sum "
+                    f"to {bucket_sums.get(cls, {})} but the class total "
+                    f"says {tot} — the time series and the totals are "
+                    "the same events; they cannot disagree")
+            if tot.get("admitted", 0) > tot.get("offered", 0):
+                out.append(f"fleet: demand class {cls!r} admitted "
+                           f"{tot.get('admitted')} > offered "
+                           f"{tot.get('offered')}")
+        if isinstance(req, dict):
+            for event, book_key in (("admitted", "admitted"),
+                                    ("served", "served")):
+                d_tot = sum(tot.get(event, 0)
+                            for tot in classes.values()
+                            if isinstance(tot, dict))
+                want = req.get(book_key)
+                if isinstance(want, int) and d_tot != want:
+                    out.append(
+                        f"fleet: unreconciled demand — {event} totals "
+                        f"across classes = {d_tot} but the embedded "
+                        f"serve book says requests.{book_key} = {want} "
+                        "(demand telemetry and the request ledger "
+                        "count the same run)")
+
+    cap = _require(obj, "capacity", dict, "fleet", out)
+    if isinstance(cap, dict):
+        nom, avail = cap.get("nominal_worker_s"), cap.get(
+            "available_worker_s")
+        if isinstance(nom, _NUM) and isinstance(avail, _NUM) and \
+                not isinstance(nom, bool) and not isinstance(avail, bool):
+            if avail > nom + 1e-6:
+                out.append(
+                    f"fleet: capacity.available_worker_s {avail} > "
+                    f"nominal_worker_s {nom} — a fleet cannot serve "
+                    "more worker-seconds than it has slots")
+        for k in ("kill_window_loss_frac", "steady_state_loss_frac"):
+            v = cap.get(k)
+            if not isinstance(v, _NUM) or isinstance(v, bool) \
+                    or not 0.0 <= v <= 1.0:
+                out.append(f"fleet: capacity.{k} {v!r} must be a number "
+                           "in [0, 1]")
+        kws = cap.get("kill_windows")
+        if not isinstance(kws, list):
+            out.append("fleet: capacity.kill_windows must be a list")
+            kws = []
+        for i, kw in enumerate(kws):
+            if not isinstance(kw, dict):
+                out.append(f"fleet: kill_windows[{i}] must be a dict")
+                continue
+            tk, tr = kw.get("t_kill_s"), kw.get("t_ready_s")
+            if isinstance(tk, _NUM) and isinstance(tr, _NUM) and tr < tk:
+                out.append(
+                    f"fleet: kill_windows[{i}] t_ready_s {tr} < "
+                    f"t_kill_s {tk} — a victim cannot be ready before "
+                    "it was killed")
+            lf = kw.get("loss_frac")
+            if lf is not None and (not isinstance(lf, _NUM)
+                                   or isinstance(lf, bool)
+                                   or not 0.0 <= lf <= 1.0):
+                out.append(f"fleet: kill_windows[{i}].loss_frac {lf!r} "
+                           "must be a number in [0, 1]")
+    lc = obj.get("lifecycle")
+    if lc is not None and not isinstance(lc, dict):
+        out.append("fleet: lifecycle must be a dict when present")
+    elif isinstance(lc, dict):
+        rw = lc.get("ready_walls_s")
+        if not isinstance(rw, list) or any(
+                not isinstance(w, _NUM) or isinstance(w, bool) or w < 0
+                for w in rw):
+            out.append("fleet: lifecycle.ready_walls_s must be a list "
+                       "of non-negative numbers")
+    return out
+
+
 _VALIDATORS = {
     "record": _validate_record,
     "lint": _validate_lint,
@@ -1512,6 +1733,7 @@ _VALIDATORS = {
     "serve": _validate_serve,
     "serve_pool": _validate_serve_pool,
     "serve_fabric": _validate_serve_fabric,
+    "fleet": _validate_fleet,
     "telemetry": _validate_telemetry,
     "driver_capture": _validate_driver_capture,
     "multichip": _validate_multichip,
@@ -1529,7 +1751,7 @@ def validate(obj, kind: str | None = None) -> list:
         return ["unrecognized artifact shape: none of the known key "
                 "signatures (record / driver_capture / multichip / phases "
                 "/ tpu_cache / telemetry / serve / serve_pool / "
-                "serve_fabric / replay / trace / lint) match"]
+                "serve_fabric / fleet / replay / trace / lint) match"]
     if kind not in _VALIDATORS:
         return [f"unknown artifact kind {kind!r}"]
     return _VALIDATORS[kind](obj)
@@ -1600,7 +1822,8 @@ def validate_tree(root: str, patterns=("BENCH_*.json", "MULTICHIP_*.json",
                                        "PHASES_*.json", "TELEMETRY_*.json",
                                        "SERVE_*.json",
                                        "REPLAY_*.json",
-                                       "TRACE_*.json")) -> dict:
+                                       "TRACE_*.json",
+                                       "FLEET_*.json")) -> dict:
     """``{relative_path: violations}`` for every committed artifact under
     ``root`` matching ``patterns`` (non-recursive: round artifacts land at
     the repo root by contract).  Paths with no violations are included

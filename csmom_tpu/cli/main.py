@@ -1667,6 +1667,7 @@ def build_parser() -> argparse.ArgumentParser:
                             help="strategy parameter, repeatable")
         sp.set_defaults(fn=fn)
 
+    from csmom_tpu.cli.fleet import register as register_fleet
     from csmom_tpu.cli.ledger import register as register_ledger
     from csmom_tpu.cli.lint import register as register_lint
     from csmom_tpu.cli.registry import register as register_registry
@@ -1679,6 +1680,7 @@ def build_parser() -> argparse.ArgumentParser:
     register_rehearse(sub)
     register_timeline(sub)
     register_trace(sub)
+    register_fleet(sub)
     register_ledger(sub)
     register_serve(sub)
     register_replay(sub)
@@ -1711,7 +1713,8 @@ def _registry_epilog(sub) -> str:
 # probe for these.  ledger pins cpu itself before its bootstrap math, so
 # the probe would only add a failure mode to an offline evidence reader.
 _DEVICE_FREE_COMMANDS = {"fetch", "strategies", "bench", "pack-info",
-                         "rehearse", "timeline", "ledger", "lint"}
+                         "rehearse", "timeline", "ledger", "lint",
+                         "fleet"}
 
 
 def _apply_platform(args) -> int:

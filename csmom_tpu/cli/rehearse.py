@@ -464,6 +464,52 @@ def _check_trace_stitch_worker_kill(r):
     return out
 
 
+def _check_fleet_capture_worker_kill(r):
+    """ISSUE 19: continuous fleet capture across a mid-batch worker
+    SIGKILL.  The landed FLEET artifact must be schema-valid (every
+    process stream reason-closed, counter series monotone, demand
+    reconciling with the request books), the victim's stream must read
+    as a SEVERED series gap — never silent truncation — its
+    replacement's spawn→ready wall must land as a lifecycle sample
+    beyond the initial fleet's, and the kill-window capacity account
+    must show a loss the steady state does not."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve_pool")
+    fart = r.get("fleet_artifact") or {}
+    out += [f"fleet: {v}" for v in inv.validate(fart, "fleet")]
+    series = fart.get("series") or {}
+    procs = series.get("processes") or {}
+    severed = [p for p, b in procs.items()
+               if "severed" in str(b.get("close_reason", ""))]
+    if not severed:
+        out.append("no severed stream book — the SIGKILLed worker's "
+                   "emitter died without its gap being reason-closed "
+                   "(silent truncation, the one outcome the observatory "
+                   "exists to forbid)")
+    n_workers = ((art.get("pool") or {}).get("n_workers")
+                 or (fart.get("capacity") or {}).get("n_slots") or 0)
+    walls = (fart.get("lifecycle") or {}).get("ready_walls_s") or []
+    if len(walls) <= n_workers:
+        out.append(f"{len(walls)} ready-wall sample(s) for "
+                   f"{n_workers} worker slot(s) — the replacement's "
+                   "(re)spawn→ready wall never landed in the lifecycle "
+                   "book")
+    cap = fart.get("capacity") or {}
+    if not cap.get("kill_windows"):
+        out.append("no kill window in the capacity account — the "
+                   "injected process kill left no trace in the "
+                   "availability timeline")
+    if not (cap.get("kill_window_loss_frac") or 0) > 0:
+        out.append("kill-window capacity loss fraction is 0 — a dead "
+                   "worker slot cost nothing, which no capacity account "
+                   "should claim")
+    demand = (fart.get("demand") or {}).get("classes") or {}
+    if not demand:
+        out.append("empty demand book — the client-tier hooks never "
+                   "fired while the load ran")
+    return out
+
+
 def _check_pool_rolling_restart(r):
     """ISSUE 6: a rolling restart under load replaces every worker with
     zero in-window fresh compiles (warm-before-ready via the AOT cache)
@@ -621,6 +667,26 @@ def _serve_pool_scenarios():
                   "trace books balance and stage sums reconcile (trace "
                   "schema)",
             env={"mode": "kill", "trace": True, "wait_respawn": True,
+                 "pool": {"n_workers": 2},
+                 "load": {"schedule": "0.8x70", "seed": 16,
+                          "deadline_s": 3.0}},
+        ),
+        Scenario(
+            "fleet-capture-worker-kill", "serve-pool",
+            FaultPlan("fleet-capture-worker-kill", seed=33, faults=(
+                Fault(point="serve.dispatch", action="kill",
+                      after=probe_dispatches,
+                      max_fires=1, global_once=True),
+            )),
+            _check_fleet_capture_worker_kill, fast=True,
+            notes="ISSUE 19: the pool kill with the fleet observatory "
+                  "ARMED — the victim's metric stream closes as a "
+                  "severed series gap (never silent truncation), its "
+                  "replacement's spawn→ready wall lands as a lifecycle "
+                  "sample, the kill-window capacity account books a "
+                  "loss the steady state does not, and the demand book "
+                  "reconciles with the request ledger (fleet schema)",
+            env={"mode": "kill", "fleet": True, "wait_respawn": True,
                  "pool": {"n_workers": 2},
                  "load": {"schedule": "0.8x70", "seed": 16,
                           "deadline_s": 3.0}},
@@ -1338,6 +1404,7 @@ def _run_serve_pool(scenario, box: str) -> dict:
     LoadConfig overrides.
     """
     from csmom_tpu.chaos import inject
+    from csmom_tpu.obs import fleet as obs_fleet
     from csmom_tpu.obs import trace as obs_trace
     from csmom_tpu.serve.loadgen import (
         LoadConfig,
@@ -1346,6 +1413,7 @@ def _run_serve_pool(scenario, box: str) -> dict:
     )
     from csmom_tpu.serve.router import Router, RouterConfig
     from csmom_tpu.serve.supervisor import PoolConfig, PoolSupervisor
+    from csmom_tpu.utils.deadline import mono_now_s
 
     mode = scenario.env.get("mode", "load")
     saved = {k: os.environ.get(k) for k in (PLAN_ENV, "CSMOM_FAULT_STATE")}
@@ -1353,6 +1421,11 @@ def _run_serve_pool(scenario, box: str) -> dict:
     trace_book = (obs_trace.arm_tracing(seed=scenario.plan.seed
                                         if scenario.plan else 0)
                   if scenario.env.get("trace") else None)
+    # fleet capture arms BEFORE the supervisor exists so the worker
+    # processes inherit the env contract at spawn (ISSUE 19)
+    fleet_agg = (obs_fleet.arm(f"rehearse_{scenario.name}",
+                               scratch_dir=box)
+                 if scenario.env.get("fleet") else None)
     result: dict = {"rc": 0, "stdout": "", "stderr": "",
                     "trailing": None, "headline_violations": [],
                     "sidecar_rows": 0}
@@ -1394,6 +1467,11 @@ def _run_serve_pool(scenario, box: str) -> dict:
             profile="serve-smoke", default_deadline_s=deadline))
         load = LoadConfig(run_id=f"rehearse_{scenario.name}",
                           deadline_s=deadline, **load_over)
+        if fleet_agg is not None:
+            # the pool path runs no self-probes through the router, so
+            # the demand window opens at the measured load's doorstep
+            obs_fleet.open_demand_window()
+        t_load0 = mono_now_s()
         if mode == "roll":
             roll_box: dict = {}
 
@@ -1442,6 +1520,31 @@ def _run_serve_pool(scenario, box: str) -> dict:
                 )
                 write_artifact(box, tart, prefix="TRACE")
                 result["trace_artifact"] = tart
+            if fleet_agg is not None:
+                # drain-stop the pool NOW so every surviving worker's
+                # emitter fins before the books freeze — the SIGKILLed
+                # generation's severed close reason is already booked,
+                # and Channel.request is a synchronous round-trip so
+                # stop() returning implies the fins are ingested
+                sup.stop()
+                obs_fleet.disarm_emitter("loadgen finished")
+                fleet_agg.close_all("run-end")
+                fart = obs_fleet.build_artifact(
+                    fleet_agg, load.run_id,
+                    requests={k: art["requests"][k]
+                              for k in ("admitted", "served", "rejected",
+                                        "expired")},
+                    worker_events=obs_fleet.absolute_events(
+                        sup.summary()["events"], sup.t0_mono_s),
+                    n_workers=cfg.n_workers,
+                    window=(t_load0, t_load0 + art["wall_s"]),
+                    fresh_compiles=(art.get("compile") or {}).get(
+                        "in_window_fresh_compiles"),
+                    platform=(art.get("extra") or {}).get("platform"),
+                    workload=(art.get("extra") or {}).get("workload"),
+                )
+                write_artifact(box, fart, prefix="FLEET")
+                result["fleet_artifact"] = fart
         result["trailing"] = art
         result["artifact"] = art
         return result
@@ -1450,6 +1553,11 @@ def _run_serve_pool(scenario, box: str) -> dict:
             obs_trace.disarm_tracing()
         if sup is not None:
             sup.stop()
+        if fleet_agg is not None:
+            # idempotent after the success path's own disarm: fin the
+            # local emitter, close any still-open books, retract the env
+            # contract so the NEXT scenario's spawns stay disarmed
+            obs_fleet.disarm("rehearse-end")
         for k, v in saved.items():
             if v is None:
                 os.environ.pop(k, None)

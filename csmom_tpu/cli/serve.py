@@ -154,9 +154,18 @@ def _print_pool_ready(sup, router) -> None:
     print(f"  cache version: {sup.expect_cache_version}")
     for h in sup.handles:
         rep = h.ready_report or {}
+        # the lifecycle walls are recorded even with fleet capture
+        # disarmed (ISSUE 19): spawn→ready, with the worker-reported
+        # main→bind and warm legs — the denominator of a kill window
+        walls = rep.get("walls") or {}
+        wall = (f" ready_wall {h.t_ready_s - h.t_spawned_s:.2f}s"
+                f" (bind {walls.get('main_to_bind_s', '—')}s, warm "
+                f"{walls.get('warm_s', '—')}s)"
+                if h.t_ready_s is not None and h.t_spawned_s is not None
+                else "")
         print(f"  {h.worker_id} g{h.generation} [{h.state}] pid "
               f"{h.proc.pid if h.proc else '-'} fresh_compiles "
-              f"{rep.get('fresh_compiles')!r}")
+              f"{rep.get('fresh_compiles')!r}{wall}")
     print(f"  hedging: fraction {router.config.hedge_fraction}, floor "
           f"{router.config.hedge_floor_s * 1e3:g} ms, max attempts "
           f"{router.config.max_attempts}")
@@ -367,6 +376,88 @@ def _land_trace(args, book, run_id: str, art: dict, out_dir: str) -> int:
     return 0
 
 
+def _arm_fleet(args, run_id: str):
+    """Arm the fleet observatory when --fleet was asked for (obs.fleet).
+
+    MUST run before the supervisors spawn: arming exports the
+    CSMOM_FLEET env contract, and worker/router processes join the
+    aggregator only if they inherit it.  The disarmed path costs one
+    env read at each child's main, so this is the ONLY place the flag
+    is consulted."""
+    if not getattr(args, "fleet", False):
+        return None
+    from csmom_tpu.obs import fleet as obs_fleet
+
+    transport = ("tcp" if getattr(args, "transport", "unix") == "tcp"
+                 else "unix")
+    agg = obs_fleet.arm(run_id, transport=transport)
+    print(f"fleet observatory armed: aggregator at {agg.address} "
+          f"(cadence {agg.cadence_s}s)")
+    return agg
+
+
+def _land_fleet(run_id: str, art: dict, out_dir: str, wsup, rsup,
+                window: tuple) -> int:
+    """Build, validate, and land FLEET_<run>.json from the armed
+    aggregator + the serve artifact its demand book must reconcile
+    with.  Called AFTER the fabric/pool stopped, so every surviving
+    emitter's fin frame is already in the books (a SIGKILL victim's
+    stream was severed-closed when its connection died).  Returns
+    nonzero when the fleet books are broken — an unclosed or
+    unreconciled observatory is invalid evidence."""
+    from csmom_tpu.chaos import invariants as inv
+    from csmom_tpu.obs import fleet as obs_fleet
+    from csmom_tpu.serve.loadgen import write_artifact
+
+    agg = obs_fleet.current_aggregator()
+    if agg is None:
+        return 0
+    # fin-close the loadgen process's own emitter, then reason-close
+    # any straggler book before the snapshot freezes
+    obs_fleet.disarm_emitter("loadgen finished")
+    agg.close_all("run-end")
+    worker_events = obs_fleet.absolute_events(
+        wsup.summary()["events"], wsup.t0_mono_s)
+    router_events = (obs_fleet.absolute_events(
+        rsup.summary()["events"], rsup.t0_mono_s)
+        if rsup is not None else None)
+    fleet_art = obs_fleet.build_artifact(
+        agg, run_id,
+        requests={k: art["requests"][k]
+                  for k in ("admitted", "served", "rejected", "expired")},
+        worker_events=worker_events,
+        router_events=router_events,
+        n_workers=wsup.config.n_workers,
+        n_routers=(rsup.config.n_workers if rsup is not None else None),
+        window=window,
+        channels=(art.get("extra") or {}).get("client_channels"),
+        fresh_compiles=art["compile"]["in_window_fresh_compiles"],
+        platform=art["extra"].get("platform"),
+        workload=art["extra"].get("workload"),
+    )
+    path = write_artifact(out_dir, fleet_art, prefix="FLEET")
+    books = fleet_art["series"]["books"]
+    cap = fleet_art["capacity"]
+    print(f"\nfleet books: {books['procs_opened']} stream(s) opened = "
+          f"{books['procs_closed']} reason-closed; {books['frames']} "
+          f"frames, {books['seq_gaps']} seq gap(s), "
+          f"{books['frames_dropped_by_emitters']} dropped")
+    print(f"fleet capacity: kill-window loss "
+          f"{cap['kill_window_loss_frac']} over "
+          f"{len(cap['kill_windows'])} window(s), steady-state "
+          f"{cap['steady_state_loss_frac']}; ready walls "
+          f"{fleet_art['lifecycle']['ready_walls_s']} s")
+    print(f"fleet artifact: {path} (render with `csmom fleet {run_id}`)")
+    obs_fleet.disarm("run-end")
+    schema = inv.validate_file(path)
+    if schema:
+        print("FLEET INVALID:", file=sys.stderr)
+        for v in schema:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _cmd_loadgen_pool(args, schedule: str, run_id: str,
                       schedule_kind: str = "custom",
                       preset: dict | None = None) -> int:
@@ -381,13 +472,25 @@ def _cmd_loadgen_pool(args, schedule: str, run_id: str,
     )
 
     run_dir = tempfile.mkdtemp(prefix="csmom-pool-")
+    # fleet arming must precede the spawns: workers join the aggregator
+    # through the env contract they inherit at fork
+    fleet_agg = _arm_fleet(args, run_id)
     try:
         sup, router = _mk_pool(args, run_dir)
     except RuntimeError as e:
         print(f"pool failed to start: {e}", file=sys.stderr)
+        if fleet_agg is not None:
+            from csmom_tpu.obs import fleet as obs_fleet
+            obs_fleet.disarm("pool failed to start")
         return 1
     try:
         _print_pool_ready(sup, router)
+        if fleet_agg is not None:
+            # the pool path runs no self-probes through the router, so
+            # the demand window opens at the measured load's doorstep
+            # and reconciles with the router's request book by schema
+            from csmom_tpu.obs import fleet as obs_fleet
+            obs_fleet.open_demand_window()
         # a named schedule's preset applies where the pool loadgen
         # implements it (the class mix); cache reuse / version bumps are
         # single-process shapes today (the pool has no shared cache yet
@@ -442,6 +545,9 @@ def _cmd_loadgen_pool(args, schedule: str, run_id: str,
               + (", trace armed" if trace_book is not None else "")
               + (f", worker kill @{kill_after:g}s" if kill_after else "")
               + ") ...")
+        from csmom_tpu.utils.deadline import mono_now_s as _mono
+
+        t_load0 = _mono()
         art = run_pool_loadgen(router, sup, load, concurrent=concurrent)
     finally:
         # a Ctrl-C or a loadgen failure must not leak N live worker
@@ -475,6 +581,9 @@ def _cmd_loadgen_pool(args, schedule: str, run_id: str,
     rc = 0
     if trace_book is not None:
         rc = _land_trace(args, trace_book, run_id, art, out_dir)
+    if fleet_agg is not None:
+        rc = max(rc, _land_fleet(run_id, art, out_dir, sup, None,
+                                 (t_load0, t_load0 + art["wall_s"])))
     viols = inv.validate_file(path)
     if viols:
         print("ARTIFACT INVALID:", file=sys.stderr)
@@ -546,10 +655,16 @@ def _cmd_loadgen_fabric(args, schedule: str, run_id: str,
     )
 
     run_dir = tempfile.mkdtemp(prefix="csmom-fabric-")
+    # fleet arming must precede the spawns: router replicas and workers
+    # join the aggregator through the env contract they inherit at fork
+    fleet_agg = _arm_fleet(args, run_id)
     try:
         wsup, publisher, rsup, client = _mk_fabric(args, run_dir)
     except RuntimeError as e:
         print(f"fabric failed to start: {e}", file=sys.stderr)
+        if fleet_agg is not None:
+            from csmom_tpu.obs import fleet as obs_fleet
+            obs_fleet.disarm("fabric failed to start")
         return 1
     trace_book = None
     try:
@@ -582,10 +697,19 @@ def _cmd_loadgen_fabric(args, schedule: str, run_id: str,
             for p in failed:
                 print(f"    {p.kind}: state={p.state} error={p.error}",
                       file=sys.stderr)
+            if fleet_agg is not None:
+                from csmom_tpu.obs import fleet as obs_fleet
+                obs_fleet.disarm("self-probe failed")
             return 1
         # the throwaway probe client's channels must not linger into
         # the measured window (its dials are not the run's evidence)
         probe_client.close()
+        if fleet_agg is not None:
+            # demand opens AFTER the probes' terminal events, so the
+            # book counts exactly the measured client's arrivals and
+            # reconciles with its request ledger by schema
+            from csmom_tpu.obs import fleet as obs_fleet
+            obs_fleet.open_demand_window()
         trace_book = _arm_trace(args)
 
         preset = dict(preset or {})
@@ -643,6 +767,9 @@ def _cmd_loadgen_fabric(args, schedule: str, run_id: str,
               + (f", worker kill @{kill_worker_after:g}s"
                  if kill_worker_after else "")
               + ") ...")
+        from csmom_tpu.utils.deadline import mono_now_s as _mono
+
+        t_load0 = _mono()
         art = run_fabric_loadgen(client, rsup, wsup, load,
                                  concurrent=concurrent)
     finally:
@@ -683,6 +810,9 @@ def _cmd_loadgen_fabric(args, schedule: str, run_id: str,
     rc = 0
     if trace_book is not None:
         rc = _land_trace(args, trace_book, run_id, art, out_dir)
+    if fleet_agg is not None:
+        rc = max(rc, _land_fleet(run_id, art, out_dir, wsup, rsup,
+                                 (t_load0, t_load0 + art["wall_s"])))
     viols = inv.validate_file(path)
     if viols:
         print("ARTIFACT INVALID:", file=sys.stderr)
@@ -955,6 +1085,14 @@ def register(sub) -> None:
                          "book closes the orphan halves with reason, "
                          "and the artifact is built only after the "
                          "replacement is ready; 0 = no kill)")
+    lg.add_argument("--fleet", action="store_true",
+                    help="arm the fleet observatory (obs.fleet): every "
+                         "process streams metrics snapshot deltas to a "
+                         "per-run aggregator on a fixed cadence; lands "
+                         "FLEET_<run-id>.json (continuous time series, "
+                         "demand book, kill-window capacity account) "
+                         "next to the serve artifact; render with "
+                         "`csmom fleet <run-id>`")
     lg.add_argument("--allow-fresh-compiles", dest="allow_fresh_compiles",
                     action="store_true",
                     help="land the artifact even when the serving window "

@@ -56,6 +56,7 @@ DEFAULT_PATTERNS = (
     "SERVE_*.json",
     "REPLAY_*.json",
     "TRACE_*.json",
+    "FLEET_*.json",
 )
 
 _RUN_RE = re.compile(r"_r(\d+)")
@@ -72,7 +73,8 @@ def _scratch_note(basename: str) -> str | None:
     still ingests — flagged as a variant, never gate-eligible."""
     if basename == "BENCH_TPU_LAST.json":
         return "per-machine TPU session cache, not round evidence: skipped"
-    if (basename.startswith(("TELEMETRY_", "SERVE_", "REPLAY_", "TRACE_"))
+    if (basename.startswith(("TELEMETRY_", "SERVE_", "REPLAY_", "TRACE_",
+                             "FLEET_"))
             and not inv.committable_sidecar(basename)
             and run_of(basename)[0] is None):
         return ("scratch sidecar (uncommittable name, no round id), not "
@@ -554,6 +556,57 @@ def _serve_fabric_rows(obj: dict, run: str, num: int, variant,
     return rows
 
 
+def _fleet_rows(obj: dict, run: str, num: int, variant,
+                source: str) -> list:
+    """Rows from a FLEET artifact: the observatory's trajectory
+    (ISSUE 19).  The kill-window capacity-loss fraction (lower — how
+    much of the fleet's nominal worker-seconds a kill actually cost,
+    CI-backed by the per-window sample list) and the worst spawn→ready
+    wall (lower — the re-warm interval that IS the kill window's width,
+    sampled once per (re)spawn) gate; per-class demand rates ride as
+    info because offered load tracks the loadgen plan, not code
+    quality."""
+    extra = obj.get("extra") or {}
+    platform = extra.get("platform")
+    device_kind = extra.get("device_kind") or platform
+    workload = extra.get("workload")
+    flags = _flags(obj, variant)
+    samples = _sample_map(extra)
+    base = dict(run=run, run_num=num, source=source, platform=platform,
+                device_kind=device_kind, workload=workload)
+    rows = []
+    v = _num(obj.get("value"))
+    if v is not None:
+        rows.append(Row(
+            metric="fleet_kill_window_capacity_loss_frac", value=v,
+            unit=str(obj.get("unit", "frac")), direction="lower",
+            flags=flags,
+            samples=samples.get("fleet_kill_window_capacity_loss_frac",
+                                ()), **base))
+    walls = (obj.get("lifecycle") or {}).get("ready_walls_s")
+    if isinstance(walls, list):
+        nums = [w for w in (_num(x) for x in walls) if w is not None]
+        if nums:
+            rows.append(Row(
+                metric="fleet_worker_ready_wall_s", value=max(nums),
+                unit="s", direction="lower", flags=flags,
+                samples=samples.get("fleet_worker_ready_wall_s", ()),
+                **base))
+    classes = (obj.get("demand") or {}).get("classes")
+    window_s = _num(obj.get("window_s"))
+    if isinstance(classes, dict) and window_s:
+        for cls, tot in sorted(classes.items()):
+            off = _num((tot or {}).get("offered")) if isinstance(
+                tot, dict) else None
+            if off is not None:
+                rows.append(Row(
+                    metric=f"fleet_demand_{cls}_rps",
+                    value=round(off / window_s, 3), unit="req/s",
+                    direction="higher",
+                    flags=_flags(obj, variant, info=True), **base))
+    return rows
+
+
 def _trace_rows(obj: dict, run: str, num: int, variant,
                 source: str) -> list:
     """Rows from a TRACE artifact: the request-path decomposition's
@@ -755,6 +808,15 @@ def ingest_file(path: str, have_full_runs=frozenset()) -> tuple:
                                  f"{list(inv.KNOWN_REPLAY_SCHEMA_VERSIONS)}"
                                  "): not half-parsed into rows"}]
         return _replay_rows(obj, run, num, variant, source), []
+    if kind == "fleet":
+        ver = obj.get("schema_version")
+        if ver not in inv.KNOWN_FLEET_SCHEMA_VERSIONS:
+            return [], [{"source": source,
+                         "note": f"unknown fleet schema_version {ver!r} "
+                                 f"(reader understands "
+                                 f"{list(inv.KNOWN_FLEET_SCHEMA_VERSIONS)}"
+                                 "): not half-parsed into rows"}]
+        return _fleet_rows(obj, run, num, variant, source), []
     if kind == "serve_fabric":
         ver = obj.get("schema_version")
         if ver not in inv.KNOWN_SERVE_FABRIC_SCHEMA_VERSIONS:

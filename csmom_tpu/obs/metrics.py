@@ -15,21 +15,31 @@ compile-cache accounting folded in from ``profiling.compile_stats`` —
 cache hits/misses, trace vs backend-compile counts — and the
 jax.monitoring listener state, both read lazily so a jax-free process
 (the bench supervisor) can snapshot without importing jax.
+
+Every snapshot is sequence-numbered and process-identity-stamped (pid +
+role + slot, see :func:`set_identity`), and :func:`snapshot_delta` turns
+two consecutive snapshots into the wire-ready delta the fleet emitters
+stream: counter deltas are non-negative BY CONSTRUCTION (a counter that
+reads lower than it did one sequence number ago is a corrupted registry,
+and the delta refuses to exist rather than emit a lie).
 """
 
 from __future__ import annotations
 
 import math
+import os
 import sys
 import threading
 
 from csmom_tpu.obs import spans as _spans
 
-__all__ = ["budget_burn", "counter", "gauge", "histogram", "snapshot",
-           "reset"]
+__all__ = ["budget_burn", "counter", "gauge", "histogram", "set_identity",
+           "snapshot", "snapshot_delta", "reset"]
 
 _LOCK = threading.Lock()
 _REGISTRY: dict = {}  # name -> metric handle
+_SEQ = 0  # monotonic per-process snapshot sequence number
+_IDENTITY = {"role": "main", "slot": None}  # stamped into every snapshot
 
 
 class Counter:
@@ -209,8 +219,22 @@ def budget_burn(n_served: int, n_violations: int,
     return round((n_violations / n_served) / allowed, 4)
 
 
+def set_identity(role: str, slot=None) -> None:
+    """Declare who this process is in the fleet (``worker``/``router``/
+    ``loadgen``/...).  Stamped into every subsequent snapshot so a delta
+    landing at the aggregator names its emitter without side-channel
+    bookkeeping.  The pid is read at snapshot time, not here — a fork
+    after ``set_identity`` must not inherit a stale pid."""
+    with _LOCK:
+        _IDENTITY["role"] = str(role)
+        _IDENTITY["slot"] = slot
+
+
 def reset() -> None:
-    """Drop every registered metric (tests re-register per case)."""
+    """Drop every registered metric (tests re-register per case).  The
+    sequence number is NOT reset — it is a per-process lifetime counter,
+    and rewinding it would let a post-reset snapshot alias a pre-reset
+    one in a delta stream."""
     with _LOCK:
         _REGISTRY.clear()
 
@@ -224,8 +248,13 @@ def snapshot(include_compile: bool = True) -> dict:
     its own registry and records WHY the compile block is absent instead
     of importing a backend to fill it.
     """
+    global _SEQ
     with _LOCK:
+        _SEQ += 1
         out: dict = {
+            "seq": _SEQ,
+            "identity": {"pid": os.getpid(), "role": _IDENTITY["role"],
+                         "slot": _IDENTITY["slot"]},
             "counters": {m.name: m.value for m in _REGISTRY.values()
                          if isinstance(m, Counter)},
             "gauges": {m.name: m.value for m in _REGISTRY.values()
@@ -255,3 +284,65 @@ def snapshot(include_compile: bool = True) -> dict:
             out["compile"] = ("not applicable: jax not imported in this "
                               "process (supervisor-side snapshot)")
     return out
+
+
+def snapshot_delta(prev: dict, cur: dict) -> dict:
+    """The change between two snapshots of the SAME process, wire-ready.
+
+    This is the primitive every exporter shares: counters become
+    non-negative deltas (a counter first seen in ``cur`` deltas from
+    zero), gauges carry their current value (a gauge is a last-write,
+    not an accumulation), histograms carry count/sum deltas.  Three
+    things are refused loudly instead of smoothed over:
+
+    - a pid or role mismatch (a delta across two different processes is
+      not a delta, it is a splice);
+    - a non-advancing sequence number (``cur`` must be strictly newer);
+    - a counter or histogram count that went DOWN — counters are monotone
+      by construction, so a regression means registry corruption, and
+      emitting it would poison every downstream cumulative series.
+    """
+    pid_prev = prev.get("identity", {}).get("pid")
+    pid_cur = cur.get("identity", {}).get("pid")
+    if pid_prev != pid_cur:
+        raise ValueError(
+            f"snapshot_delta across processes: prev pid {pid_prev}, "
+            f"cur pid {pid_cur}"
+        )
+    seq_prev, seq_cur = prev.get("seq"), cur.get("seq")
+    if seq_prev is None or seq_cur is None or seq_cur <= seq_prev:
+        raise ValueError(
+            f"snapshot_delta needs advancing seq: prev {seq_prev}, "
+            f"cur {seq_cur}"
+        )
+    counters = {}
+    prev_c = prev.get("counters", {})
+    for name, v in cur.get("counters", {}).items():
+        d = v - prev_c.get(name, 0)
+        if d < 0:
+            raise ValueError(
+                f"counter {name!r} went backwards ({prev_c.get(name)} -> "
+                f"{v}): counters are monotone by construction"
+            )
+        counters[name] = d
+    hists = {}
+    prev_h = prev.get("histograms", {})
+    for name, s in cur.get("histograms", {}).items():
+        p = prev_h.get(name, {})
+        dc = s.get("count", 0) - p.get("count", 0)
+        if dc < 0:
+            raise ValueError(
+                f"histogram {name!r} count went backwards "
+                f"({p.get('count')} -> {s.get('count')})"
+            )
+        hists[name] = {
+            "count": dc,
+            "sum": round(s.get("sum", 0.0) - p.get("sum", 0.0), 6),
+        }
+    return {
+        "seq": seq_cur,
+        "identity": dict(cur.get("identity", {})),
+        "counters": counters,
+        "gauges": dict(cur.get("gauges", {})),
+        "histograms": hists,
+    }

@@ -551,6 +551,7 @@ class FabricClient:
                priority: str = "interactive",
                deadline_s: float | None = None,
                panel_version: int | None = None) -> FabricRequest:
+        from csmom_tpu.obs import fleet as obs_fleet
         from csmom_tpu.obs import trace as obs_trace
 
         values = np.asarray(values)
@@ -567,6 +568,11 @@ class FabricClient:
                                   panel_version=panel_version))
         with self._lock:
             self.admitted += 1
+        # fleet demand telemetry (no-op disarmed): the client tier is
+        # open-loop, so offered == admitted here — the FLEET artifact's
+        # demand book reconciles with accounting() BY SCHEMA
+        obs_fleet.demand("offered", priority)
+        obs_fleet.demand("admitted", priority)
         t = threading.Thread(
             target=self._drive, args=(req, values, mask),
             name=f"csmom-fabric-req-{req.req_id}", daemon=True)
@@ -740,6 +746,10 @@ class FabricClient:
                                             t_sent_s=sent)
                 req.trace.close_routed(state, req.t_done_s, reason=error)
             req._done.set()
+        if state == "served":
+            from csmom_tpu.obs import fleet as obs_fleet
+
+            obs_fleet.demand("served", req.priority)
 
     # ---------------------------------------------------------- accounting
 

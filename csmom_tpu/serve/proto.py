@@ -117,16 +117,21 @@ Ops the worker answers (see :mod:`csmom_tpu.serve.worker`); the router
 replica answers the same lifecycle set (see
 :mod:`csmom_tpu.serve.router`):
 
-=========  ==================================================
-op         meaning
-=========  ==================================================
-ping       liveness: "the process responds" — no service state
-ready      readiness report (warm + self-probe + cache version)
-score      one scoring request (arrays: values, mask)
-stats      accounting / batch stats / fresh-compile count
-drain      stop admitting, drain the queue, report accounting
-stop       drain, then exit the process
-=========  ==================================================
+===========  ==================================================
+op           meaning
+===========  ==================================================
+ping         liveness: "the process responds" — no service state
+ready        readiness report (warm + self-probe + cache version)
+score        one scoring request (arrays: values, mask)
+stats        accounting / batch stats / fresh-compile count
+stats_stream one metrics snapshot delta, emitter -> fleet
+             aggregator (``obs/fleet.py``): a lifecycle op on a
+             PERSISTENT channel, never the request hot path, and
+             chaos-free by construction (``serve.transport``
+             faults fire only for ``score``)
+drain        stop admitting, drain the queue, report accounting
+stop         drain, then exit the process
+===========  ==================================================
 """
 
 from __future__ import annotations

@@ -359,6 +359,7 @@ class Router:
         (the router-replica path); without one, a context is minted iff
         this process's trace book is armed."""
         from csmom_tpu.chaos.inject import checkpoint
+        from csmom_tpu.obs import fleet as obs_fleet
         from csmom_tpu.obs import metrics
         from csmom_tpu.obs import trace as obs_trace
 
@@ -389,6 +390,11 @@ class Router:
             self.admitted += 1
             if priority in self.by_class:
                 self.by_class[priority]["admitted"] += 1
+        # fleet demand telemetry (no-op disarmed): at this tier every
+        # offered request is admitted — the class books reconcile with
+        # these counts BY SCHEMA in the FLEET artifact
+        obs_fleet.demand("offered", priority)
+        obs_fleet.demand("admitted", priority)
         checkpoint("pool.route", kind=kind, req=req.req_id)
         reason = self._unserveable_reason(kind, values, mask)
         if reason is not None:
@@ -771,6 +777,10 @@ class Router:
                 req.trace.close_routed(state, req.t_done_s,
                                        reason=error)
             req._done.set()
+        if state == "served":
+            from csmom_tpu.obs import fleet as obs_fleet
+
+            obs_fleet.demand("served", req.priority)
         return True
 
     # ---------------------------------------------------------- accounting
@@ -1137,6 +1147,12 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, _term)
 
+    # join the run's fleet observatory when armed (env inherited from
+    # the router supervisor); disarmed env leaves the replica untouched
+    from csmom_tpu.obs import fleet as obs_fleet
+
+    obs_fleet.arm_emitter_from_env("router", args.router_id)
+
     server.bind()
     ok, reason = server.routes.status()
     print(f"[router {args.router_id}] pid {os.getpid()} listening on "
@@ -1144,6 +1160,7 @@ def main(argv=None) -> int:
           f"({len(server.routes.workers())} workers)",
           file=sys.stderr, flush=True)
     server.run_until_stopped()
+    obs_fleet.disarm_emitter("router stopped (drained)")
     return 0
 
 

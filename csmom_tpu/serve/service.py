@@ -373,6 +373,7 @@ class SignalService:
         fired = checkpoint("serve.dispatch", kind=mb.kind,
                            n=len(live), bucket=f"{mb.batch_bucket}x"
                            f"{mb.asset_bucket}x{self.spec.months}")
+        metrics.gauge("serve.in_flight").set(len(live))
         t_engine = mono_now_s()
         try:
             if fired == "fail":
@@ -441,6 +442,7 @@ class SignalService:
             obs_trace.note_batch(mb.kind, mb.batch_bucket, mb.asset_bucket,
                                  used, pad, mb.fire_reason)
             metrics.histogram("serve.batch_size").observe(len(live))
+            metrics.gauge("serve.in_flight").set(0)
 
     # ------------------------------------------------------------ reporting
 

@@ -46,6 +46,13 @@ from csmom_tpu.utils.deadline import mono_now_s
 
 __all__ = ["PoolConfig", "PoolSupervisor", "WorkerHandle"]
 
+# the repo checkout that owns this module: spawned workers run
+# ``sys.executable -m csmom_tpu...``, so the package must resolve in the
+# child no matter what cwd the caller is parked in (smoke/test runs chdir
+# into scratch dirs; for an installed package the prepend is a no-op)
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 
 @dataclasses.dataclass(frozen=True)
 class PoolConfig:
@@ -158,6 +165,25 @@ class PoolSupervisor:
         with self._lock:
             self.events.append(rec)
 
+    @property
+    def t0_mono_s(self) -> float:
+        """The absolute monotonic instant this supervisor's event clock
+        started — ``event["t_s"] + t0_mono_s`` puts lifecycle events on
+        the same system-wide timeline the fleet observatory samples on
+        (``obs.fleet.absolute_events``)."""
+        return self._t0
+
+    def ready_walls(self) -> list:
+        """Every (re)spawn's spawn→ready wall plus the worker-reported
+        bind/warm decomposition — the ``worker-ready-wall`` samples the
+        capacity account and ROADMAP item 2's autoscaler consume."""
+        with self._lock:
+            return [{"worker_id": e["worker_id"],
+                     "generation": e.get("generation"),
+                     "wall_s": e.get("wall_s"),
+                     "walls": e.get("walls")}
+                    for e in self.events if e["event"] == "ready"]
+
     # --------------------------------------------------------------- spawn
 
     def _slot_address(self, slot: int, generation: int = 0) -> str:
@@ -202,6 +228,8 @@ class PoolSupervisor:
         h.log_path = os.path.join(
             self.run_dir, f"{h.worker_id}.g{h.generation}.log")
         env = dict(os.environ)  # fault plans and JAX_PLATFORMS inherit
+        env["PYTHONPATH"] = (_PKG_ROOT + os.pathsep
+                             + env.get("PYTHONPATH", ""))
         env.update(self.extra_env)
         c = self.config
         if (c.devices_per_worker > 0 and c.engine == "jax-mesh"
@@ -274,10 +302,17 @@ class PoolSupervisor:
                 h.state = "ready"
                 h.t_ready_s = mono_now_s()
                 h.ready_report = report
+                # the spawn→bind→warm→ready decomposition: wall_s is the
+                # supervisor-observed spawn→ready; "walls" carries the
+                # worker's own bind/warm stamps from its ready report —
+                # one sample per (re)spawn, recorded even with fleet
+                # capture disarmed (the re-warm window is measured at
+                # the source)
                 self._event("ready", h.worker_id,
                             generation=h.generation,
                             fresh_compiles=report.get("fresh_compiles"),
-                            wall_s=round(h.t_ready_s - h.t_spawned_s, 3))
+                            wall_s=round(h.t_ready_s - h.t_spawned_s, 3),
+                            walls=report.get("walls"))
                 self._gauge_ready()
                 return True
             self._stop.wait(self.config.poll_interval_s)
@@ -527,6 +562,11 @@ class PoolSupervisor:
             rec = {"worker_id": h.worker_id, "state": h.state,
                    "generation": h.generation, "restarts": h.restarts,
                    "device_slice": h.device_slice}
+            if h.t_ready_s is not None and h.t_spawned_s is not None:
+                rec["lifecycle"] = {
+                    "ready_wall_s": round(h.t_ready_s - h.t_spawned_s, 3),
+                    "walls": (h.ready_report or {}).get("walls"),
+                }
             if h.state == "ready":
                 try:
                     obj, _ = proto.request_once(h.socket_path, {"op": "stats"},

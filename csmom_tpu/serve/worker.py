@@ -106,10 +106,15 @@ class WorkerServer:
                              daemon=True)
         t.start()
 
-    def warm_and_probe(self) -> dict:
+    def warm_and_probe(self, walls: dict | None = None) -> dict:
         """Warm every bucket shape, then demonstrate readiness: one
         self-probe request per endpoint through the full pipeline; ready
-        iff all served with zero fresh compiles since the warm snapshot."""
+        iff all served with zero fresh compiles since the warm snapshot.
+
+        ``walls`` carries the caller's earlier lifecycle stamps (e.g.
+        ``main_to_bind_s``); this method adds its own ``warm_s`` so the
+        ready report decomposes the spawn→ready wall at the source."""
+        t_warm0 = mono_now_s()
         self.service.start()
         spec = self.service.spec
         A = spec.asset_buckets[0]
@@ -147,6 +152,12 @@ class WorkerServer:
             "warm": self.service.warm_report,
             "probes": probes,
             "fresh_compiles": fresh,
+            # spawn→bind→warm→ready decomposed at the source: the
+            # supervisor's ready event copies this block, so every
+            # (re)spawn's re-warm window is a measured sample even with
+            # fleet capture disarmed
+            "walls": dict(walls or {},
+                          warm_s=round(mono_now_s() - t_warm0, 3)),
             "reason": None if ok else (
                 f"self-probe states {probes}, fresh_compiles={fresh!r}"),
         }
@@ -336,6 +347,7 @@ def main(argv=None) -> int:
                     help="persistent-cache namespace shared with "
                          "`csmom warmup` (default 'bench')")
     args = ap.parse_args(argv)
+    t_main0 = mono_now_s()
 
     fault = os.environ.get(FAULT_ENV, "")
     if fault.startswith("exit:"):
@@ -420,15 +432,25 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, _term)
 
+    # join the run's fleet observatory when armed (CSMOM_FLEET inherited
+    # from the supervisor's env) — sampling off the request path; a
+    # disarmed env leaves this process exactly as before
+    from csmom_tpu.obs import fleet as obs_fleet
+
+    obs_fleet.arm_emitter_from_env("worker", args.worker_id)
+
     server.bind()
+    t_bind = mono_now_s()
     t0 = mono_now_s()
-    report = server.warm_and_probe()
+    report = server.warm_and_probe(
+        walls={"main_to_bind_s": round(t_bind - t_main0, 3)})
     print(f"[worker {args.worker_id}] pid {os.getpid()} "
           f"{'READY' if report['ok'] else 'NOT READY'} in "
           f"{mono_now_s() - t0:.2f}s: probes {report['probes']}, "
           f"fresh_compiles {report['fresh_compiles']!r}",
           file=sys.stderr, flush=True)
     server.run_until_stopped()
+    obs_fleet.disarm_emitter("worker stopped (drained)")
     return 0
 
 

@@ -491,11 +491,14 @@ def test_histrank_multihost_records_are_info_never_gated():
     deliberate exceptions: those families have their own schemas + known
     directions (throughput up, latency/staleness down), so their
     unflagged rows DO gate.  TRACE joins them in r17: per-stage p99s and
-    budget-burn rows are first-class gate rows by design."""
+    budget-burn rows are first-class gate rows by design.  FLEET joins
+    in r20: kill-window capacity loss and worker-ready walls gate (the
+    per-class demand rps rows inside it stay info — demand is workload,
+    not performance)."""
     L = ld.load(_REPO)
     other = [r for r in L.rows
              if not r.source.startswith(("BENCH", "TELEMETRY", "SERVE",
-                                         "REPLAY", "TRACE"))]
+                                         "REPLAY", "TRACE", "FLEET"))]
     assert other, "committed HISTRANK/MULTIHOST should yield info rows"
     assert all("info" in r.flags and not r.gate_eligible() for r in other)
     replay = [r for r in L.rows if r.source.startswith("REPLAY")]

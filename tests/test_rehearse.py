@@ -33,7 +33,7 @@ def _run_cli(args, timeout):
 
 def test_fast_tier_is_small_and_capture_path_only():
     fast = builtin_matrix(fast=True)
-    assert 1 <= len(fast) <= 14, "the fast tier must stay <= 14 faults"
+    assert 1 <= len(fast) <= 15, "the fast tier must stay <= 15 faults"
     # mini/shell run as jax-free subprocesses; serve and replay run
     # IN-PROCESS on the stub engine; serve-pool spawns stub-engine
     # worker PROCESSES — none may need a jax-importing rehearsed pipeline
@@ -58,6 +58,10 @@ def test_fast_tier_is_small_and_capture_path_only():
     # ISSUE 10: the mesh path's kill — a DEVICE-PINNED worker dies
     # mid-batch and its replacement re-pins the same slice
     assert any("mesh-pinned" in n for n in pool), pool
+    # ISSUE 19: the fleet observatory's capture-under-kill rehearsal —
+    # a SIGKILLed emitter must land as a severed stream book feeding a
+    # kill-window capacity account, never silent truncation
+    assert any("fleet-capture" in n for n in pool), pool
     # ISSUE 7: both replay degradation scenarios ride in the fast tier —
     # the tick storm (late/ooo/dup/gap) and the ingest-serve skew gate
     replay = [s.name for s in fast if s.pipeline == "replay"]
