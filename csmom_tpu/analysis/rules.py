@@ -135,6 +135,12 @@ class ClockDiscipline(LintRule):
         # cross-process composition the artifact's arithmetic rests on
         "csmom_tpu/obs/fleet.py",
         "csmom_tpu/cli/fleet.py",
+        # the elastic fleet controller (ISSUE 20): promotion walls,
+        # hysteresis sustain/cooldown windows, and quota refill all
+        # measure intervals on the clock the supervisor stamps events
+        # on — a wall-clock jump here could promote on thin air or
+        # thrash the band
+        "csmom_tpu/serve/fleet.py",
     )
 
     # the stream data plane runs on EVENT TIME: bar stamps and version

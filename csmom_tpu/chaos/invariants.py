@@ -1722,6 +1722,113 @@ def _validate_fleet(obj: dict) -> list:
                 for w in rw):
             out.append("fleet: lifecycle.ready_walls_s must be a list "
                        "of non-negative numbers")
+    out += _validate_fleet_elastic(obj)
+    return out
+
+
+def _validate_fleet_elastic(obj: dict) -> list:
+    """The ``fleet.elastic`` block (ISSUE 20): spares held out of the
+    serving books BY SCHEMA, promotions exactly-once, every autoscaler
+    decision reasoned."""
+    el = obj.get("elastic")
+    if el is None:
+        return []
+    if not isinstance(el, dict):
+        return ["fleet: elastic must be a dict when present"]
+    out = []
+    spare_ids = el.get("spare_ids")
+    if not isinstance(spare_ids, list) or any(
+            not isinstance(s, str) for s in spare_ids):
+        out.append("fleet: elastic.spare_ids must be a list of strings")
+        spare_ids = []
+    # spares never enter the serving books: lifecycle samples and kill
+    # windows may not carry a spare's id (the victim's SLOT keeps its
+    # own id through a promotion)
+    spares = set(spare_ids)
+    lc = obj.get("lifecycle") or {}
+    for e in (lc.get("events") or []):
+        if isinstance(e, dict) and e.get("worker_id") in spares:
+            out.append(
+                f"fleet: spare {e['worker_id']!r} appears in "
+                "lifecycle.events — a parked spare must be held out of "
+                "the serving lifecycle book by schema")
+    cap = obj.get("capacity") or {}
+    for kw in (cap.get("kill_windows") or []):
+        if isinstance(kw, dict) and kw.get("worker_id") in spares:
+            out.append(
+                f"fleet: spare {kw['worker_id']!r} opened a kill window "
+                "— a parked spare was never serving, so its death digs "
+                "no capacity hole")
+    promos = el.get("promotions")
+    if not isinstance(promos, list):
+        out.append("fleet: elastic.promotions must be a list")
+        promos = []
+    seen_spares, seen_slots = set(), set()
+    for i, p in enumerate(promos):
+        if not isinstance(p, dict):
+            out.append(f"fleet: elastic.promotions[{i}] must be a dict")
+            continue
+        tk, tr = p.get("t_kill_s"), p.get("t_ready_s")
+        if isinstance(tk, _NUM) and isinstance(tr, _NUM) and tr < tk:
+            out.append(
+                f"fleet: elastic.promotions[{i}] t_ready_s {tr} < "
+                f"t_kill_s {tk} — a promotion cannot complete before "
+                "the kill it answers")
+        sid = p.get("spare")
+        if sid in seen_spares:
+            out.append(
+                f"fleet: spare {sid!r} promoted twice — promotion must "
+                "be exactly-once per spare (one process cannot fill two "
+                "slots)")
+        seen_spares.add(sid)
+        slot = (p.get("victim"), p.get("generation"))
+        if slot in seen_slots:
+            out.append(
+                f"fleet: slot generation {slot!r} filled by two "
+                "promotions — promotion must be exactly-once per "
+                "(victim, generation)")
+        seen_slots.add(slot)
+        if sid is not None and sid not in spares:
+            out.append(f"fleet: promotion spare {sid!r} is not a "
+                       "declared spare id")
+    sp = el.get("spares")
+    if not isinstance(sp, dict):
+        out.append("fleet: elastic.spares must be a dict of counters")
+    elif isinstance(sp.get("promoted"), int) \
+            and sp["promoted"] != len(promos):
+        out.append(
+            f"fleet: elastic.spares.promoted {sp['promoted']} != "
+            f"{len(promos)} promotion records — the counter and the "
+            "record list count the same events")
+    decisions = el.get("decisions")
+    if not isinstance(decisions, list):
+        out.append("fleet: elastic.decisions must be a list")
+        decisions = []
+    for i, d in enumerate(decisions):
+        if not isinstance(d, dict):
+            out.append(f"fleet: elastic.decisions[{i}] must be a dict")
+            continue
+        if not str(d.get("reason") or "").strip():
+            out.append(
+                f"fleet: elastic.decisions[{i}] "
+                f"({d.get('action')!r}) has no reason — every "
+                "autoscaler decision must be a reasoned event")
+        if d.get("action") not in ("scale_up", "scale_down", "hold",
+                                   "tune_quota"):
+            out.append(f"fleet: elastic.decisions[{i}].action "
+                       f"{d.get('action')!r} unknown")
+    quota = el.get("quota")
+    if isinstance(quota, dict):
+        floor, ceil = quota.get("floor_rps"), quota.get("ceiling_rps")
+        for q in (quota.get("applied") or []):
+            r = q.get("quota_rps") if isinstance(q, dict) else None
+            if isinstance(r, _NUM) and isinstance(floor, _NUM) \
+                    and isinstance(ceil, _NUM) \
+                    and not (floor - 1e-9 <= r <= ceil + 1e-9):
+                out.append(
+                    f"fleet: applied quota {r} rps outside the declared "
+                    f"floor/ceiling [{floor}, {ceil}] — auto-tuning must "
+                    "respect its declared bounds")
     return out
 
 

@@ -510,6 +510,59 @@ def _check_fleet_capture_worker_kill(r):
     return out
 
 
+def _check_spare_promote_on_kill(r):
+    """ISSUE 20: a hot spare is parked OUT of the ring when chaos
+    SIGKILLs a worker mid-batch.  The elastic tier must promote the
+    spare into the victim's slot (one promotion, ready wall far below a
+    re-warm), the spare-credited capacity account must show ~no
+    kill-window capacity loss (the reserve covered the hole), the
+    spare's ids must never leak into the serving books, and the FLEET
+    artifact — elastic block included — must close by schema."""
+    art = r.get("artifact") or {}
+    out = inv.validate(art, "serve_pool")
+    fart = r.get("fleet_artifact") or {}
+    out += [f"fleet: {v}" for v in inv.validate(fart, "fleet")]
+    el = fart.get("elastic") or {}
+    if not el:
+        out.append("no elastic block in the FLEET artifact — the spare "
+                   "tier ran unbooked")
+    promos = el.get("promotions") or []
+    if len(promos) != 1:
+        out.append(f"{len(promos)} promotion(s) booked for 1 kill with "
+                   "1 spare — the spare was not promoted exactly once")
+    for p in promos:
+        if (p.get("wall_s") or 0) > 1.5:
+            out.append(f"promotion ready wall {p['wall_s']:.3f}s > 1.5s "
+                       "— promoting a pre-warmed spare took as long as "
+                       "a re-warm, which defeats the reserve")
+    if el.get("promotions_missed"):
+        out.append(f"{el['promotions_missed']} promotion(s) MISSED — a "
+                   "death found no ready spare despite one configured")
+    cap = fart.get("capacity") or {}
+    loss = cap.get("kill_window_loss_frac")
+    if loss is None or loss > 0.10:
+        out.append(f"kill-window capacity loss {loss!r} > 0.10 — the "
+                   "spare reserve did not cover the kill window (the "
+                   "account found a capacity hole the spare exists to "
+                   "fill)")
+    series = fart.get("series") or {}
+    procs = series.get("processes") or {}
+    if not any("severed" in str(b.get("close_reason", ""))
+               for b in procs.values()):
+        out.append("no severed stream book — the victim's emitter died "
+                   "without its gap being reason-closed")
+    spare_ids = set(el.get("spare_ids") or [])
+    booked = {e.get("worker_id")
+              for e in ((fart.get("lifecycle") or {}).get("events") or [])}
+    for w in (cap.get("kill_windows") or []):
+        booked.add(w.get("worker_id"))
+    if spare_ids & booked:
+        out.append(f"spare id(s) {sorted(spare_ids & booked)} leaked "
+                   "into the serving lifecycle/kill-window books — "
+                   "spares must stay out of the ring until promoted")
+    return out
+
+
 def _check_pool_rolling_restart(r):
     """ISSUE 6: a rolling restart under load replaces every worker with
     zero in-window fresh compiles (warm-before-ready via the AOT cache)
@@ -687,6 +740,27 @@ def _serve_pool_scenarios():
                   "loss the steady state does not, and the demand book "
                   "reconciles with the request ledger (fleet schema)",
             env={"mode": "kill", "fleet": True, "wait_respawn": True,
+                 "pool": {"n_workers": 2},
+                 "load": {"schedule": "0.8x70", "seed": 16,
+                          "deadline_s": 3.0}},
+        ),
+        Scenario(
+            "spare-promote-on-kill", "serve-pool",
+            FaultPlan("spare-promote-on-kill", seed=34, faults=(
+                Fault(point="serve.dispatch", action="kill",
+                      after=probe_dispatches,
+                      max_fires=1, global_once=True),
+            )),
+            _check_spare_promote_on_kill, fast=True,
+            notes="ISSUE 20: the pool kill with a HOT SPARE parked out "
+                  "of the ring — the elastic tier promotes the spare "
+                  "into the victim's slot (one promotion, wall far "
+                  "below a re-warm), the spare-credited capacity "
+                  "account shows no kill-window capacity hole, the "
+                  "spare ids never leak into the serving books, and "
+                  "the elastic block closes by schema",
+            env={"mode": "kill", "fleet": True, "spares": 1,
+                 "wait_respawn": True,
                  "pool": {"n_workers": 2},
                  "load": {"schedule": "0.8x70", "seed": 16,
                           "deadline_s": 3.0}},
@@ -1461,6 +1535,18 @@ def _run_serve_pool(scenario, box: str) -> dict:
             }
             return result
         sup.start()
+        spares = int(scenario.env.get("spares", 0) or 0)
+        if spares:
+            # the elastic tier (ISSUE 20): hot spares parked out of the
+            # ring; in pool mode a promotion propagates the instant the
+            # handle swaps (the router reads ready_workers live)
+            from csmom_tpu.serve.fleet import FleetConfig, FleetController
+
+            FleetController(
+                sup, FleetConfig(spares=spares,
+                                 min_workers=cfg.n_workers,
+                                 max_workers=cfg.n_workers + 2),
+                aggregator=fleet_agg).start()
         load_over = dict(scenario.env.get("load", {}))
         deadline = load_over.pop("deadline_s", 3.0)
         router = Router(sup.ready_workers, RouterConfig(
@@ -1542,6 +1628,9 @@ def _run_serve_pool(scenario, box: str) -> dict:
                         "in_window_fresh_compiles"),
                     platform=(art.get("extra") or {}).get("platform"),
                     workload=(art.get("extra") or {}).get("workload"),
+                    elastic=(sup.fleet.summary()
+                             if getattr(sup, "fleet", None) is not None
+                             else None),
                 )
                 write_artifact(box, fart, prefix="FLEET")
                 result["fleet_artifact"] = fart

@@ -396,6 +396,42 @@ def _arm_fleet(args, run_id: str):
     return agg
 
 
+def _elastic_config(args, n_workers: int):
+    """The FleetConfig the --spares/--autoscale/--prefork flags ask for
+    (None when the elastic tier is not armed).  The configured fleet
+    size is the autoscaler's declared floor — a drain can never shrink
+    the fleet below what the operator asked to run."""
+    spares = getattr(args, "spares", 0) or 0
+    autoscale = bool(getattr(args, "autoscale", False))
+    prefork = bool(getattr(args, "prefork", False))
+    if not (spares or autoscale or prefork):
+        return None
+    from csmom_tpu.serve.fleet import FleetConfig
+
+    return FleetConfig(spares=spares, autoscale=autoscale,
+                       prefork=prefork, min_workers=n_workers,
+                       max_workers=n_workers + 2)
+
+
+def _arm_elastic(args, wsup, publisher=None):
+    """Pool-mode elastic arming: attach a FleetController to a running
+    supervisor (fabric mode threads the config through build_fabric
+    instead).  Returns the controller or None."""
+    cfg = _elastic_config(args, wsup.config.n_workers)
+    if cfg is None:
+        return None
+    from csmom_tpu.obs import fleet as obs_fleet
+    from csmom_tpu.serve.fleet import FleetController
+
+    ctl = FleetController(wsup, cfg, publisher=publisher,
+                          aggregator=obs_fleet.current_aggregator())
+    ctl.start()
+    print(f"elastic fleet armed: {cfg.spares} hot spare(s)"
+          + (", prefork warm path" if cfg.prefork else "")
+          + (", autoscaler" if cfg.autoscale else ""))
+    return ctl
+
+
 def _land_fleet(run_id: str, art: dict, out_dir: str, wsup, rsup,
                 window: tuple) -> int:
     """Build, validate, and land FLEET_<run>.json from the armed
@@ -427,13 +463,17 @@ def _land_fleet(run_id: str, art: dict, out_dir: str, wsup, rsup,
                   for k in ("admitted", "served", "rejected", "expired")},
         worker_events=worker_events,
         router_events=router_events,
-        n_workers=wsup.config.n_workers,
+        # the autoscaler may have grown the fleet past the configured
+        # size: nominal capacity counts the slots that actually existed
+        n_workers=max(wsup.config.n_workers, len(wsup.handles)),
         n_routers=(rsup.config.n_workers if rsup is not None else None),
         window=window,
         channels=(art.get("extra") or {}).get("client_channels"),
         fresh_compiles=art["compile"]["in_window_fresh_compiles"],
         platform=art["extra"].get("platform"),
         workload=art["extra"].get("workload"),
+        elastic=(wsup.fleet.summary()
+                 if getattr(wsup, "fleet", None) is not None else None),
     )
     path = write_artifact(out_dir, fleet_art, prefix="FLEET")
     books = fleet_art["series"]["books"]
@@ -447,6 +487,15 @@ def _land_fleet(run_id: str, art: dict, out_dir: str, wsup, rsup,
           f"{len(cap['kill_windows'])} window(s), steady-state "
           f"{cap['steady_state_loss_frac']}; ready walls "
           f"{fleet_art['lifecycle']['ready_walls_s']} s")
+    el = fleet_art.get("elastic")
+    if el:
+        sp = el["spares"]
+        print(f"elastic: {sp['promoted']} promotion(s) "
+              f"{[p['wall_s'] for p in el['promotions']]} s wall, "
+              f"{sp['spawned']} spare(s) spawned "
+              f"({sp['died_parked']} died parked, {sp['backfills']} "
+              f"backfill(s)), {len(el['decisions'])} reasoned "
+              "autoscaler decision(s)")
     print(f"fleet artifact: {path} (render with `csmom fleet {run_id}`)")
     obs_fleet.disarm("run-end")
     schema = inv.validate_file(path)
@@ -485,6 +534,9 @@ def _cmd_loadgen_pool(args, schedule: str, run_id: str,
         return 1
     try:
         _print_pool_ready(sup, router)
+        # pool mode has no routes publisher: a promotion propagates the
+        # instant the handle swaps (the router reads ready_workers live)
+        _arm_elastic(args, sup)
         if fleet_agg is not None:
             # the pool path runs no self-probes through the router, so
             # the demand window opens at the measured load's doorstep
@@ -635,7 +687,8 @@ def _mk_fabric(args, run_dir: str):
         hedge_fraction=args.hedge_fraction,
         trace=getattr(args, "trace", False),
         client_deadline_s=(None if pool_deadline_ms == 0
-                           else pool_deadline_ms / 1e3))
+                           else pool_deadline_ms / 1e3),
+        fleet_config=_elastic_config(args, wcfg.n_workers))
 
 
 def _cmd_loadgen_fabric(args, schedule: str, run_id: str,
@@ -679,6 +732,12 @@ def _cmd_loadgen_fabric(args, schedule: str, run_id: str,
         for h in wsup.handles:
             print(f"  {h.worker_id} g{h.generation} [{h.state}] "
                   f"{h.socket_path}")
+        if getattr(wsup, "fleet", None) is not None:
+            fcfg = wsup.fleet.config
+            print(f"  elastic: {len(wsup.fleet.spares)} hot spare(s) "
+                  "parked out of the ring"
+                  + (", prefork warm path" if fcfg.prefork else "")
+                  + (", autoscaler armed" if fcfg.autoscale else ""))
         # a demonstrated three-tier ready: one probe per endpoint
         # through client -> replica -> worker.  Probes go through a
         # THROWAWAY client and tracing arms only AFTER they pass: the
@@ -1093,6 +1152,27 @@ def register(sub) -> None:
                          "demand book, kill-window capacity account) "
                          "next to the serve artifact; render with "
                          "`csmom fleet <run-id>`")
+    lg.add_argument("--spares", type=int, default=0, metavar="N",
+                    help="elastic fleet (serve.fleet): park N hot spare "
+                         "workers — pre-spawned, demonstrated-ready, "
+                         "held OUT of the hash ring — and promote one "
+                         "into a dead victim's slot in O(routes-publish) "
+                         "instead of paying the re-warm window; the pool "
+                         "backfills off the hot path (0 = off)")
+    lg.add_argument("--autoscale", action="store_true",
+                    help="elastic fleet: arm the demand-driven control "
+                         "loop — hysteresis-banded scale up/down within "
+                         "declared floors/ceilings off the fleet "
+                         "observatory's per-class demand series, plus "
+                         "SLO-class quota auto-tune; every decision "
+                         "lands reasoned in the fleet.elastic block "
+                         "(requires --fleet for the demand input)")
+    lg.add_argument("--prefork", action="store_true",
+                    help="elastic fleet: spawn spares through a "
+                         "forkserver-style prefork parent with the "
+                         "serve stack pre-imported and the AOT cache "
+                         "prewarmed into the page cache (fast warm "
+                         "path)")
     lg.add_argument("--allow-fresh-compiles", dest="allow_fresh_compiles",
                     action="store_true",
                     help="land the artifact even when the serving window "

@@ -461,6 +461,29 @@ class FleetAggregator:
                         n += cb.get("offered", 0)
             return n
 
+    def demand_recent_rps(self, horizon_s: float = 3.0,
+                          event: str = "offered",
+                          slo_class: str | None = None) -> float:
+        """Trailing arrival rate (events/s) over ``horizon_s``, read
+        from the demand book's one-second buckets — the autoscaler's
+        control input (``serve/fleet.py``).  ``slo_class`` narrows to a
+        single class (for quota tuning); default sums all classes."""
+        now = mono_now_s()
+        with self._lock:
+            if self._demand_t0 is None or not self._demand_open:
+                return 0.0
+            width = max(1, int(math.ceil(horizon_s)))
+            now_b = int(now - self._demand_t0)
+            b0 = now_b - width + 1
+            n = 0
+            for b, per in self._demand_per_s.items():
+                if b0 <= b <= now_b:
+                    for cls, cb in per.items():
+                        if slo_class is not None and cls != slo_class:
+                            continue
+                        n += cb.get(event, 0)
+            return n / float(width)
+
     # ---------------------------------------------------------- closing ---
 
     def close_proc(self, proc: str, reason: str) -> None:
@@ -650,7 +673,9 @@ def absolute_events(events: list, t0_mono_s: float) -> list:
 def lifecycle_walls(events: list) -> list:
     """One sample per (re)spawn: every ``ready`` event's spawn→ready
     wall plus the worker-reported bind/warm decomposition (see
-    ``serve/supervisor.py``)."""
+    ``serve/supervisor.py``).  ``kind`` carries the spawn regime
+    (cold / respawn / roll / spare-promotion) so fast-path samples gate
+    against their own kind instead of averaging across regimes."""
     out = []
     for e in events:
         if e.get("event") != "ready":
@@ -658,6 +683,7 @@ def lifecycle_walls(events: list) -> list:
         out.append({
             "worker_id": e.get("worker_id"),
             "generation": e.get("generation"),
+            "kind": e.get("spawn_kind") or "cold",
             "wall_s": e.get("wall_s"),
             "walls": e.get("walls"),
         })
@@ -681,16 +707,45 @@ def capacity_account(events: list, n_slots: int, window: tuple) -> dict:
     trailing a booked kill never double-opens).  The account is computed
     purely from measured lifecycle stamps: no model, no imputation —
     steady-state loss ≈ 0 is a *result*, not an assumption.
+
+    Hot spares (``serve/fleet.py``) enter the account as WARM-CAPACITY
+    reserve intervals: ``spare_ready`` opens one, and any of
+    ``spare_promoted``/``spare_death``/``spare_stopped`` closes it.
+    Spare events never open kill windows (a parked spare dying costs no
+    serving capacity — it was never routed), and a reserve covering a
+    kill window offsets the victim's hole: the account measures warm
+    capacity the fleet *possesses*.  The routable gap a client could
+    feel is gated separately, by the promotion-kind ready wall and the
+    in-window demand/latency criteria.
     """
     t0, t1 = float(window[0]), float(window[1])
     per_slot: dict = {}
+    spare_marks: dict = {}
     for e in events:
         wid = e.get("worker_id")
         ev = e.get("event")
-        if wid is None or ev not in ("ready", "chaos_kill", "death"):
+        if wid is None:
             continue
-        per_slot.setdefault(wid, []).append((float(e["t_s"]), ev))
+        if ev in ("ready", "chaos_kill", "death"):
+            per_slot.setdefault(wid, []).append((float(e["t_s"]), ev))
+        elif ev in ("spare_ready", "spare_promoted", "spare_death",
+                    "spare_stopped"):
+            spare_marks.setdefault(wid, []).append((float(e["t_s"]), ev))
     intervals = []       # (start, end) of availability, per slot merged
+    spare_intervals = []
+    for wid, marks in spare_marks.items():
+        marks.sort()
+        up_since = None
+        for t, ev in marks:
+            if ev == "spare_ready":
+                if up_since is None:
+                    up_since = t
+            elif up_since is not None:
+                spare_intervals.append((up_since, t))
+                up_since = None
+        if up_since is not None:
+            spare_intervals.append((up_since, t1))
+    intervals.extend(spare_intervals)
     kill_windows = []
     for wid, marks in per_slot.items():
         marks.sort()
@@ -754,17 +809,22 @@ def capacity_account(events: list, n_slots: int, window: tuple) -> dict:
             t_kill_s=round(kw["t_kill_s"] - t0, 3),
             t_ready_s=round(kw["t_ready_s"] - t0, 3),
             width_s=round(width, 3),
-            loss_frac=(round(1.0 - avail / (width * n_slots), 4)
+            # spare reserve can push in-window available past nominal;
+            # loss never reads negative (warm capacity ≥ nominal means
+            # the hole was covered, not that capacity was conjured)
+            loss_frac=(round(max(0.0, 1.0 - avail / (width * n_slots)), 4)
                        if width > 0 and n_slots else 0.0),
         )
+    spare_reserve = sum(_overlap(a, b, t0, t1) for a, b in spare_intervals)
     return {
         "n_slots": n_slots,
         "window_s": round(t1 - t0, 3),
         "nominal_worker_s": round(nominal, 3),
         "available_worker_s": round(min(available, nominal), 3),
+        "spare_reserve_worker_s": round(spare_reserve, 3),
         "kill_windows": kill_windows,
         "kill_window_loss_frac": (
-            round(1.0 - kw_available / kw_nominal, 4)
+            round(max(0.0, 1.0 - kw_available / kw_nominal), 4)
             if kw_nominal > 0 else 0.0),
         "steady_state_loss_frac": (
             round(max(0.0, 1.0 - ss_available / ss_nominal), 4)
@@ -796,6 +856,7 @@ def build_artifact(agg: FleetAggregator, run_id: str, *,
                    fresh_compiles=None,
                    platform: str | None = None,
                    workload: str | None = None,
+                   elastic: dict | None = None,
                    extra: dict | None = None) -> dict:
     """The FLEET artifact (kind ``fleet``, schema v1): closed stream
     books + ring-buffer series + demand book + lifecycle walls + the
@@ -828,12 +889,26 @@ def build_artifact(agg: FleetAggregator, run_id: str, *,
             occ = occupancy.setdefault(s["proc"], {})
             occ[s["metric"].split(".", 1)[1]] = _series_quantiles(s["v"])
     loss = capacity["kill_window_loss_frac"]
+    # split the ready walls by spawn regime: a spare promotion gating
+    # against the cold-spawn distribution (or vice versa) is a lie
+    walls_by_kind: dict = {}
+    for w in walls:
+        if isinstance(w.get("wall_s"), (int, float)):
+            kind = str(w.get("kind") or "cold")
+            walls_by_kind.setdefault(kind, []).append(round(w["wall_s"], 4))
+    kind_samples = {
+        "fleet_worker_ready_wall_%s_s"
+        % kind.replace("spare-promotion", "promotion").replace("-", "_"):
+        samples
+        for kind, samples in sorted(walls_by_kind.items())
+    }
     ex = {
         "platform": platform,
         "workload": workload,
         "samples": {
             "fleet_worker_ready_wall_s": [
                 round(w, 4) for w in wall_samples],
+            **kind_samples,
             "fleet_kill_window_capacity_loss_frac": [
                 kw["loss_frac"] for kw in capacity["kill_windows"]],
         },
@@ -862,6 +937,7 @@ def build_artifact(agg: FleetAggregator, run_id: str, *,
         },
         "capacity": capacity,
         "router_capacity": router_capacity,
+        "elastic": dict(elastic) if elastic else None,
         "requests": dict(requests) if requests else None,
         "channels": dict(channels) if channels else None,
         "compile": {

@@ -333,7 +333,7 @@ def build_fabric(wcfg: PoolConfig, rcfg: PoolConfig, run_dir: str, *,
                  deadline_ms: float, hedge_fraction: float = 0.35,
                  trace: bool = False, publisher_interval_s: float = 0.05,
                  client_deadline_s: float | None = None,
-                 configure_router=None):
+                 configure_router=None, fleet_config=None):
     """The three-tier bring-up, in the one order that works: worker
     supervisor first (the fleet the view describes), routes publisher
     (the admission view every replica reads), router supervisor (the
@@ -348,7 +348,12 @@ def build_fabric(wcfg: PoolConfig, rcfg: PoolConfig, run_dir: str, *,
     already-running tiers before the error propagates.  Tear down with
     :func:`stop_fabric` — both CLI drivers and the rehearse runner
     share this sequencing so a fix to one cannot silently miss the
-    others.  Returns ``(wsup, publisher, rsup, client)``.
+    others.  ``fleet_config`` (a :class:`~csmom_tpu.serve.fleet.
+    FleetConfig`) arms the elastic tier: hot spares + autoscaler attach
+    to the worker supervisor as ``wsup.fleet`` AFTER the routes
+    publisher exists (a promotion is a routes publish away) and stop
+    first on teardown via ``wsup.stop()``.  Returns
+    ``(wsup, publisher, rsup, client)``.
     """
     wsup = PoolSupervisor(wcfg, os.path.join(run_dir, "workers"))
     os.makedirs(wsup.run_dir, exist_ok=True)
@@ -361,6 +366,15 @@ def build_fabric(wcfg: PoolConfig, rcfg: PoolConfig, run_dir: str, *,
         routes_path = os.path.join(run_dir, "routes.json")
         publisher = RoutesPublisher(wsup, routes_path,
                                     interval_s=publisher_interval_s).start()
+        if fleet_config is not None and (
+                fleet_config.spares > 0 or fleet_config.autoscale
+                or fleet_config.prefork):
+            from csmom_tpu.obs import fleet as obs_fleet
+            from csmom_tpu.serve.fleet import FleetController
+
+            FleetController(
+                wsup, fleet_config, publisher=publisher,
+                aggregator=obs_fleet.current_aggregator()).start()
         rcfg = dataclasses.replace(
             rcfg, expect_cache_version=wsup.expect_cache_version)
         rsup = RouterSupervisor(rcfg, os.path.join(run_dir, "routers"),
@@ -380,9 +394,19 @@ def build_fabric(wcfg: PoolConfig, rcfg: PoolConfig, run_dir: str, *,
 
 def stop_fabric(publisher, rsup, wsup) -> None:
     """Ordered teardown — every exit path must stop BOTH process tiers
-    and the publisher: publisher first (stops must not churn the view),
-    then the router replicas, then the workers.  ``None`` slots are
-    skipped; every tier stops even when an earlier stop raises."""
+    and the publisher: the elastic tier first (no promotion or scaling
+    may race the teardown), then the publisher (stops must not churn
+    the view), the router replicas, and the workers.  ``None`` slots
+    are skipped; every tier stops even when an earlier stop raises."""
+    fleet = getattr(wsup, "fleet", None)
+    try:
+        if fleet is not None:
+            fleet.stop()
+    finally:
+        _stop_fabric_rest(publisher, rsup, wsup)
+
+
+def _stop_fabric_rest(publisher, rsup, wsup) -> None:
     try:
         if publisher is not None:
             publisher.stop()

@@ -1,0 +1,934 @@
+"""Elastic fleet controller: hot spares, fast warm path, autoscaling.
+
+The tier ABOVE :mod:`csmom_tpu.serve.supervisor` (ISSUE 20).  r20's
+observatory priced the problem: a SIGKILLed worker costs
+``fleet_kill_window_capacity_loss_frac`` 0.3333 while its replacement
+re-warms — 23.1 s cold, 6.5 s even off the AOT cache — and the
+per-class demand series sat unused.  Tail at Scale's answer is to pay
+for capacity BEFORE the outage, not during it:
+
+- **Hot spares** (:class:`FleetController`): N pre-spawned,
+  demonstrated-ready workers parked OUT of the hash ring and the routes
+  file.  On a worker death the controller's death hook promotes a spare
+  into the victim's slot — swap the handle, publish routes — so the
+  kill costs one failover instead of a re-warm window.  The pool
+  backfills off the hot path.  Spares live in the supervisor's event
+  book under ``spare_*`` names: serving consumers (capacity kill
+  windows, lifecycle walls, the router's ready set) filter by event
+  name, so spares are held out of the serving books BY SCHEMA, and the
+  capacity account credits a parked spare as warm reserve
+  (:func:`csmom_tpu.obs.fleet.capacity_account`).
+- **Fast warm path** (:class:`PreforkServer`): a forkserver-style
+  prefork parent (``python -m csmom_tpu.serve.fleet``) with the serve
+  stack — and, for jax engines, the jax *module* — pre-imported, plus a
+  page-cache prewarm pass over the serialized-executable cache so a
+  forked child's AOT loads hit warm pages.  The parent NEVER
+  initializes the accelerator backend (initializing XLA before fork is
+  unsafe); children do that during their own warmup, off a warm import
+  graph.  Spawn/poll run over one-shot lifecycle ops; the parent's
+  accept loop is single-threaded so ``fork`` happens with exactly one
+  thread alive.
+- **Demand-driven autoscaler** (:class:`AutoscalerPolicy` +
+  controller loop): a control loop reading the FleetAggregator's
+  per-class demand series (``demand_recent_rps``), hysteresis-banded
+  with sustain and cooldown so bursty schedules don't thrash, growing /
+  shrinking the fleet within declared floors/ceilings and auto-tuning
+  the r13 static SLO-class quotas (``tune_quota`` worker op →
+  ``AdmissionQueue.retune_quota``).  Every decision — including the
+  reasoned no-ops — lands in the closed-world ``fleet.elastic``
+  artifact block with a reason.
+
+Clock discipline: monotonic only (``analysis/rules.py`` pins this
+module mono-only — promotion walls and scaling decisions must never
+jump with wall-clock adjustments).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+from csmom_tpu.serve import health, proto
+from csmom_tpu.serve.supervisor import WorkerHandle
+from csmom_tpu.utils.deadline import mono_now_s
+
+__all__ = ["FleetConfig", "FleetController", "AutoscalerPolicy",
+           "PreforkServer"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Everything the elastic tier needs, with declared bounds."""
+
+    spares: int = 0                    # hot spares held in reserve
+    prefork: bool = False              # spawn via the prefork parent
+    autoscale: bool = False            # arm the demand control loop
+    poll_interval_s: float = 0.2       # spare monitor cadence
+    spare_ready_timeout_s: float = 120.0
+    # -- autoscaler (hysteresis band on offered rps per ready worker) --
+    autoscale_interval_s: float = 0.5
+    demand_horizon_s: float = 2.0      # trailing window the rate reads
+    high_rps_per_worker: float = 200.0
+    low_rps_per_worker: float = 5.0
+    sustain_s: float = 1.5             # band breach must persist this long
+    cooldown_s: float = 5.0            # dead time after any action
+    min_workers: int = 1               # declared floor (never shrink past)
+    max_workers: int = 8               # declared ceiling (never grow past)
+    # -- SLO-class quota auto-tune (bulk is the only quota'd class) -----
+    quota_class: str = "bulk"
+    quota_floor_rps: float = 8.0
+    quota_ceiling_rps: float = 64.0
+    quota_headroom: float = 1.25       # quota = headroom × offered rate
+    quota_min_rel_change: float = 0.25  # retune only past this delta
+
+
+# ------------------------------------------------------------- prefork ----
+
+_PREFORK_DEFAULT_IMPORTS = "csmom_tpu.serve.worker"
+
+
+class _PreforkChild:
+    """Duck-typed ``subprocess.Popen`` stand-in for a forked worker.
+
+    The supervisor only ever touches ``pid`` / ``poll`` / ``wait`` /
+    ``terminate`` / ``kill`` / ``returncode``.  ``poll`` asks the
+    prefork PARENT (``waitpid`` with cached statuses) because probing a
+    zombie with ``os.kill(pid, 0)`` succeeds — the one bug that would
+    make a dead child read alive forever.  If the parent itself is
+    gone, the child was reparented to init (which reaps), so the signal
+    probe becomes truthful and we fall back to it.
+    """
+
+    def __init__(self, pid: int, control_address: str):
+        self.pid = pid
+        self._address = control_address
+        self.returncode: int | None = None
+
+    def _probe_parent(self) -> dict:
+        """One-shot liveness probe of the forked child via the prefork
+        parent's control socket — a fresh dial per probe is the point
+        (the control socket is never a request path)."""
+        obj, _ = proto.request_once(
+            self._address, {"op": "poll", "pid": self.pid},
+            timeout_s=2.0)
+        return obj
+
+    def poll(self) -> int | None:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            # `poll` is the Popen contract name and cannot be renamed;
+            # the dial lives in the probe-named helper above
+            rc = self._probe_parent().get("returncode")
+            if rc is not None:
+                self.returncode = int(rc)
+        except (OSError, proto.ProtocolError):
+            # parent gone: init owns the child now, the probe is honest
+            try:
+                os.kill(self.pid, 0)
+            except ProcessLookupError:
+                self.returncode = -1  # exited; true rc reaped by init
+            except PermissionError:
+                pass
+        return self.returncode
+
+    def wait(self, timeout: float | None = None) -> int:
+        give_up = None if timeout is None else mono_now_s() + timeout
+        while True:
+            rc = self.poll()
+            if rc is not None:
+                return rc
+            if give_up is not None and mono_now_s() >= give_up:
+                raise subprocess.TimeoutExpired("prefork-child", timeout)
+            threading.Event().wait(0.05)
+
+    def _signal(self, sig) -> None:
+        try:
+            os.kill(self.pid, sig)
+        except ProcessLookupError:
+            pass
+
+    def terminate(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+
+class PreforkServer:
+    """The prefork parent process (``python -m csmom_tpu.serve.fleet``).
+
+    Single-threaded by construction: one accept loop, lifecycle ops
+    handled inline, ``fork`` with exactly one thread alive.  Ops:
+
+    - ``ping``    → liveness + what got pre-imported / prewarmed
+    - ``spawn``   → fork; child redirects stdio to the requested log,
+      applies env overrides, and runs ``serve.worker.main(argv)``
+    - ``poll``    → ``waitpid(WNOHANG)`` with cached exit statuses
+    - ``shutdown`` → reply, close the listener, exit the loop
+
+    The parent never initializes an accelerator backend; it imports
+    modules and touches cache FILES (page-cache prewarm) only.
+    """
+
+    def __init__(self, address: str, preimport: str = "",
+                 prewarm_dir: str = ""):
+        self.address = address
+        self.preimport = [m for m in preimport.split(",") if m]
+        self.prewarm_dir = prewarm_dir
+        self.imported: list = []
+        self.prewarmed_bytes = 0
+        self.prewarmed_files = 0
+        self._children: dict = {}   # pid -> returncode | None
+        self._listener = None
+        self._stopping = False
+
+    # ------------------------------------------------------------ warmup
+
+    def warm(self) -> None:
+        import importlib
+
+        for mod in self.preimport:
+            try:
+                importlib.import_module(mod)
+                self.imported.append(mod)
+            except Exception as e:  # a missing engine dep must not kill
+                self.imported.append(f"{mod}!{type(e).__name__}")
+        if self.prewarm_dir and os.path.isdir(self.prewarm_dir):
+            self._prewarm(self.prewarm_dir)
+
+    def _prewarm(self, root: str, budget_bytes: int = 1 << 29) -> None:
+        """Fault the serialized-executable cache into the page cache so
+        every forked child's AOT load is an mmap of warm pages, not a
+        cold disk read (best-effort, bounded)."""
+        for dirpath, _dirs, files in os.walk(root):
+            for name in files:
+                if self.prewarmed_bytes >= budget_bytes:
+                    return
+                path = os.path.join(dirpath, name)
+                try:
+                    with open(path, "rb") as f:
+                        while f.read(1 << 20):
+                            pass
+                    self.prewarmed_bytes += os.path.getsize(path)
+                    self.prewarmed_files += 1
+                except OSError:
+                    continue
+
+    # -------------------------------------------------------------- ops
+
+    def _op_spawn(self, obj: dict) -> dict:
+        argv = list(obj.get("argv") or [])
+        log_path = obj.get("log_path")
+        env = obj.get("env") or {}
+        pid = os.fork()
+        if pid == 0:
+            # the child: shed the parent's sockets, point stdio at the
+            # slot log, then BECOME the worker (never return)
+            try:
+                if self._listener is not None:
+                    self._listener.close()
+                if log_path:
+                    fd = os.open(log_path,
+                                 os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                                 0o644)
+                    os.dup2(fd, 1)
+                    os.dup2(fd, 2)
+                    os.close(fd)
+                os.environ.update({str(k): str(v) for k, v in env.items()})
+                from csmom_tpu.serve import worker as worker_mod
+
+                rc = worker_mod.main(argv)
+            except SystemExit as e:
+                rc = (e.code if isinstance(e.code, int)
+                      else 0 if e.code is None else 1)
+            except BaseException:
+                rc = 70  # EX_SOFTWARE: the child must never unwind into
+                #          the parent's stack
+            os._exit(int(rc) & 0xFF)
+        self._children[pid] = None
+        return {"state": "ok", "pid": pid}
+
+    def _op_poll(self, obj: dict) -> dict:
+        pid = int(obj.get("pid", -1))
+        rc = self._children.get(pid)
+        if rc is None and pid in self._children:
+            try:
+                done, status = os.waitpid(pid, os.WNOHANG)
+                if done == pid:
+                    rc = (os.WEXITSTATUS(status) if os.WIFEXITED(status)
+                          else -os.WTERMSIG(status))
+                    self._children[pid] = rc
+            except ChildProcessError:
+                rc = -1  # not ours / already reaped: report exited
+                self._children[pid] = rc
+        return {"state": "ok", "returncode": rc}
+
+    def handle(self, obj: dict) -> dict:
+        op = obj.get("op")
+        if op == "ping":
+            return {"state": "ok", "pid": os.getpid(),
+                    "imported": list(self.imported),
+                    "prewarmed_bytes": self.prewarmed_bytes,
+                    "prewarmed_files": self.prewarmed_files,
+                    "children": len(self._children)}
+        if op == "spawn":
+            return self._op_spawn(obj)
+        if op == "poll":
+            return self._op_poll(obj)
+        if op == "shutdown":
+            self._stopping = True
+            return {"state": "ok"}
+        return {"state": "rejected", "error": f"unknown op {op!r}"}
+
+    # ------------------------------------------------------------- loop
+
+    def run(self) -> int:
+        self._listener = proto.listen(self.address)
+        self._listener.settimeout(0.25)
+        try:
+            while not self._stopping:
+                try:
+                    conn, _addr = self._listener.accept()
+                except TimeoutError:
+                    continue
+                except OSError:
+                    break
+                try:
+                    conn.settimeout(5.0)
+                    msg = proto.recv_msg(conn, deadline_s=5.0)
+                    if msg is None:
+                        continue
+                    obj, _arrays = msg
+                    obj.pop("_mux", None)
+                    proto.send_msg(conn, self.handle(obj))
+                except (OSError, proto.ProtocolError):
+                    pass  # a broken client must not kill the parent
+                finally:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+        finally:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            proto.unlink_address(self.address)
+        return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="csmom-prefork",
+        description="forkserver-style prefork parent for serve workers")
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--preimport", default=_PREFORK_DEFAULT_IMPORTS,
+                    help="comma-separated modules to import before "
+                         "serving forks (never initializes a backend)")
+    ap.add_argument("--prewarm-dir", default="",
+                    help="AOT cache directory to fault into the page "
+                         "cache before the first fork")
+    args = ap.parse_args(argv)
+    srv = PreforkServer(args.socket, preimport=args.preimport,
+                        prewarm_dir=args.prewarm_dir)
+    srv.warm()
+    return srv.run()
+
+
+# ---------------------------------------------------------- autoscaler ----
+
+class AutoscalerPolicy:
+    """Pure hysteresis-banded scaling policy (no clocks, no I/O).
+
+    ``decide(now_s, offered_rps, n_ready)`` returns one reasoned
+    decision dict per tick: ``scale_up`` / ``scale_down`` / ``hold``,
+    always with a human-readable ``reason``.  A band breach must
+    SUSTAIN (``sustain_s``) before it acts, every action starts a
+    cooldown, and the floor/ceiling are hard bounds — three separate
+    guards against thrash on bursty schedules.  The clock is an
+    argument (the TokenBucket idiom), so tests drive synthetic demand
+    series without sleeping.
+    """
+
+    def __init__(self, *, high_rps_per_worker: float,
+                 low_rps_per_worker: float, sustain_s: float,
+                 cooldown_s: float, min_workers: int, max_workers: int):
+        if low_rps_per_worker >= high_rps_per_worker:
+            raise ValueError("hysteresis band inverted: low >= high")
+        self.high = float(high_rps_per_worker)
+        self.low = float(low_rps_per_worker)
+        self.sustain_s = float(sustain_s)
+        self.cooldown_s = float(cooldown_s)
+        self.min_workers = int(min_workers)
+        self.max_workers = int(max_workers)
+        self._above_since: float | None = None
+        self._below_since: float | None = None
+        self._cooldown_until: float | None = None
+
+    def _decision(self, now_s, action, reason, rps, n_ready) -> dict:
+        return {"t_s": round(float(now_s), 4), "action": action,
+                "reason": reason, "offered_rps": round(float(rps), 3),
+                "n_ready": int(n_ready)}
+
+    def decide(self, now_s: float, offered_rps: float,
+               n_ready: int) -> dict:
+        per = offered_rps / max(1, n_ready)
+        mk = lambda a, r: self._decision(now_s, a, r, offered_rps, n_ready)  # noqa: E731
+        if self._cooldown_until is not None:
+            if now_s < self._cooldown_until:
+                return mk("hold", f"cooldown: {self._cooldown_until - now_s:.1f}s "
+                                  "until the last action's dead time ends")
+            self._cooldown_until = None
+        if per > self.high:
+            self._below_since = None
+            if self._above_since is None:
+                self._above_since = now_s
+            held = now_s - self._above_since
+            if held < self.sustain_s:
+                return mk("hold", f"{per:.1f} rps/worker above high "
+                                  f"watermark {self.high:.0f}, sustaining "
+                                  f"({held:.1f}/{self.sustain_s:.1f}s)")
+            self._above_since = None
+            if n_ready >= self.max_workers:
+                return mk("hold", f"sustained burst ({per:.1f} rps/worker) "
+                                  f"but at declared ceiling "
+                                  f"{self.max_workers} workers")
+            self._cooldown_until = now_s + self.cooldown_s
+            return mk("scale_up", f"{per:.1f} rps/worker > high watermark "
+                                  f"{self.high:.0f} sustained "
+                                  f"{self.sustain_s:.1f}s")
+        if per < self.low:
+            self._above_since = None
+            if self._below_since is None:
+                self._below_since = now_s
+            held = now_s - self._below_since
+            if held < self.sustain_s:
+                return mk("hold", f"{per:.1f} rps/worker below low "
+                                  f"watermark {self.low:.0f}, sustaining "
+                                  f"({held:.1f}/{self.sustain_s:.1f}s)")
+            self._below_since = None
+            if n_ready <= self.min_workers:
+                return mk("hold", f"drained ({per:.1f} rps/worker) but at "
+                                  f"declared floor {self.min_workers} "
+                                  "workers")
+            self._cooldown_until = now_s + self.cooldown_s
+            return mk("scale_down", f"{per:.1f} rps/worker < low watermark "
+                                    f"{self.low:.0f} sustained "
+                                    f"{self.sustain_s:.1f}s")
+        self._above_since = self._below_since = None
+        return mk("hold", f"{per:.1f} rps/worker inside hysteresis band "
+                          f"[{self.low:.0f}, {self.high:.0f}]")
+
+
+# ---------------------------------------------------------- controller ----
+
+class FleetController:
+    """Owns the spare pool, the promotion seam, and the control loop.
+
+    Attaches to a running :class:`PoolSupervisor` as ``wsup.fleet`` and
+    registers a death hook.  All spare lifecycle lands in the
+    SUPERVISOR's event book under ``spare_*`` names, so the existing
+    plumbing (``summary()["events"]`` → ``absolute_events`` → the FLEET
+    artifact) carries it with zero new channels — and the serving
+    consumers, which filter by event name, never see a spare.
+    """
+
+    def __init__(self, wsup, config: FleetConfig, publisher=None,
+                 aggregator=None):
+        self.wsup = wsup
+        self.config = config
+        self.publisher = publisher    # RoutesPublisher | None (pool mode)
+        self.aggregator = aggregator  # FleetAggregator | None
+        self.spares: list = []        # parked WorkerHandle's, NOT in wsup
+        self.promotions: list = []
+        self.promotions_missed = 0
+        self.decisions: list = []
+        self.quota_applied: list = []
+        self.counts = {"spawned": 0, "ready": 0, "promoted": 0,
+                       "backfills": 0, "died_parked": 0}
+        self._all_spare_ids: list = []
+        self._spare_seq = 0
+        self._lock = threading.Lock()
+        self._backfill_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._loop_thread: threading.Thread | None = None
+        self._prefork_proc = None
+        self._prefork_address: str | None = None
+        self._policy = AutoscalerPolicy(
+            high_rps_per_worker=config.high_rps_per_worker,
+            low_rps_per_worker=config.low_rps_per_worker,
+            sustain_s=config.sustain_s, cooldown_s=config.cooldown_s,
+            min_workers=config.min_workers,
+            max_workers=config.max_workers) if config.autoscale else None
+        self._quota_current: float | None = None
+        self._quota_cooldown_until: float | None = None
+        self._last_hold_reason: str | None = None
+
+    # ------------------------------------------------------------ prefork
+
+    def _start_prefork(self) -> None:
+        self._prefork_address = os.path.join(self.wsup.run_dir,
+                                             "prefork.sock")
+        prewarm = ""
+        try:
+            from csmom_tpu.utils.jit_cache import cache_dir
+
+            prewarm = cache_dir(self.wsup.config.cache_subdir) or ""
+        except Exception:
+            pass
+        argv = [sys.executable, "-m", "csmom_tpu.serve.fleet",
+                "--socket", self._prefork_address]
+        if prewarm:
+            argv += ["--prewarm-dir", prewarm]
+        log_path = os.path.join(self.wsup.run_dir, "prefork.log")
+        log = open(log_path, "ab")
+        try:
+            self._prefork_proc = subprocess.Popen(
+                argv, stdout=log, stderr=log, env=self.wsup._spawn_env())
+        finally:
+            log.close()
+        give_up = mono_now_s() + 60.0
+        last_err = "never pinged"
+        while mono_now_s() < give_up:
+            if self._prefork_proc.poll() is not None:
+                last_err = f"exited rc={self._prefork_proc.returncode}"
+                break
+            try:
+                obj = self._probe_prefork()
+                if obj.get("state") == "ok":
+                    self.wsup._event(
+                        "prefork_ready", "prefork",
+                        imported=obj.get("imported"),
+                        prewarmed_bytes=obj.get("prewarmed_bytes"))
+                    return
+            except (OSError, proto.ProtocolError) as e:
+                last_err = f"{type(e).__name__}: {e}"[:120]
+            self._stop.wait(0.1)
+        # fall back to plain Popen spawns rather than fail the fleet
+        self.wsup._event("prefork_failed", "prefork", reason=last_err)
+        self._stop_prefork()
+
+    def _probe_prefork(self) -> dict:
+        """One-shot readiness probe of the prefork parent (fresh dial
+        by design: the control socket is not a request path)."""
+        obj, _ = proto.request_once(self._prefork_address,
+                                    {"op": "ping"}, timeout_s=2.0)
+        return obj
+
+    def _prefork_admin(self, obj: dict, timeout_s: float = 2.0) -> dict:
+        """One-shot admin op (spawn/shutdown) to the prefork parent —
+        fresh dial by design, same rationale as :meth:`_probe_prefork`."""
+        out, _ = proto.request_once(self._prefork_address, obj,
+                                    timeout_s=timeout_s)
+        return out
+
+    def _stop_prefork(self) -> None:
+        proc, self._prefork_proc = self._prefork_proc, None
+        if proc is None:
+            return
+        try:
+            self._prefork_admin({"op": "shutdown"})
+        except (OSError, proto.ProtocolError):
+            pass
+        try:
+            proc.wait(timeout=3.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    # ------------------------------------------------------------- spares
+
+    def _spawn_spare(self, kind: str = "spare") -> WorkerHandle | None:
+        """Spawn + demonstrated-ready probe one spare (blocking).  The
+        spare is a full worker on its own socket; it just never enters
+        the routes file until promoted."""
+        with self._lock:
+            seq = self._spare_seq
+            self._spare_seq += 1
+        sid = f"s{seq}"
+        h = WorkerHandle(
+            slot=-1, worker_id=sid,
+            socket_path=self.wsup._slot_address(
+                self.wsup.config.n_workers + seq))
+        h.log_path = os.path.join(self.wsup.run_dir, f"{sid}.g0.log")
+        h.spawn_kind = "spare"
+        argv = self.wsup._worker_argv(h)
+        t_spawn = mono_now_s()
+        spawned_via = "popen"
+        if self._prefork_proc is not None \
+                and self._prefork_proc.poll() is None:
+            try:
+                obj = self._prefork_admin(
+                    {"op": "spawn", "argv": argv[3:],
+                     "log_path": h.log_path}, timeout_s=5.0)
+                if obj.get("state") == "ok":
+                    h.proc = _PreforkChild(int(obj["pid"]),
+                                           self._prefork_address)
+                    spawned_via = "prefork"
+            except (OSError, proto.ProtocolError):
+                pass
+        if h.proc is None:
+            log = open(h.log_path, "ab")
+            try:
+                h.proc = subprocess.Popen(argv, stdout=log, stderr=log,
+                                          env=self.wsup._spawn_env())
+            finally:
+                log.close()
+        h.t_spawned_s = t_spawn
+        with self._lock:
+            self.counts["spawned"] += 1
+            self._all_spare_ids.append(sid)
+        self.wsup._event("spare_spawn", sid, pid=h.proc.pid, via=spawned_via,
+                         kind=kind)
+        give_up = t_spawn + self.config.spare_ready_timeout_s
+        while mono_now_s() < give_up and not self._stop.is_set():
+            rc = h.proc.poll()
+            if rc is not None:
+                self.wsup._event("spare_death", sid, rc=rc, phase="starting")
+                with self._lock:
+                    self.counts["died_parked"] += 1
+                return None
+            report = health.readiness(h.socket_path, timeout_s=2.0)
+            if report.get("ok"):
+                h.state = "ready"
+                h.t_ready_s = mono_now_s()
+                h.ready_report = report
+                with self._lock:
+                    self.counts["ready"] += 1
+                self.wsup._event(
+                    "spare_ready", sid, via=spawned_via,
+                    fresh_compiles=report.get("fresh_compiles"),
+                    wall_s=round(h.t_ready_s - t_spawn, 3),
+                    walls=report.get("walls"))
+                return h
+            self._stop.wait(self.wsup.config.poll_interval_s)
+        self.wsup._event("spare_ready_timeout", sid)
+        self.wsup._reap(h)
+        return None
+
+    def _fill_pool(self, target: int, kind: str) -> None:
+        """Grow the parked pool to ``target`` ready spares (serialized
+        by the backfill lock so racing deaths don't double-spawn)."""
+        with self._backfill_lock:
+            while not self._stop.is_set():
+                with self._lock:
+                    if len(self.spares) >= target:
+                        return
+                # the backfill lock EXISTS to serialize slow spawns —
+                # nothing on a request path ever contends it (only the
+                # fill/backfill threads), so blocking under it is the
+                # design, not a hidden wait
+                # lint: allow[lock-order] backfill lock serializes slow spawns by design
+                h = self._spawn_spare(kind=kind)
+                if h is None:
+                    return  # spawn/probe failed: stay short rather than
+                    #         hot-spin a spawn that just demonstrated failure
+                with self._lock:
+                    self.spares.append(h)
+
+    def _backfill_async(self) -> None:
+        with self._lock:
+            self.counts["backfills"] += 1
+        self.wsup._event("spare_backfill", "fleet",
+                         pool=len(self.spares),
+                         target=self.config.spares)
+        threading.Thread(target=self._fill_pool,
+                         args=(self.config.spares, "backfill"),
+                         name="csmom-fleet-backfill", daemon=True).start()
+
+    # ---------------------------------------------------------- promotion
+
+    def _on_worker_death(self, victim: WorkerHandle, t_kill: float) -> bool:
+        """The supervisor's death hook: promote a parked spare into the
+        victim's slot.  Returns True when the death is CLAIMED (no
+        backoff re-warm); False hands the slot back to the supervisor's
+        normal machinery (no spare left, or the spare was dead too)."""
+        if self._stop.is_set():
+            return False
+        while True:
+            with self._lock:
+                spare = None
+                for i, s in enumerate(self.spares):
+                    if s.state == "ready":
+                        spare = self.spares.pop(i)
+                        break
+            if spare is None:
+                with self._lock:
+                    self.promotions_missed += 1
+                self.wsup._event("spare_promotion_missed",
+                                 victim.worker_id,
+                                 reason="no ready spare parked")
+                return False
+            # demonstrated-ready at promotion time, not just at spawn: a
+            # spare that died parked must fall through to the next one
+            if spare.proc.poll() is not None \
+                    or not health.readiness(spare.socket_path,
+                                            timeout_s=2.0).get("ok"):
+                self.wsup._event("spare_death", spare.worker_id,
+                                 rc=spare.proc.poll(), phase="parked")
+                with self._lock:
+                    self.counts["died_parked"] += 1
+                continue
+            break
+        t0 = self.wsup.t0_mono_s
+        with self._lock:
+            victim.proc = spare.proc
+            victim.socket_path = spare.socket_path
+            victim.log_path = spare.log_path
+            victim.generation += 1
+            victim.spawn_kind = "spare-promotion"
+            victim.restarts = 0
+            victim.t_spawned_s = t_kill
+            victim.t_ready_s = mono_now_s()
+            victim.ready_report = spare.ready_report
+            victim.state = "ready"
+            victim.reason = None
+            victim.next_restart_at = None
+            self.counts["promoted"] += 1
+            wall = victim.t_ready_s - t_kill
+            self.promotions.append({
+                "victim": victim.worker_id,
+                "spare": spare.worker_id,
+                "generation": victim.generation,
+                "t_kill_s": round(t_kill - t0, 4),
+                "t_ready_s": round(victim.t_ready_s - t0, 4),
+                "wall_s": round(wall, 4),
+            })
+        self.wsup._event("spare_promoted", spare.worker_id,
+                         victim=victim.worker_id,
+                         generation=victim.generation)
+        # the promotion IS a ready transition for the victim's slot: one
+        # lifecycle sample in the spare-promotion regime, closing the
+        # capacity account's kill window
+        self.wsup._event(
+            "ready", victim.worker_id, generation=victim.generation,
+            spawn_kind="spare-promotion",
+            fresh_compiles=(victim.ready_report or {}).get(
+                "fresh_compiles"),
+            wall_s=round(wall, 3),
+            walls=(victim.ready_report or {}).get("walls"))
+        self.wsup._gauge_ready()
+        if self.publisher is not None:
+            # routability is one routes publish away — this is the whole
+            # point: O(publish), not O(re-warm)
+            try:
+                self.publisher.publish_once()
+            except OSError:
+                pass  # the interval publisher retries on its own clock
+        self._backfill_async()
+        return True
+
+    # -------------------------------------------------------- autoscaling
+
+    def _record_decision(self, d: dict) -> None:
+        """Actions always land; holds land only when their reason
+        CHANGES (the elastic block stays reasoned, not flooded)."""
+        with self._lock:
+            if d["action"] == "hold":
+                if d["reason"] == self._last_hold_reason:
+                    return
+                self._last_hold_reason = d["reason"]
+            else:
+                self._last_hold_reason = None
+            self.decisions.append(d)
+
+    def _scale_up(self) -> None:
+        wsup = self.wsup
+        slot = len(wsup.handles)
+        h = WorkerHandle(slot=slot,
+                         worker_id=f"{wsup.slot_prefix}{slot}",
+                         socket_path=wsup._slot_address(slot))
+        wsup.handles.append(h)
+        wsup._spawn(h)
+        threading.Thread(target=wsup._probe_until_ready,
+                         args=(h, wsup.config.ready_timeout_s),
+                         daemon=True).start()
+
+    def _scale_down(self) -> None:
+        wsup = self.wsup
+        victim = None
+        for h in reversed(wsup.handles):
+            if h.state == "ready":
+                victim = h
+                break
+        if victim is None:
+            return
+        victim.state = "draining"
+        self.wsup._event("scale_down_drain", victim.worker_id,
+                         generation=victim.generation)
+        threading.Thread(target=wsup._drain_stop, args=(victim,),
+                         daemon=True).start()
+
+    def _admin_tune_quota(self, now_rel: float, offered_rps: float) -> None:
+        """One-shot ``tune_quota`` admin op to each ready worker (fresh
+        dial by design: quota retunes must not ride a channel the
+        request path might sever)."""
+        c = self.config
+        desired = min(c.quota_ceiling_rps,
+                      max(c.quota_floor_rps,
+                          offered_rps * c.quota_headroom))
+        if self._quota_cooldown_until is not None \
+                and mono_now_s() < self._quota_cooldown_until:
+            return
+        cur = self._quota_current
+        if cur is not None and cur > 0 \
+                and abs(desired - cur) / cur < c.quota_min_rel_change:
+            return
+        applied_to = []
+        for h in self.wsup.ready_workers():
+            try:
+                obj, _ = proto.request_once(
+                    h.socket_path,
+                    {"op": "tune_quota", "slo_class": c.quota_class,
+                     "quota_rps": desired,
+                     "quota_burst": desired * 1.5}, timeout_s=2.0)
+                if obj.get("state") == "ok":
+                    applied_to.append(h.worker_id)
+            except (OSError, proto.ProtocolError):
+                pass
+        if not applied_to:
+            return
+        self._quota_current = desired
+        self._quota_cooldown_until = mono_now_s() + c.cooldown_s
+        rec = {"t_s": round(now_rel, 4), "slo_class": c.quota_class,
+               "quota_rps": round(desired, 3),
+               "applied_to": applied_to}
+        with self._lock:
+            self.quota_applied.append(rec)
+        self._record_decision({
+            "t_s": round(now_rel, 4), "action": "tune_quota",
+            "reason": (f"{c.quota_class} offered {offered_rps:.1f} rps → "
+                       f"quota {desired:.1f} rps (headroom "
+                       f"{c.quota_headroom}×, within "
+                       f"[{c.quota_floor_rps:.0f}, "
+                       f"{c.quota_ceiling_rps:.0f}])"),
+            "offered_rps": round(offered_rps, 3),
+            "n_ready": len(self.wsup.ready_workers())})
+
+    def _autoscale_tick(self) -> None:
+        agg = self.aggregator
+        if agg is None or self._policy is None:
+            return
+        now = mono_now_s()
+        now_rel = now - self.wsup.t0_mono_s
+        rps = agg.demand_recent_rps(self.config.demand_horizon_s)
+        n_ready = len(self.wsup.ready_workers())
+        d = self._policy.decide(now, rps, n_ready)
+        d = dict(d, t_s=round(now_rel, 4))
+        self._record_decision(d)
+        if d["action"] == "scale_up":
+            self._scale_up()
+        elif d["action"] == "scale_down":
+            self._scale_down()
+        cls_rps = agg.demand_recent_rps(self.config.demand_horizon_s,
+                                        slo_class=self.config.quota_class)
+        self._admin_tune_quota(now_rel, cls_rps)
+
+    # --------------------------------------------------------------- loop
+
+    def _loop(self) -> None:
+        next_autoscale = mono_now_s()
+        while not self._stop.is_set():
+            # parked spares must be ALIVE spares: a corpse in the pool
+            # would promote thin air
+            dead = []
+            with self._lock:
+                parked = list(self.spares)
+            for s in parked:
+                if s.state == "ready" and s.proc.poll() is not None:
+                    dead.append(s)
+            for s in dead:
+                with self._lock:
+                    if s in self.spares:
+                        self.spares.remove(s)
+                    self.counts["died_parked"] += 1
+                self.wsup._event("spare_death", s.worker_id,
+                                 rc=s.proc.poll(), phase="parked")
+                self._backfill_async()
+            if self.config.autoscale \
+                    and mono_now_s() >= next_autoscale:
+                next_autoscale = (mono_now_s()
+                                  + self.config.autoscale_interval_s)
+                try:
+                    self._autoscale_tick()
+                except Exception as e:  # the loop must outlive a bad tick
+                    self.wsup._event("autoscale_error", "fleet",
+                                     error=f"{type(e).__name__}: {e}"[:200])
+            self._stop.wait(self.config.poll_interval_s)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self, wait_ready: bool = True) -> "FleetController":
+        if self.config.prefork:
+            self._start_prefork()
+        if self.config.spares > 0:
+            if wait_ready:
+                self._fill_pool(self.config.spares, "initial")
+            else:
+                threading.Thread(target=self._fill_pool,
+                                 args=(self.config.spares, "initial"),
+                                 daemon=True).start()
+        self.wsup.death_hooks.append(self._on_worker_death)
+        self.wsup.fleet = self
+        self._loop_thread = threading.Thread(
+            target=self._loop, name="csmom-fleet-controller", daemon=True)
+        self._loop_thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Idempotent: unhook, stop the loop, drain parked spares, then
+        shut the prefork parent down (last — promoted children's polls
+        route through it until they drain)."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        try:
+            self.wsup.death_hooks.remove(self._on_worker_death)
+        except ValueError:
+            pass
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=3.0)
+        with self._backfill_lock:
+            with self._lock:
+                parked, self.spares = list(self.spares), []
+        for s in parked:
+            self.wsup._drain_stop(s)
+            self.wsup._event("spare_stopped", s.worker_id)
+        self._stop_prefork()
+
+    # ------------------------------------------------------------ summary
+
+    def summary(self) -> dict:
+        """The closed-world ``fleet.elastic`` block (validated by
+        ``chaos/invariants._validate_fleet``)."""
+        c = self.config
+        with self._lock:
+            return {
+                "armed": True,
+                "spares_configured": c.spares,
+                "prefork": bool(self._prefork_address is not None),
+                "autoscale": c.autoscale,
+                "spare_ids": list(self._all_spare_ids),
+                "spares": dict(self.counts),
+                "promotions": [dict(p) for p in self.promotions],
+                "promotions_missed": self.promotions_missed,
+                "decisions": [dict(d) for d in self.decisions],
+                "quota": {
+                    "slo_class": c.quota_class,
+                    "floor_rps": c.quota_floor_rps,
+                    "ceiling_rps": c.quota_ceiling_rps,
+                    "applied": [dict(q) for q in self.quota_applied],
+                },
+                "bounds": {"min_workers": c.min_workers,
+                           "max_workers": c.max_workers},
+            }
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -974,6 +974,16 @@ def build_fabric_artifact(client, router_sup, worker_sup,
     if spec.name == "serve-smoke":
         extra["smoke"] = ("smoke-bucket fabric run: pipeline-shaped, "
                           "workload reduced — NOT a performance capture")
+    # observatory provenance (ISSUE 20 satellite): an armed fleet
+    # observatory costs ~+0.3-0.4 ms p50 at steady 25 rps but +5-13 ms
+    # p50 under the 240-300 rps bursts of the committed schedule (A/B
+    # measured at r20 — the cost is distributed across client span
+    # recording, the demand hook, and in-router emitters, not one hot
+    # line).  Recording the state lets the ledger footnote the latency
+    # rows mechanically instead of leaving the r19->r20 p50 step (28.6
+    # -> 49.9) to look like an unexplained regression.
+    from csmom_tpu.obs import fleet as obs_fleet
+    extra["observatory_armed"] = bool(obs_fleet.armed())
     return {
         "kind": "serve_fabric",
         "schema_version": FABRIC_SCHEMA_VERSION,

@@ -204,6 +204,23 @@ class AdmissionQueue:
     def resolve_class(self, name: str) -> str:
         return self.policy.resolve_name(name)
 
+    def retune_quota(self, cls_name: str, quota_rps: float,
+                     quota_burst: float | None = None) -> bool:
+        """The autoscaler's seam (``serve/fleet.py``): retune a class's
+        admission quota IN PLACE.  Only classes that already carry a
+        bucket are tunable — granting an unquota'd class a quota at
+        runtime would change admission semantics, not tune them.
+        Returns True when applied."""
+        cls = self.policy.resolve(cls_name)
+        with self._lock:
+            bucket = self._buckets.get(cls.name)
+            if bucket is None or quota_rps <= 0:
+                return False
+            bucket.rate = float(quota_rps)
+            bucket.burst = float(quota_burst if quota_burst
+                                 and quota_burst > 0 else 1.5 * quota_rps)
+            return True
+
     # ------------------------------------------------------------- admit --
 
     def submit(self, req: Request) -> Request:
@@ -262,7 +279,7 @@ class AdmissionQueue:
                 self._terminate_locked(
                     req, "rejected",
                     error=f"class {cls.name!r} over its admission quota "
-                          f"({cls.quota_rps:g} req/s sustained); retry "
+                          f"({bucket.rate:g} req/s sustained); retry "
                           f"after ~{req.retry_after_s:.3f}s",
                 )
                 metrics.counter("serve.rejected_quota").inc()

@@ -95,6 +95,9 @@ class WorkerHandle:
     device_slice: str | None = None
     proc: subprocess.Popen | None = None
     state: str = "starting"   # starting | ready | draining | dead | failed
+    # how the CURRENT process came to exist: cold | respawn | roll |
+    # spare-promotion — ready-wall samples gate per regime (ISSUE 20)
+    spawn_kind: str = "cold"
     generation: int = 0
     restarts: int = 0          # consecutive young deaths (resets on uptime)
     next_restart_at: float | None = None
@@ -156,6 +159,12 @@ class PoolSupervisor:
         self.kills_observed = 0
         self.restarts_total = 0
         self.rolls_completed = 0
+        # the elastic tier (serve/fleet.py) attaches here when armed:
+        # death hooks run on the monitor thread BEFORE backoff/park —
+        # a hook returning True claims the death (spare promotion) and
+        # the supervisor schedules no re-warm for that slot
+        self.fleet = None
+        self.death_hooks: list = []
 
     # -------------------------------------------------------------- events
 
@@ -180,6 +189,7 @@ class PoolSupervisor:
         with self._lock:
             return [{"worker_id": e["worker_id"],
                      "generation": e.get("generation"),
+                     "kind": e.get("spawn_kind") or "cold",
                      "wall_s": e.get("wall_s"),
                      "walls": e.get("walls")}
                     for e in self.events if e["event"] == "ready"]
@@ -221,12 +231,10 @@ class PoolSupervisor:
             argv.append("--require-warm-cache")
         return argv
 
-    def _spawn(self, h: WorkerHandle) -> None:
-        from csmom_tpu.chaos.inject import checkpoint
-
-        checkpoint("pool.spawn", worker=h.worker_id, gen=h.generation)
-        h.log_path = os.path.join(
-            self.run_dir, f"{h.worker_id}.g{h.generation}.log")
+    def _spawn_env(self) -> dict:
+        """The environment every slot process runs under (shared with
+        the spare pool in ``serve/fleet.py`` — a promoted spare must be
+        indistinguishable from a supervisor-spawned worker)."""
         env = dict(os.environ)  # fault plans and JAX_PLATFORMS inherit
         env["PYTHONPATH"] = (_PKG_ROOT + os.pathsep
                              + env.get("PYTHONPATH", ""))
@@ -245,6 +253,15 @@ class PoolSupervisor:
                 env.get("XLA_FLAGS", "")
                 + f" --xla_force_host_platform_device_count={need}"
             ).strip()
+        return env
+
+    def _spawn(self, h: WorkerHandle) -> None:
+        from csmom_tpu.chaos.inject import checkpoint
+
+        checkpoint("pool.spawn", worker=h.worker_id, gen=h.generation)
+        h.log_path = os.path.join(
+            self.run_dir, f"{h.worker_id}.g{h.generation}.log")
+        env = self._spawn_env()
         log = open(h.log_path, "ab")
         try:
             h.proc = subprocess.Popen(
@@ -310,6 +327,7 @@ class PoolSupervisor:
                 # the source)
                 self._event("ready", h.worker_id,
                             generation=h.generation,
+                            spawn_kind=h.spawn_kind,
                             fresh_compiles=report.get("fresh_compiles"),
                             wall_s=round(h.t_ready_s - h.t_spawned_s, 3),
                             walls=report.get("walls"))
@@ -415,6 +433,16 @@ class PoolSupervisor:
                     uptime_s=round(uptime, 3), young=young,
                     consecutive=h.restarts)
         self._gauge_ready()
+        # the elastic tier's seam: a hook that promotes a hot spare into
+        # the slot returns True and the re-warm machinery below never
+        # runs — the kill cost one routes publish, not a warm window
+        for hook in list(self.death_hooks):
+            try:
+                if hook(h, now):
+                    return
+            except Exception as e:  # a broken hook must not kill the monitor
+                self._event("death_hook_error", h.worker_id,
+                            error=f"{type(e).__name__}: {e}"[:200])
         if h.restarts > self.config.max_restarts:
             h.state = "failed"
             h.reason = (f"crash loop: {h.restarts - 1} consecutive young "
@@ -433,6 +461,7 @@ class PoolSupervisor:
 
     def _restart(self, h: WorkerHandle) -> None:
         h.generation += 1
+        h.spawn_kind = "respawn"
         if self.config.transport == "tcp":
             # the crash may have BEEN a lost port race (or the port got
             # claimed while the slot was down): a replacement probes a
@@ -467,6 +496,7 @@ class PoolSupervisor:
                 # the slot's slice, not a fresh assignment: a rolled
                 # worker re-pins exactly its predecessor's devices
                 device_slice=old.device_slice,
+                spawn_kind="roll",
                 generation=old.generation + 1)
             self._event("roll_start", old.worker_id,
                         from_generation=old.generation,
@@ -532,6 +562,11 @@ class PoolSupervisor:
 
     def stop(self) -> None:
         """Drain-stop the fleet and the monitor (idempotent)."""
+        fleet = self.fleet
+        if fleet is not None:
+            # the elastic tier first: no promotion/backfill/scaling may
+            # race the drain (controller stop is idempotent)
+            fleet.stop()
         self._stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout=5.0)

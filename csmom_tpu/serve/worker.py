@@ -225,6 +225,17 @@ class WorkerServer:
             return self._stats(), None
         if op == "score":
             return self._score(obj, arrays)
+        if op == "tune_quota":
+            # the fleet autoscaler's quota seam (serve/fleet.py): retune
+            # a class's admission bucket within the declared policy shape
+            applied = self.service.queue.retune_quota(
+                str(obj.get("slo_class", "")),
+                float(obj.get("quota_rps") or 0.0),
+                (float(obj["quota_burst"])
+                 if obj.get("quota_burst") else None))
+            return {"state": "ok" if applied else "rejected",
+                    "ok": applied, "worker_id": self.worker_id,
+                    "applied": applied}, None
         if op in ("drain", "stop"):
             self._draining = True
             self.service.stop(drain=True)

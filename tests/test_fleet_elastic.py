@@ -1,0 +1,509 @@
+"""The elastic fleet tier (ISSUE 20): hot spares, promotion, the
+demand-driven autoscaler, and the ``fleet.elastic`` evidence block.
+
+The contracts pinned here:
+
+- **promotion is O(swap), not O(re-warm)**: a parked, demonstrated-ready
+  spare fills a SIGKILLed slot in well under the re-warm wall, the
+  victim's slot keeps its own id through the swap, and a routes publish
+  in flight never wedges the promotion;
+- **spares never enter the serving books**: a spare that dies parked
+  opens no kill window and lands no lifecycle sample — the backfill
+  refills the pool off the hot path;
+- **double-kill honesty**: with one spare, the second victim re-warms
+  the slow way and the books SAY so (``spawn_kind="respawn"`` plus a
+  ``spare_promotion_missed`` event) — no pretending two spares existed;
+- the **capacity account** credits spare reserve intervals, so a kill
+  window covered by a parked spare reads as ~zero capacity loss (and
+  loss never reads negative);
+- the **autoscaler policy** is pure and clock-passed-in: hysteresis
+  band, sustain, cooldown, floor/ceiling — every decision reasoned;
+- quota auto-tune retunes the live admission bucket in place, bounded
+  by the declared floor/ceiling;
+- the ``fleet.elastic`` schema refuses doctored evidence, and the
+  ledger ingests the per-spawn-kind ready-wall rows.
+"""
+
+import json
+import signal
+import threading
+import time
+
+import pytest
+
+from csmom_tpu.chaos import invariants as inv
+from csmom_tpu.obs import fleet as obs_fleet
+from csmom_tpu.obs import metrics
+from csmom_tpu.obs import spans as obs_spans
+from csmom_tpu.serve.fleet import AutoscalerPolicy, FleetConfig, FleetController
+from csmom_tpu.serve.queue import AdmissionQueue
+from csmom_tpu.serve.supervisor import PoolConfig, PoolSupervisor
+from csmom_tpu.utils.deadline import mono_now_s
+
+
+@pytest.fixture(autouse=True)
+def _clean_observatory():
+    obs_fleet.disarm("test setup")
+    metrics.reset()
+    yield
+    obs_fleet.disarm("test teardown")
+    obs_spans.disarm()
+    metrics.reset()
+
+
+_SMOKE_POOL = dict(profile="serve-smoke", engine="stub",
+                   ready_timeout_s=30.0, poll_interval_s=0.05,
+                   backoff_base_s=0.05, backoff_cap_s=0.3)
+
+
+def _poll(pred, timeout_s=10.0):
+    give_up = time.monotonic() + timeout_s
+    while time.monotonic() < give_up:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def _events(sup, name):
+    return [e for e in sup.summary()["events"] if e["event"] == name]
+
+
+# -------------------------------------------------- autoscaler policy ----
+
+def _policy(**over):
+    kw = dict(high_rps_per_worker=10.0, low_rps_per_worker=2.0,
+              sustain_s=1.0, cooldown_s=5.0, min_workers=1, max_workers=4)
+    kw.update(over)
+    return AutoscalerPolicy(**kw)
+
+
+def test_policy_holds_inside_the_hysteresis_band():
+    p = _policy()
+    d = p.decide(0.0, 5.0, 1)
+    assert d["action"] == "hold" and "band" in d["reason"]
+    assert d["offered_rps"] == 5.0 and d["n_ready"] == 1
+
+
+def test_policy_scale_up_requires_sustain_then_cools_down():
+    p = _policy()
+    assert p.decide(0.0, 50.0, 1)["action"] == "hold", "breach must sustain"
+    d = p.decide(1.2, 50.0, 1)
+    assert d["action"] == "scale_up" and "sustained" in d["reason"]
+    d = p.decide(1.3, 50.0, 2)
+    assert d["action"] == "hold" and "cooldown" in d["reason"], (
+        "an action's dead time must absorb the follow-on breach — no "
+        "thrash on a single burst")
+
+
+def test_policy_scale_up_stops_at_the_declared_ceiling():
+    p = _policy(cooldown_s=0.1)
+    p.decide(0.0, 100.0, 4)
+    d = p.decide(1.5, 100.0, 4)
+    assert d["action"] == "hold" and "ceiling" in d["reason"], (
+        "max_workers is a hard bound, not advice")
+
+
+def test_policy_scale_down_requires_sustain_and_respects_floor():
+    p = _policy()
+    assert p.decide(0.0, 1.0, 2)["action"] == "hold"
+    assert p.decide(1.5, 1.0, 2)["action"] == "scale_down"
+    p2 = _policy()
+    p2.decide(0.0, 1.0, 1)
+    d = p2.decide(1.5, 1.0, 1)
+    assert d["action"] == "hold" and "floor" in d["reason"]
+
+
+def test_policy_band_dip_resets_the_sustain_timer():
+    p = _policy()
+    p.decide(0.0, 50.0, 1)          # above, sustaining
+    p.decide(0.5, 5.0, 1)           # back in band: timer resets
+    d = p.decide(1.2, 50.0, 1)
+    assert d["action"] == "hold", (
+        "a breach interrupted by an in-band tick must re-sustain from "
+        "scratch — hysteresis exists to ignore blips")
+
+
+def test_policy_refuses_an_inverted_band():
+    with pytest.raises(ValueError, match="inverted"):
+        _policy(low_rps_per_worker=20.0)
+
+
+def test_policy_every_decision_is_reasoned():
+    p = _policy(cooldown_s=0.5)
+    t, seen = 0.0, []
+    for rps in (0.0, 0.0, 50.0, 50.0, 50.0, 5.0, 0.5, 0.5, 0.5):
+        d = p.decide(t, rps, 2)
+        seen.append(d)
+        t += 0.7
+    for d in seen:
+        assert d["action"] in ("scale_up", "scale_down", "hold")
+        assert str(d["reason"]).strip(), d
+
+
+# ------------------------------------------- capacity: spare reserve ----
+
+def _ev(event, wid, t, **kw):
+    return dict({"event": event, "worker_id": wid, "t_s": t}, **kw)
+
+
+def test_spare_reserve_covers_the_kill_window():
+    events = [
+        _ev("ready", "w0", 0.0), _ev("ready", "w1", 0.0),
+        _ev("ready", "w2", 0.0),
+        _ev("spare_ready", "s0", 0.5),
+        _ev("chaos_kill", "w1", 2.0),
+        _ev("spare_promoted", "s0", 2.1),
+        _ev("ready", "w1", 2.1, spawn_kind="spare-promotion"),
+    ]
+    cap = obs_fleet.capacity_account(events, 3, (0.0, 10.0))
+    kw = cap["kill_windows"][0]
+    assert kw["worker_id"] == "w1" and not kw["open_ended"]
+    assert kw["loss_frac"] == pytest.approx(0.0), (
+        "a kill window covered by a parked-ready spare is no capacity "
+        "hole — the reserve credit is the whole point of the tier")
+    assert cap["kill_window_loss_frac"] == pytest.approx(0.0)
+    assert cap["spare_reserve_worker_s"] == pytest.approx(1.6), \
+        "spare_ready 0.5 → spare_promoted 2.1"
+    # the same kill WITHOUT the spare reads as the full hole
+    bare = [e for e in events if not e["event"].startswith("spare")]
+    cap2 = obs_fleet.capacity_account(bare, 3, (0.0, 10.0))
+    assert cap2["kill_window_loss_frac"] == pytest.approx(1 / 3, abs=1e-3)
+
+
+def test_spare_death_opens_no_kill_window():
+    events = [
+        _ev("ready", "w0", 0.0),
+        _ev("spare_ready", "s0", 0.5),
+        _ev("spare_death", "s0", 3.0),
+    ]
+    cap = obs_fleet.capacity_account(events, 1, (0.0, 10.0))
+    assert cap["kill_windows"] == [], (
+        "a parked spare dying costs no serving capacity — it was never "
+        "routed")
+    assert cap["spare_reserve_worker_s"] == pytest.approx(2.5)
+
+
+def test_loss_fractions_never_read_negative():
+    # spare reserve overlapping steady state pushes available past
+    # nominal; the account must clamp, not report capacity conjured
+    events = [
+        _ev("ready", "w0", 0.0),
+        _ev("spare_ready", "s0", 0.0),
+        _ev("chaos_kill", "w0", 4.0),
+        _ev("ready", "w0", 4.2),
+    ]
+    cap = obs_fleet.capacity_account(events, 1, (0.0, 10.0))
+    assert cap["kill_window_loss_frac"] >= 0.0
+    assert cap["steady_state_loss_frac"] >= 0.0
+    for kw in cap["kill_windows"]:
+        assert kw["loss_frac"] >= 0.0
+
+
+# --------------------------------------------------- demand rate input ----
+
+def test_demand_recent_rps_reads_the_open_window(tmp_path):
+    agg = obs_fleet.arm("unit-elastic", cadence_s=60.0,
+                        scratch_dir=str(tmp_path))
+    try:
+        assert agg.demand_recent_rps(2.0) == 0.0, (
+            "before the window opens the control input must read 0, "
+            "not poison the policy with stale buckets")
+        obs_fleet.open_demand_window()
+        for _ in range(6):
+            obs_fleet.demand("offered", "interactive")
+        for _ in range(3):
+            obs_fleet.demand("offered", "bulk")
+        assert agg.demand_recent_rps(2.0) > 0.0
+        assert agg.demand_recent_rps(2.0, slo_class="bulk") > 0.0
+        assert agg.demand_recent_rps(2.0, slo_class="bulk") < \
+            agg.demand_recent_rps(2.0), "class filter narrows the sum"
+        assert agg.demand_recent_rps(2.0, slo_class="nope") == 0.0
+    finally:
+        obs_fleet.disarm("unit over")
+
+
+# ---------------------------------------------------- quota auto-tune ----
+
+def test_retune_quota_retunes_the_live_bucket_in_place():
+    q = AdmissionQueue(capacity=8)
+    assert q.retune_quota("bulk", 32.0)
+    b = q._buckets["bulk"]
+    assert b.rate == 32.0 and b.burst == pytest.approx(48.0), \
+        "burst defaults to 1.5x the retuned rate"
+    assert q.retune_quota("bulk", 40.0, quota_burst=50.0)
+    assert q._buckets["bulk"].burst == 50.0
+    assert q.retune_quota("batch", 20.0), "the r10 alias resolves"
+    assert q._buckets["bulk"].rate == 20.0
+
+
+def test_retune_quota_refuses_unquotad_classes_and_bad_rates():
+    q = AdmissionQueue(capacity=8)
+    assert not q.retune_quota("interactive", 10.0), (
+        "granting an unquota'd class a quota at runtime would change "
+        "admission semantics, not tune them")
+    assert not q.retune_quota("bulk", 0.0)
+    assert not q.retune_quota("bulk", -5.0)
+
+
+# ------------------------------------------- live pool: promotion seam ----
+
+class _InFlightPublisher:
+    """A routes publisher whose publish may be IN FLIGHT when the
+    promotion lands — the promotion must queue behind it, not wedge."""
+
+    def __init__(self):
+        self.calls = 0
+        self.lock = threading.Lock()
+
+    def publish_once(self):
+        with self.lock:
+            self.calls += 1
+
+
+def test_promotion_fills_the_slot_with_a_publish_in_flight(tmp_path):
+    cfg = PoolConfig(n_workers=1, **_SMOKE_POOL)
+    sup = PoolSupervisor(cfg, str(tmp_path)).start()
+    pub = _InFlightPublisher()
+    fleet = None
+    try:
+        fleet = FleetController(
+            sup, FleetConfig(spares=1, min_workers=1, max_workers=3),
+            publisher=pub).start()
+        assert len(fleet.spares) == 1, "start() waits for the spare"
+        spare_id = fleet.spares[0].worker_id
+        old_pid = sup.handles[0].proc.pid
+        with pub.lock:  # a publish is in flight while the kill lands
+            assert sup.kill_worker("w0", signal.SIGKILL)
+            assert _poll(lambda: fleet.counts["promoted"] == 1)
+        h = sup.handles[0]
+        assert h.worker_id == "w0", "the slot keeps its own id"
+        assert h.spawn_kind == "spare-promotion"
+        assert h.generation == 1
+        assert h.state == "ready"
+        assert h.proc.pid != old_pid, "the spare's PROCESS fills the slot"
+        assert _poll(lambda: pub.calls >= 1), (
+            "promotion must publish routes once the in-flight publish "
+            "releases — queued behind it, never skipped")
+        (p,) = fleet.promotions
+        assert p["victim"] == "w0" and p["spare"] == spare_id
+        assert p["wall_s"] <= 1.5, (
+            f"promotion wall {p['wall_s']}s — a parked-ready swap must "
+            "be O(publish), nowhere near a re-warm")
+        ready = _events(sup, "ready")
+        assert ready[-1]["spawn_kind"] == "spare-promotion"
+        assert ready[-1]["worker_id"] == "w0"
+        # backfill refills the pool off the hot path
+        assert _poll(lambda: any(s.state == "ready" for s in fleet.spares))
+        assert fleet.counts["backfills"] >= 1
+    finally:
+        if fleet is not None:
+            fleet.stop()
+        sup.stop()
+
+
+def test_double_kill_with_one_spare_rewarns_the_second_honestly(tmp_path):
+    cfg = PoolConfig(n_workers=2, **_SMOKE_POOL)
+    sup = PoolSupervisor(cfg, str(tmp_path)).start()
+    fleet = None
+    try:
+        fleet = FleetController(
+            sup, FleetConfig(spares=1, min_workers=2, max_workers=4)).start()
+        assert sup.kill_worker("w0", signal.SIGKILL)
+        assert sup.kill_worker("w1", signal.SIGKILL)
+        assert _poll(lambda: all(h.generation >= 1 and h.state == "ready"
+                                 for h in sup.handles), timeout_s=20.0)
+        kinds = sorted(h.spawn_kind for h in sup.handles)
+        # one slot promoted; the other re-warmed the slow way (unless
+        # the backfilled second spare landed first, which is also legal
+        # — but the books must SAY which happened)
+        assert fleet.counts["promoted"] >= 1
+        if "respawn" in kinds:
+            assert _events(sup, "spare_promotion_missed"), (
+                "a victim re-warmed because no spare was parked — the "
+                "miss must be a booked event, not silence")
+        ready = _events(sup, "ready")
+        assert all(e.get("spawn_kind") in ("cold", "respawn",
+                                           "spare-promotion")
+                   for e in ready)
+    finally:
+        if fleet is not None:
+            fleet.stop()
+        sup.stop()
+
+
+def test_spare_dying_parked_backfills_and_never_enters_the_books(tmp_path):
+    cfg = PoolConfig(n_workers=1, **_SMOKE_POOL)
+    sup = PoolSupervisor(cfg, str(tmp_path)).start()
+    fleet = None
+    try:
+        fleet = FleetController(
+            sup, FleetConfig(spares=1, min_workers=1, max_workers=3)).start()
+        s0 = fleet.spares[0]
+        s0.proc.kill()
+        assert _poll(lambda: fleet.counts["died_parked"] >= 1)
+        deaths = _events(sup, "spare_death")
+        assert deaths and deaths[-1]["phase"] == "parked"
+        # the backfill restores the reserve without touching the pool
+        assert _poll(lambda: any(s.state == "ready" for s in fleet.spares),
+                     timeout_s=20.0)
+        assert sup.handles[0].generation == 0, (
+            "a parked spare's death must not disturb the serving slot")
+        spare_ids = set(fleet._all_spare_ids)
+        walls = obs_fleet.lifecycle_walls(sup.summary()["events"])
+        assert not spare_ids & {w["worker_id"] for w in walls}, (
+            "spares must never land lifecycle samples")
+        cap = obs_fleet.capacity_account(
+            obs_fleet.absolute_events(sup.summary()["events"],
+                                      sup.t0_mono_s),
+            1, (sup.t0_mono_s, mono_now_s()))
+        assert not [kw for kw in cap["kill_windows"]
+                    if kw["worker_id"] in spare_ids], (
+            "a spare death digs no capacity hole")
+    finally:
+        if fleet is not None:
+            fleet.stop()
+        sup.stop()
+
+
+# ------------------------------------- elastic block schema + doctored ----
+
+def _mini_elastic_artifact(tmp_path, run_id="r97"):
+    """A REAL loopback capture with a consistent elastic block and a
+    promotion-regime lifecycle sample."""
+    agg = obs_fleet.arm(run_id, cadence_s=0.05, scratch_dir=str(tmp_path))
+    obs_fleet.open_demand_window()
+    t0 = mono_now_s()
+    metrics.counter("unit.work").inc(2)
+    for _ in range(5):
+        obs_fleet.demand("offered", "interactive")
+        obs_fleet.demand("admitted", "interactive")
+    for _ in range(4):
+        obs_fleet.demand("served", "interactive")
+    assert _poll(lambda: any(b["samples"] >= 2 for b in
+                             agg.snapshot()["processes"].values()))
+    obs_fleet.disarm_emitter("drained for the unit")
+    agg.close_all("run-end")
+    events = [
+        dict(_ev("ready", "w0", t0 - 0.5), generation=0, wall_s=6.5,
+             spawn_kind="cold", walls={}),
+        _ev("spare_ready", "s0", t0 - 0.4),
+        _ev("chaos_kill", "w0", t0 + 0.01),
+        _ev("spare_promoted", "s0", t0 + 0.02),
+        dict(_ev("ready", "w0", t0 + 0.02), generation=1, wall_s=0.01,
+             spawn_kind="spare-promotion", walls={}),
+    ]
+    elastic = {
+        "armed": True, "spares_configured": 1, "prefork": False,
+        "autoscale": True, "spare_ids": ["s0", "s1"],
+        "spares": {"spawned": 2, "ready": 2, "promoted": 1,
+                   "backfills": 1, "died_parked": 0},
+        "promotions": [{"victim": "w0", "spare": "s0", "generation": 1,
+                        "t_kill_s": 0.01, "t_ready_s": 0.02,
+                        "wall_s": 0.01}],
+        "promotions_missed": 0,
+        "decisions": [{"t_s": 0.1, "action": "hold",
+                       "reason": "2.0 rps/worker inside hysteresis band "
+                                 "[5, 200]", "offered_rps": 2.0,
+                       "n_ready": 1}],
+        "quota": {"slo_class": "bulk", "floor_rps": 8.0,
+                  "ceiling_rps": 64.0,
+                  "applied": [{"t_s": 0.2, "slo_class": "bulk",
+                               "quota_rps": 12.0,
+                               "applied_to": ["w0"]}]},
+        "bounds": {"min_workers": 1, "max_workers": 3},
+    }
+    art = obs_fleet.build_artifact(
+        agg, run_id,
+        requests={"admitted": 5, "served": 4, "rejected": 1, "expired": 0},
+        worker_events=events, n_workers=1, window=(t0, t0 + 0.2),
+        fresh_compiles=0, platform="stub", workload="unit loopback",
+        elastic=elastic)
+    obs_fleet.disarm("unit over")
+    return art
+
+
+def test_elastic_block_validates_and_splits_walls_by_kind(tmp_path):
+    art = _mini_elastic_artifact(tmp_path)
+    assert inv.validate(art, "fleet") == []
+    samples = art["extra"]["samples"]
+    assert samples["fleet_worker_ready_wall_cold_s"] == [6.5]
+    assert samples["fleet_worker_ready_wall_promotion_s"] == [0.01], (
+        "promotion-regime walls gate against their own kind, never "
+        "averaged into the cold-spawn distribution")
+
+
+def test_elastic_schema_refuses_doctored_evidence(tmp_path):
+    art = _mini_elastic_artifact(tmp_path)
+
+    def doctored(mutate):
+        obj = json.loads(json.dumps(art))
+        mutate(obj)
+        return inv.validate(obj, "fleet")
+
+    def _time_travel(o):
+        o["elastic"]["promotions"][0]["t_ready_s"] = -5.0
+    assert any("before the kill" in v for v in doctored(_time_travel))
+
+    def _spare_in_lifecycle(o):
+        o["lifecycle"]["events"].append(
+            {"worker_id": "s0", "generation": 0, "kind": "cold",
+             "wall_s": 0.5, "walls": {}})
+    assert any("held out of the serving lifecycle" in v
+               for v in doctored(_spare_in_lifecycle))
+
+    def _spare_kill_window(o):
+        o["capacity"]["kill_windows"].append(
+            {"worker_id": "s0", "t_kill_s": 0.1, "t_ready_s": 0.2,
+             "open_ended": False, "width_s": 0.1, "loss_frac": 1.0})
+    assert any("digs no capacity hole" in v
+               for v in doctored(_spare_kill_window))
+
+    def _double_promotion(o):
+        p = dict(o["elastic"]["promotions"][0])
+        p["generation"] = 2
+        o["elastic"]["promotions"].append(p)
+        o["elastic"]["spares"]["promoted"] = 2
+    assert any("promoted twice" in v for v in doctored(_double_promotion))
+
+    def _counter_mismatch(o):
+        o["elastic"]["spares"]["promoted"] = 3
+    assert any("promotion records" in v
+               for v in doctored(_counter_mismatch))
+
+    def _unreasoned(o):
+        o["elastic"]["decisions"][0]["reason"] = "  "
+    assert any("reasoned event" in v for v in doctored(_unreasoned))
+
+    def _bad_action(o):
+        o["elastic"]["decisions"][0]["action"] = "yolo"
+    assert any("unknown" in v for v in doctored(_bad_action))
+
+    def _quota_breach(o):
+        o["elastic"]["quota"]["applied"][0]["quota_rps"] = 9999.0
+    assert any("declared bounds" in v for v in doctored(_quota_breach))
+
+    def _undeclared_spare(o):
+        o["elastic"]["promotions"][0]["spare"] = "sX"
+    assert any("not a declared spare" in v
+               for v in doctored(_undeclared_spare))
+
+
+# ------------------------------------------------------ ledger per-kind ----
+
+def test_ledger_ingests_per_kind_ready_wall_rows(tmp_path):
+    art = _mini_elastic_artifact(tmp_path)
+    with open(tmp_path / "FLEET_r97.json", "w") as f:
+        json.dump(art, f)
+    from csmom_tpu.obs import ledger as ld
+
+    L = ld.load(str(tmp_path))
+    rows = {r.metric: r for r in L.rows}
+    agg = rows["fleet_worker_ready_wall_s"]
+    assert agg.value == pytest.approx(6.5), "aggregate keeps the max"
+    promo = rows["fleet_worker_ready_wall_promotion_s"]
+    assert promo.direction == "lower"
+    assert promo.value == pytest.approx(0.01)
+    assert list(promo.samples) == [0.01]
+    cold = rows["fleet_worker_ready_wall_cold_s"]
+    assert cold.value == pytest.approx(6.5)

@@ -681,3 +681,56 @@ def test_repo_roadmap_tables_are_generated_and_current():
     assert splice_roadmap(text, tables) == text, (
         "ROADMAP.md trajectory tables are stale or hand-edited — run "
         "`csmom ledger roadmap --write`")
+
+
+def test_observatory_armed_is_a_footnote_not_a_flag(tmp_path):
+    """The r20 steady-path cost, pinned (ISSUE 20): a fabric capture
+    taken with the fleet observatory armed notes its latency rows with
+    ``observatory-armed`` — the rows still gate (armed is the steady
+    state from r20 on; a real latency regression must still fail the
+    PR), they share their comparability key with disarmed history (the
+    verdict printer surfaces the asymmetry), and the throughput row is
+    untouched."""
+    with open(os.path.join(_REPO, "SERVE_FABRIC_r20.json")) as f:
+        base = json.load(f)
+    armed = json.loads(json.dumps(base))
+    armed["run_id"] = "r91"
+    armed["extra"]["observatory_armed"] = True
+    disarmed = json.loads(json.dumps(base))
+    disarmed["run_id"] = "r90"
+    disarmed["extra"]["observatory_armed"] = False
+    _write(tmp_path, "SERVE_FABRIC_r90.json", disarmed)
+    _write(tmp_path, "SERVE_FABRIC_r91.json", armed)
+    L = ld.load(str(tmp_path))
+    lat = {r.run: r for r in L.rows if r.metric == "serve_fabric_p50_ms"}
+    assert lat["r91"].notes == ("observatory-armed",)
+    assert lat["r90"].notes == ()
+    # a footnote, not a flag: gating and pairing are unaffected
+    assert lat["r91"].gate_eligible() and lat["r90"].gate_eligible()
+    assert lat["r91"].key() == lat["r90"].key()
+    assert lat["r91"].flags == ()
+    thr = [r for r in L.rows
+           if r.metric == "serve_fabric_throughput_rps" and r.run == "r91"]
+    assert thr and thr[0].notes == ()
+
+
+def test_verdict_printer_surfaces_note_asymmetry(capsys):
+    """A note on only one side of a diff means the two captures ran
+    under different provenance — the printed verdict must say the delta
+    includes the documented cost."""
+    from csmom_tpu.cli.ledger import _print_verdict
+
+    def row(run, num, notes):
+        return ld.Row(run=run, run_num=num, metric="serve_fabric_p50_ms",
+                      value=30.0 if num == 1 else 45.0, unit="ms",
+                      direction="lower", platform="cpu",
+                      device_kind="cpu", workload="w",
+                      source=f"S_{run}.json", notes=notes)
+
+    ref, cand = row("r01", 1, ()), row("r02", 2, ("observatory-armed",))
+    v = regress.compare_points(cand.value, ref.value, direction="lower",
+                               suspect_rel=0.05, reason="test")
+    _print_verdict(cand, ref, v)
+    out = capsys.readouterr().out
+    assert "note[observatory-armed]: r02 only" in out
+    assert "documented cost" in out

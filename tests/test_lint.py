@@ -624,9 +624,10 @@ def test_committed_tree_lock_audit_is_pinned():
     nest (no order edge touches Router._lock — ``_terminate`` and
     ``_conclude_attempt`` are called sequentially, never one inside the
     other), (b) the supervisor's restart path spawns/probes OUTSIDE its
-    event lock, and (c) the only cross-lock acquisition order in the
-    serve tier is AdmissionQueue._lock -> obs.metrics._LOCK (the
-    counter increments inside admission), which is one-directional.  A
+    event lock, and (c) the serve tier's cross-lock acquisition orders
+    are exactly AdmissionQueue._lock -> obs.metrics._LOCK (the counter
+    increments inside admission) and the r21 elastic tier's backfill
+    lock over its probe-path leaves — each one-directional.  A
     new edge here is not automatically a bug — but it IS a new global
     ordering constraint, and this test makes adding one a deliberate
     act."""
@@ -655,8 +656,23 @@ def test_committed_tree_lock_audit_is_pinned():
                     for h in s.held:
                         if h != lock:
                             edges.add((h, lock))
+    # r21 added the elastic tier's backfill lock: it exists to
+    # serialize slow spare spawns (Popen/fork + readiness probes), so
+    # the probe's chaos-checkpoint and partition-table consults and the
+    # controller's own state lock are reached UNDER it — each a leaf
+    # (acquires nothing further) and every edge one-directional: no
+    # path holds _STATE_LOCK/_PARTITION_LOCK/FleetController._lock and
+    # then takes _backfill_lock (the closure proves exactly that)
+    backfill = "csmom_tpu.serve.fleet.FleetController._backfill_lock"
     assert edges == {("csmom_tpu.serve.queue.AdmissionQueue._lock",
-                      "csmom_tpu.obs.metrics._LOCK")}, sorted(edges)
+                      "csmom_tpu.obs.metrics._LOCK"),
+                     (backfill, "csmom_tpu.chaos.inject._STATE_LOCK"),
+                     (backfill, "csmom_tpu.serve.fleet."
+                                "FleetController._lock"),
+                     (backfill, "csmom_tpu.serve.proto._PARTITION_LOCK"),
+                     }, sorted(edges)
+    assert not any(e[1] == backfill for e in edges), (
+        "a reverse edge INTO the backfill lock would complete a cycle")
     router_lock = "csmom_tpu.serve.router.Router._lock"
     assert not any(router_lock in e for e in edges)
     # the supervisor restart path: _restart/_spawn (Popen, file opens)
